@@ -1,0 +1,72 @@
+"""Benchmark: Higgs-like binary GBDT training throughput on the real chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor (BASELINE.md, LOW CONFIDENCE until the reference mount is
+populated): reference CPU training of Higgs 10.5M x 28 runs 500 boosting
+iterations in ~240 s => ~2.08 iters/sec on a dual-Xeon of the docs era.
+vs_baseline = our_iters_per_sec / 2.08 on a synthetic dataset with the same
+feature count and bin width (1M rows here to keep bench wall-clock sane; the
+hist kernel cost is linear in rows, so iters/sec at 10.5M rows ~ value/10.5).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    n = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    f = 28
+    iters = int(os.environ.get("BENCH_ITERS", 30))
+
+    import jax
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f) / np.sqrt(f)
+    y = ((X @ w + 0.3 * rng.randn(n)) > 0).astype(np.float64)
+
+    params = {
+        "objective": "binary",
+        "num_leaves": 31,
+        "max_bin": 255,
+        "learning_rate": 0.1,
+        "verbosity": -1,
+        "min_data_in_leaf": 20,
+    }
+    train = lgb.Dataset(X, label=y)
+    # warmup: construct + compile (first tree triggers all jit compiles)
+    bst = lgb.Booster(params=params, train_set=train)
+    bst.update()
+    jax.block_until_ready(bst._gbdt._score)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        bst.update()
+    jax.block_until_ready(bst._gbdt._score)
+    dt = time.perf_counter() - t0
+    ips = iters / dt
+
+    baseline_ips = 500.0 / 240.0  # reference CPU Higgs anchor (BASELINE.md)
+    # scale our 1M-row rate to the baseline's 10.5M rows (linear in rows)
+    ips_at_higgs_scale = ips * (n / 10_500_000.0)
+    print(
+        json.dumps(
+            {
+                "metric": f"boosting_iters_per_sec_binary_{n//1000}k_rows_x{f}f_255bins",
+                "value": round(ips, 3),
+                "unit": "iters/sec",
+                "vs_baseline": round(ips_at_higgs_scale / baseline_ips, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""lightgbm_tpu: a TPU-native gradient-boosting framework.
+
+A from-scratch re-design of the LightGBM capability surface
+(reference: xiangyu/LightGBM, fork of microsoft/LightGBM) for TPU hardware:
+histogram construction, split search, partitioning and prediction are
+JAX/XLA/Pallas programs; distributed training is SPMD over a
+jax.sharding.Mesh with XLA collectives instead of the reference's
+socket/MPI Network layer.
+
+Public API mirrors python-package/lightgbm/__init__.py.
+"""
+
+from .basic import Booster, Dataset, LightGBMError
+from .callback import EarlyStopException, early_stopping, log_evaluation, record_evaluation, reset_parameter
+from .engine import CVBooster, cv, train
+from .utils.log import register_logger
+
+__all__ = [
+    "Dataset",
+    "Booster",
+    "CVBooster",
+    "LightGBMError",
+    "register_logger",
+    "train",
+    "cv",
+    "early_stopping",
+    "log_evaluation",
+    "record_evaluation",
+    "reset_parameter",
+    "EarlyStopException",
+]
+
+__version__ = "0.1.0"
+
+try:  # sklearn wrappers are optional at import time (mirrors compat.py)
+    from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor  # noqa: F401
+
+    __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+except ImportError:  # pragma: no cover
+    pass

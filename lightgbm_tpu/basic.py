@@ -1,0 +1,525 @@
+"""User-facing Dataset and Booster.
+
+Reference: python-package/lightgbm/basic.py — class Dataset (lazy
+construction, reference= bin alignment, set_field/get_field, free_raw_data)
+and class Booster (update, rollback_one_iter, eval, predict, save_model,
+model_from_string, feature_importance...).
+
+Unlike the reference there is no ctypes boundary: the "C API layer" of the
+reference (src/c_api.cpp) collapses into direct Python calls; the hot arrays
+live on the TPU as jax arrays owned by the model objects.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .binning import DatasetBinner
+from .config import Config
+from .models.gbdt import GBDT, create_boosting
+from .models.tree import Tree
+from .ops import predict as predict_ops
+
+
+class LightGBMError(Exception):
+    """reference: LightGBMError in python-package/lightgbm/basic.py."""
+
+
+def _to_2d_float(data) -> np.ndarray:
+    if hasattr(data, "values"):  # pandas
+        data = data.values
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr
+
+
+def _feature_names_of(data, num_features: int) -> List[str]:
+    if hasattr(data, "columns"):
+        return [str(c) for c in data.columns]
+    return [f"Column_{i}" for i in range(num_features)]
+
+
+class Dataset:
+    """reference: class Dataset in python-package/lightgbm/basic.py.
+
+    Lazily constructed: raw data is held until `construct()` (which the
+    training entry calls), then binned via binning.DatasetBinner and shipped
+    to the device as a compact int matrix.
+    """
+
+    def __init__(
+        self,
+        data,
+        label=None,
+        reference: Optional["Dataset"] = None,
+        weight=None,
+        group=None,
+        init_score=None,
+        feature_name: Union[str, List[str]] = "auto",
+        categorical_feature: Union[str, List[int]] = "auto",
+        params: Optional[Dict[str, Any]] = None,
+        free_raw_data: bool = True,
+    ):
+        self.data = data
+        self.label = None if label is None else np.asarray(label, dtype=np.float64).ravel()
+        self.reference = reference
+        self.weight = None if weight is None else np.asarray(weight, dtype=np.float64).ravel()
+        self.group = None if group is None else np.asarray(group, dtype=np.int64).ravel()
+        self.init_score = None if init_score is None else np.asarray(init_score, dtype=np.float64)
+        self.params = dict(params or {})
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.free_raw_data = free_raw_data
+        self._constructed = False
+        self.binner: Optional[DatasetBinner] = None
+        self.bins: Optional[np.ndarray] = None
+        self.feature_names: List[str] = []
+        self.position = None  # rank position info (reference: Metadata positions_)
+        self._used_indices = None
+
+    # -- construction ---------------------------------------------------
+    def construct(self, reference: Optional["Dataset"] = None) -> "Dataset":
+        if self._constructed:
+            return self
+        ref = reference if reference is not None else self.reference
+        cfg = Config.from_dict(self.params)
+        raw = _to_2d_float(self.data)
+        self.feature_names = (
+            list(self.feature_name)
+            if isinstance(self.feature_name, (list, tuple))
+            else _feature_names_of(self.data, raw.shape[1])
+        )
+        cats: Sequence[int] = ()
+        if isinstance(self.categorical_feature, (list, tuple)):
+            cats = [
+                self.feature_names.index(c) if isinstance(c, str) else int(c)
+                for c in self.categorical_feature
+            ]
+        if ref is not None:
+            ref.construct()
+            # bin alignment with the reference dataset (reference= semantics)
+            self.binner = ref.binner
+        else:
+            self.binner = DatasetBinner.fit(
+                raw,
+                max_bin=cfg.max_bin,
+                min_data_in_bin=cfg.min_data_in_bin,
+                sample_cnt=cfg.bin_construct_sample_cnt,
+                use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing,
+                categorical_features=cats,
+                max_bin_by_feature=cfg.max_bin_by_feature,
+                seed=cfg.data_random_seed,
+            )
+        self.bins = self.binner.transform(raw)
+        self.bins_device = jnp.asarray(self.bins)
+        self.num_bins_pf_device = jnp.asarray(self.binner.num_bins_per_feature)
+        self.missing_bin_pf_device = jnp.asarray(self.binner.missing_bin_per_feature)
+        self.max_num_bins = int(self.binner.max_num_bins)
+        self._num_data, self._num_feature = raw.shape
+        if self.free_raw_data:
+            self.data = None
+        self._constructed = True
+        return self
+
+    @property
+    def query_boundaries(self) -> Optional[np.ndarray]:
+        if self.group is None:
+            return None
+        return np.concatenate([[0], np.cumsum(self.group)]).astype(np.int64)
+
+    def num_data(self) -> int:
+        if self._constructed:
+            return self._num_data
+        return _to_2d_float(self.data).shape[0]
+
+    def num_feature(self) -> int:
+        if self._constructed:
+            return self._num_feature
+        return _to_2d_float(self.data).shape[1]
+
+    # -- field access (reference: Dataset.set_field/get_field) ----------
+    def set_field(self, field_name: str, data) -> "Dataset":
+        if field_name == "label":
+            self.label = None if data is None else np.asarray(data, np.float64).ravel()
+        elif field_name == "weight":
+            self.weight = None if data is None else np.asarray(data, np.float64).ravel()
+        elif field_name == "group" or field_name == "query":
+            self.group = None if data is None else np.asarray(data, np.int64).ravel()
+        elif field_name == "init_score":
+            self.init_score = None if data is None else np.asarray(data, np.float64)
+        elif field_name == "position":
+            self.position = None if data is None else np.asarray(data, np.int64).ravel()
+        else:
+            raise LightGBMError(f"Unknown field: {field_name}")
+        return self
+
+    def get_field(self, field_name: str):
+        return {
+            "label": self.label,
+            "weight": self.weight,
+            "group": self.group,
+            "query": self.group,
+            "init_score": self.init_score,
+            "position": self.position,
+        }.get(field_name)
+
+    set_label = lambda self, label: self.set_field("label", label)
+    set_weight = lambda self, weight: self.set_field("weight", weight)
+    set_group = lambda self, group: self.set_field("group", group)
+    set_init_score = lambda self, s: self.set_field("init_score", s)
+    get_label = lambda self: self.label
+    get_weight = lambda self: self.weight
+    get_group = lambda self: self.group
+    get_init_score = lambda self: self.init_score
+
+    def create_valid(self, data, label=None, weight=None, group=None, init_score=None,
+                     params=None) -> "Dataset":
+        """reference: Dataset.create_valid — valid set sharing this dataset's
+        bin mappers."""
+        return Dataset(
+            data, label=label, reference=self, weight=weight, group=group,
+            init_score=init_score, params=params or self.params,
+        )
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row subset sharing bin mappers (reference: Dataset.subset/CopySubrow)."""
+        self.construct()
+        idx = np.asarray(used_indices, dtype=np.int64)
+        sub = Dataset.__new__(Dataset)
+        sub.__dict__.update({k: v for k, v in self.__dict__.items()})
+        sub.bins = self.bins[idx]
+        sub.bins_device = jnp.asarray(sub.bins)
+        sub.label = None if self.label is None else self.label[idx]
+        sub.weight = None if self.weight is None else self.weight[idx]
+        sub.init_score = None if self.init_score is None else self.init_score[idx]
+        if self.group is not None:
+            # rebuild group sizes from the selected rows' query ids
+            # (reference: Metadata partitioning of query boundaries)
+            qid = np.repeat(np.arange(len(self.group)), self.group)[idx]
+            change = np.nonzero(np.diff(qid) != 0)[0] + 1
+            bounds = np.concatenate([[0], change, [len(qid)]])
+            sub.group = np.diff(bounds).astype(np.int64)
+        else:
+            sub.group = None
+        sub._num_data = len(idx)
+        sub._used_indices = idx
+        sub._constructed = True
+        return sub
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Binned dataset checkpoint (reference: Dataset::SaveBinaryFile).
+        Uses npz rather than the reference's custom byte format."""
+        self.construct()
+        np.savez_compressed(
+            filename,
+            bins=self.bins,
+            label=self.label if self.label is not None else np.zeros(0),
+            weight=self.weight if self.weight is not None else np.zeros(0),
+            group=self.group if self.group is not None else np.zeros(0, np.int64),
+            uppers=np.concatenate([m.upper_bounds for m in self.binner.mappers]),
+            upper_sizes=np.asarray([len(m.upper_bounds) for m in self.binner.mappers]),
+            missing_types=np.asarray([m.missing_type for m in self.binner.mappers]),
+            feature_names=np.asarray(self.feature_names),
+        )
+        return self
+
+    # -- tree traversal on binned data ----------------------------------
+    def predict_leaf_binned_tree(self, tree: Tree) -> jnp.ndarray:
+        """Leaf index per row for one tree on this dataset's binned matrix.
+        Pads node arrays to power-of-two buckets to bound jit recompiles."""
+        n = self.num_data()
+        m = tree.num_internal
+        if m == 0:
+            return jnp.zeros((n,), jnp.int32)
+        if tree.threshold_bin is None:
+            # tree came from a model string: recover bin-space thresholds from
+            # the real-valued ones (exact when thresholds are this binner's
+            # bin uppers; reference stores bin uppers as thresholds)
+            tb = np.zeros(m, np.int32)
+            for i in range(m):
+                f = int(tree.split_feature[i])
+                tb[i] = int(self.binner.mappers[f].transform(np.asarray([tree.threshold[i]]))[0])
+            tree.threshold_bin = tb
+        cap = 1
+        while cap < m:
+            cap *= 2
+
+        def pad(a, fill=0):
+            out = np.full(cap, fill, dtype=np.asarray(a).dtype)
+            out[:m] = a[:m]
+            return jnp.asarray(out[None])
+
+        leaf = predict_ops.predict_leaf_binned(
+            self.bins_device,
+            self.missing_bin_pf_device,
+            pad(tree.split_feature),
+            pad(tree.threshold_bin),
+            pad(tree.default_left()),
+            pad(tree.left_child, fill=-1),
+            pad(tree.right_child, fill=-1),
+            jnp.asarray([tree.num_leaves], jnp.int32),
+        )[0]
+        return leaf
+
+
+class Booster:
+    """reference: class Booster in python-package/lightgbm/basic.py."""
+
+    def __init__(
+        self,
+        params: Optional[Dict[str, Any]] = None,
+        train_set: Optional[Dataset] = None,
+        model_file: Optional[str] = None,
+        model_str: Optional[str] = None,
+    ):
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_set = train_set
+        if model_file is not None:
+            model_str = Path(model_file).read_text()
+        if model_str is not None:
+            self._gbdt = GBDT.load_model_from_string(model_str)
+            self.cfg = self._gbdt.cfg
+        elif train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be Dataset instance")
+            self.cfg = Config.from_dict(self.params)
+            merged = dict(train_set.params or {})
+            merged.update(self.params)
+            train_set.params = merged
+            self._gbdt = create_boosting(self.cfg, train_set)
+        else:
+            raise LightGBMError("need either params+train_set or a model")
+
+    # -- training -------------------------------------------------------
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; returns True if training should stop
+        (reference: Booster.update / LGBM_BoosterUpdateOneIter)."""
+        if train_set is not None and train_set is not self._train_set:
+            self._train_set = train_set
+            self._gbdt.reset_training_data(train_set)
+        if fobj is not None:
+            score = self._gbdt._score
+            grad, hess = fobj(np.asarray(score), self._gbdt.train_set)
+            return self.__boost(grad, hess)
+        return self._gbdt.train_one_iter()
+
+    def __boost(self, grad, hess) -> bool:
+        return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        self._gbdt.add_valid(data, name)
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.iter_
+
+    def num_trees(self) -> int:
+        return len(self._gbdt.models)
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        return len(self._gbdt.feature_names)
+
+    def feature_name(self) -> List[str]:
+        return list(self._gbdt.feature_names)
+
+    # -- eval -------------------------------------------------------------
+    def eval_train(self, feval=None):
+        return self._eval(0, "training", feval)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for i in range(len(self._gbdt.valid_sets)):
+            out.extend(self._eval(i + 1, self._gbdt.valid_names[i], feval))
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        for i, vs in enumerate(self._gbdt.valid_sets):
+            if vs is data:
+                return self._eval(i + 1, name, feval)
+        self.add_valid(data, name)
+        return self._eval(len(self._gbdt.valid_sets), name, feval)
+
+    def _eval(self, data_idx: int, name: str, feval=None):
+        res = [
+            (name, mname, val, hib)
+            for (_n, mname, val, hib) in self._gbdt.eval_at(data_idx)
+        ]
+        if feval is not None:
+            ds = self._gbdt.train_set if data_idx == 0 else self._gbdt.valid_sets[data_idx - 1]
+            score = self._gbdt._score if data_idx == 0 else self._gbdt._valid_scores[data_idx - 1]
+            for r in _call_feval(feval, np.asarray(score), ds):
+                res.append((name, r[0], r[1], r[2]))
+        return res
+
+    # -- prediction -------------------------------------------------------
+    def predict(
+        self,
+        data,
+        start_iteration: int = 0,
+        num_iteration: Optional[int] = None,
+        raw_score: bool = False,
+        pred_leaf: bool = False,
+        pred_contrib: bool = False,
+        **kwargs,
+    ) -> np.ndarray:
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        X = _to_2d_float(data)
+        n_feat = self.num_feature()
+        if n_feat and X.shape[1] != n_feat and not kwargs.get("predict_disable_shape_check", False):
+            # reference: LGBM_BoosterPredictForMat raises on feature-count
+            # mismatch unless predict_disable_shape_check is set
+            raise LightGBMError(
+                f"The number of features in data ({X.shape[1]}) is not the same "
+                f"as it was in training data ({n_feat}). You can set "
+                f"predict_disable_shape_check=true to discard this error."
+            )
+        return self._gbdt.predict(
+            X,
+            raw_score=raw_score,
+            start_iteration=start_iteration,
+            num_iteration=num_iteration,
+            pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib,
+        )
+
+    def refit(self, data, label, decay_rate: float = 0.9, **kwargs) -> "Booster":
+        """Refit leaf values on new data (reference: GBDT::RefitTree via
+        LGBM_BoosterRefit): new_leaf = decay * old + (1-decay) * new_optimal."""
+        X = _to_2d_float(data)
+        label = np.asarray(label, dtype=np.float64).ravel()
+        new_booster = Booster(model_str=self.model_to_string())
+        new_booster._gbdt.cfg = self.cfg
+        gbdt = new_booster._gbdt
+        score = np.zeros(len(label), dtype=np.float64)
+        from .objectives import create_objective
+
+        obj = create_objective(self.cfg)
+        for t_i, tree in enumerate(gbdt.models):
+            leaf = tree.predict_leaf(X)
+            g, h = obj.get_gradients(jnp.asarray(score, jnp.float32), jnp.asarray(label, jnp.float32), None)
+            g, h = np.asarray(g, np.float64), np.asarray(h, np.float64)
+            sum_g = np.bincount(leaf, weights=g, minlength=tree.num_leaves)
+            sum_h = np.bincount(leaf, weights=h, minlength=tree.num_leaves)
+            lam2 = self.cfg.lambda_l2
+            new_vals = -sum_g / (sum_h + lam2 + 1e-15) * tree.shrinkage
+            tree.leaf_value = decay_rate * tree.leaf_value + (1.0 - decay_rate) * np.where(
+                sum_h > 0, new_vals, tree.leaf_value
+            )
+            score += tree.predict(X)
+        return new_booster
+
+    # -- serialization ----------------------------------------------------
+    def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        return self._gbdt.save_model_to_string(num_iteration, start_iteration, importance_type)
+
+    def save_model(self, filename, num_iteration: int = -1, start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        Path(filename).write_text(self.model_to_string(num_iteration, start_iteration, importance_type))
+        return self
+
+    @classmethod
+    def model_from_string(cls, model_str: str) -> "Booster":
+        return cls(model_str=model_str)
+
+    def dump_model(self, num_iteration: int = -1, start_iteration: int = 0) -> Dict[str, Any]:
+        """JSON model dump (reference: GBDT::DumpModel)."""
+        g = self._gbdt
+        trees = []
+        k = g.num_tree_per_iteration
+        lo = start_iteration * k
+        hi = len(g.models) if num_iteration < 0 else min((start_iteration + num_iteration) * k, len(g.models))
+        for idx, t in enumerate(g.models[lo:hi]):
+            trees.append({
+                "tree_index": idx,
+                "num_leaves": t.num_leaves,
+                "num_cat": t.num_cat,
+                "shrinkage": t.shrinkage,
+                "tree_structure": _dump_node(t, 0 if t.num_internal else -1),
+            })
+        return {
+            "name": "tree",
+            "version": "v4",
+            "num_class": self.cfg.num_class if hasattr(self, "cfg") else 1,
+            "num_tree_per_iteration": k,
+            "label_index": 0,
+            "max_feature_idx": len(g.feature_names) - 1,
+            "objective": g._objective_string(),
+            "average_output": g.average_output,
+            "feature_names": list(g.feature_names),
+            "monotone_constraints": [],
+            "feature_infos": {},
+            "tree_info": trees,
+        }
+
+    def feature_importance(self, importance_type: str = "split", iteration=None) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type)
+
+    # network API compatibility (collectives are XLA's job on TPU)
+    def set_network(self, *args, **kwargs) -> "Booster":
+        return self
+
+    def free_network(self) -> "Booster":
+        return self
+
+    def free_dataset(self) -> "Booster":
+        self._train_set = None
+        return self
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int, value: float) -> "Booster":
+        self._gbdt.models[tree_id].leaf_value[leaf_id] = value
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        return float(self._gbdt.models[tree_id].leaf_value[leaf_id])
+
+
+def _dump_node(tree: Tree, node: int) -> Dict[str, Any]:
+    if node < 0 or tree.num_internal == 0:
+        leaf = -node - 1 if node < 0 else 0
+        return {
+            "leaf_index": leaf,
+            "leaf_value": float(tree.leaf_value[leaf]),
+            "leaf_weight": float(tree.leaf_weight[leaf]) if len(tree.leaf_weight) > leaf else 0.0,
+            "leaf_count": int(tree.leaf_count[leaf]) if len(tree.leaf_count) > leaf else 0,
+        }
+    return {
+        "split_index": node,
+        "split_feature": int(tree.split_feature[node]),
+        "split_gain": float(tree.split_gain[node]),
+        "threshold": float(tree.threshold[node]),
+        "decision_type": "<=",
+        "default_left": bool(tree.default_left()[node]),
+        "missing_type": ["None", "Zero", "NaN"][(int(tree.decision_type[node]) >> 2) & 3],
+        "internal_value": float(tree.internal_value[node]),
+        "internal_weight": float(tree.internal_weight[node]),
+        "internal_count": int(tree.internal_count[node]),
+        "left_child": _dump_node(tree, tree.left_child[node]),
+        "right_child": _dump_node(tree, tree.right_child[node]),
+    }
+
+
+def _call_feval(feval, score: np.ndarray, ds: Dataset):
+    ret = feval(score, ds)
+    if ret is None:
+        return []
+    if isinstance(ret, list):
+        return ret
+    return [ret]

@@ -1,0 +1,283 @@
+"""Training entry points: train() and cv().
+
+Reference: python-package/lightgbm/engine.py — train(), cv(), CVBooster,
+callback ordering by `.order` / `.before_iteration`, EarlyStopException flow.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset, LightGBMError
+from .callback import CallbackEnv, EarlyStopException
+from .config import Config, choose_param_value
+from .utils.log import log_info, log_warning, set_verbosity
+
+
+def train(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    valid_sets: Optional[List[Dataset]] = None,
+    valid_names: Optional[List[str]] = None,
+    feval: Optional[Callable] = None,
+    init_model: Optional[Union[str, Booster]] = None,
+    keep_training_booster: bool = False,
+    callbacks: Optional[List[Callable]] = None,
+) -> Booster:
+    """reference: engine.py train()."""
+    params = dict(params or {})
+    params = choose_param_value("num_iterations", params, None)
+    if params.get("num_iterations") is not None:
+        num_boost_round = int(params["num_iterations"])
+    params["num_iterations"] = num_boost_round
+    params = choose_param_value("early_stopping_round", params, None)
+    early_stopping_round = params.get("early_stopping_round")
+    cfg_probe = Config.from_dict(params)
+    set_verbosity(cfg_probe.verbosity)
+
+    fobj = None
+    if callable(params.get("objective")):
+        fobj = params["objective"]
+        params["objective"] = "none"
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        init_booster = init_model if isinstance(init_model, Booster) else Booster(model_file=init_model)
+        # continued training (reference: GBDT continued training via
+        # input_model): seed with the SAVED form of the model — init scores
+        # folded into the trees — then replay scores from the trees alone, so
+        # the fresh booster's own boost_from_average must not contribute.
+        import numpy as _np
+        from .models.gbdt import GBDT as _GBDT
+
+        gbdt = booster._gbdt
+        seeded = _GBDT.load_model_from_string(init_booster.model_to_string())
+        gbdt.models = seeded.models
+        gbdt.iter_ = seeded.iter_
+        gbdt.init_scores = [0.0] * gbdt.num_tree_per_iteration
+        base = _np.zeros(gbdt._score.shape, dtype=_np.float32)
+        if train_set.init_score is not None:
+            base += _np.asarray(train_set.init_score, _np.float32).reshape(base.shape)
+        import jax.numpy as _jnp
+
+        gbdt._score = _jnp.asarray(base)
+        _replay_scores(gbdt)
+
+    valid_sets = valid_sets or []
+    valid_names = valid_names or []
+    for i, vs in enumerate(valid_sets):
+        if vs is train_set:
+            name = valid_names[i] if i < len(valid_names) else "training"
+            booster._gbdt.metrics_train_alias = name
+            continue
+        name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+        booster.add_valid(vs, name)
+
+    callbacks = list(callbacks or [])
+    if early_stopping_round is not None and int(early_stopping_round) > 0:
+        from .callback import early_stopping
+
+        callbacks.append(
+            early_stopping(
+                int(early_stopping_round),
+                first_metric_only=bool(params.get("first_metric_only", False)),
+                verbose=cfg_probe.verbosity >= 1,
+                min_delta=float(params.get("early_stopping_min_delta", 0.0)),
+            )
+        )
+    for cb in callbacks:
+        if not hasattr(cb, "order"):
+            cb.order = 0  # type: ignore[attr-defined]
+    callbacks_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: cb.order)
+    callbacks_after.sort(key=lambda cb: cb.order)
+
+    train_in_valids = any(vs is train_set for vs in (valid_sets or []))
+
+    try:
+        for i in range(num_boost_round):
+            for cb in callbacks_before:
+                cb(CallbackEnv(booster, params, i, 0, num_boost_round, []))
+            finished = booster.update(fobj=fobj)
+            evaluation_result_list = []
+            if train_in_valids or booster._gbdt.cfg.is_provide_training_metric:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+            for cb in callbacks_after:
+                cb(CallbackEnv(booster, params, i, 0, num_boost_round, evaluation_result_list))
+            if finished:
+                log_info("Stopped training because there are no more leaves that meet the split requirements")
+                break
+    except EarlyStopException as e:
+        booster.best_iteration = e.best_iteration + 1
+        for item in e.best_score:
+            booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration()
+    return booster
+
+
+def _replay_scores(gbdt) -> None:
+    """Recompute train scores from existing trees (continued training)."""
+    import jax.numpy as jnp
+
+    k = gbdt.num_tree_per_iteration
+    for i, tree in enumerate(gbdt.models):
+        c = i % k
+        leaf = gbdt.train_set.predict_leaf_binned_tree(tree)
+        vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf]
+        if k == 1:
+            gbdt._score = gbdt._score + vals
+        else:
+            gbdt._score = gbdt._score.at[:, c].add(vals)
+
+
+class CVBooster:
+    """reference: engine.py CVBooster — container of per-fold boosters."""
+
+    def __init__(self, boosters: Optional[List[Booster]] = None):
+        self.boosters = boosters or []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params: Dict, seed: int,
+                  stratified: bool, shuffle: bool):
+    full_data.construct()
+    num_data = full_data.num_data()
+    rng = np.random.RandomState(seed)
+    if full_data.group is not None:
+        # ranking: folds must respect query boundaries (reference: cv's
+        # _make_n_folds group-aware split)
+        nq = len(full_data.group)
+        qidx = np.arange(nq)
+        if shuffle:
+            rng.shuffle(qidx)
+        bounds = np.concatenate([[0], np.cumsum(full_data.group)]).astype(np.int64)
+        for q_chunk in np.array_split(qidx, nfold):
+            te = np.concatenate([np.arange(bounds[q], bounds[q + 1]) for q in q_chunk])
+            te = np.sort(te)
+            tr = np.setdiff1d(np.arange(num_data), te)
+            yield tr, te
+        return
+    if stratified and full_data.label is not None:
+        label = np.asarray(full_data.label)
+        classes = np.unique(label)
+        folds = [[] for _ in range(nfold)]
+        for c in classes:
+            idx = np.nonzero(label == c)[0]
+            if shuffle:
+                rng.shuffle(idx)
+            for i, chunk in enumerate(np.array_split(idx, nfold)):
+                folds[i].extend(chunk.tolist())
+        test_indices = [np.asarray(sorted(f), dtype=np.int64) for f in folds]
+    else:
+        idx = np.arange(num_data)
+        if shuffle:
+            rng.shuffle(idx)
+        test_indices = [np.sort(chunk) for chunk in np.array_split(idx, nfold)]
+    for te in test_indices:
+        tr = np.setdiff1d(np.arange(num_data), te)
+        yield tr, te
+
+
+def cv(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    folds=None,
+    nfold: int = 5,
+    stratified: bool = True,
+    shuffle: bool = True,
+    metrics=None,
+    feval=None,
+    init_model=None,
+    fpreproc=None,
+    seed: int = 0,
+    callbacks=None,
+    eval_train_metric: bool = False,
+    return_cvbooster: bool = False,
+) -> Dict[str, Any]:
+    """reference: engine.py cv()."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    params = choose_param_value("num_iterations", params, None)
+    if params.get("num_iterations") is not None:
+        num_boost_round = int(params["num_iterations"])
+    params.pop("num_iterations", None)
+    params = choose_param_value("early_stopping_round", params, None)
+    early_stopping_round = params.get("early_stopping_round")
+    objective = params.get("objective", "")
+    stratified = stratified and isinstance(objective, str) and (
+        objective.startswith("binary") or objective.startswith("multiclass")
+    )
+
+    train_set.construct()
+    if folds is None:
+        folds = list(_make_n_folds(train_set, nfold, params, seed, stratified, shuffle))
+    elif hasattr(folds, "split"):
+        folds = list(folds.split(np.zeros(train_set.num_data()), np.asarray(train_set.label)))
+
+    cvbooster = CVBooster()
+    fold_valid_sets = []
+    for tr_idx, te_idx in folds:
+        tr = train_set.subset(tr_idx)
+        te = train_set.subset(te_idx)
+        bst = Booster(params=params, train_set=tr)
+        bst.add_valid(te, "valid")
+        cvbooster.append(bst)
+        fold_valid_sets.append(te)
+
+    callbacks = list(callbacks or [])
+    if early_stopping_round is not None and int(early_stopping_round) > 0:
+        from .callback import early_stopping
+
+        callbacks.append(early_stopping(int(early_stopping_round), verbose=False))
+    for cb in callbacks:
+        if not hasattr(cb, "order"):
+            cb.order = 0  # type: ignore[attr-defined]
+    cb_before = sorted([c for c in callbacks if getattr(c, "before_iteration", False)], key=lambda c: c.order)
+    cb_after = sorted([c for c in callbacks if not getattr(c, "before_iteration", False)], key=lambda c: c.order)
+
+    results: Dict[str, List[float]] = {}
+    try:
+        for i in range(num_boost_round):
+            for cb in cb_before:
+                cb(CallbackEnv(cvbooster, params, i, 0, num_boost_round, []))
+            merged: Dict[tuple, List[float]] = {}
+            for bst in cvbooster.boosters:
+                bst.update()
+                evals = bst.eval_valid(feval)
+                if eval_train_metric:
+                    evals = bst.eval_train(feval) + evals
+                for (name, metric, val, hib) in evals:
+                    merged.setdefault((name, metric, hib), []).append(val)
+            agg = []
+            for (name, metric, hib), vals in merged.items():
+                mean, std = float(np.mean(vals)), float(np.std(vals))
+                results.setdefault(f"{name} {metric}-mean", []).append(mean)
+                results.setdefault(f"{name} {metric}-stdv", []).append(std)
+                agg.append((name, metric, mean, hib, std))
+            for cb in cb_after:
+                cb(CallbackEnv(cvbooster, params, i, 0, num_boost_round, agg))
+    except EarlyStopException as e:
+        cvbooster.best_iteration = e.best_iteration + 1
+        for k in list(results.keys()):
+            results[k] = results[k][: cvbooster.best_iteration]
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster  # type: ignore[assignment]
+    return results

@@ -1,0 +1,738 @@
+"""Gradient-boosting orchestration.
+
+Reference: src/boosting/gbdt.cpp (GBDT::{Init,TrainOneIter,UpdateScore,
+RollbackOneIter}), gbdt_model_text.cpp (SaveModelToString/LoadModelFromString),
+dart.hpp, rf.hpp, sample_strategy.cpp / bagging.hpp / goss.hpp,
+score_updater.hpp.
+
+TPU-first structure: the boosting loop stays in Python (it is inherently
+sequential — one tree depends on the previous scores), but every O(N) step is
+a jitted device op: gradient computation, tree growth (ops/treegrow.py), and
+the score update, which is a pure gather `score += leaf_value[leaf_id]` since
+tree growth maintains per-row leaf ids for ALL rows (the partition-based fast
+path of ScoreUpdater::AddScore).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..metrics import Metric, create_metrics
+from ..objectives import Objective, create_objective
+from ..ops.split import SplitParams
+from ..ops.treegrow import grow_tree
+from ..ops import predict as predict_ops
+from .tree import Tree, tree_from_device
+
+_MODEL_VERSION = "v4"
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _add_leaf_scores(score, leaf_value, leaf_id, shrinkage):
+    return score + leaf_value[leaf_id] * shrinkage
+
+
+class GBDT:
+    """reference: class GBDT in src/boosting/gbdt.h."""
+
+    average_output = False  # RF mode: predictions are averaged over trees
+
+    def __init__(self, cfg: Config, train_set=None, objective: Optional[Objective] = None):
+        self.cfg = cfg
+        self.objective = objective if objective is not None else create_objective(cfg)
+        self.train_set = None
+        self.models: List[Tree] = []  # flattened: iter-major, class-minor
+        self.iter_ = 0
+        self.num_tree_per_iteration = cfg.num_tree_per_iteration
+        self.init_scores = [0.0] * self.num_tree_per_iteration
+        self.best_iteration = -1
+        self.feature_names: List[str] = []
+        self.metrics: List[Metric] = []
+        self.valid_sets: List = []
+        self.valid_names: List[str] = []
+        self._valid_scores: List[jnp.ndarray] = []
+        self._pred_cache = None
+        self.binner = None
+        self.rng = np.random.RandomState(cfg.seed)
+        if train_set is not None:
+            self.reset_training_data(train_set)
+
+    # ------------------------------------------------------------------
+    def reset_training_data(self, train_set) -> None:
+        """reference: GBDT::ResetTrainingData."""
+        self.train_set = train_set
+        train_set.construct()
+        self.binner = train_set.binner
+        self.feature_names = list(train_set.feature_names)
+        self.metrics = create_metrics(self.cfg)
+        n = train_set.num_data()
+        k = self.num_tree_per_iteration
+        self._label = jnp.asarray(train_set.label, dtype=jnp.float32)
+        self._weight = (
+            None if train_set.weight is None else jnp.asarray(train_set.weight, jnp.float32)
+        )
+        shape = (n,) if k == 1 else (n, k)
+        init = np.zeros(shape, dtype=np.float32)
+        if self.objective is not None and hasattr(self.objective, "prepare"):
+            # label-dependent objective state (is_unbalance weights etc.) is
+            # needed regardless of boost_from_average
+            self.objective.prepare(np.asarray(train_set.label), train_set.weight)
+        if self.objective is not None and self.cfg.boost_from_average and not self.models:
+            if k == 1:
+                self.init_scores = [self.objective.boost_from_score(self._label, self._weight)]
+                init += np.float32(self.init_scores[0])
+            else:
+                # per-class init (reference: multiclass BoostFromScore per tree id)
+                self.init_scores = []
+                for c in range(k):
+                    lbl = (np.asarray(train_set.label) == c).astype(np.float32)
+                    p = float(lbl.mean() if self._weight is None else np.average(lbl, weights=np.asarray(self._weight)))
+                    p = min(max(p, 1e-15), 1 - 1e-15)
+                    self.init_scores.append(float(np.log(p / (1 - p))))
+                init += np.asarray(self.init_scores, dtype=np.float32)[None, :]
+        if train_set.init_score is not None:
+            init += np.asarray(train_set.init_score, dtype=np.float32).reshape(shape)
+        self._score = jnp.asarray(init)
+        if self.objective is not None and hasattr(self.objective, "set_query") and train_set.query_boundaries is not None:
+            self.objective.set_query(train_set.query_boundaries, np.asarray(train_set.label))
+        self._split_params = SplitParams(
+            lambda_l1=self.cfg.lambda_l1,
+            lambda_l2=self.cfg.lambda_l2,
+            min_data_in_leaf=self.cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.cfg.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.cfg.min_gain_to_split,
+            max_delta_step=self.cfg.max_delta_step,
+            path_smooth=self.cfg.path_smooth,
+        )
+        # Categorical optimal splits (sorted many-vs-many, bitset thresholds)
+        # are not implemented yet; excluding categorical columns from split
+        # search beats producing numerically-bogus splits on frequency-ordered
+        # bins.  (P4 work: FindBestThresholdCategorical equivalent.)
+        cat_mask = np.asarray(self.binner.categorical_mask)
+        self._allowed_features = jnp.asarray(~cat_mask)
+        if cat_mask.any():
+            from ..utils.log import log_warning
+
+            log_warning(
+                f"{int(cat_mask.sum())} categorical feature(s) excluded from "
+                "split search (categorical splits not yet implemented)"
+            )
+        # distributed tree learner over the device mesh (reference:
+        # TreeLearner::CreateTreeLearner picking {serial,data,feature,voting})
+        self._dp = None
+        if self.cfg.tree_learner in ("data", "feature", "voting"):
+            import jax as _jax
+
+            if _jax.device_count() > 1:
+                from ..parallel.data_parallel import ShardedData
+                from ..parallel.mesh import make_mesh
+
+                mesh = make_mesh()
+                self._dp = ShardedData(
+                    mesh,
+                    np.asarray(train_set.bins),
+                    np.asarray(train_set.binner.num_bins_per_feature),
+                    np.asarray(train_set.binner.missing_bin_per_feature),
+                )
+
+    def reset_split_params(self) -> None:
+        """Refresh jit-static split hyperparams after a config mutation
+        (reference: GBDT::ResetConfig via reset_parameter callbacks)."""
+        self._split_params = SplitParams(
+            lambda_l1=self.cfg.lambda_l1,
+            lambda_l2=self.cfg.lambda_l2,
+            min_data_in_leaf=self.cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.cfg.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.cfg.min_gain_to_split,
+            max_delta_step=self.cfg.max_delta_step,
+            path_smooth=self.cfg.path_smooth,
+        )
+
+    def add_valid(self, valid_set, name: str) -> None:
+        valid_set.construct(reference=self.train_set)
+        self.valid_sets.append(valid_set)
+        self.valid_names.append(name)
+        n = valid_set.num_data()
+        k = self.num_tree_per_iteration
+        shape = (n,) if k == 1 else (n, k)
+        init = np.zeros(shape, dtype=np.float32)
+        if self.init_scores and any(s != 0.0 for s in self.init_scores):
+            init += np.asarray(self.init_scores, dtype=np.float32) if k > 1 else np.float32(self.init_scores[0])
+        if valid_set.init_score is not None:
+            init += np.asarray(valid_set.init_score, dtype=np.float32).reshape(shape)
+        # replay existing trees (continued training)
+        score = jnp.asarray(init)
+        for i, tree in enumerate(self.models):
+            c = i % k
+            leaf = valid_set.predict_leaf_binned_tree(tree)
+            vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf]
+            if k == 1:
+                score = score + vals
+            else:
+                score = score.at[:, c].add(vals)
+        self._valid_scores.append(score)
+
+    # ------------------------------------------------------------------
+    def _bagging_mask(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Row selection for this iteration: (mask bool, weights f32).
+
+        reference: BaggingSampleStrategy (bagging.hpp) & GOSSStrategy
+        (goss.hpp) via SampleStrategy::CreateSampleStrategy."""
+        n = self.train_set.num_data()
+        cfg = self.cfg
+        if cfg.data_sample_strategy == "goss" or cfg.boosting == "goss":
+            return self._goss_mask()
+        use_bagging = cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0
+            or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0
+        )
+        if not use_bagging:
+            return jnp.ones((n,), dtype=bool), jnp.ones((n,), jnp.float32)
+        if self._last_mask is not None and (self.iter_ % cfg.bagging_freq) != 0:
+            # re-bag only every bagging_freq iterations (reference: bagging.hpp)
+            return self._last_mask
+        rng = np.random.RandomState(cfg.bagging_seed + self.iter_)
+        if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
+            lbl = np.asarray(self.train_set.label)
+            mask = np.zeros(n, dtype=bool)
+            pos = lbl > 0
+            mask[pos] = rng.rand(int(pos.sum())) < cfg.pos_bagging_fraction
+            mask[~pos] = rng.rand(int((~pos).sum())) < cfg.neg_bagging_fraction
+        else:
+            mask = rng.rand(n) < cfg.bagging_fraction
+        out = (jnp.asarray(mask), jnp.ones((n,), jnp.float32))
+        self._last_mask = out
+        return out
+
+    def _goss_mask(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """GOSS (reference: goss.hpp): keep top `top_rate` rows by
+        |grad*hess|, sample `other_rate` of the rest and amplify them by
+        (1-top_rate)/other_rate.  First 1/learning_rate iterations use the
+        full data (reference warm-up rule)."""
+        n = self.train_set.num_data()
+        cfg = self.cfg
+        warmup = int(1.0 / max(cfg.learning_rate, 1e-12))
+        if self.iter_ < warmup:
+            return jnp.ones((n,), bool), jnp.ones((n,), jnp.float32)
+        g, h = self._cur_grad, self._cur_hess
+        score_abs = jnp.abs(g * h)
+        if score_abs.ndim > 1:
+            score_abs = jnp.sum(score_abs, axis=1)
+        top_k = max(int(n * cfg.top_rate), 1)
+        other_k = max(int(n * cfg.other_rate), 1)
+        thresh = jnp.sort(score_abs)[-top_k]
+        top_mask = score_abs >= thresh
+        rng_key = jax.random.PRNGKey(cfg.bagging_seed + self.iter_)
+        u = jax.random.uniform(rng_key, (n,))
+        rest_prob = other_k / jnp.maximum(n - top_k, 1)
+        rest_mask = (~top_mask) & (u < rest_prob)
+        mask = top_mask | rest_mask
+        amp = (1.0 - cfg.top_rate) / cfg.other_rate
+        weights = jnp.where(rest_mask, amp, 1.0).astype(jnp.float32)
+        return mask, weights
+
+    def _feature_mask(self) -> jnp.ndarray:
+        """reference: ColSampler::ResetByTree (col_sampler.hpp)."""
+        f = self.train_set.num_feature()
+        frac = self.cfg.feature_fraction
+        if frac >= 1.0:
+            return self._allowed_features
+        rng = np.random.RandomState(self.cfg.feature_fraction_seed + self.iter_)
+        k = max(int(np.ceil(f * frac)), 1)
+        chosen = rng.choice(f, size=k, replace=False)
+        mask = np.zeros(f, dtype=bool)
+        mask[chosen] = True
+        return jnp.asarray(mask) & self._allowed_features
+
+    _last_mask = None
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad: Optional[np.ndarray] = None, hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (reference: GBDT::TrainOneIter).  Returns
+        True when training cannot continue (all trees constant)."""
+        ts = self.train_set
+        k = self.num_tree_per_iteration
+        if grad is None:
+            g, h = self.objective.get_gradients(self._score, self._label, self._weight)
+        else:
+            g = jnp.asarray(grad, jnp.float32).reshape(self._score.shape)
+            h = jnp.asarray(hess, jnp.float32).reshape(self._score.shape)
+        self._cur_grad, self._cur_hess = g, h
+        row_mask, sample_weight = self._bagging_mask()
+        feature_mask = self._feature_mask()
+
+        all_const = True
+        for c in range(k):
+            gc = g if k == 1 else g[:, c]
+            hc = h if k == 1 else h[:, c]
+            if self._dp is not None:
+                from ..parallel.data_parallel import grow_tree_data_parallel
+
+                dp = self._dp
+                arrays, leaf_id_pad = grow_tree_data_parallel(
+                    dp,
+                    dp.pad_rows(np.asarray(gc, np.float32)),
+                    dp.pad_rows(np.asarray(hc, np.float32)),
+                    dp.pad_rows(np.asarray(row_mask, bool) & True, fill=False),
+                    dp.pad_rows(np.asarray(sample_weight, np.float32), fill=1.0),
+                    feature_mask,
+                    num_leaves=self.cfg.num_leaves,
+                    num_bins=ts.max_num_bins,
+                    max_depth=self.cfg.max_depth,
+                    params=self._split_params,
+                )
+                leaf_id = leaf_id_pad[: ts.num_data()]
+            else:
+                arrays, leaf_id = grow_tree(
+                    ts.bins_device,
+                    gc,
+                    hc,
+                    row_mask,
+                    sample_weight,
+                    feature_mask,
+                    ts.num_bins_pf_device,
+                    ts.missing_bin_pf_device,
+                    num_leaves=self.cfg.num_leaves,
+                    num_bins=ts.max_num_bins,
+                    max_depth=self.cfg.max_depth,
+                    params=self._split_params,
+                    hist_strategy="auto",
+                )
+            leaf_values = arrays.leaf_value
+            if self.objective is not None and self.objective.need_renew:
+                renewed = self.objective.renew_tree_output(
+                    None, self._label, self._weight,
+                    self._score if k == 1 else self._score[:, c],
+                    leaf_id, self.cfg.num_leaves,
+                )
+                if renewed is not None:
+                    active = jnp.arange(self.cfg.num_leaves) < arrays.num_leaves
+                    leaf_values = jnp.where(active, renewed, 0.0)
+                    arrays = arrays._replace(leaf_value=leaf_values)
+            tree = tree_from_device(arrays, self.binner)
+            if tree.num_leaves > 1:
+                all_const = False
+            shrinkage = 1.0 if self.cfg.boosting == "rf" else self.cfg.learning_rate
+            tree.apply_shrinkage(shrinkage)
+            # Trees hold PURE deltas during training; the boost_from_average
+            # init score lives in self.init_scores and is folded into tree 0
+            # only at serialization time (_trees_for_export), so valid-score
+            # updates, rollback, DART rescaling and continued training all
+            # treat trees uniformly (reference folds via Tree::AddBias; we
+            # fold at save to keep the .txt model self-contained).
+            dev_leaf_vals = jnp.asarray(tree.leaf_value, jnp.float32)
+            pad = self.cfg.num_leaves - dev_leaf_vals.shape[0]
+            if pad > 0:
+                dev_leaf_vals = jnp.concatenate([dev_leaf_vals, jnp.zeros(pad, jnp.float32)])
+            delta = dev_leaf_vals
+            if k == 1:
+                self._score = self._score + delta[leaf_id]
+            else:
+                self._score = self._score.at[:, c].add(delta[leaf_id])
+            self.models.append(tree)
+            # valid scores
+            for vi, vs in enumerate(self.valid_sets):
+                leaf_v = vs.predict_leaf_binned_tree(tree)
+                vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf_v]
+                if k == 1:
+                    self._valid_scores[vi] = self._valid_scores[vi] + vals
+                else:
+                    self._valid_scores[vi] = self._valid_scores[vi].at[:, c].add(vals)
+        self.iter_ += 1
+        self._pred_cache = None
+        return all_const
+
+    def rollback_one_iter(self) -> None:
+        """reference: GBDT::RollbackOneIter."""
+        if self.iter_ <= 0:
+            return
+        k = self.num_tree_per_iteration
+        for c in reversed(range(k)):
+            tree = self.models.pop()
+            leaf_id = self.train_set.predict_leaf_binned_tree(tree)
+            vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf_id]
+            if k == 1:
+                self._score = self._score - vals
+            else:
+                self._score = self._score.at[:, c].add(-vals)
+            for vi, vs in enumerate(self.valid_sets):
+                leaf_v = vs.predict_leaf_binned_tree(tree)
+                vv = jnp.asarray(tree.leaf_value, jnp.float32)[leaf_v]
+                if k == 1:
+                    self._valid_scores[vi] = self._valid_scores[vi] - vv
+                else:
+                    self._valid_scores[vi] = self._valid_scores[vi].at[:, c].add(-vv)
+        self.iter_ -= 1
+        self._pred_cache = None
+
+    # ------------------------------------------------------------------
+    def _converted(self, score: jnp.ndarray) -> np.ndarray:
+        if self.objective is not None:
+            return np.asarray(self.objective.convert_output(score))
+        return np.asarray(score)
+
+    def _eval_margin(self, score: jnp.ndarray) -> jnp.ndarray:
+        """Margin used for metric evaluation; RF averages (scores accumulate
+        raw sums during training)."""
+        return score
+
+    def eval_at(self, data_idx: int) -> List[Tuple[str, str, float, bool]]:
+        """data_idx 0 = training, 1.. = valid sets (reference: GBDT::GetEvalAt).
+        Returns (dataset_name, metric_name, value, is_higher_better)."""
+        if data_idx == 0:
+            ds, score, name = self.train_set, self._score, "training"
+        else:
+            ds = self.valid_sets[data_idx - 1]
+            score = self._valid_scores[data_idx - 1]
+            name = self.valid_names[data_idx - 1]
+        pred = self._converted(self._eval_margin(score))
+        label = np.asarray(ds.label)
+        weight = None if ds.weight is None else np.asarray(ds.weight)
+        out = []
+        for m in self.metrics:
+            for mn, v, hib in m.eval(pred, label, weight, ds.query_boundaries):
+                out.append((name, mn, v, hib))
+        return out
+
+    # ------------------------------------------------------------------
+    def _stacked(self, start: int = 0, num_iteration: int = -1):
+        trees = self.models
+        k = self.num_tree_per_iteration
+        lo = start * k
+        hi = len(trees) if num_iteration < 0 else min((start + num_iteration) * k, len(trees))
+        trees = trees[lo:hi]
+        if not trees:
+            return None
+        max_l = max(max((t.num_leaves for t in trees), default=1), 2)
+        m = max_l - 1
+        T = len(trees)
+
+        def pad(get, dtype, width, fill=0):
+            out = np.full((T, width), fill, dtype=dtype)
+            for i, t in enumerate(trees):
+                a = get(t)
+                out[i, : len(a)] = a
+            return jnp.asarray(out)
+
+        return dict(
+            split_feature=pad(lambda t: t.split_feature, np.int32, m),
+            threshold=pad(lambda t: t.threshold, np.float32, m),
+            default_left=pad(lambda t: t.default_left(), bool, m),
+            missing_type=pad(
+                lambda t: (t.decision_type.astype(np.int32) >> 2) & 3, np.int32, m
+            ),
+            left_child=pad(lambda t: t.left_child, np.int32, m, fill=-1),
+            right_child=pad(lambda t: t.right_child, np.int32, m, fill=-1),
+            num_leaves=jnp.asarray([t.num_leaves for t in trees], jnp.int32),
+            leaf_value=pad(lambda t: t.leaf_value, np.float32, max_l),
+            k=k,
+            T=T,
+        )
+
+    def predict_raw(self, X: np.ndarray, start_iteration: int = 0, num_iteration: int = -1) -> np.ndarray:
+        """Raw margin prediction on raw feature values (device traversal).
+        Adds the boost_from_average init score (trees hold pure deltas)."""
+        s = self._stacked(start_iteration, num_iteration)
+        n = X.shape[0]
+        k = self.num_tree_per_iteration
+        init = np.asarray(self.init_scores, dtype=np.float64)
+        if s is None:
+            base = np.zeros((n, k), dtype=np.float64) + init[None, :]
+            return base[:, 0] if k == 1 else base
+        x = jnp.asarray(np.asarray(X, dtype=np.float32))
+        n_per_class = max(s["T"] // k, 1)
+        scale = (1.0 / n_per_class) if self.average_output else 1.0
+        if k == 1:
+            out = predict_ops.predict_raw_values(
+                x, s["split_feature"], s["threshold"], s["default_left"],
+                s["missing_type"], s["left_child"], s["right_child"],
+                s["num_leaves"], s["leaf_value"],
+            )
+            return np.asarray(out, dtype=np.float64) * scale + init[0]
+        # multiclass: per-class sum over its trees
+        outs = np.zeros((n, k), dtype=np.float64) + init[None, :]
+        for c in range(k):
+            sel = slice(c, s["T"], k)
+            out = predict_ops.predict_raw_values(
+                x, s["split_feature"][sel], s["threshold"][sel], s["default_left"][sel],
+                s["missing_type"][sel], s["left_child"][sel], s["right_child"][sel],
+                s["num_leaves"][sel], s["leaf_value"][sel],
+            )
+            outs[:, c] += np.asarray(out) * scale
+        return outs
+
+    def predict(self, X, raw_score=False, start_iteration=0, num_iteration=-1,
+                pred_leaf=False, pred_contrib=False) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        if pred_leaf:
+            k = self.num_tree_per_iteration
+            lo = start_iteration * k
+            hi = len(self.models) if num_iteration < 0 else min((start_iteration + num_iteration) * k, len(self.models))
+            return np.stack([t.predict_leaf(X) for t in self.models[lo:hi]], axis=1)
+        if pred_contrib:
+            return self.predict_contrib(X, start_iteration, num_iteration)
+        raw = self.predict_raw(X, start_iteration, num_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    def predict_contrib(self, X, start_iteration=0, num_iteration=-1) -> np.ndarray:
+        """SHAP values via the per-tree path algorithm (reference:
+        Tree::PredictContrib / TreeSHAP in tree.cpp)."""
+        from .shap import tree_shap_ensemble
+
+        k = self.num_tree_per_iteration
+        lo = start_iteration * k
+        hi = len(self.models) if num_iteration < 0 else min((start_iteration + num_iteration) * k, len(self.models))
+        return tree_shap_ensemble(self.models[lo:hi], np.asarray(X, np.float64), k)
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        """reference: GBDT::FeatureImportance."""
+        f = len(self.feature_names) if self.feature_names else (
+            self.train_set.num_feature() if self.train_set else 0
+        )
+        imp = np.zeros(f, dtype=np.float64)
+        for t in self.models:
+            for i in range(t.num_internal):
+                if importance_type == "split":
+                    imp[t.split_feature[i]] += 1.0
+                else:
+                    imp[t.split_feature[i]] += max(float(t.split_gain[i]), 0.0)
+        return imp
+
+    # ------------------------------------------------------------------
+    # model text format (reference: gbdt_model_text.cpp)
+    # ------------------------------------------------------------------
+    def _objective_string(self) -> str:
+        o = self.cfg.objective
+        if o == "binary":
+            return f"binary sigmoid:{self.cfg.sigmoid:g}"
+        if o in ("multiclass", "multiclassova"):
+            return f"{o} num_class:{self.cfg.num_class}"
+        if o == "lambdarank":
+            return "lambdarank"
+        return o
+
+    def _trees_for_export(self, start: int, num_iteration: int) -> List[Tree]:
+        """Trees with the init score folded in so the saved model is
+        self-contained (reference: Tree::AddBias semantics): for gbdt/dart the
+        first tree per class gets +init; for RF (averaged output) EVERY tree
+        gets +init so avg(trees) = init + avg(deltas)."""
+        import copy as _copy
+
+        k = self.num_tree_per_iteration
+        lo = start * k
+        hi = len(self.models) if num_iteration < 0 else min((start + num_iteration) * k, len(self.models))
+        trees = list(self.models[lo:hi])
+        if lo != 0 or not any(s != 0.0 for s in self.init_scores):
+            return trees
+        if self.average_output:
+            fold_idx = range(len(trees))
+        else:
+            fold_idx = range(min(k, len(trees)))
+        for i in fold_idx:
+            c = i % k
+            t = _copy.deepcopy(trees[i])
+            t.leaf_value = t.leaf_value + self.init_scores[c]
+            t.internal_value = t.internal_value + self.init_scores[c]
+            trees[i] = t
+        return trees
+
+    def save_model_to_string(self, num_iteration: int = -1, start_iteration: int = 0,
+                             importance_type: str = "split") -> str:
+        k = self.num_tree_per_iteration
+        trees = self._trees_for_export(start_iteration, num_iteration)
+        feature_names = self.feature_names or [f"Column_{i}" for i in range(self.train_set.num_feature())]
+        if self.binner is not None:
+            infos = []
+            for m in self.binner.mappers:
+                if m.is_trivial:
+                    infos.append("none")
+                elif m.is_categorical:
+                    infos.append(":".join(str(int(c)) for c in m.categories))
+                else:
+                    infos.append(f"[{m.min_value:g}:{m.max_value:g}]")
+        else:
+            infos = ["none"] * len(feature_names)
+
+        blocks = [t.to_string(i) for i, t in enumerate(trees)]
+        tree_sizes = [len(b) + 1 for b in blocks]
+        lines = [
+            "tree",
+            f"version={_MODEL_VERSION}",
+            f"num_class={self.cfg.num_class}",
+            f"num_tree_per_iteration={k}",
+            "label_index=0",
+            f"max_feature_idx={len(feature_names) - 1}",
+            f"objective={self._objective_string()}",
+            *(["average_output"] if self.average_output else []),
+            "feature_names=" + " ".join(feature_names),
+            "feature_infos=" + " ".join(infos),
+            "tree_sizes=" + " ".join(str(s) for s in tree_sizes),
+            "",
+        ]
+        out = "\n".join(lines) + "\n" + "\n".join(blocks)
+        out += "\nend of trees\n\n"
+        imp = self.feature_importance(importance_type)
+        order = np.argsort(-imp, kind="stable")
+        out += "feature_importances:\n"
+        for i in order:
+            if imp[i] > 0:
+                out += f"{feature_names[i]}={imp[i]:g}\n"
+        out += "\nparameters:\n"
+        cfg = self.cfg.to_dict()
+        for key in ("objective", "boosting", "num_iterations", "learning_rate", "num_leaves",
+                    "max_depth", "min_data_in_leaf", "lambda_l1", "lambda_l2", "max_bin",
+                    "num_class", "seed", "tree_learner", "device_type"):
+            out += f"[{key}: {cfg.get(key)}]\n"
+        out += "end of parameters\n\npandas_categorical:null\n"
+        return out
+
+    @classmethod
+    def load_model_from_string(cls, model_str: str) -> "GBDT":
+        header, _, rest = model_str.partition("\nTree=")
+        kv = {}
+        for line in header.splitlines():
+            if "=" in line:
+                key, v = line.split("=", 1)
+                kv[key.strip()] = v.strip()
+        obj_str = kv.get("objective", "regression").split()
+        params: Dict[str, object] = {"objective": obj_str[0]}
+        for tok in obj_str[1:]:
+            if ":" in tok:
+                pk, pv = tok.split(":", 1)
+                params[pk] = pv
+        if int(kv.get("num_class", 1)) > 1:
+            params["num_class"] = int(kv["num_class"])
+        cfg = Config.from_dict(params)
+        booster = cls(cfg)
+        booster.feature_names = kv.get("feature_names", "").split()
+        booster.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", 1))
+        booster.average_output = any(
+            line.strip() == "average_output" for line in header.splitlines()
+        )
+        booster.init_scores = [0.0] * booster.num_tree_per_iteration  # folded into trees
+        trees_part = rest.split("\nend of trees")[0]
+        blocks = ("Tree=" + trees_part).split("\nTree=")
+        for b in blocks:
+            if b.strip():
+                booster.models.append(Tree.from_string("Tree=" + b if not b.startswith("Tree=") else b))
+        booster.iter_ = len(booster.models) // max(booster.num_tree_per_iteration, 1)
+        return booster
+
+
+class DART(GBDT):
+    """reference: src/boosting/dart.hpp — dropout boosting."""
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        cfg = self.cfg
+        k = self.num_tree_per_iteration
+        n_iters_done = self.iter_
+        rng = np.random.RandomState(cfg.drop_seed + n_iters_done)
+        drop_idx: List[int] = []
+        if n_iters_done > 0 and rng.rand() >= cfg.skip_drop:
+            if cfg.uniform_drop:
+                mask = rng.rand(n_iters_done) < cfg.drop_rate
+                drop_idx = list(np.nonzero(mask)[0])
+            else:
+                want = max(int(round(n_iters_done * cfg.drop_rate)), 1)
+                drop_idx = list(rng.choice(n_iters_done, size=min(want, n_iters_done), replace=False))
+            drop_idx = drop_idx[: cfg.max_drop] if cfg.max_drop > 0 else drop_idx
+        # remove dropped trees' contribution from scores
+        self._dart_removed = []
+        for it in drop_idx:
+            for c in range(k):
+                tree = self.models[it * k + c]
+                leaf_id = self.train_set.predict_leaf_binned_tree(tree)
+                vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf_id]
+                if k == 1:
+                    self._score = self._score - vals
+                else:
+                    self._score = self._score.at[:, c].add(-vals)
+        finished = super().train_one_iter(grad, hess)
+        # normalization (reference: DART::Normalize)
+        n_drop = len(drop_idx)
+        if n_drop > 0:
+            if cfg.xgboost_dart_mode:
+                new_scale = cfg.learning_rate / (n_drop + cfg.learning_rate)
+                old_scale = n_drop / (n_drop + cfg.learning_rate)
+            else:
+                new_scale = 1.0 / (n_drop + 1.0)
+                old_scale = n_drop / (n_drop + 1.0)
+            for c in range(k):
+                new_tree = self.models[-k + c]
+                new_tree.apply_shrinkage(new_scale)
+            for it in drop_idx:
+                for c in range(k):
+                    self.models[it * k + c].apply_shrinkage(old_scale)
+            # rebuild scores: add back dropped trees (rescaled) and fix new tree scale
+            for it in drop_idx:
+                for c in range(k):
+                    tree = self.models[it * k + c]
+                    leaf_id = self.train_set.predict_leaf_binned_tree(tree)
+                    vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf_id]
+                    if k == 1:
+                        self._score = self._score + vals
+                    else:
+                        self._score = self._score.at[:, c].add(vals)
+            for c in range(k):
+                tree = self.models[-k + c]
+                leaf_id = self.train_set.predict_leaf_binned_tree(tree)
+                # score currently holds the un-rescaled new tree: subtract the difference
+                vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf_id]
+                corr = vals * (1.0 / new_scale - 1.0)
+                if k == 1:
+                    self._score = self._score - corr
+                else:
+                    self._score = self._score.at[:, c].add(-corr)
+        return finished
+
+
+class RF(GBDT):
+    """reference: src/boosting/rf.hpp — bagging-only forest, averaged output."""
+
+    average_output = True
+
+    def __init__(self, cfg: Config, train_set=None, objective=None):
+        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            raise ValueError("Random forest needs bagging (bagging_freq > 0 and bagging_fraction < 1)")
+        super().__init__(cfg, train_set, objective)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        # RF computes gradients at the (fixed) init score every iteration
+        if grad is None and self.objective is not None:
+            base = jnp.zeros_like(self._score) + jnp.asarray(
+                np.asarray(self.init_scores, dtype=np.float32)
+                if self.num_tree_per_iteration > 1 else np.float32(self.init_scores[0])
+            )
+            g, h = self.objective.get_gradients(base, self._label, self._weight)
+            grad, hess = np.asarray(g), np.asarray(h)
+        return super().train_one_iter(grad, hess)
+
+    def _eval_margin(self, score):
+        # _score holds init + sum(deltas); metrics need init + mean(deltas)
+        init = np.asarray(self.init_scores, dtype=np.float32)
+        init = init[0] if self.num_tree_per_iteration == 1 else init[None, :]
+        return init + (score - init) / max(self.iter_, 1)
+
+
+def create_boosting(cfg: Config, train_set=None) -> GBDT:
+    """reference: Boosting::CreateBoosting in src/boosting/boosting.cpp."""
+    name = cfg.boosting
+    if name in ("gbdt", "gbrt", "goss"):
+        if name == "goss":
+            cfg.data_sample_strategy = "goss"
+        return GBDT(cfg, train_set)
+    if name == "dart":
+        return DART(cfg, train_set)
+    if name in ("rf", "random_forest"):
+        return RF(cfg, train_set)
+    raise ValueError(f"Unknown boosting type: {name}")

@@ -1,0 +1,231 @@
+"""Host-side tree model: trimmed arrays + LightGBM text-format serialization.
+
+Reference: src/io/tree.cpp / include/LightGBM/tree.h (Tree::ToString,
+Tree::Split recording real-valued thresholds from bin uppers) and
+src/boosting/gbdt_model_text.cpp (the `.txt` model format — the interop
+contract per SURVEY.md §6.4).
+
+decision_type bitfield (reference: include/LightGBM/tree.h):
+  bit 0: categorical;  bit 1: default_left;  bits 2-3: missing type
+  (0 = None, 1 = Zero, 2 = NaN).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+_MISSING_TYPE_SHIFT = 2  # reference: kMissingTypeMask >> positions
+
+
+@dataclass
+class Tree:
+    """One decision tree in host numpy arrays (trimmed to actual size)."""
+
+    num_leaves: int
+    split_feature: np.ndarray  # (M,) i32, M = num_leaves - 1
+    threshold: np.ndarray  # (M,) f64 — real-valued
+    threshold_bin: Optional[np.ndarray]  # (M,) i32 binned; None for loaded models
+    decision_type: np.ndarray  # (M,) u8
+    split_gain: np.ndarray  # (M,) f32
+    left_child: np.ndarray  # (M,) i32
+    right_child: np.ndarray  # (M,) i32
+    internal_value: np.ndarray  # (M,) f64
+    internal_weight: np.ndarray  # (M,) f64
+    internal_count: np.ndarray  # (M,) i64
+    leaf_value: np.ndarray  # (L,) f64
+    leaf_weight: np.ndarray  # (L,) f64
+    leaf_count: np.ndarray  # (L,) i64
+    shrinkage: float = 1.0
+    # categorical split storage (reference: cat_boundaries_/cat_threshold_)
+    num_cat: int = 0
+    cat_boundaries: np.ndarray = field(default_factory=lambda: np.zeros(1, np.int32))
+    cat_threshold: np.ndarray = field(default_factory=lambda: np.zeros(0, np.uint32))
+    is_linear: bool = False
+
+    @property
+    def num_internal(self) -> int:
+        return max(self.num_leaves - 1, 0)
+
+    def default_left(self) -> np.ndarray:
+        return (self.decision_type & K_DEFAULT_LEFT_MASK) != 0
+
+    def apply_shrinkage(self, rate: float) -> None:
+        """reference: Tree::Shrinkage."""
+        self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
+        self.shrinkage *= rate
+
+    # ------------------------------------------------------------------
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Scalar reference predict on raw values (numpy; used by tests and
+        small-batch paths — the hot path is ops/predict.py on device)."""
+        x = np.asarray(x, dtype=np.float64)
+        n = x.shape[0]
+        out = np.empty(n, dtype=np.float64)
+        if self.num_leaves <= 1:
+            out[:] = self.leaf_value[0] if len(self.leaf_value) else 0.0
+            return out
+        dl = self.default_left()
+        missing_type = (self.decision_type.astype(np.int32) >> _MISSING_TYPE_SHIFT) & 3
+        for i in range(n):
+            node = 0
+            while node >= 0:
+                f = self.split_feature[node]
+                v = x[i, f]
+                mt = missing_type[node]
+                if np.isnan(v) and mt == 2:
+                    left = dl[node]
+                elif mt == 1 and (np.isnan(v) or abs(v) <= 1e-35):
+                    left = dl[node]
+                else:
+                    vv = 0.0 if np.isnan(v) else v
+                    left = vv <= self.threshold[node]
+                node = self.left_child[node] if left else self.right_child[node]
+            out[i] = self.leaf_value[-node - 1]
+        return out
+
+    def predict_leaf(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float64)
+        n = x.shape[0]
+        out = np.zeros(n, dtype=np.int32)
+        if self.num_leaves <= 1:
+            return out
+        dl = self.default_left()
+        for i in range(n):
+            node = 0
+            while node >= 0:
+                f = self.split_feature[node]
+                v = x[i, f]
+                left = dl[node] if np.isnan(v) else (v <= self.threshold[node])
+                node = self.left_child[node] if left else self.right_child[node]
+            out[i] = -node - 1
+        return out
+
+    # ------------------------------------------------------------------
+    # LightGBM text model format (reference: Tree::ToString in tree.cpp)
+    # ------------------------------------------------------------------
+    def to_string(self, tree_idx: int) -> str:
+        m = self.num_internal
+        lines = [f"Tree={tree_idx}"]
+        lines.append(f"num_leaves={self.num_leaves}")
+        lines.append(f"num_cat={self.num_cat}")
+        lines.append("split_feature=" + _join_arr(self.split_feature[:m], "{:d}"))
+        lines.append("split_gain=" + _join_arr(self.split_gain[:m], "{:g}"))
+        lines.append("threshold=" + _join_arr(self.threshold[:m], "{:.17g}"))
+        lines.append("decision_type=" + _join_arr(self.decision_type[:m], "{:d}"))
+        lines.append("left_child=" + _join_arr(self.left_child[:m], "{:d}"))
+        lines.append("right_child=" + _join_arr(self.right_child[:m], "{:d}"))
+        lines.append(
+            "leaf_value=" + _join_arr(self.leaf_value[: self.num_leaves], "{:.17g}")
+        )
+        lines.append(
+            "leaf_weight=" + _join_arr(self.leaf_weight[: self.num_leaves], "{:g}")
+        )
+        lines.append("leaf_count=" + _join_arr(self.leaf_count[: self.num_leaves], "{:d}"))
+        lines.append("internal_value=" + _join_arr(self.internal_value[:m], "{:g}"))
+        lines.append("internal_weight=" + _join_arr(self.internal_weight[:m], "{:g}"))
+        lines.append("internal_count=" + _join_arr(self.internal_count[:m], "{:d}"))
+        if self.num_cat > 0:
+            lines.append("cat_boundaries=" + _join_arr(self.cat_boundaries, "{:d}"))
+            lines.append("cat_threshold=" + _join_arr(self.cat_threshold, "{:d}"))
+        lines.append(f"is_linear={int(self.is_linear)}")
+        lines.append(f"shrinkage={self.shrinkage:g}")
+        lines.append("")
+        return "\n".join(lines)
+
+    @classmethod
+    def from_string(cls, block: str) -> "Tree":
+        kv = {}
+        for line in block.strip().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        num_leaves = int(kv["num_leaves"])
+        m = max(num_leaves - 1, 0)
+
+        def parse_list(key, dtype, n):
+            s = kv.get(key, "")
+            if not s:
+                return np.zeros(n, dtype=dtype)
+            return np.asarray([float(t) for t in s.split()], dtype=dtype)
+
+        num_cat = int(kv.get("num_cat", 0))
+        tree = cls(
+            num_leaves=num_leaves,
+            split_feature=parse_list("split_feature", np.int32, m),
+            threshold=parse_list("threshold", np.float64, m),
+            # loaded models carry real-valued thresholds only; bin-space
+            # thresholds are reconstructed lazily against a binner when the
+            # tree is replayed on binned data (Dataset.predict_leaf_binned_tree)
+            threshold_bin=None,
+            decision_type=parse_list("decision_type", np.float64, m).astype(np.uint8),
+            split_gain=parse_list("split_gain", np.float32, m),
+            left_child=parse_list("left_child", np.int32, m),
+            right_child=parse_list("right_child", np.int32, m),
+            internal_value=parse_list("internal_value", np.float64, m),
+            internal_weight=parse_list("internal_weight", np.float64, m),
+            internal_count=parse_list("internal_count", np.float64, m).astype(np.int64),
+            leaf_value=parse_list("leaf_value", np.float64, num_leaves),
+            leaf_weight=parse_list("leaf_weight", np.float64, num_leaves),
+            leaf_count=parse_list("leaf_count", np.float64, num_leaves).astype(np.int64),
+            shrinkage=float(kv.get("shrinkage", 1.0)),
+            num_cat=num_cat,
+            is_linear=bool(int(kv.get("is_linear", 0))),
+        )
+        if num_cat > 0:
+            tree.cat_boundaries = parse_list("cat_boundaries", np.float64, num_cat + 1).astype(np.int32)
+            tree.cat_threshold = parse_list("cat_threshold", np.float64, 0).astype(np.uint32)
+        return tree
+
+
+def _join_arr(a, fmt: str) -> str:
+    return " ".join(fmt.format(v) for v in np.asarray(a).tolist())
+
+
+def tree_from_device(
+    arrays,  # ops.treegrow.TreeArrays (device or host)
+    binner,  # binning.DatasetBinner
+    missing_types: Optional[np.ndarray] = None,
+) -> Tree:
+    """Trim fixed-shape device TreeArrays to an exact host Tree, converting
+    bin thresholds to real values via the per-feature BinMapper
+    (reference: Tree::Split stores BinMapper bin uppers as thresholds)."""
+    num_leaves = int(arrays.num_leaves)
+    m = max(num_leaves - 1, 0)
+    split_feature = np.asarray(arrays.split_feature)[:m].astype(np.int32)
+    thr_bin = np.asarray(arrays.threshold_bin)[:m].astype(np.int32)
+    dl = np.asarray(arrays.default_left)[:m]
+
+    thresholds = np.zeros(m, dtype=np.float64)
+    decision_type = np.zeros(m, dtype=np.uint8)
+    for i in range(m):
+        f = int(split_feature[i])
+        mapper = binner.mappers[f]
+        thresholds[i] = mapper.bin_to_threshold(int(thr_bin[i]))
+        dt = 0
+        if dl[i]:
+            dt |= K_DEFAULT_LEFT_MASK
+        dt |= (mapper.missing_type & 3) << _MISSING_TYPE_SHIFT
+        decision_type[i] = dt
+
+    return Tree(
+        num_leaves=num_leaves,
+        split_feature=split_feature,
+        threshold=thresholds,
+        threshold_bin=thr_bin,
+        decision_type=decision_type,
+        split_gain=np.asarray(arrays.split_gain)[:m].astype(np.float32),
+        left_child=np.asarray(arrays.left_child)[:m].astype(np.int32),
+        right_child=np.asarray(arrays.right_child)[:m].astype(np.int32),
+        internal_value=np.asarray(arrays.internal_value)[:m].astype(np.float64),
+        internal_weight=np.asarray(arrays.internal_weight)[:m].astype(np.float64),
+        internal_count=np.asarray(arrays.internal_count)[:m].astype(np.int64),
+        leaf_value=np.asarray(arrays.leaf_value)[:num_leaves].astype(np.float64),
+        leaf_weight=np.asarray(arrays.leaf_weight)[:num_leaves].astype(np.float64),
+        leaf_count=np.asarray(arrays.leaf_count)[:num_leaves].astype(np.int64),
+    )

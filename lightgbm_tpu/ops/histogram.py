@@ -1,0 +1,112 @@
+"""Histogram construction on device.
+
+TPU-native replacement for the reference's histogram inner loops
+(reference: src/io/dense_bin.hpp -> DenseBin::ConstructHistogram,
+src/io/multi_val_dense_bin.hpp, src/treelearner/cuda/cuda_histogram_constructor.cu).
+
+The reference accumulates (sum_grad, sum_hess) per bin with 4-way unrolled
+scalar loops (CPU) or shared-memory atomics (CUDA).  TPUs have neither scalar
+loops nor atomics; instead we express the histogram as an XLA scatter-add over
+a flat (F*B) index space, which XLA lowers to a deterministic on-device
+combiner.  A one-hot-matmul (MXU) variant is provided for wide-row tiles and
+picked by a cost model, mirroring TrainingShareStates' col-wise/row-wise
+choice (reference: src/io/train_share_states.cpp).
+
+Channels: 0 = sum_grad, 1 = sum_hess, 2 = count (reference keeps 2 doubles and
+recovers count; we keep an explicit count channel since f32 hessians do not
+always encode counts).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NUM_CHANNELS = 3
+
+
+def histogram_scatter(
+    bins: jnp.ndarray,  # (N, F) int
+    grad: jnp.ndarray,  # (N,) f32
+    hess: jnp.ndarray,  # (N,) f32
+    mask: jnp.ndarray,  # (N,) bool or f32 — rows contributing to this hist
+    num_bins: int,
+) -> jnp.ndarray:
+    """Masked histogram over all features: returns (F, B, 3) f32.
+
+    Rows with mask=0 contribute zeros (they still scatter, but with zero
+    payload) — this is the TPU analogue of histogramming only the rows of one
+    leaf (reference: Dataset::ConstructHistograms with use_indices=true).
+    """
+    n, f = bins.shape
+    m = mask.astype(grad.dtype)
+    flat_idx = bins.astype(jnp.int32) + (jnp.arange(f, dtype=jnp.int32) * num_bins)[None, :]
+    payload = jnp.stack([grad * m, hess * m, m], axis=-1)  # (N, 3)
+    payload = jnp.broadcast_to(payload[:, None, :], (n, f, NUM_CHANNELS))
+    hist = jnp.zeros((f * num_bins, NUM_CHANNELS), dtype=grad.dtype)
+    hist = hist.at[flat_idx].add(payload, mode="drop")
+    return hist.reshape(f, num_bins, NUM_CHANNELS)
+
+
+def histogram_onehot_matmul(
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    mask: jnp.ndarray,
+    num_bins: int,
+    row_tile: int = 8192,
+) -> jnp.ndarray:
+    """MXU variant: one-hot(bin) contracted against (grad, hess, 1) payloads.
+
+    For a row tile of size T this is F batched (B x T)@(T x 3) matmuls — the
+    systolic-array-friendly formulation of histogramming (SURVEY.md §10.1
+    strategy 1).  Processes rows in tiles via lax.scan to bound memory.
+    """
+    n, f = bins.shape
+    m = mask.astype(grad.dtype)
+    payload = jnp.stack([grad * m, hess * m, m], axis=-1)  # (N, 3)
+
+    pad = (-n) % row_tile
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        payload = jnp.pad(payload, ((0, pad), (0, 0)))
+    nt = (n + pad) // row_tile
+    bins_t = bins.reshape(nt, row_tile, f)
+    pay_t = payload.reshape(nt, row_tile, NUM_CHANNELS)
+
+    def body(acc, inp):
+        b_tile, p_tile = inp  # (T, F), (T, 3)
+        onehot = jax.nn.one_hot(b_tile.T, num_bins, dtype=grad.dtype)  # (F, T, B)
+        # (F, B, T) @ (T, 3) -> (F, B, 3)
+        h = jnp.einsum("ftb,tc->fbc", onehot, p_tile, precision=jax.lax.Precision.HIGHEST)
+        return acc + h, None
+
+    init = jnp.zeros((f, num_bins, NUM_CHANNELS), dtype=grad.dtype)
+    hist, _ = jax.lax.scan(body, init, (bins_t, pay_t))
+    return hist
+
+
+def histogram(
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    mask: jnp.ndarray,
+    num_bins: int,
+    strategy: str = "auto",
+) -> jnp.ndarray:
+    """Dispatch between strategies (reference analogue: TrainingShareStates'
+    col-wise vs row-wise cost model)."""
+    if strategy == "auto":
+        # scatter wins for many features / large bins; matmul for narrow bins.
+        strategy = "onehot" if num_bins <= 64 and bins.shape[1] <= 512 else "scatter"
+    if strategy == "onehot":
+        return histogram_onehot_matmul(bins, grad, hess, mask, num_bins)
+    return histogram_scatter(bins, grad, hess, mask, num_bins)
+
+
+def fix_histogram_subtract(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:
+    """Sibling histogram by subtraction (reference: Dataset::FixHistogram /
+    the histogram subtraction trick) — exact because bins are identical."""
+    return parent - child

@@ -1,0 +1,303 @@
+"""Jitted leaf-wise tree growth.
+
+TPU-native re-design of the reference's serial tree learner
+(reference: src/treelearner/serial_tree_learner.cpp ->
+SerialTreeLearner::{Train,BeforeTrain,FindBestSplits,Split} and its CUDA
+sibling src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp).
+
+Design differences from the reference, chosen for XLA (SURVEY.md §10.1):
+  * No per-leaf row-index lists (DataPartition).  Instead a per-row `leaf_id`
+    vector is maintained; partitioning a leaf is a pure elementwise update and
+    histogramming a leaf is a masked scatter.  Fixed shapes throughout.
+  * The whole tree is grown inside ONE `lax.fori_loop` with `num_leaves - 1`
+    trip count; exhausted trees turn remaining iterations into no-ops via
+    `lax.cond` (the reference `break`s out of its leaf loop).
+  * Histogram subtraction trick preserved: only the smaller child is
+    histogrammed; the sibling is parent - child.
+  * Under `shard_map` the same code runs data-parallel: histograms and leaf
+    aggregates are `psum`'d over the mesh axis, after which every shard
+    computes identical splits (reference analogue:
+    src/treelearner/data_parallel_tree_learner.cpp, with psum standing in for
+    ReduceScatter + SyncUpGlobalBestSplit).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import histogram
+from .split import BestSplit, SplitParams, find_best_split, leaf_output, KMIN_SCORE
+
+
+class TreeArrays(NamedTuple):
+    """Structure-of-arrays tree (reference: class Tree in include/LightGBM/tree.h).
+
+    Internal node slots: 0..num_leaves-2 (slot t = t-th split).  Children
+    encode leaves as ~leaf_index (negative), matching the reference's
+    left_child_/right_child_ convention.
+    """
+
+    num_leaves: jnp.ndarray  # i32 scalar — actual leaf count
+    split_feature: jnp.ndarray  # (L-1,) i32
+    threshold_bin: jnp.ndarray  # (L-1,) i32
+    default_left: jnp.ndarray  # (L-1,) bool
+    split_gain: jnp.ndarray  # (L-1,) f32
+    left_child: jnp.ndarray  # (L-1,) i32
+    right_child: jnp.ndarray  # (L-1,) i32
+    internal_value: jnp.ndarray  # (L-1,) f32 — leaf output the node would have
+    internal_weight: jnp.ndarray  # (L-1,) f32 — sum hessian
+    internal_count: jnp.ndarray  # (L-1,) f32
+    leaf_value: jnp.ndarray  # (L,) f32
+    leaf_weight: jnp.ndarray  # (L,) f32 — sum hessian
+    leaf_count: jnp.ndarray  # (L,) f32
+    leaf_sum_g: jnp.ndarray  # (L,) f32 (for quantized/renew paths)
+    leaf_depth: jnp.ndarray  # (L,) i32
+
+
+class GrowState(NamedTuple):
+    leaf_id: jnp.ndarray  # (N,) i32
+    hist: jnp.ndarray  # (L, F, B, 3)
+    best: BestSplit  # vectorized over L
+    leaf_sum_g: jnp.ndarray  # (L,)
+    leaf_sum_h: jnp.ndarray
+    leaf_count: jnp.ndarray
+    leaf_depth: jnp.ndarray  # (L,) i32
+    leaf_parent: jnp.ndarray  # (L,) i32 node the leaf hangs from (-1 for root)
+    leaf_side: jnp.ndarray  # (L,) i32 0=left 1=right
+    num_leaves_cur: jnp.ndarray  # i32
+    tree: TreeArrays
+
+
+def _empty_best(num_leaves: int) -> BestSplit:
+    z = jnp.zeros((num_leaves,), dtype=jnp.float32)
+    zi = jnp.zeros((num_leaves,), dtype=jnp.int32)
+    return BestSplit(
+        gain=jnp.full((num_leaves,), KMIN_SCORE, dtype=jnp.float32),
+        feature=zi,
+        threshold_bin=zi,
+        default_left=jnp.zeros((num_leaves,), dtype=bool),
+        left_sum_g=z,
+        left_sum_h=z,
+        left_count=z,
+        right_sum_g=z,
+        right_sum_h=z,
+        right_count=z,
+    )
+
+
+def _set_best(best: BestSplit, i: jnp.ndarray, s: BestSplit) -> BestSplit:
+    return BestSplit(*[arr.at[i].set(v) for arr, v in zip(best, s)])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_leaves",
+        "num_bins",
+        "max_depth",
+        "params",
+        "hist_strategy",
+        "axis_name",
+    ),
+)
+def grow_tree(
+    bins: jnp.ndarray,  # (N, F) int — binned features (device-resident)
+    grad: jnp.ndarray,  # (N,) f32
+    hess: jnp.ndarray,  # (N,) f32
+    row_mask: jnp.ndarray,  # (N,) bool — bagging/GOSS row selection
+    sample_weight: jnp.ndarray,  # (N,) f32 — GOSS amplification (1.0 if unused)
+    feature_mask: jnp.ndarray,  # (F,) bool — feature_fraction selection
+    num_bins_per_feature: jnp.ndarray,  # (F,) i32
+    missing_bin_per_feature: jnp.ndarray,  # (F,) i32 (-1 = no missing bin)
+    *,
+    num_leaves: int,
+    num_bins: int,
+    max_depth: int = -1,
+    params: SplitParams = SplitParams(),
+    hist_strategy: str = "auto",
+    axis_name: Optional[str] = None,
+) -> tuple[TreeArrays, jnp.ndarray]:
+    """Grow one tree; returns (tree, final leaf_id per row).
+
+    `leaf_id` is maintained for ALL rows (in-bag and out-of-bag), so the score
+    update after growth is simply `leaf_value[leaf_id]` — the partition-based
+    fast path of the reference's ScoreUpdater::AddScore.
+    """
+    n, f = bins.shape
+    bins = bins.astype(jnp.int32)
+    grad = grad.astype(jnp.float32) * sample_weight
+    hess = hess.astype(jnp.float32) * sample_weight
+    L = num_leaves
+
+    def psum(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+    def leaf_hist(mask):
+        h = histogram(bins, grad, hess, mask, num_bins, strategy=hist_strategy)
+        return psum(h)
+
+    def best_for(hist_leaf, sum_g, sum_h, count, depth):
+        s = find_best_split(
+            hist_leaf,
+            sum_g,
+            sum_h,
+            count,
+            num_bins_per_feature,
+            missing_bin_per_feature,
+            params,
+            feature_mask=feature_mask,
+        )
+        # depth cap (reference: max_depth check in BeforeFindBestSplit)
+        if max_depth > 0:
+            s = s._replace(gain=jnp.where(depth >= max_depth, KMIN_SCORE, s.gain))
+        return s
+
+    # --- leaf 0: all in-bag rows ---
+    mask0 = row_mask.astype(jnp.float32)
+    hist0 = leaf_hist(mask0)
+    sum0 = jnp.sum(hist0[0], axis=0)  # totals from feature 0's hist: (3,)
+    g0, h0, c0 = sum0[0], sum0[1], sum0[2]
+
+    tree0 = TreeArrays(
+        num_leaves=jnp.asarray(1, jnp.int32),
+        split_feature=jnp.zeros((L - 1,), jnp.int32),
+        threshold_bin=jnp.zeros((L - 1,), jnp.int32),
+        default_left=jnp.zeros((L - 1,), bool),
+        split_gain=jnp.zeros((L - 1,), jnp.float32),
+        left_child=jnp.zeros((L - 1,), jnp.int32),
+        right_child=jnp.zeros((L - 1,), jnp.int32),
+        internal_value=jnp.zeros((L - 1,), jnp.float32),
+        internal_weight=jnp.zeros((L - 1,), jnp.float32),
+        internal_count=jnp.zeros((L - 1,), jnp.float32),
+        leaf_value=jnp.zeros((L,), jnp.float32),
+        leaf_weight=jnp.zeros((L,), jnp.float32),
+        leaf_count=jnp.zeros((L,), jnp.float32),
+        leaf_sum_g=jnp.zeros((L,), jnp.float32),
+        leaf_depth=jnp.zeros((L,), jnp.int32),
+    )
+
+    state = GrowState(
+        leaf_id=jnp.zeros((n,), jnp.int32),
+        hist=jnp.zeros((L, f, num_bins, 3), jnp.float32).at[0].set(hist0),
+        best=_set_best(
+            _empty_best(L), jnp.asarray(0), best_for(hist0, g0, h0, c0, jnp.asarray(0))
+        ),
+        leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
+        leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(h0),
+        leaf_count=jnp.zeros((L,), jnp.float32).at[0].set(c0),
+        leaf_depth=jnp.zeros((L,), jnp.int32),
+        leaf_parent=jnp.full((L,), -1, jnp.int32),
+        leaf_side=jnp.zeros((L,), jnp.int32),
+        num_leaves_cur=jnp.asarray(1, jnp.int32),
+        tree=tree0,
+    )
+
+    def do_split(state: GrowState) -> GrowState:
+        best_leaf = jnp.argmax(state.best.gain).astype(jnp.int32)
+        s = jax.tree.map(lambda a: a[best_leaf], state.best)
+        node = state.num_leaves_cur - 1  # next internal node slot
+        new_leaf = state.num_leaves_cur  # right child's leaf index
+
+        # --- partition: pure elementwise leaf_id update (reference:
+        # DataPartition::Split, but with no data movement) ---
+        fcol = bins[:, s.feature]
+        is_missing = fcol == missing_bin_per_feature[s.feature]
+        go_left = jnp.where(is_missing, s.default_left, fcol <= s.threshold_bin)
+        in_leaf = state.leaf_id == best_leaf
+        leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_id)
+
+        # --- histogram the smaller child; sibling by subtraction ---
+        left_smaller = s.left_count <= s.right_count
+        small_leaf = jnp.where(left_smaller, best_leaf, new_leaf)
+        mask_small = (leaf_id == small_leaf) & row_mask
+        hist_small = leaf_hist(mask_small.astype(jnp.float32))
+        parent_hist = state.hist[best_leaf]
+        hist_big = parent_hist - hist_small
+        hist_left = jnp.where(left_smaller, hist_small, hist_big)
+        hist_right = jnp.where(left_smaller, hist_big, hist_small)
+        hist = state.hist.at[best_leaf].set(hist_left).at[new_leaf].set(hist_right)
+
+        # --- record the node (reference: Tree::Split) ---
+        parent_out = leaf_output(
+            state.leaf_sum_g[best_leaf], state.leaf_sum_h[best_leaf], params
+        )
+        old_parent = state.leaf_parent[best_leaf]
+        old_side = state.leaf_side[best_leaf]
+        t = state.tree
+        # re-point the grandparent's child slot from ~best_leaf to this node
+        lc = jnp.where(
+            (old_parent >= 0) & (old_side == 0),
+            t.left_child.at[old_parent].set(node),
+            t.left_child,
+        )
+        rc = jnp.where(
+            (old_parent >= 0) & (old_side == 1),
+            t.right_child.at[old_parent].set(node),
+            t.right_child,
+        )
+        lc = lc.at[node].set(-best_leaf - 1)
+        rc = rc.at[node].set(-new_leaf - 1)
+        depth_child = state.leaf_depth[best_leaf] + 1
+        tree = t._replace(
+            num_leaves=state.num_leaves_cur + 1,
+            split_feature=t.split_feature.at[node].set(s.feature),
+            threshold_bin=t.threshold_bin.at[node].set(s.threshold_bin),
+            default_left=t.default_left.at[node].set(s.default_left),
+            split_gain=t.split_gain.at[node].set(s.gain),
+            left_child=lc,
+            right_child=rc,
+            internal_value=t.internal_value.at[node].set(parent_out),
+            internal_weight=t.internal_weight.at[node].set(state.leaf_sum_h[best_leaf]),
+            internal_count=t.internal_count.at[node].set(state.leaf_count[best_leaf]),
+        )
+
+        # --- update leaf aggregates ---
+        leaf_sum_g = state.leaf_sum_g.at[best_leaf].set(s.left_sum_g).at[new_leaf].set(s.right_sum_g)
+        leaf_sum_h = state.leaf_sum_h.at[best_leaf].set(s.left_sum_h).at[new_leaf].set(s.right_sum_h)
+        leaf_count = state.leaf_count.at[best_leaf].set(s.left_count).at[new_leaf].set(s.right_count)
+        leaf_depth = state.leaf_depth.at[best_leaf].set(depth_child).at[new_leaf].set(depth_child)
+        leaf_parent = state.leaf_parent.at[best_leaf].set(node).at[new_leaf].set(node)
+        leaf_side = state.leaf_side.at[best_leaf].set(0).at[new_leaf].set(1)
+
+        # --- best splits for the two fresh leaves ---
+        bl = best_for(hist_left, s.left_sum_g, s.left_sum_h, s.left_count, depth_child)
+        br = best_for(hist_right, s.right_sum_g, s.right_sum_h, s.right_count, depth_child)
+        best = _set_best(_set_best(state.best, best_leaf, bl), new_leaf, br)
+
+        return GrowState(
+            leaf_id=leaf_id,
+            hist=hist,
+            best=best,
+            leaf_sum_g=leaf_sum_g,
+            leaf_sum_h=leaf_sum_h,
+            leaf_count=leaf_count,
+            leaf_depth=leaf_depth,
+            leaf_parent=leaf_parent,
+            leaf_side=leaf_side,
+            num_leaves_cur=state.num_leaves_cur + 1,
+            tree=tree,
+        )
+
+    def body(_t, state: GrowState) -> GrowState:
+        can_split = jnp.max(state.best.gain) > KMIN_SCORE / 2
+        return jax.lax.cond(can_split, do_split, lambda st: st, state)
+
+    state = jax.lax.fori_loop(0, L - 1, body, state)
+
+    # finalize leaf values (reference: leaf outputs are computed during growth;
+    # equivalent here since sums are exact)
+    leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
+    active = jnp.arange(L, dtype=jnp.int32) < state.num_leaves_cur
+    tree = state.tree._replace(
+        num_leaves=state.num_leaves_cur,
+        leaf_value=jnp.where(active, leaf_value, 0.0),
+        leaf_weight=jnp.where(active, state.leaf_sum_h, 0.0),
+        leaf_count=jnp.where(active, state.leaf_count, 0.0),
+        leaf_sum_g=jnp.where(active, state.leaf_sum_g, 0.0),
+        leaf_depth=state.leaf_depth,
+    )
+    return tree, state.leaf_id

@@ -1,0 +1,144 @@
+"""Data-parallel tree learning over a device mesh.
+
+TPU-native re-design of the reference's parallel tree learners
+(reference: src/treelearner/data_parallel_tree_learner.cpp,
+feature_parallel_tree_learner.cpp, voting_parallel_tree_learner.cpp and the
+Network collectives they call — ReduceScatter of histogram buffers,
+Allreduce(max-gain SplitInfo), GlobalSyncUpBySum).
+
+Mapping (SURVEY.md §3.5):
+  * rows sharded over the mesh DATA_AXIS (reference: pre_partition row split);
+  * each shard histograms its local rows, then `jax.lax.psum` merges the
+    (F, B, 3) histogram across the axis — standing in for the reference's
+    ReduceScatter + per-rank feature ownership.  Because every shard then
+    holds the GLOBAL histogram, split finding is replicated and the
+    SyncUpGlobalBestSplit Allreduce disappears entirely: all shards compute
+    the same argmax deterministically.
+  * per-row leaf ids stay shard-local; tree arrays come out replicated.
+
+This collapses the reference's 3-collective-per-split protocol into one psum
+per histogram — the right trade on ICI where bandwidth is plentiful and
+latency dominates.  A psum_scatter + owned-feature variant (closer to the
+reference at DCN scale) is the voting-parallel path's job.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.split import SplitParams
+from ..ops.treegrow import TreeArrays, grow_tree
+from .mesh import DATA_AXIS
+
+
+class ShardedData:
+    """Training arrays laid out over the mesh's data axis (rows padded to a
+    multiple of the axis size; padding rows carry row_mask=0 so they never
+    contribute to histograms)."""
+
+    def __init__(self, mesh: Mesh, bins: np.ndarray, num_bins_pf: np.ndarray,
+                 missing_bin_pf: np.ndarray):
+        self.mesh = mesh
+        n, f = bins.shape
+        self.n_devices = mesh.devices.size
+        pad = (-n) % self.n_devices
+        self.num_data = n
+        self.padded = n + pad
+        if pad:
+            bins = np.concatenate([bins, np.zeros((pad, f), bins.dtype)], axis=0)
+        row_valid = np.zeros(self.padded, bool)
+        row_valid[:n] = True
+        self.row_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self.rep_sharding = NamedSharding(mesh, P())
+        self.bins = jax.device_put(bins, self.row_sharding)
+        self.row_valid = jax.device_put(row_valid, self.row_sharding)
+        self.num_bins_pf = jax.device_put(num_bins_pf, self.rep_sharding)
+        self.missing_bin_pf = jax.device_put(missing_bin_pf, self.rep_sharding)
+
+    def pad_rows(self, arr: np.ndarray, fill=0.0) -> jnp.ndarray:
+        pad = self.padded - self.num_data
+        if pad:
+            arr = np.concatenate([np.asarray(arr), np.full((pad,) + np.shape(arr)[1:], fill, np.asarray(arr).dtype)])
+        return jax.device_put(arr, self.row_sharding)
+
+
+def grow_tree_data_parallel(
+    sharded: ShardedData,
+    grad: jnp.ndarray,  # (Npad,) sharded over DATA_AXIS
+    hess: jnp.ndarray,
+    row_mask: jnp.ndarray,  # (Npad,) bool sharded — bagging AND validity
+    sample_weight: jnp.ndarray,
+    feature_mask: jnp.ndarray,  # (F,) replicated
+    *,
+    num_leaves: int,
+    num_bins: int,
+    max_depth: int = -1,
+    params: SplitParams = SplitParams(),
+    hist_strategy: str = "auto",
+) -> Tuple[TreeArrays, jnp.ndarray]:
+    """SPMD tree growth: identical trees on every shard, shard-local leaf ids.
+
+    reference call-stack analogue: DataParallelTreeLearner::Train (SURVEY.md
+    §4.4) with psum in place of ReduceScatter/Allreduce.
+    """
+    mesh = sharded.mesh
+
+    fn = jax.jit(
+        jax.shard_map(
+            functools.partial(
+                grow_tree,
+                num_leaves=num_leaves,
+                num_bins=num_bins,
+                max_depth=max_depth,
+                params=params,
+                hist_strategy=hist_strategy,
+                axis_name=DATA_AXIS,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(DATA_AXIS),  # bins
+                P(DATA_AXIS),  # grad
+                P(DATA_AXIS),  # hess
+                P(DATA_AXIS),  # row_mask
+                P(DATA_AXIS),  # sample_weight
+                P(),  # feature_mask
+                P(),  # num_bins_pf
+                P(),  # missing_bin_pf
+            ),
+            out_specs=(
+                TreeArrays(*([P()] * len(TreeArrays._fields))),  # tree replicated
+                P(DATA_AXIS),  # leaf_id
+            ),
+            check_vma=False,
+        )
+    )
+    return fn(
+        sharded.bins, grad, hess, row_mask, sample_weight, feature_mask,
+        sharded.num_bins_pf, sharded.missing_bin_pf,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("axis_name",))
+def _psum_scalar(x, axis_name: str):
+    return jax.lax.psum(x, axis_name)
+
+
+def distributed_metric_sums(mesh: Mesh, local_loss_sum: jnp.ndarray, local_weight_sum: jnp.ndarray):
+    """Distributed metric reduction (reference: Network::GlobalSyncUpBySum used
+    by Metric::Eval in every distributed mode)."""
+    fn = jax.jit(
+        jax.shard_map(
+            lambda l, w: (jax.lax.psum(l, DATA_AXIS), jax.lax.psum(w, DATA_AXIS)),
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+    return fn(local_loss_sum, local_weight_sum)

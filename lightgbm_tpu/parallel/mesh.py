@@ -1,0 +1,55 @@
+"""Device mesh helpers.
+
+TPU-native replacement for the reference's network bring-up
+(reference: src/network/network.cpp Network::Init, linkers_socket.cpp —
+machine lists, listen ports, full TCP mesh).  On TPU the SPMD context is a
+jax.sharding.Mesh over the slice's chips; multi-host bring-up is
+jax.distributed.initialize, and the collectives ride ICI/DCN via XLA.
+
+The reference's network params (num_machines, machines, local_listen_port,
+time_out, machine_list_filename) are accepted by the config layer and
+translated: num_machines>1 simply asserts the mesh is large enough.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"  # rows (reference: tree_learner=data rank axis)
+FEATURE_AXIS = "feature"  # feature blocks (reference: tree_learner=feature)
+
+
+def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D data mesh over the available chips."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def make_mesh_2d(n_data: int, n_feature: int, devices: Optional[Sequence] = None) -> Mesh:
+    """(data, feature) mesh for combined data+feature parallel histograms."""
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices[: n_data * n_feature]).reshape(n_data, n_feature)
+    return Mesh(devices, (DATA_AXIS, FEATURE_AXIS))
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (reference analogue: Network::Init from
+    machine_list — here jax.distributed over the TPU pod's control plane)."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,31 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's multi-node-without-a-cluster test strategy
+(tests/test_dask.py LocalCluster, tests/distributed/_test_distributed.py):
+sharding tests run against N virtual CPU devices via
+--xla_force_host_platform_device_count, no TPU required (SURVEY.md §5.3).
+
+The session environment may register a remote-TPU PJRT plugin at interpreter
+startup (sitecustomize), which cannot be undone in-process; when detected, the
+whole pytest process is re-exec'd once with a scrubbed environment so the
+suite runs hermetically on local CPU.
+"""
+
+import os
+import sys
+
+if os.environ.get("PALLAS_AXON_POOL_IPS") and not os.environ.get("_LGBM_TPU_TEST_REEXEC"):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip remote-TPU plugin registration
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    env["_LGBM_TPU_TEST_REEXEC"] = "1"
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,89 @@
+"""Binning unit tests (reference semantics: src/io/bin.cpp BinMapper)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (
+    MISSING_NAN,
+    MISSING_NONE,
+    BinMapper,
+    DatasetBinner,
+    find_bin,
+)
+
+
+def test_distinct_value_fast_path():
+    vals = np.array([1.0, 2.0, 3.0, 1.0, 2.0, 3.0, 1.0] * 3)
+    m = find_bin(vals, max_bin=255, min_data_in_bin=1)
+    assert m.missing_type == MISSING_NONE
+    # 3 distinct values -> 3 bins; boundaries at midpoints
+    b = m.transform(np.array([1.0, 1.4, 1.6, 2.0, 2.6, 3.0, 100.0]))
+    assert b.tolist() == [0, 0, 1, 1, 2, 2, 2]
+
+
+def test_min_data_in_bin_merges():
+    # values with counts 1 each and min_data_in_bin=2 -> merged pairs
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    m = find_bin(vals, max_bin=255, min_data_in_bin=2)
+    b = m.transform(vals)
+    assert b[0] == b[1]
+    assert b[2] == b[3]
+    assert b[0] != b[2]
+
+
+def test_nan_gets_last_bin():
+    vals = np.array([1.0, 2.0, np.nan, 3.0, np.nan])
+    m = find_bin(vals, max_bin=255, min_data_in_bin=1)
+    assert m.missing_type == MISSING_NAN
+    assert m.missing_bin == m.num_bins - 1
+    b = m.transform(vals)
+    assert b[2] == m.missing_bin
+    assert b[4] == m.missing_bin
+    assert b[0] < m.missing_bin
+
+
+def test_equal_count_binning_large():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(100000)
+    m = find_bin(vals, max_bin=63, min_data_in_bin=3)
+    assert m.num_bins <= 63
+    b = m.transform(vals)
+    counts = np.bincount(b, minlength=m.num_bins)
+    # roughly equal-frequency: no empty bins, max/median bounded
+    assert (counts[counts > 0] > 0).all()
+    assert m.num_bins > 32
+
+
+def test_threshold_roundtrip():
+    """Real-valued thresholds must reproduce binned decisions
+    (reference: BinMapper::BinToValue + Tree threshold recording)."""
+    rng = np.random.RandomState(1)
+    vals = rng.randn(5000)
+    m = find_bin(vals, max_bin=31, min_data_in_bin=3)
+    bins = m.transform(vals)
+    for t in range(m.num_bins - 1):
+        thr = m.bin_to_threshold(t)
+        np.testing.assert_array_equal(bins <= t, vals <= thr)
+
+
+def test_categorical_binning():
+    vals = np.array([5.0, 5.0, 5.0, 7.0, 7.0, 9.0])
+    m = find_bin(vals, max_bin=255, is_categorical=True)
+    assert m.is_categorical
+    b = m.transform(vals)
+    # most frequent category -> bin 0
+    assert b[0] == 0
+    assert b[3] == 1
+    assert b[5] == 2
+
+
+def test_dataset_binner():
+    rng = np.random.RandomState(2)
+    X = rng.randn(1000, 5)
+    X[::7, 2] = np.nan
+    binner = DatasetBinner.fit(X, max_bin=255)
+    bins = binner.transform(X)
+    assert bins.shape == X.shape
+    assert bins.dtype == np.uint8
+    assert binner.missing_bin_per_feature[2] >= 0
+    assert binner.missing_bin_per_feature[0] == -1

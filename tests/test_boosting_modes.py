@@ -1,0 +1,173 @@
+"""Regression tests for the init-score handling family of bugs plus
+DART/RF/GOSS boosting modes (reference behaviors from gbdt.cpp, dart.hpp,
+rf.hpp, goss.hpp)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def shifted_regression():
+    """Regression data with a large nonzero mean — catches any path that
+    double-counts or drops the boost_from_average init score."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 8)
+    y = 100.0 + X @ rng.randn(8) + 0.1 * rng.randn(2000)
+    return X, y
+
+
+def test_valid_scores_not_double_counting_init(shifted_regression):
+    X, y = shifted_regression
+    train = lgb.Dataset(X[:1500], label=y[:1500])
+    valid = lgb.Dataset(X[1500:], label=y[1500:], reference=train)
+    rec = {}
+    bst = lgb.train(
+        {"objective": "regression", "metric": ["l2"], "verbosity": -1},
+        train, num_boost_round=5, valid_sets=[valid],
+        callbacks=[lgb.record_evaluation(rec)],
+    )
+    # internal valid-set margin must equal raw predict on the same rows
+    internal = np.asarray(bst._gbdt._valid_scores[0])
+    raw = bst.predict(X[1500:], raw_score=True)
+    np.testing.assert_allclose(internal, raw, rtol=1e-4, atol=1e-3)
+    # and the recorded l2 must be sane (not ~100^2 biased)
+    assert rec["valid_0"]["l2"][-1] < 50.0
+
+
+def test_init_model_continuation(shifted_regression):
+    X, y = shifted_regression
+    params = {"objective": "regression", "verbosity": -1}
+    d1 = lgb.Dataset(X, label=y)
+    bst1 = lgb.train(params, d1, num_boost_round=5)
+    d2 = lgb.Dataset(X, label=y)
+    bst2 = lgb.train(params, d2, num_boost_round=5, init_model=bst1)
+    assert bst2.num_trees() == 10
+    pred = bst2.predict(X)
+    mse10 = np.mean((pred - y) ** 2)
+    mse5 = np.mean((bst1.predict(X) - y) ** 2)
+    assert mse10 < mse5  # continued training improves
+    # margins consistent: internal score == predict
+    np.testing.assert_allclose(
+        np.asarray(bst2._gbdt._score), pred, rtol=1e-4, atol=1e-3
+    )
+
+
+def test_dart_with_shifted_labels(shifted_regression):
+    X, y = shifted_regression
+    bst = lgb.train(
+        {"objective": "regression", "boosting": "dart", "drop_rate": 0.5,
+         "verbosity": -1, "drop_seed": 7},
+        lgb.Dataset(X, label=y), num_boost_round=15,
+    )
+    pred = bst.predict(X)
+    # DART rescaling must never corrupt the ~100 baseline
+    assert abs(pred.mean() - y.mean()) < 5.0
+    assert np.mean((pred - y) ** 2) < np.var(y)
+    # save/load parity
+    re = lgb.Booster.model_from_string(bst.model_to_string())
+    np.testing.assert_allclose(pred, re.predict(X), rtol=1e-5, atol=1e-5)
+
+
+def test_rf_mode(shifted_regression):
+    X, y = shifted_regression
+    rec = {}
+    train = lgb.Dataset(X[:1500], label=y[:1500])
+    valid = lgb.Dataset(X[1500:], label=y[1500:], reference=train)
+    bst = lgb.train(
+        {"objective": "regression", "boosting": "rf", "bagging_fraction": 0.7,
+         "bagging_freq": 1, "verbosity": -1, "metric": ["l2"]},
+        train, num_boost_round=20, valid_sets=[valid],
+        callbacks=[lgb.record_evaluation(rec)],
+    )
+    pred = bst.predict(X[1500:])
+    mse = np.mean((pred - y[1500:]) ** 2)
+    assert mse < np.var(y)  # beats predicting the mean... loosely
+    # eval-time metric must match predict-time metric (averaged margins)
+    assert abs(rec["valid_0"]["l2"][-1] - mse) < 0.2 * max(mse, 1.0)
+    # save/load roundtrip with average_output
+    re = lgb.Booster.model_from_string(bst.model_to_string())
+    assert re._gbdt.average_output
+    np.testing.assert_allclose(pred, re.predict(X[1500:]), rtol=1e-4, atol=1e-3)
+
+
+def test_goss_sampling():
+    rng = np.random.RandomState(1)
+    X = rng.randn(3000, 10)
+    y = ((X @ rng.randn(10)) > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "data_sample_strategy": "goss",
+         "top_rate": 0.2, "other_rate": 0.2, "verbosity": -1},
+        lgb.Dataset(X, label=y), num_boost_round=25,
+    )
+    assert roc_auc_score(y, bst.predict(X)) > 0.9
+
+
+def test_is_unbalance_weights_positives():
+    rng = np.random.RandomState(2)
+    n = 4000
+    X = rng.randn(n, 5)
+    y = ((X[:, 0] + rng.randn(n) * 2.0) > 1.8).astype(float)  # ~5% positives
+    p_plain = lgb.train(
+        {"objective": "binary", "verbosity": -1, "boost_from_average": False},
+        lgb.Dataset(X, label=y), 10).predict(X)
+    p_unbal = lgb.train(
+        {"objective": "binary", "is_unbalance": True, "verbosity": -1,
+         "boost_from_average": False},
+        lgb.Dataset(X, label=y), 10).predict(X)
+    # unbalanced weighting must raise predicted probabilities for positives
+    assert p_unbal[y > 0].mean() > p_plain[y > 0].mean() + 0.05
+
+
+def test_categorical_feature_does_not_crash():
+    rng = np.random.RandomState(3)
+    X = rng.randn(500, 3)
+    X[:, 0] = rng.randint(0, 8, 500)  # categorical codes
+    y = (X[:, 1] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "verbosity": -1},
+        lgb.Dataset(X, label=y, categorical_feature=[0]), 5,
+    )
+    p = bst.predict(X)
+    assert np.isfinite(p).all()
+
+
+def test_missing_type_none_nan_prediction_consistency():
+    """Rows with NaN at predict time on a feature that had no NaN in
+    training must follow the reference's NaN->0.0 convention on the device
+    path, matching the host Tree.predict."""
+    rng = np.random.RandomState(4)
+    X = rng.randn(2000, 4) + 5.0  # all positive-ish, no NaN
+    y = (X[:, 0] > 5.0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), 10)
+    X_test = X[:20].copy()
+    X_test[:, 0] = np.nan
+    dev = bst.predict(X_test, raw_score=True)
+    host = sum(t.predict(X_test) for t in bst._gbdt._trees_for_export(0, -1))
+    np.testing.assert_allclose(dev, host, rtol=1e-4, atol=1e-4)
+
+
+def test_cv_basic():
+    rng = np.random.RandomState(5)
+    X = rng.randn(600, 6)
+    y = ((X @ rng.randn(6)) > 0).astype(float)
+    res = lgb.cv({"objective": "binary", "metric": ["auc"], "verbosity": -1},
+                 lgb.Dataset(X, label=y), num_boost_round=5, nfold=3)
+    assert len(res["valid auc-mean"]) == 5
+    assert res["valid auc-mean"][-1] > 0.7
+
+
+def test_cv_ranking_groups():
+    rng = np.random.RandomState(6)
+    n_q, per_q = 40, 10
+    X = rng.randn(n_q * per_q, 5)
+    y = rng.randint(0, 3, n_q * per_q).astype(float)
+    g = np.full(n_q, per_q)
+    res = lgb.cv({"objective": "lambdarank", "metric": ["ndcg"], "eval_at": [3],
+                  "verbosity": -1, "min_data_in_leaf": 5},
+                 lgb.Dataset(X, label=y, group=g), num_boost_round=3, nfold=2,
+                 stratified=False)
+    assert len(res["valid ndcg@3-mean"]) == 3

@@ -1,0 +1,163 @@
+"""P0 exit gate: histogram + split search vs. hand-computed oracles
+(SURVEY.md §10.2 P0; reference semantics from feature_histogram.hpp)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.histogram import histogram_onehot_matmul, histogram_scatter
+from lightgbm_tpu.ops.split import SplitParams, find_best_split
+from lightgbm_tpu.ops.treegrow import grow_tree
+
+
+def _oracle_hist(bins, grad, hess, mask, num_bins):
+    n, f = bins.shape
+    out = np.zeros((f, num_bins, 3))
+    for i in range(n):
+        if not mask[i]:
+            continue
+        for j in range(f):
+            b = bins[i, j]
+            out[j, b, 0] += grad[i]
+            out[j, b, 1] += hess[i]
+            out[j, b, 2] += 1
+    return out
+
+
+@pytest.mark.parametrize("fn", [histogram_scatter, histogram_onehot_matmul])
+def test_histogram_matches_oracle(fn):
+    rng = np.random.RandomState(0)
+    n, f, b = 500, 4, 16
+    bins = rng.randint(0, b, size=(n, f)).astype(np.int32)
+    grad = rng.randn(n).astype(np.float32)
+    hess = rng.rand(n).astype(np.float32)
+    mask = (rng.rand(n) < 0.7).astype(np.float32)
+    hist = np.asarray(fn(jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(mask), b))
+    oracle = _oracle_hist(bins, grad, hess, mask, b)
+    np.testing.assert_allclose(hist, oracle, rtol=1e-4, atol=1e-4)
+
+
+def _oracle_best_split(hist, nbins, miss_bin, params: SplitParams):
+    """Brute-force best split over (feature, threshold, missing-dir)."""
+    f, b, _ = hist.shape
+
+    def gain(G, H):
+        tg = np.sign(G) * max(abs(G) - params.lambda_l1, 0.0)
+        return tg * tg / (H + params.lambda_l2 + 1e-15)
+
+    tot = hist[0].sum(axis=0)
+    best = (-1e30, -1, -1, False)
+    for j in range(f):
+        mb = miss_bin[j]
+        nb = nbins[j]
+        miss = hist[j, mb] if mb >= 0 else np.zeros(3)
+        last_nm = nb - 2 if mb >= 0 else nb - 1
+        for t in range(last_nm):
+            left = hist[j, : t + 1].sum(axis=0)
+            if mb >= 0 and mb <= t:
+                left = left - hist[j, mb]
+            for missing_left in (False, True):
+                l = left + (miss if missing_left else 0)
+                r = tot - l
+                if l[2] < params.min_data_in_leaf or r[2] < params.min_data_in_leaf:
+                    continue
+                if l[1] < params.min_sum_hessian_in_leaf or r[1] < params.min_sum_hessian_in_leaf:
+                    continue
+                g = gain(l[0], l[1]) + gain(r[0], r[1]) - gain(tot[0], tot[1])
+                if g > params.min_gain_to_split and g > best[0] + 1e-9:
+                    best = (g, j, t, missing_left)
+    return best
+
+
+def test_split_matches_oracle():
+    rng = np.random.RandomState(1)
+    f, b = 5, 12
+    hist = rng.randn(f, b, 3).astype(np.float32)
+    hist[..., 1] = np.abs(hist[..., 1])  # hessians positive
+    hist[..., 2] = rng.randint(1, 50, size=(f, b))
+    nbins = np.full(f, b, np.int32)
+    nbins[1] = 8  # ragged bin counts
+    miss_bin = np.full(f, -1, np.int32)
+    miss_bin[2] = b - 1
+    # zero out invalid bins for the ragged feature
+    hist[1, 8:] = 0.0
+    params = SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=0.0)
+    tot = hist[0].sum(axis=0)
+    # make totals consistent across features (hist of the same rows)
+    for j in range(1, f):
+        scale = tot / np.where(hist[j].sum(axis=0) == 0, 1, hist[j].sum(axis=0))
+        hist[j] *= scale[None, :]
+
+    s = find_best_split(
+        jnp.asarray(hist),
+        jnp.asarray(tot[0]),
+        jnp.asarray(tot[1]),
+        jnp.asarray(tot[2]),
+        jnp.asarray(nbins),
+        jnp.asarray(miss_bin),
+        params,
+    )
+    og, oj, ot, oml = _oracle_best_split(hist, nbins, miss_bin, params)
+    assert abs(float(s.gain) - og) < 1e-3 * max(1.0, abs(og))
+    assert int(s.feature) == oj
+    assert int(s.threshold_bin) == ot
+
+
+def test_grow_tree_single_split_oracle():
+    """One split on a tiny crafted dataset matches hand computation."""
+    # feature 0: clean separator; feature 1: noise
+    bins = np.array([[0, 1], [0, 0], [0, 1], [1, 0], [1, 1], [1, 0]], np.int32)
+    grad = np.array([1.0, 1.0, 1.0, -1.0, -1.0, -1.0], np.float32)
+    hess = np.ones(6, np.float32)
+    params = SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=0.0)
+    tree, leaf_id = grow_tree(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(6, bool), jnp.ones(6, jnp.float32), jnp.ones(2, bool),
+        jnp.asarray([2, 2], jnp.int32), jnp.asarray([-1, -1], jnp.int32),
+        num_leaves=2, num_bins=2, params=params,
+    )
+    assert int(tree.num_leaves) == 2
+    assert int(tree.split_feature[0]) == 0
+    assert int(tree.threshold_bin[0]) == 0
+    # leaf values: -G/H = -3/3 = -1 (left), +1 -> -(-3)/3 = 1 (right)
+    lv = np.asarray(tree.leaf_value)
+    np.testing.assert_allclose(sorted(lv[:2]), [-1.0, 1.0], atol=1e-6)
+    # gain oracle: G_L=3,H_L=3 ; G_R=-3,H_R=3 ; parent G=0 H=6
+    # gain = 9/3 + 9/3 - 0 = 6
+    np.testing.assert_allclose(float(tree.split_gain[0]), 6.0, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(leaf_id), [0, 0, 0, 1, 1, 1])
+
+
+def test_grow_tree_respects_min_data():
+    rng = np.random.RandomState(3)
+    n = 100
+    bins = rng.randint(0, 10, size=(n, 3)).astype(np.int32)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    params = SplitParams(min_data_in_leaf=20, min_sum_hessian_in_leaf=0.0)
+    tree, leaf_id = grow_tree(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, bool), jnp.ones(n, jnp.float32), jnp.ones(3, bool),
+        jnp.asarray([10, 10, 10], jnp.int32), jnp.asarray([-1, -1, -1], jnp.int32),
+        num_leaves=16, num_bins=10, params=params,
+    )
+    counts = np.asarray(tree.leaf_count)[: int(tree.num_leaves)]
+    assert (counts >= 20).all()
+
+
+def test_grow_tree_depth_cap():
+    rng = np.random.RandomState(4)
+    n = 512
+    bins = rng.randint(0, 16, size=(n, 4)).astype(np.int32)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    params = SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=0.0)
+    tree, _ = grow_tree(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, bool), jnp.ones(n, jnp.float32), jnp.ones(4, bool),
+        jnp.asarray([16] * 4, jnp.int32), jnp.asarray([-1] * 4, jnp.int32),
+        num_leaves=31, num_bins=16, max_depth=3, params=params,
+    )
+    depths = np.asarray(tree.leaf_depth)[: int(tree.num_leaves)]
+    assert depths.max() <= 3
+    assert int(tree.num_leaves) <= 8

@@ -1,0 +1,169 @@
+"""P1 exit gate: single-host GBDT end-to-end (SURVEY.md §10.2 P1).
+
+Modeled on the reference's test strategy: small real data + the real engine +
+tolerance asserts (reference: tests/python_package_test/test_engine.py).
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, make_regression
+from sklearn.metrics import roc_auc_score
+from sklearn.model_selection import train_test_split
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def breast_cancer_split():
+    X, y = load_breast_cancer(return_X_y=True)
+    return train_test_split(X, y, test_size=0.2, random_state=42)
+
+
+def test_binary_end_to_end(breast_cancer_split):
+    X_tr, X_te, y_tr, y_te = breast_cancer_split
+    train = lgb.Dataset(X_tr, label=y_tr)
+    params = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+              "verbosity": -1, "min_data_in_leaf": 5}
+    bst = lgb.train(params, train, num_boost_round=30)
+    pred = bst.predict(X_te)
+    assert pred.shape == (len(y_te),)
+    assert ((pred >= 0) & (pred <= 1)).all()
+    auc = roc_auc_score(y_te, pred)
+    assert auc > 0.98, auc
+
+
+def test_regression_end_to_end():
+    X, y = make_regression(n_samples=2000, n_features=10, noise=10.0, random_state=0)
+    X_tr, X_te = X[:1600], X[1600:]
+    y_tr, y_te = y[:1600], y[1600:]
+    train = lgb.Dataset(X_tr, label=y_tr)
+    bst = lgb.train({"objective": "regression", "verbosity": -1}, train, num_boost_round=50)
+    pred = bst.predict(X_te)
+    base = np.mean((y_te - y_tr.mean()) ** 2)
+    mse = np.mean((y_te - pred) ** 2)
+    assert mse < 0.25 * base, (mse, base)
+
+
+def test_train_score_matches_predict(breast_cancer_split):
+    """Training-time scores (leaf_id gather) must equal raw predict
+    (tree traversal on raw values) — the threshold-roundtrip contract."""
+    X_tr, _, y_tr, _ = breast_cancer_split
+    train = lgb.Dataset(X_tr, label=y_tr)
+    params = {"objective": "binary", "num_leaves": 31, "verbosity": -1}
+    bst = lgb.train(params, train, num_boost_round=10)
+    internal_score = np.asarray(bst._gbdt._score)
+    raw_pred = bst.predict(X_tr, raw_score=True)
+    np.testing.assert_allclose(internal_score, raw_pred, rtol=1e-4, atol=1e-4)
+
+
+def test_model_save_load_roundtrip(tmp_path, breast_cancer_split):
+    X_tr, X_te, y_tr, _ = breast_cancer_split
+    train = lgb.Dataset(X_tr, label=y_tr)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, train, num_boost_round=10)
+    path = tmp_path / "model.txt"
+    bst.save_model(str(path))
+    loaded = lgb.Booster(model_file=str(path))
+    np.testing.assert_allclose(
+        bst.predict(X_te, raw_score=True), loaded.predict(X_te, raw_score=True), rtol=1e-6
+    )
+    # string roundtrip too
+    s = bst.model_to_string()
+    loaded2 = lgb.Booster.model_from_string(s)
+    np.testing.assert_allclose(
+        bst.predict(X_te), loaded2.predict(X_te), rtol=1e-6
+    )
+
+
+def test_missing_values_learned_direction():
+    """NaN routing must be learned per split (reference: use_missing)."""
+    rng = np.random.RandomState(0)
+    n = 2000
+    x = rng.randn(n, 2)
+    y = (x[:, 0] > 0).astype(np.float64)
+    # make x0 missing for some positives -> missing should route right (positive)
+    miss = rng.rand(n) < 0.3
+    x[miss & (y > 0), 0] = np.nan
+    train = lgb.Dataset(x, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                    train, num_boost_round=20)
+    x_test = np.array([[np.nan, 0.0]])
+    p = bst.predict(x_test)
+    assert p[0] > 0.5
+
+
+def test_early_stopping(breast_cancer_split):
+    X_tr, X_te, y_tr, y_te = breast_cancer_split
+    train = lgb.Dataset(X_tr, label=y_tr)
+    valid = lgb.Dataset(X_te, label=y_te, reference=train)
+    bst = lgb.train(
+        {"objective": "binary", "metric": ["binary_logloss"], "verbosity": -1},
+        train, num_boost_round=200, valid_sets=[valid],
+        callbacks=[lgb.early_stopping(5, verbose=False)],
+    )
+    assert bst.best_iteration < 200
+    assert bst.best_score["valid_0"]["binary_logloss"] < 0.2
+
+
+def test_record_and_log_evaluation(breast_cancer_split):
+    X_tr, X_te, y_tr, y_te = breast_cancer_split
+    train = lgb.Dataset(X_tr, label=y_tr)
+    valid = lgb.Dataset(X_te, label=y_te, reference=train)
+    record = {}
+    bst = lgb.train(
+        {"objective": "binary", "metric": ["auc", "binary_logloss"], "verbosity": -1},
+        train, num_boost_round=10, valid_sets=[valid],
+        callbacks=[lgb.record_evaluation(record)],
+    )
+    assert "valid_0" in record
+    assert len(record["valid_0"]["auc"]) == 10
+    assert record["valid_0"]["auc"][-1] > 0.95
+
+
+def test_multiclass():
+    from sklearn.datasets import load_iris
+
+    X, y = load_iris(return_X_y=True)
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "multiclass", "num_class": 3, "verbosity": -1, "min_data_in_leaf": 5},
+        train, num_boost_round=20,
+    )
+    pred = bst.predict(X)
+    assert pred.shape == (len(y), 3)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-5)
+    acc = (np.argmax(pred, axis=1) == y).mean()
+    assert acc > 0.95
+
+
+def test_feature_importance(breast_cancer_split):
+    X_tr, _, y_tr, _ = breast_cancer_split
+    train = lgb.Dataset(X_tr, label=y_tr)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, train, num_boost_round=5)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.shape == (X_tr.shape[1],)
+    assert imp_split.sum() > 0
+    assert imp_gain.sum() > 0
+
+
+def test_bagging_and_feature_fraction(breast_cancer_split):
+    X_tr, X_te, y_tr, y_te = breast_cancer_split
+    train = lgb.Dataset(X_tr, label=y_tr)
+    bst = lgb.train(
+        {"objective": "binary", "bagging_fraction": 0.5, "bagging_freq": 1,
+         "feature_fraction": 0.5, "verbosity": -1},
+        train, num_boost_round=30,
+    )
+    auc = roc_auc_score(y_te, bst.predict(X_te))
+    assert auc > 0.97, auc
+
+
+def test_lambda_regularization_shrinks_outputs(breast_cancer_split):
+    X_tr, _, y_tr, _ = breast_cancer_split
+    train1 = lgb.Dataset(X_tr, label=y_tr)
+    train2 = lgb.Dataset(X_tr, label=y_tr)
+    b1 = lgb.train({"objective": "binary", "lambda_l2": 0.0, "verbosity": -1}, train1, 5)
+    b2 = lgb.train({"objective": "binary", "lambda_l2": 100.0, "verbosity": -1}, train2, 5)
+    lv1 = np.abs(np.concatenate([t.leaf_value for t in b1._gbdt.models[1:]]))
+    lv2 = np.abs(np.concatenate([t.leaf_value for t in b2._gbdt.models[1:]]))
+    assert lv2.mean() < lv1.mean()
