@@ -340,7 +340,7 @@ class Booster:
 
     # -- eval -------------------------------------------------------------
     def eval_train(self, feval=None):
-        return self._eval(0, "training", feval)
+        return self._eval(0, self._gbdt.train_name, feval)
 
     def eval_valid(self, feval=None):
         out = []

@@ -49,14 +49,15 @@ class BinMapper:
     def num_bins(self) -> int:
         """Total bins including the trailing missing bin if present."""
         n = len(self.upper_bounds) if not self.is_categorical else len(self.categories)
-        if self.missing_type == MISSING_NAN:
+        if self.missing_type != MISSING_NONE:
             n += 1
         return n
 
     @property
     def missing_bin(self) -> int:
-        """Index of the NaN bin, or -1."""
-        if self.missing_type == MISSING_NAN:
+        """Index of the missing bin (NaN bin, or the zero/NaN bin when
+        zero_as_missing), or -1 when the feature has no missing stream."""
+        if self.missing_type != MISSING_NONE:
             return self.num_bins - 1
         return -1
 
@@ -81,14 +82,15 @@ class BinMapper:
             if self.missing_type == MISSING_NAN:
                 out[np.isnan(values)] = self.missing_bin
             return out
-        vals = values
-        if self.missing_type == MISSING_ZERO:
-            vals = np.where(np.isnan(vals), 0.0, vals)
         # bin = first index with value <= upper_bounds[bin]
-        bins = np.searchsorted(self.upper_bounds, vals, side="left").astype(np.int32)
+        bins = np.searchsorted(self.upper_bounds, values, side="left").astype(np.int32)
         np.clip(bins, 0, len(self.upper_bounds) - 1, out=bins)
         if self.missing_type == MISSING_NAN:
             bins[np.isnan(values)] = self.missing_bin
+        elif self.missing_type == MISSING_ZERO:
+            # zero_as_missing: zeros AND NaNs share the missing bin (reference:
+            # MissingType::Zero routes both to the default bin)
+            bins[np.isnan(values) | (np.abs(values) <= _KZERO_THRESHOLD)] = self.missing_bin
         return bins
 
     def bin_to_threshold(self, bin_idx: int) -> float:

@@ -72,7 +72,7 @@ def train(
     for i, vs in enumerate(valid_sets):
         if vs is train_set:
             name = valid_names[i] if i < len(valid_names) else "training"
-            booster._gbdt.metrics_train_alias = name
+            booster._gbdt.train_name = name
             continue
         name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
         booster.add_valid(vs, name)

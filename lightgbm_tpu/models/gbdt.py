@@ -38,6 +38,18 @@ def _add_leaf_scores(score, leaf_value, leaf_id, shrinkage):
     return score + leaf_value[leaf_id] * shrinkage
 
 
+def _f32_threshold_upper(t: np.ndarray) -> np.ndarray:
+    """Round f64 thresholds UP to f32 so the device's f32 traversal keeps the
+    invariant `v <= t (f64)  =>  f32(v) <= t32`: rows left of the split stay
+    left.  (Plain nearest-rounding can err in both directions; the remaining
+    right-side window (t, t32] is below one f32 ulp — reference traverses in
+    double, include/LightGBM/tree.h NumericalDecision.)"""
+    t = np.asarray(t, np.float64)
+    t32 = t.astype(np.float32)
+    bump = t32.astype(np.float64) < t
+    return np.where(bump, np.nextafter(t32, np.float32(np.inf)), t32)
+
+
 class GBDT:
     """reference: class GBDT in src/boosting/gbdt.h."""
 
@@ -54,6 +66,7 @@ class GBDT:
         self.best_iteration = -1
         self.feature_names: List[str] = []
         self.metrics: List[Metric] = []
+        self.train_name = "training"  # overridable via valid_names (engine.py)
         self.valid_sets: List = []
         self.valid_names: List[str] = []
         self._valid_scores: List[jnp.ndarray] = []
@@ -319,7 +332,9 @@ class GBDT:
             tree = tree_from_device(arrays, self.binner)
             if tree.num_leaves > 1:
                 all_const = False
-            shrinkage = 1.0 if self.cfg.boosting == "rf" else self.cfg.learning_rate
+            # RF (average_output) takes unscaled deltas regardless of which
+            # alias ("rf"/"random_forest") selected the mode
+            shrinkage = 1.0 if self.average_output else self.cfg.learning_rate
             tree.apply_shrinkage(shrinkage)
             # Trees hold PURE deltas during training; the boost_from_average
             # init score lives in self.init_scores and is folded into tree 0
@@ -387,7 +402,7 @@ class GBDT:
         """data_idx 0 = training, 1.. = valid sets (reference: GBDT::GetEvalAt).
         Returns (dataset_name, metric_name, value, is_higher_better)."""
         if data_idx == 0:
-            ds, score, name = self.train_set, self._score, "training"
+            ds, score, name = self.train_set, self._score, self.train_name
         else:
             ds = self.valid_sets[data_idx - 1]
             score = self._valid_scores[data_idx - 1]
@@ -402,12 +417,13 @@ class GBDT:
         return out
 
     # ------------------------------------------------------------------
-    def _stacked(self, start: int = 0, num_iteration: int = -1):
-        trees = self.models
+    def _stacked(self, start: int = 0, num_iteration: int = -1, trees=None):
         k = self.num_tree_per_iteration
-        lo = start * k
-        hi = len(trees) if num_iteration < 0 else min((start + num_iteration) * k, len(trees))
-        trees = trees[lo:hi]
+        if trees is None:
+            trees = self.models
+            lo = start * k
+            hi = len(trees) if num_iteration < 0 else min((start + num_iteration) * k, len(trees))
+            trees = trees[lo:hi]
         if not trees:
             return None
         max_l = max(max((t.num_leaves for t in trees), default=1), 2)
@@ -423,7 +439,7 @@ class GBDT:
 
         return dict(
             split_feature=pad(lambda t: t.split_feature, np.int32, m),
-            threshold=pad(lambda t: t.threshold, np.float32, m),
+            threshold=pad(lambda t: _f32_threshold_upper(t.threshold), np.float32, m),
             default_left=pad(lambda t: t.default_left(), bool, m),
             missing_type=pad(
                 lambda t: (t.decision_type.astype(np.int32) >> 2) & 3, np.int32, m
@@ -438,12 +454,17 @@ class GBDT:
 
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0, num_iteration: int = -1) -> np.ndarray:
         """Raw margin prediction on raw feature values (device traversal).
-        Adds the boost_from_average init score (trees hold pure deltas)."""
-        s = self._stacked(start_iteration, num_iteration)
+
+        Uses the export representation — init score folded into the first
+        tree(s) per class — so an in-memory model and its .txt save/load
+        round-trip predict BIT-IDENTICALLY (the reference also folds:
+        Tree::AddBias)."""
+        trees = self._trees_for_export(start_iteration, num_iteration)
+        s = self._stacked(trees=trees)
         n = X.shape[0]
         k = self.num_tree_per_iteration
-        init = np.asarray(self.init_scores, dtype=np.float64)
         if s is None:
+            init = np.asarray(self.init_scores, dtype=np.float64)
             base = np.zeros((n, k), dtype=np.float64) + init[None, :]
             return base[:, 0] if k == 1 else base
         x = jnp.asarray(np.asarray(X, dtype=np.float32))
@@ -455,9 +476,9 @@ class GBDT:
                 s["missing_type"], s["left_child"], s["right_child"],
                 s["num_leaves"], s["leaf_value"],
             )
-            return np.asarray(out, dtype=np.float64) * scale + init[0]
+            return np.asarray(out, dtype=np.float64) * scale
         # multiclass: per-class sum over its trees
-        outs = np.zeros((n, k), dtype=np.float64) + init[None, :]
+        outs = np.zeros((n, k), dtype=np.float64)
         for c in range(k):
             sel = slice(c, s["T"], k)
             out = predict_ops.predict_raw_values(

@@ -211,7 +211,12 @@ class RegressionMAPE(Objective):
         return jnp.sign(score - label) * scale, scale
 
     def boost_from_score(self, label, weight):
-        return float(_weighted_quantile_np(np.asarray(label), None, 0.5))
+        # same 1/max(1,|label|)-scaled weights as the boosting rounds
+        # (reference: RegressionMAPELOSS::BoostFromScore weighted percentile)
+        lab = np.asarray(label, np.float64)
+        w = np.ones_like(lab) if weight is None else np.asarray(weight, np.float64)
+        w = w / np.maximum(1.0, np.abs(lab))
+        return float(_weighted_quantile_np(lab, w, 0.5))
 
     def renew_tree_output(self, leaf_pred, label, weight, score, leaf_id, num_leaves):
         w = self._w(weight, label) / jnp.maximum(1.0, jnp.abs(label))
@@ -325,6 +330,76 @@ class CrossEntropy(Objective):
 
     def convert_output(self, score):
         return 1.0 / (1.0 + jnp.exp(-score))
+
+
+class _RankingObjective(Objective):
+    """Shared per-query padding machinery (reference: RankingObjective in
+    rank_objective.hpp — per-query parallel gradient computation).  Queries
+    are laid out as a dense (Q, S) block padded to the longest query; masked
+    lanes contribute zeros (SURVEY.md §10.3 item 3)."""
+
+    def set_query(self, query_boundaries: np.ndarray, labels: np.ndarray):
+        self.query_boundaries = np.asarray(query_boundaries)
+        nq = len(self.query_boundaries) - 1
+        lens = np.diff(self.query_boundaries)
+        self.max_query = int(lens.max()) if nq else 0
+        pad_idx = np.zeros((nq, self.max_query), dtype=np.int64)
+        pad_mask = np.zeros((nq, self.max_query), dtype=bool)
+        for q in range(nq):
+            lo, hi = self.query_boundaries[q], self.query_boundaries[q + 1]
+            pad_idx[q, : hi - lo] = np.arange(lo, hi)
+            pad_mask[q, : hi - lo] = True
+        self._pad_idx = jnp.asarray(pad_idx)
+        self._pad_mask = jnp.asarray(pad_mask)
+
+
+class RankXENDCG(_RankingObjective):
+    """reference: RankXENDCGObjective in rank_xendcg_objective.hpp — the
+    listwise cross-entropy NDCG surrogate (Bruch 2020, "An Alternative Cross
+    Entropy Loss for Learning-to-Rank").
+
+    Per query: rho = softmax(scores); phi_i = 2^label_i − u_i with u_i ~
+    Uniform(0,1) resampled each iteration (objective_seed); then the
+    three-term gradient
+        l1_i = rho_i − phi_i / Σphi
+        l2_i = l1_i − rho_i · Σl1
+        λ_i  = l2_i − rho_i · Σl2,   h_i = rho_i (1 − rho_i).
+    """
+
+    name = "rank_xendcg"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self._iter = 0
+        self._seed = int(getattr(cfg, "objective_seed", 5))
+
+    def get_gradients(self, score, label, weight):
+        idx, msk = self._pad_idx, self._pad_mask
+        s = score[idx.reshape(-1)].reshape(idx.shape)
+        l = label[idx.reshape(-1)].reshape(idx.shape)
+        key = jax.random.PRNGKey(self._seed + self._iter)
+        self._iter += 1
+        u = jax.random.uniform(key, idx.shape, dtype=jnp.float32)
+        g, h = _xendcg_query(s, l, msk, u)
+        grad = jnp.zeros_like(score).at[idx.reshape(-1)].set(g.reshape(-1))
+        hess = jnp.zeros_like(score).at[idx.reshape(-1)].set(h.reshape(-1))
+        return grad, hess
+
+
+@jax.jit
+def _xendcg_query(scores, labels, mask, u):
+    """Vectorized XE-NDCG gradients over padded queries: (Q, S) in/out."""
+    neg_inf = jnp.float32(-1e30)
+    masked = jnp.where(mask, scores, neg_inf)
+    rho = jax.nn.softmax(masked, axis=1)
+    rho = jnp.where(mask, rho, 0.0)
+    phi = jnp.where(mask, jnp.exp2(labels.astype(jnp.float32)) - u, 0.0)
+    denom = jnp.maximum(jnp.sum(phi, axis=1, keepdims=True), 1e-20)
+    l1 = rho - phi / denom
+    l2 = l1 - rho * jnp.sum(l1, axis=1, keepdims=True)
+    lam = l2 - rho * jnp.sum(l2, axis=1, keepdims=True)
+    hess = rho * (1.0 - rho)
+    return jnp.where(mask, lam, 0.0), jnp.where(mask, hess, 0.0)
 
 
 class LambdarankNDCG(Objective):
@@ -499,6 +574,7 @@ _REGISTRY: Dict[str, Callable[[Config], Objective]] = {
     "cross_entropy": CrossEntropy,
     "cross_entropy_lambda": CrossEntropy,
     "lambdarank": LambdarankNDCG,
+    "rank_xendcg": RankXENDCG,
 }
 
 

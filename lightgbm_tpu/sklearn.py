@@ -307,9 +307,6 @@ class LGBMRegressor(RegressorMixin, LGBMModel):
 class LGBMClassifier(ClassifierMixin, LGBMModel):
     """reference: sklearn.py LGBMClassifier (LabelEncoder + predict_proba)."""
 
-    def _default_objective(self) -> str:
-        return "binary"
-
     def fit(self, X, y, **kwargs) -> "LGBMClassifier":
         y = np.asarray(y).ravel()
         self._le = LabelEncoder().fit(y)

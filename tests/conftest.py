@@ -6,26 +6,30 @@ sharding tests run against N virtual CPU devices via
 --xla_force_host_platform_device_count, no TPU required (SURVEY.md §5.3).
 
 The session environment may register a remote-TPU PJRT plugin at interpreter
-startup (sitecustomize), which cannot be undone in-process; when detected, the
-whole pytest process is re-exec'd once with a scrubbed environment so the
-suite runs hermetically on local CPU.
+startup (sitecustomize).  Registration is harmless as long as the backend is
+never *selected*: forcing ``jax_platforms=cpu`` before the first device query
+keeps the whole suite hermetic on local CPU.  (An os.execve re-exec is NOT an
+option here: pytest's fd-level capture is already active when conftest loads,
+so the re-exec'd process inherits redirected fds and its output is orphaned.)
 """
 
 import os
-import sys
 
-if os.environ.get("PALLAS_AXON_POOL_IPS") and not os.environ.get("_LGBM_TPU_TEST_REEXEC"):
-    env = dict(os.environ)
-    env["PALLAS_AXON_POOL_IPS"] = ""  # skip remote-TPU plugin registration
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-    env["_LGBM_TPU_TEST_REEXEC"] = "1"
-    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
-
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # backends already initialized: verified cpu below
+    pass
+
+# fail fast if the remote backend was selected anyway — a non-hermetic run
+# would otherwise surface as confusing library failures
+assert jax.default_backend() == "cpu", (
+    f"test suite must run on local CPU, got {jax.default_backend()!r}"
+)
