@@ -238,6 +238,11 @@ class Dataset:
         m = tree.num_internal
         if m == 0:
             return jnp.zeros((n,), jnp.int32)
+        if tree.num_cat > 0:
+            # categorical nodes need bin-subset membership — host walk
+            return jnp.asarray(
+                tree.predict_leaf_binned_batch(np.asarray(self.bins), self.binner)
+            )
         if tree.threshold_bin is None:
             # tree came from a model string: recover bin-space thresholds from
             # the real-valued ones (exact when thresholds are this binner's

@@ -122,20 +122,16 @@ class GBDT:
             min_gain_to_split=self.cfg.min_gain_to_split,
             max_delta_step=self.cfg.max_delta_step,
             path_smooth=self.cfg.path_smooth,
+            cat_l2=self.cfg.cat_l2,
+            cat_smooth=self.cfg.cat_smooth,
+            max_cat_threshold=self.cfg.max_cat_threshold,
+            max_cat_to_onehot=self.cfg.max_cat_to_onehot,
         )
-        # Categorical optimal splits (sorted many-vs-many, bitset thresholds)
-        # are not implemented yet; excluding categorical columns from split
-        # search beats producing numerically-bogus splits on frequency-ordered
-        # bins.  (P4 work: FindBestThresholdCategorical equivalent.)
         cat_mask = np.asarray(self.binner.categorical_mask)
-        self._allowed_features = jnp.asarray(~cat_mask)
-        if cat_mask.any():
-            from ..utils.log import log_warning
-
-            log_warning(
-                f"{int(cat_mask.sum())} categorical feature(s) excluded from "
-                "split search (categorical splits not yet implemented)"
-            )
+        self._allowed_features = jnp.ones(cat_mask.shape, dtype=bool)
+        # pass None when no categorical features so the all-numerical jit
+        # graph skips the categorical candidate evaluation entirely
+        self._categorical_mask = jnp.asarray(cat_mask) if cat_mask.any() else None
         # distributed tree learner over the device mesh (reference:
         # TreeLearner::CreateTreeLearner picking {serial,data,feature,voting})
         self._dp = None
@@ -165,6 +161,10 @@ class GBDT:
             min_gain_to_split=self.cfg.min_gain_to_split,
             max_delta_step=self.cfg.max_delta_step,
             path_smooth=self.cfg.path_smooth,
+            cat_l2=self.cfg.cat_l2,
+            cat_smooth=self.cfg.cat_smooth,
+            max_cat_threshold=self.cfg.max_cat_threshold,
+            max_cat_to_onehot=self.cfg.max_cat_to_onehot,
         )
 
     def add_valid(self, valid_set, name: str) -> None:
@@ -296,6 +296,7 @@ class GBDT:
                     dp.pad_rows(np.asarray(row_mask, bool) & True, fill=False),
                     dp.pad_rows(np.asarray(sample_weight, np.float32), fill=1.0),
                     feature_mask,
+                    self._categorical_mask,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -312,6 +313,7 @@ class GBDT:
                     feature_mask,
                     ts.num_bins_pf_device,
                     ts.missing_bin_pf_device,
+                    self._categorical_mask,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -467,6 +469,16 @@ class GBDT:
             init = np.asarray(self.init_scores, dtype=np.float64)
             base = np.zeros((n, k), dtype=np.float64) + init[None, :]
             return base[:, 0] if k == 1 else base
+        if any(t.num_cat > 0 for t in trees):
+            # categorical bitset decisions: vectorized host walk (the device
+            # traversal handles numerical nodes only for now)
+            Xh = np.asarray(X, dtype=np.float64)
+            n_per_class = max(len(trees) // k, 1)
+            scale = (1.0 / n_per_class) if self.average_output else 1.0
+            outs = np.zeros((n, k), dtype=np.float64)
+            for i, t in enumerate(trees):
+                outs[:, i % k] += t.predict_batch(Xh) * scale
+            return outs[:, 0] if k == 1 else outs
         x = jnp.asarray(np.asarray(X, dtype=np.float32))
         n_per_class = max(s["T"] // k, 1)
         scale = (1.0 / n_per_class) if self.average_output else 1.0
@@ -510,9 +522,11 @@ class GBDT:
         from .shap import tree_shap_ensemble
 
         k = self.num_tree_per_iteration
-        lo = start_iteration * k
-        hi = len(self.models) if num_iteration < 0 else min((start_iteration + num_iteration) * k, len(self.models))
-        return tree_shap_ensemble(self.models[lo:hi], np.asarray(X, np.float64), k)
+        # export trees fold the boost_from_average init into the first tree per
+        # class, so the SHAP bias column matches predictions (the constant
+        # shift lands in the expected value, not in feature attributions)
+        trees = self._trees_for_export(start_iteration, num_iteration)
+        return tree_shap_ensemble(trees, np.asarray(X, np.float64), k)
 
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:

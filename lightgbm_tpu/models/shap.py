@@ -83,12 +83,24 @@ def tree_shap_one(tree, x: np.ndarray, phi: np.ndarray) -> None:
 
     phi[-1] += _expected_value(tree)
 
+    is_cat = tree.is_categorical_node()
+    missing_type = (tree.decision_type.astype(np.int32) >> 2) & 3
+
     def decision(node):
+        """Same semantics as Tree.predict (incl. missing_type Zero routing)."""
         f = tree.split_feature[node]
         v = x[f]
-        if np.isnan(v):
-            return tree.left_child[node] if dl[node] else tree.right_child[node]
-        return tree.left_child[node] if v <= tree.threshold[node] else tree.right_child[node]
+        if is_cat[node]:
+            left = tree.cat_decision_left(node, v)
+        else:
+            mt = missing_type[node]
+            if np.isnan(v) and mt == 2:
+                left = dl[node]
+            elif mt == 1 and (np.isnan(v) or abs(v) <= 1e-35):
+                left = dl[node]
+            else:
+                left = (0.0 if np.isnan(v) else v) <= tree.threshold[node]
+        return tree.left_child[node] if left else tree.right_child[node]
 
     def recurse(node, path: List[_PathElement], parent_zero, parent_one, parent_idx):
         unique_depth = len(path)

@@ -45,7 +45,29 @@ class Tree:
     num_cat: int = 0
     cat_boundaries: np.ndarray = field(default_factory=lambda: np.zeros(1, np.int32))
     cat_threshold: np.ndarray = field(default_factory=lambda: np.zeros(0, np.uint32))
+    # runtime-only (not serialized): per-node bin-space left mask for binned
+    # replay of categorical nodes within the training session
+    cat_bin_masks: Optional[dict] = None
     is_linear: bool = False
+
+    def is_categorical_node(self) -> np.ndarray:
+        return (self.decision_type & K_CATEGORICAL_MASK) != 0
+
+    def cat_decision_left(self, node: int, value: float) -> bool:
+        """reference: Tree::CategoricalDecision — value in bitset -> left;
+        NaN / negative / not-found -> right."""
+        if np.isnan(value):
+            return False
+        iv = int(value)
+        if iv < 0:
+            return False
+        cat_idx = int(self.threshold[node])
+        lo = int(self.cat_boundaries[cat_idx])
+        hi = int(self.cat_boundaries[cat_idx + 1])
+        word = iv // 32
+        if word >= hi - lo:
+            return False
+        return bool((int(self.cat_threshold[lo + word]) >> (iv % 32)) & 1)
 
     @property
     def num_internal(self) -> int:
@@ -71,22 +93,134 @@ class Tree:
             out[:] = self.leaf_value[0] if len(self.leaf_value) else 0.0
             return out
         dl = self.default_left()
+        is_cat = self.is_categorical_node()
         missing_type = (self.decision_type.astype(np.int32) >> _MISSING_TYPE_SHIFT) & 3
         for i in range(n):
             node = 0
             while node >= 0:
                 f = self.split_feature[node]
                 v = x[i, f]
-                mt = missing_type[node]
-                if np.isnan(v) and mt == 2:
-                    left = dl[node]
-                elif mt == 1 and (np.isnan(v) or abs(v) <= 1e-35):
-                    left = dl[node]
+                if is_cat[node]:
+                    left = self.cat_decision_left(node, v)
                 else:
-                    vv = 0.0 if np.isnan(v) else v
-                    left = vv <= self.threshold[node]
+                    mt = missing_type[node]
+                    if np.isnan(v) and mt == 2:
+                        left = dl[node]
+                    elif mt == 1 and (np.isnan(v) or abs(v) <= 1e-35):
+                        left = dl[node]
+                    else:
+                        vv = 0.0 if np.isnan(v) else v
+                        left = vv <= self.threshold[node]
                 node = self.left_child[node] if left else self.right_child[node]
             out[i] = self.leaf_value[-node - 1]
+        return out
+
+    def predict_leaf_batch(self, x: np.ndarray) -> np.ndarray:
+        """Vectorized numpy walk over all rows at once (host fallback path for
+        categorical ensembles; the numerical hot path is ops/predict.py)."""
+        x = np.asarray(x, dtype=np.float64)
+        n = x.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        dl = self.default_left()
+        is_cat = self.is_categorical_node()
+        mt = (self.decision_type.astype(np.int32) >> _MISSING_TYPE_SHIFT) & 3
+        node = np.zeros(n, dtype=np.int64)
+        rows = np.arange(n)
+        for _ in range(2 * self.num_leaves):
+            active = node >= 0
+            if not active.any():
+                break
+            nd = np.where(active, node, 0)
+            f = self.split_feature[nd]
+            v = x[rows, f]
+            nanv = np.isnan(v)
+            use_default = ((mt[nd] == 2) & nanv) | (
+                (mt[nd] == 1) & (nanv | (np.abs(v) <= 1e-35))
+            )
+            veff = np.where(nanv, 0.0, v)
+            left = np.where(use_default, dl[nd], veff <= self.threshold[nd])
+            if is_cat.any():
+                iv = veff.astype(np.int64)
+                cat_idx = self.threshold[nd].astype(np.int64)
+                cat_idx = np.clip(cat_idx, 0, max(self.num_cat - 1, 0))
+                lo = self.cat_boundaries[cat_idx].astype(np.int64)
+                nw = self.cat_boundaries[cat_idx + 1].astype(np.int64) - lo
+                word = iv >> 5
+                in_range = (~nanv) & (iv >= 0) & (word < nw)
+                widx = lo + np.clip(word, 0, None)
+                widx = np.clip(widx, 0, max(len(self.cat_threshold) - 1, 0))
+                bits = (
+                    self.cat_threshold[widx].astype(np.int64)
+                    if len(self.cat_threshold)
+                    else np.zeros(n, np.int64)
+                )
+                left_cat = in_range & (((bits >> (iv & 31)) & 1) != 0)
+                left = np.where(is_cat[nd], left_cat, left)
+            nxt = np.where(left, self.left_child[nd], self.right_child[nd])
+            node = np.where(active, nxt, node)
+        return (-node - 1).astype(np.int32)
+
+    def predict_batch(self, x: np.ndarray) -> np.ndarray:
+        return self.leaf_value[self.predict_leaf_batch(x)]
+
+    def predict_leaf_binned_batch(self, bins: np.ndarray, binner) -> np.ndarray:
+        """Vectorized walk on BINNED data (host; handles categorical nodes via
+        bin-space masks).  Used for valid-score replay of categorical trees."""
+        bins = np.asarray(bins)
+        n = bins.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        m = self.num_internal
+        is_cat = self.is_categorical_node()
+        dl = self.default_left()
+        if self.threshold_bin is None:
+            tb = np.zeros(m, np.int32)
+            for i in range(m):
+                if is_cat[i]:
+                    continue
+                f = int(self.split_feature[i])
+                tb[i] = int(
+                    binner.mappers[f].transform(np.asarray([self.threshold[i]]))[0]
+                )
+            self.threshold_bin = tb
+        masks = self._bin_masks(binner) if is_cat.any() else None
+        missing_bin = binner.missing_bin_per_feature
+        node = np.zeros(n, dtype=np.int64)
+        rows = np.arange(n)
+        for _ in range(2 * self.num_leaves):
+            active = node >= 0
+            if not active.any():
+                break
+            nd = np.where(active, node, 0)
+            f = self.split_feature[nd]
+            v = bins[rows, f].astype(np.int64)
+            is_missing = v == missing_bin[f]
+            left = np.where(is_missing, dl[nd], v <= self.threshold_bin[nd])
+            if masks is not None:
+                left_cat = masks[nd, v]
+                left = np.where(is_cat[nd], left_cat, left)
+            nxt = np.where(left, self.left_child[nd], self.right_child[nd])
+            node = np.where(active, nxt, node)
+        return (-node - 1).astype(np.int32)
+
+    def _bin_masks(self, binner) -> np.ndarray:
+        """(M, B) bool left-masks per node in bin space; from cat_bin_masks if
+        in-session, else reconstructed from the value bitsets."""
+        m = self.num_internal
+        B = binner.max_num_bins
+        out = np.zeros((m, B), dtype=bool)
+        is_cat = self.is_categorical_node()
+        for i in range(m):
+            if not is_cat[i]:
+                continue
+            if self.cat_bin_masks is not None and i in self.cat_bin_masks:
+                mk = self.cat_bin_masks[i]
+                out[i, : len(mk)] = mk
+            else:
+                mapper = binner.mappers[int(self.split_feature[i])]
+                for b, cval in enumerate(mapper.categories):
+                    out[i, b] = self.cat_decision_left(i, float(cval))
         return out
 
     def predict_leaf(self, x: np.ndarray) -> np.ndarray:
@@ -96,12 +230,24 @@ class Tree:
         if self.num_leaves <= 1:
             return out
         dl = self.default_left()
+        is_cat = self.is_categorical_node()
+        missing_type = (self.decision_type.astype(np.int32) >> _MISSING_TYPE_SHIFT) & 3
         for i in range(n):
             node = 0
             while node >= 0:
                 f = self.split_feature[node]
                 v = x[i, f]
-                left = dl[node] if np.isnan(v) else (v <= self.threshold[node])
+                if is_cat[node]:
+                    left = self.cat_decision_left(node, v)
+                else:
+                    mt = missing_type[node]
+                    if np.isnan(v) and mt == 2:
+                        left = dl[node]
+                    elif mt == 1 and (np.isnan(v) or abs(v) <= 1e-35):
+                        left = dl[node]
+                    else:
+                        vv = 0.0 if np.isnan(v) else v
+                        left = vv <= self.threshold[node]
                 node = self.left_child[node] if left else self.right_child[node]
             out[i] = -node - 1
         return out
@@ -200,20 +346,59 @@ def tree_from_device(
     split_feature = np.asarray(arrays.split_feature)[:m].astype(np.int32)
     thr_bin = np.asarray(arrays.threshold_bin)[:m].astype(np.int32)
     dl = np.asarray(arrays.default_left)[:m]
+    node_is_cat = (
+        np.asarray(arrays.is_cat)[:m]
+        if getattr(arrays, "is_cat", None) is not None
+        else np.zeros(m, bool)
+    )
+    node_cat_mask = (
+        np.asarray(arrays.cat_mask)[:m] if node_is_cat.any() else None
+    )
 
     thresholds = np.zeros(m, dtype=np.float64)
     decision_type = np.zeros(m, dtype=np.uint8)
+    num_cat = 0
+    cat_boundaries = [0]
+    cat_words: list = []
+    cat_bin_masks = {} if node_is_cat.any() else None
     for i in range(m):
         f = int(split_feature[i])
         mapper = binner.mappers[f]
-        thresholds[i] = mapper.bin_to_threshold(int(thr_bin[i]))
         dt = 0
-        if dl[i]:
-            dt |= K_DEFAULT_LEFT_MASK
-        dt |= (mapper.missing_type & 3) << _MISSING_TYPE_SHIFT
+        if node_is_cat[i]:
+            # bin mask -> LightGBM value bitset (reference: Tree::SplitCategorical
+            # storing cat_boundaries_/cat_threshold_ over raw category values)
+            mask = node_cat_mask[i]
+            cat_bin_masks[i] = mask.copy()
+            values = mapper.categories[
+                np.flatnonzero(mask[: len(mapper.categories)])
+            ].astype(np.int64)
+            n_words = int(values.max() // 32 + 1) if len(values) else 1
+            words = np.zeros(n_words, dtype=np.uint32)
+            for v in values:
+                if v >= 0:
+                    words[v // 32] |= np.uint32(1) << np.uint32(v % 32)
+            thresholds[i] = float(num_cat)  # cat idx
+            cat_boundaries.append(cat_boundaries[-1] + n_words)
+            cat_words.append(words)
+            num_cat += 1
+            dt |= K_CATEGORICAL_MASK
+        else:
+            thresholds[i] = mapper.bin_to_threshold(int(thr_bin[i]))
+            if dl[i]:
+                dt |= K_DEFAULT_LEFT_MASK
+            dt |= (mapper.missing_type & 3) << _MISSING_TYPE_SHIFT
         decision_type[i] = dt
 
     return Tree(
+        num_cat=num_cat,
+        cat_boundaries=np.asarray(cat_boundaries, np.int32),
+        cat_threshold=(
+            np.concatenate(cat_words).astype(np.uint32)
+            if cat_words
+            else np.zeros(0, np.uint32)
+        ),
+        cat_bin_masks=cat_bin_masks,
         num_leaves=num_leaves,
         split_feature=split_feature,
         threshold=thresholds,

@@ -381,8 +381,10 @@ class RankXENDCG(_RankingObjective):
         self._iter += 1
         u = jax.random.uniform(key, idx.shape, dtype=jnp.float32)
         g, h = _xendcg_query(s, l, msk, u)
-        grad = jnp.zeros_like(score).at[idx.reshape(-1)].set(g.reshape(-1))
-        hess = jnp.zeros_like(score).at[idx.reshape(-1)].set(h.reshape(-1))
+        # .add, not .set: pad_idx's padding lanes all alias row 0 and carry
+        # masked-out zeros — a duplicate-index .set would zero row 0's grads
+        grad = jnp.zeros_like(score).at[idx.reshape(-1)].add(g.reshape(-1))
+        hess = jnp.zeros_like(score).at[idx.reshape(-1)].add(h.reshape(-1))
         return grad, hess
 
 
@@ -402,7 +404,7 @@ def _xendcg_query(scores, labels, mask, u):
     return jnp.where(mask, lam, 0.0), jnp.where(mask, hess, 0.0)
 
 
-class LambdarankNDCG(Objective):
+class LambdarankNDCG(_RankingObjective):
     """reference: LambdarankNDCG in rank_objective.hpp.
 
     Pairwise NDCG-weighted lambdas inside each query, truncated to
@@ -430,7 +432,7 @@ class LambdarankNDCG(Objective):
         inverse_max_dcgs_ in LambdarankNDCG::Init)."""
         from .metrics import dcg_at_k
 
-        self.query_boundaries = np.asarray(query_boundaries)
+        super().set_query(query_boundaries, labels)
         nq = len(self.query_boundaries) - 1
         inv = np.zeros(nq, dtype=np.float64)
         trunc = self.truncation
@@ -441,17 +443,6 @@ class LambdarankNDCG(Objective):
             m = dcg_at_k(ideal, min(len(ql), trunc), self.label_gain)
             inv[q] = 1.0 / m if m > 0 else 0.0
         self.inverse_max_dcg = inv
-        # padded query layout
-        lens = np.diff(self.query_boundaries)
-        self.max_query = int(lens.max()) if nq else 0
-        pad_idx = np.zeros((nq, self.max_query), dtype=np.int64)
-        pad_mask = np.zeros((nq, self.max_query), dtype=bool)
-        for q in range(nq):
-            lo, hi = self.query_boundaries[q], self.query_boundaries[q + 1]
-            pad_idx[q, : hi - lo] = np.arange(lo, hi)
-            pad_mask[q, : hi - lo] = True
-        self._pad_idx = jnp.asarray(pad_idx)
-        self._pad_mask = jnp.asarray(pad_mask)
 
     def get_gradients(self, score, label, weight):
         idx, msk = self._pad_idx, self._pad_mask
@@ -462,8 +453,10 @@ class LambdarankNDCG(Objective):
         g, h = _lambdarank_pairwise(
             s, l, msk, gains, inv_mdcg, self.sigmoid, self.truncation, self.norm
         )
-        grad = jnp.zeros_like(score).at[idx.reshape(-1)].set(g.reshape(-1))
-        hess = jnp.zeros_like(score).at[idx.reshape(-1)].set(h.reshape(-1))
+        # .add, not .set: pad_idx's padding lanes all alias row 0 and carry
+        # masked-out zeros — a duplicate-index .set would zero row 0's grads
+        grad = jnp.zeros_like(score).at[idx.reshape(-1)].add(g.reshape(-1))
+        hess = jnp.zeros_like(score).at[idx.reshape(-1)].add(h.reshape(-1))
         return grad, hess
 
 

@@ -37,16 +37,24 @@ class SplitParams(NamedTuple):
     min_gain_to_split: float = 0.0
     max_delta_step: float = 0.0
     path_smooth: float = 0.0
+    # categorical split params (reference: FindBestThresholdCategoricalInner)
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
 
 
 class BestSplit(NamedTuple):
     """Per-leaf best split description (reference: struct SplitInfo in
-    src/treelearner/split_info.hpp)."""
+    src/treelearner/split_info.hpp — incl. its cat_threshold bitset, here a
+    dense (B,) bool mask over bins that go LEFT)."""
 
     gain: jnp.ndarray  # f32
     feature: jnp.ndarray  # i32
     threshold_bin: jnp.ndarray  # i32 (bin <= threshold_bin -> left)
     default_left: jnp.ndarray  # bool (missing goes left)
+    is_cat: jnp.ndarray  # bool — categorical (bitmask) split
+    cat_mask: jnp.ndarray  # (B,) bool — bins going left (categorical only)
     left_sum_g: jnp.ndarray
     left_sum_h: jnp.ndarray
     left_count: jnp.ndarray
@@ -81,6 +89,16 @@ def leaf_gain(sum_g, sum_h, p: SplitParams):
     return tg * tg / denom
 
 
+def _gain_l2(sum_g, sum_h, l1, l2, max_delta_step):
+    """leaf_gain with explicit regularizers (categorical adds cat_l2)."""
+    tg = threshold_l1(sum_g, l1)
+    denom = sum_h + l2 + KEPSILON
+    if max_delta_step > 0:
+        out = jnp.clip(-tg / denom, -max_delta_step, max_delta_step)
+        return -(2.0 * tg * out + denom * out * out)
+    return tg * tg / denom
+
+
 def find_best_split(
     hist: jnp.ndarray,  # (F, B, 3) f32 — per-feature histograms for ONE leaf
     parent_sum_g: jnp.ndarray,
@@ -90,6 +108,7 @@ def find_best_split(
     missing_bin_per_feature: jnp.ndarray,  # (F,) i32; -1 if feature has no NaN bin
     params: SplitParams,
     feature_mask: jnp.ndarray | None = None,  # (F,) bool — col sampling / constraints
+    categorical_mask: jnp.ndarray | None = None,  # (F,) bool — categorical features
 ) -> BestSplit:
     """Evaluate every (feature, threshold, missing-direction) candidate.
 
@@ -147,6 +166,89 @@ def find_best_split(
     use_left = gain_l > gain_r
     gain = jnp.where(use_left, gain_l, gain_r)  # (F, B)
 
+    if categorical_mask is not None:
+        gain = jnp.where(categorical_mask[:, None], KMIN_SCORE, gain)
+
+    # ------------------------------------------------------------------
+    # Categorical candidates (reference: feature_histogram.hpp ->
+    # FindBestThresholdCategoricalInner).  Two families:
+    #   one-hot   (<= max_cat_to_onehot used bins): each bin alone vs rest;
+    #   many-vs-many: bins sorted by sum_g/(sum_h+cat_smooth), prefix of the
+    #     sorted order (scanned from both ends, bounded by max_cat_threshold)
+    #     goes left.  cat_l2 is added to lambda_l2 in the gain.
+    # The missing bin is excluded from left subsets (NaN/unseen -> right),
+    # matching Tree::CategoricalDecision's not-in-bitset => right.
+    # ------------------------------------------------------------------
+    if categorical_mask is not None:
+        l2c = params.lambda_l2 + params.cat_l2
+
+        def cgain(g_, h_):
+            return _gain_l2(g_, h_, params.lambda_l1, l2c, params.max_delta_step)
+
+        gain_parent_cat = cgain(parent_g, parent_h)
+        used = (hist_nm[..., 2] > 0) & ~is_missing_bin  # (F, B)
+        num_used = jnp.sum(used, axis=1)  # (F,)
+        ratio = jnp.where(
+            used,
+            hist_nm[..., 0] / (hist_nm[..., 1] + params.cat_smooth),
+            jnp.inf,
+        )
+
+        def cat_ok(l_c, r_c, l_h, r_h):
+            return (
+                (l_c >= params.min_data_in_leaf)
+                & (r_c >= params.min_data_in_leaf)
+                & (l_h >= params.min_sum_hessian_in_leaf)
+                & (r_h >= params.min_sum_hessian_in_leaf)
+            )
+
+        def eval_sorted(keys):
+            order = jnp.argsort(keys, axis=1)  # (F, B) bin ids, unused last
+            rank = jnp.argsort(order, axis=1)  # rank of each bin in the order
+            sh = jnp.take_along_axis(hist_nm, order[..., None], axis=1)
+            cum = jnp.cumsum(sh, axis=1)  # prefix stats; index k-1 = prefix len k
+            k_len = bins_idx[None, :] + 1  # (1, B) prefix length at index b
+            lg_, lh_, lc_ = cum[..., 0], cum[..., 1], cum[..., 2]
+            rg_, rh_, rc_ = parent_g - lg_, parent_h - lh_, parent_count - lc_
+            ok = (
+                (k_len <= params.max_cat_threshold)
+                & (k_len < num_used[:, None])
+                & cat_ok(lc_, rc_, lh_, rh_)
+            )
+            g_ = cgain(lg_, lh_) + cgain(rg_, rh_) - gain_parent_cat
+            g_ = jnp.where(ok & (g_ > params.min_gain_to_split), g_, KMIN_SCORE)
+            return g_, rank, (lg_, lh_, lc_)
+
+        gain_asc, rank_asc, st_asc = eval_sorted(ratio)
+        gain_desc, rank_desc, st_desc = eval_sorted(
+            jnp.where(used, -ratio, jnp.inf)
+        )
+        # one-hot: bin b alone goes left
+        oh_l = hist_nm  # (F, B, 3)
+        oh_ok = (
+            used
+            & cat_ok(
+                oh_l[..., 2], parent_count - oh_l[..., 2],
+                oh_l[..., 1], parent_h - oh_l[..., 1],
+            )
+        )
+        gain_oh = (
+            cgain(oh_l[..., 0], oh_l[..., 1])
+            + cgain(parent_g - oh_l[..., 0], parent_h - oh_l[..., 1])
+            - gain_parent_cat
+        )
+        gain_oh = jnp.where(oh_ok & (gain_oh > params.min_gain_to_split), gain_oh, KMIN_SCORE)
+
+        onehot_mode = (num_used <= params.max_cat_to_onehot)[:, None]  # (F, 1)
+        gain_mvm = jnp.maximum(gain_asc, gain_desc)
+        variant_mvm = jnp.where(gain_desc > gain_asc, 2, 1)
+        gain_cat = jnp.where(onehot_mode, gain_oh, gain_mvm)
+        variant = jnp.where(onehot_mode, 0, variant_mvm)  # (F, B)
+        cat_col = categorical_mask[:, None]
+        if feature_mask is not None:
+            cat_col = cat_col & feature_mask[:, None]
+        gain = jnp.where(cat_col, gain_cat, gain)
+
     flat = gain.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -160,12 +262,45 @@ def find_best_split(
     lg = pick(stats_l[0], stats_r[0])
     lh = pick(stats_l[1], stats_r[1])
     lc = pick(stats_l[2], stats_r[2])
+    best_is_cat = jnp.asarray(False)
+    best_cat_mask = jnp.zeros((b,), dtype=bool)
+
+    if categorical_mask is not None:
+        best_is_cat = categorical_mask[best_f]
+        v = variant.reshape(-1)[best]
+        mask_oh = bins_idx == best_t
+        mask_asc = rank_asc[best_f] <= best_t
+        mask_desc = rank_desc[best_f] <= best_t
+        best_cat_mask = jnp.where(
+            best_is_cat,
+            jnp.where(v == 0, mask_oh, jnp.where(v == 1, mask_asc, mask_desc)),
+            jnp.zeros((b,), bool),
+        )
+
+        def pick_cat():
+            stats = [
+                (oh_l[..., 0], oh_l[..., 1], oh_l[..., 2]),
+                st_asc,
+                st_desc,
+            ]
+            g_ = jnp.stack([s[0].reshape(-1)[best] for s in stats])[v]
+            h_ = jnp.stack([s[1].reshape(-1)[best] for s in stats])[v]
+            c_ = jnp.stack([s[2].reshape(-1)[best] for s in stats])[v]
+            return g_, h_, c_
+
+        cg, ch, cc = pick_cat()
+        lg = jnp.where(best_is_cat, cg, lg)
+        lh = jnp.where(best_is_cat, ch, lh)
+        lc = jnp.where(best_is_cat, cc, lc)
+        best_left = jnp.where(best_is_cat, False, best_left)
 
     return BestSplit(
         gain=best_gain,
         feature=best_f,
         threshold_bin=best_t,
         default_left=best_left,
+        is_cat=best_is_cat,
+        cat_mask=best_cat_mask,
         left_sum_g=lg,
         left_sum_h=lh,
         left_count=lc,

@@ -56,6 +56,8 @@ class TreeArrays(NamedTuple):
     leaf_count: jnp.ndarray  # (L,) f32
     leaf_sum_g: jnp.ndarray  # (L,) f32 (for quantized/renew paths)
     leaf_depth: jnp.ndarray  # (L,) i32
+    is_cat: jnp.ndarray  # (L-1,) bool — node is a categorical (bitset) split
+    cat_mask: jnp.ndarray  # (L-1, B) bool — bins going left at cat nodes
 
 
 class GrowState(NamedTuple):
@@ -72,7 +74,7 @@ class GrowState(NamedTuple):
     tree: TreeArrays
 
 
-def _empty_best(num_leaves: int) -> BestSplit:
+def _empty_best(num_leaves: int, num_bins: int) -> BestSplit:
     z = jnp.zeros((num_leaves,), dtype=jnp.float32)
     zi = jnp.zeros((num_leaves,), dtype=jnp.int32)
     return BestSplit(
@@ -80,6 +82,8 @@ def _empty_best(num_leaves: int) -> BestSplit:
         feature=zi,
         threshold_bin=zi,
         default_left=jnp.zeros((num_leaves,), dtype=bool),
+        is_cat=jnp.zeros((num_leaves,), dtype=bool),
+        cat_mask=jnp.zeros((num_leaves, num_bins), dtype=bool),
         left_sum_g=z,
         left_sum_h=z,
         left_count=z,
@@ -113,6 +117,7 @@ def grow_tree(
     feature_mask: jnp.ndarray,  # (F,) bool — feature_fraction selection
     num_bins_per_feature: jnp.ndarray,  # (F,) i32
     missing_bin_per_feature: jnp.ndarray,  # (F,) i32 (-1 = no missing bin)
+    categorical_mask: jnp.ndarray = None,  # (F,) bool — categorical features
     *,
     num_leaves: int,
     num_bins: int,
@@ -150,6 +155,7 @@ def grow_tree(
             missing_bin_per_feature,
             params,
             feature_mask=feature_mask,
+            categorical_mask=categorical_mask,
         )
         # depth cap (reference: max_depth check in BeforeFindBestSplit)
         if max_depth > 0:
@@ -178,13 +184,16 @@ def grow_tree(
         leaf_count=jnp.zeros((L,), jnp.float32),
         leaf_sum_g=jnp.zeros((L,), jnp.float32),
         leaf_depth=jnp.zeros((L,), jnp.int32),
+        is_cat=jnp.zeros((L - 1,), bool),
+        cat_mask=jnp.zeros((L - 1, num_bins), bool),
     )
 
     state = GrowState(
         leaf_id=jnp.zeros((n,), jnp.int32),
         hist=jnp.zeros((L, f, num_bins, 3), jnp.float32).at[0].set(hist0),
         best=_set_best(
-            _empty_best(L), jnp.asarray(0), best_for(hist0, g0, h0, c0, jnp.asarray(0))
+            _empty_best(L, num_bins), jnp.asarray(0),
+            best_for(hist0, g0, h0, c0, jnp.asarray(0)),
         ),
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
         leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(h0),
@@ -206,7 +215,10 @@ def grow_tree(
         # DataPartition::Split, but with no data movement) ---
         fcol = bins[:, s.feature]
         is_missing = fcol == missing_bin_per_feature[s.feature]
-        go_left = jnp.where(is_missing, s.default_left, fcol <= s.threshold_bin)
+        go_left_num = jnp.where(is_missing, s.default_left, fcol <= s.threshold_bin)
+        # categorical: bin in the winning subset -> left (missing/unseen bins
+        # are never in the subset, mirroring CategoricalDecision -> right)
+        go_left = jnp.where(s.is_cat, s.cat_mask[fcol], go_left_num)
         in_leaf = state.leaf_id == best_leaf
         leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_id)
 
@@ -253,6 +265,8 @@ def grow_tree(
             internal_value=t.internal_value.at[node].set(parent_out),
             internal_weight=t.internal_weight.at[node].set(state.leaf_sum_h[best_leaf]),
             internal_count=t.internal_count.at[node].set(state.leaf_count[best_leaf]),
+            is_cat=t.is_cat.at[node].set(s.is_cat),
+            cat_mask=t.cat_mask.at[node].set(s.cat_mask),
         )
 
         # --- update leaf aggregates ---

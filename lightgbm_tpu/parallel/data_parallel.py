@@ -75,6 +75,7 @@ def grow_tree_data_parallel(
     row_mask: jnp.ndarray,  # (Npad,) bool sharded — bagging AND validity
     sample_weight: jnp.ndarray,
     feature_mask: jnp.ndarray,  # (F,) replicated
+    categorical_mask: Optional[jnp.ndarray] = None,  # (F,) replicated
     *,
     num_leaves: int,
     num_bins: int,
@@ -110,7 +111,7 @@ def grow_tree_data_parallel(
                 P(),  # feature_mask
                 P(),  # num_bins_pf
                 P(),  # missing_bin_pf
-            ),
+            ) + ((P(),) if categorical_mask is not None else ()),
             out_specs=(
                 TreeArrays(*([P()] * len(TreeArrays._fields))),  # tree replicated
                 P(DATA_AXIS),  # leaf_id
@@ -118,9 +119,10 @@ def grow_tree_data_parallel(
             check_vma=False,
         )
     )
+    extra = (categorical_mask,) if categorical_mask is not None else ()
     return fn(
         sharded.bins, grad, hess, row_mask, sample_weight, feature_mask,
-        sharded.num_bins_pf, sharded.missing_bin_pf,
+        sharded.num_bins_pf, sharded.missing_bin_pf, *extra,
     )
 
 

@@ -1,0 +1,158 @@
+"""Categorical split tests.
+
+Mirrors the reference's categorical coverage in
+tests/python_package_test/test_engine.py (categorical round-trips, one-hot vs
+many-vs-many) plus a brute-force oracle for the sorted-subset search
+(reference: feature_histogram.hpp -> FindBestThresholdCategoricalInner).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_cat_regression(n=4000, n_cat=12, seed=0):
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, n_cat, n).astype(np.float64)
+    effect = rng.randn(n_cat) * 2.0
+    X = np.column_stack([cat, rng.randn(n), rng.randn(n)])
+    y = effect[cat.astype(int)] + 0.1 * rng.randn(n)
+    return X, y
+
+
+def test_categorical_regression_learns_signal():
+    X, y = _make_cat_regression()
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "min_data_in_leaf": 5,
+         "verbosity": -1, "learning_rate": 0.2},
+        train, num_boost_round=30,
+    )
+    pred = bst.predict(X)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    assert rmse < 0.3, rmse
+    # the ensemble must actually contain categorical (bitset) splits
+    assert any(t.num_cat > 0 for t in bst._gbdt.models)
+
+
+def test_categorical_save_load_bit_exact():
+    X, y = _make_cat_regression(seed=1)
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1},
+        train, num_boost_round=8,
+    )
+    pred = bst.predict(X)
+    bst2 = lgb.Booster.model_from_string(bst.model_to_string())
+    np.testing.assert_array_equal(pred, bst2.predict(X))
+
+
+def test_categorical_unseen_category_goes_right():
+    X, y = _make_cat_regression(seed=2)
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1},
+        train, num_boost_round=5,
+    )
+    X_unseen = X[:16].copy()
+    X_unseen[:, 0] = 999.0  # never-seen category
+    out = bst.predict(X_unseen)
+    assert np.all(np.isfinite(out))
+    # NaN categorical behaves like not-in-bitset (same traversal as unseen)
+    X_nan = X[:16].copy()
+    X_nan[:, 0] = np.nan
+    out_nan = bst.predict(X_nan)
+    assert np.all(np.isfinite(out_nan))
+
+
+def test_categorical_onehot_small_cardinality_oracle():
+    """With <= max_cat_to_onehot categories the split must be one-vs-rest and
+    match a brute-force oracle on the root split."""
+    rng = np.random.RandomState(3)
+    n = 2000
+    cat = rng.randint(0, 3, n).astype(np.float64)
+    y = np.where(cat == 1, 5.0, 0.0) + 0.01 * rng.randn(n)
+    X = cat[:, None]
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 2, "min_data_in_leaf": 1,
+         "verbosity": -1, "learning_rate": 1.0, "max_cat_to_onehot": 4,
+         "lambda_l2": 0.0, "cat_l2": 0.0, "cat_smooth": 0.0,
+         "boost_from_average": False},
+        train, num_boost_round=1,
+    )
+    tree = bst._gbdt.models[0]
+    assert tree.num_cat == 1
+    # the isolated side (one-hot left subset) must be exactly category 1
+    left = [c for c in range(3) if tree.cat_decision_left(0, float(c))]
+    assert left == [1], left
+
+
+def test_categorical_many_vs_many_oracle():
+    """Root split vs brute-force over all sorted-prefix subsets
+    (the reference's search space: prefixes of the g/(h+cat_smooth) order)."""
+    rng = np.random.RandomState(4)
+    n = 3000
+    k = 8
+    cat = rng.randint(0, k, n).astype(np.float64)
+    effect = np.array([3.0, -2.0, 1.0, 0.5, -1.0, 2.0, -3.0, 0.0])
+    y = effect[cat.astype(int)] + 0.01 * rng.randn(n)
+    X = cat[:, None]
+    cat_smooth = 10.0
+    params = {
+        "objective": "regression", "num_leaves": 2, "min_data_in_leaf": 1,
+        "verbosity": -1, "learning_rate": 1.0, "max_cat_to_onehot": 1,
+        "lambda_l2": 0.0, "cat_l2": 0.0, "cat_smooth": cat_smooth,
+        "min_sum_hessian_in_leaf": 0.0, "boost_from_average": False,
+    }
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(params, train, num_boost_round=1)
+    tree = bst._gbdt.models[0]
+    assert tree.num_cat == 1
+    chosen_left = frozenset(c for c in range(k) if tree.cat_decision_left(0, float(c)))
+
+    # oracle: L2 objective => grad = pred - y = -y at score 0, hess = 1
+    g = np.array([-(y[cat == c]).sum() for c in range(k)])
+    h = np.array([float((cat == c).sum()) for c in range(k)])
+    ratio = g / (h + cat_smooth)
+    best_gain, best_subset = -1.0, None
+    for order in (np.argsort(ratio), np.argsort(-ratio)):
+        for plen in range(1, k):
+            left = order[:plen]
+            lg, lh = g[left].sum(), h[left].sum()
+            rg, rh = g.sum() - lg, h.sum() - lh
+            gain = lg * lg / lh + rg * rg / rh - g.sum() ** 2 / h.sum()
+            if gain > best_gain:
+                best_gain, best_subset = gain, frozenset(int(c) for c in left)
+    # the chosen subset (or its complement — sides are symmetric) must match
+    assert chosen_left in (best_subset, frozenset(range(k)) - best_subset)
+
+
+def test_categorical_multiclass():
+    rng = np.random.RandomState(5)
+    n = 3000
+    cat = rng.randint(0, 6, n).astype(np.float64)
+    y = cat.astype(int) % 3
+    X = np.column_stack([cat, rng.randn(n)])
+    train = lgb.Dataset(X, label=y.astype(np.float64), categorical_feature=[0])
+    bst = lgb.train(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+         "min_data_in_leaf": 5, "verbosity": -1},
+        train, num_boost_round=10,
+    )
+    pred = bst.predict(X)
+    acc = float((pred.argmax(axis=1) == y).mean())
+    assert acc > 0.95, acc
+
+
+def test_categorical_shap_sums_to_prediction():
+    X, y = _make_cat_regression(n=500, seed=6)
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 7, "verbosity": -1},
+        train, num_boost_round=4,
+    )
+    contrib = bst.predict(X[:32], pred_contrib=True)
+    pred = bst.predict(X[:32])
+    np.testing.assert_allclose(contrib.sum(axis=1), pred, rtol=1e-5, atol=1e-5)

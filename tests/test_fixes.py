@@ -105,3 +105,27 @@ def test_f32_threshold_round_up():
     assert t32.dtype == np.float32
     assert np.all(t32.astype(np.float64) >= t)
     assert t32[2] == np.float32(2.5)
+
+
+def test_ranking_variable_query_lengths_row0_gradient():
+    """Regression: padded-query scatter used .set with duplicate index 0 —
+    any ragged query layout silently zeroed document 0's grad/hess."""
+    import numpy as np
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.objectives import LambdarankNDCG, RankXENDCG
+
+    group = np.array([3, 5, 2])
+    qb = np.concatenate([[0], np.cumsum(group)])
+    n = int(qb[-1])
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 3, n).astype(np.float64)
+    score = jnp.asarray(rng.randn(n), jnp.float32)
+    for cls in (LambdarankNDCG, RankXENDCG):
+        obj = cls(Config(objective="lambdarank"))
+        obj.set_query(qb, labels)
+        g, h = obj.get_gradients(score, jnp.asarray(labels, jnp.float32), None)
+        g, h = np.asarray(g), np.asarray(h)
+        assert np.all(np.isfinite(g)) and np.all(np.isfinite(h))
+        # row 0 belongs to a non-degenerate query: its hessian must be > 0
+        assert h[0] > 0, (cls.__name__, h[:5])
