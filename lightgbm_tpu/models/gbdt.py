@@ -126,12 +126,39 @@ class GBDT:
             cat_smooth=self.cfg.cat_smooth,
             max_cat_threshold=self.cfg.max_cat_threshold,
             max_cat_to_onehot=self.cfg.max_cat_to_onehot,
+            feature_fraction_bynode=self.cfg.feature_fraction_bynode,
+            extra_trees=bool(self.cfg.extra_trees),
         )
         cat_mask = np.asarray(self.binner.categorical_mask)
         self._allowed_features = jnp.ones(cat_mask.shape, dtype=bool)
         # pass None when no categorical features so the all-numerical jit
         # graph skips the categorical candidate evaluation entirely
         self._categorical_mask = jnp.asarray(cat_mask) if cat_mask.any() else None
+        # monotone constraints (reference: monotone_constraints.hpp, "basic")
+        f = train_set.num_feature()
+        mc = list(self.cfg.monotone_constraints or [])
+        if mc and any(int(c) != 0 for c in mc):
+            mc = (mc + [0] * f)[:f]
+            self._monotone = jnp.asarray(np.asarray(mc, np.int32))
+        else:
+            self._monotone = None
+        # interaction constraints (reference: config interaction_constraints
+        # parsed into index sets; col_sampler.hpp filters per-leaf)
+        sets = _parse_interaction_constraints(
+            self.cfg.interaction_constraints, self.feature_names
+        )
+        if sets:
+            mat = np.zeros((len(sets), f), dtype=bool)
+            for i, st in enumerate(sets):
+                for j in st:
+                    if 0 <= j < f:
+                        mat[i, j] = True
+            self._interaction_sets = jnp.asarray(mat)
+        else:
+            self._interaction_sets = None
+        self._needs_node_rng = bool(
+            self.cfg.extra_trees or self.cfg.feature_fraction_bynode < 1.0
+        )
         # distributed tree learner over the device mesh (reference:
         # TreeLearner::CreateTreeLearner picking {serial,data,feature,voting})
         self._dp = None
@@ -165,6 +192,8 @@ class GBDT:
             cat_smooth=self.cfg.cat_smooth,
             max_cat_threshold=self.cfg.max_cat_threshold,
             max_cat_to_onehot=self.cfg.max_cat_to_onehot,
+            feature_fraction_bynode=self.cfg.feature_fraction_bynode,
+            extra_trees=bool(self.cfg.extra_trees),
         )
 
     def add_valid(self, valid_set, name: str) -> None:
@@ -297,6 +326,10 @@ class GBDT:
                     dp.pad_rows(np.asarray(sample_weight, np.float32), fill=1.0),
                     feature_mask,
                     self._categorical_mask,
+                    self._monotone,
+                    self._interaction_sets,
+                    (jax.random.PRNGKey(self.cfg.extra_seed + self.iter_ * 131 + c)
+                     if self._needs_node_rng else None),
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -304,6 +337,10 @@ class GBDT:
                 )
                 leaf_id = leaf_id_pad[: ts.num_data()]
             else:
+                node_key = (
+                    jax.random.PRNGKey(self.cfg.extra_seed + self.iter_ * 131 + c)
+                    if self._needs_node_rng else None
+                )
                 arrays, leaf_id = grow_tree(
                     ts.bins_device,
                     gc,
@@ -314,6 +351,9 @@ class GBDT:
                     ts.num_bins_pf_device,
                     ts.missing_bin_pf_device,
                     self._categorical_mask,
+                    self._monotone,
+                    self._interaction_sets,
+                    node_key,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -771,3 +811,31 @@ def create_boosting(cfg: Config, train_set=None) -> GBDT:
     if name in ("rf", "random_forest"):
         return RF(cfg, train_set)
     raise ValueError(f"Unknown boosting type: {name}")
+
+def _parse_interaction_constraints(spec, feature_names):
+    """Parse interaction_constraints: "[0,1,2],[2,3]" or list of lists of
+    feature indices/names (reference: Config interaction_constraints string)."""
+    if not spec:
+        return []
+    if isinstance(spec, str):
+        import re
+
+        groups = re.findall(r"\[([^\]]*)\]", spec)
+        sets = []
+        for g in groups:
+            items = [t.strip() for t in g.split(",") if t.strip()]
+            sets.append(items)
+    else:
+        sets = [list(g) for g in spec]
+    out = []
+    name_to_idx = {n: i for i, n in enumerate(feature_names or [])}
+    for g in sets:
+        idxs = []
+        for it in g:
+            if isinstance(it, str) and not it.lstrip("-").isdigit():
+                if it in name_to_idx:
+                    idxs.append(name_to_idx[it])
+            else:
+                idxs.append(int(it))
+        out.append(idxs)
+    return out

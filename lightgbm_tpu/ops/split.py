@@ -42,6 +42,9 @@ class SplitParams(NamedTuple):
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
+    # node-level sampling (reference: ColSampler bynode / extra_trees)
+    feature_fraction_bynode: float = 1.0
+    extra_trees: bool = False
 
 
 class BestSplit(NamedTuple):
@@ -109,6 +112,10 @@ def find_best_split(
     params: SplitParams,
     feature_mask: jnp.ndarray | None = None,  # (F,) bool — col sampling / constraints
     categorical_mask: jnp.ndarray | None = None,  # (F,) bool — categorical features
+    monotone_constraints: jnp.ndarray | None = None,  # (F,) i32 in {-1,0,1}
+    out_lo: jnp.ndarray | None = None,  # scalar — leaf output lower bound
+    out_hi: jnp.ndarray | None = None,  # scalar — leaf output upper bound
+    rng_key: jnp.ndarray | None = None,  # per-node key (extra_trees / bynode)
 ) -> BestSplit:
     """Evaluate every (feature, threshold, missing-direction) candidate.
 
@@ -131,7 +138,22 @@ def find_best_split(
     # candidate validity: threshold t splits between bin t and t+1; the last
     # non-missing bin cannot be a threshold.
     last_nm_bin = num_bins_per_feature - jnp.where(has_missing, 2, 1)  # index of last non-missing bin
+
+    # node-level feature sampling (reference: ColSampler::GetByNode) and
+    # extra_trees' single random threshold per feature (ExtraTreeLearner-like
+    # mode folded into the scan by masking candidates)
+    if rng_key is not None:
+        k_bynode, k_extra = jax.random.split(rng_key)
+        if params.feature_fraction_bynode < 1.0:
+            keep = jax.random.uniform(k_bynode, (f,)) < params.feature_fraction_bynode
+            feature_mask = keep if feature_mask is None else (feature_mask & keep)
+
     valid_thr = bins_idx[None, :] < last_nm_bin[:, None]  # (F, B)
+    if rng_key is not None and params.extra_trees:
+        rbin = jnp.floor(
+            jax.random.uniform(k_extra, (f,)) * jnp.maximum(last_nm_bin, 1)
+        ).astype(jnp.int32)
+        valid_thr = valid_thr & (bins_idx[None, :] == rbin[:, None])
     if feature_mask is not None:
         valid_thr = valid_thr & feature_mask[:, None]
 
@@ -154,7 +176,27 @@ def find_best_split(
             & (left_h >= params.min_sum_hessian_in_leaf)
             & (right_h >= params.min_sum_hessian_in_leaf)
         )
-        g = leaf_gain(left_g, left_h, params) + leaf_gain(right_g, right_h, params) - gain_parent
+        if monotone_constraints is None:
+            g = leaf_gain(left_g, left_h, params) + leaf_gain(right_g, right_h, params) - gain_parent
+        else:
+            # basic monotone method (reference: monotone_constraints.hpp ->
+            # BasicLeafConstraints): outputs clipped to the leaf's inherited
+            # [out_lo, out_hi] band, gain evaluated at the clipped outputs
+            # (GetSplitGainGivenOutput) and ordering violations rejected.
+            lo = jnp.float32(-jnp.inf) if out_lo is None else out_lo
+            hi = jnp.float32(jnp.inf) if out_hi is None else out_hi
+            out_l = jnp.clip(leaf_output(left_g, left_h, params), lo, hi)
+            out_r = jnp.clip(leaf_output(right_g, right_h, params), lo, hi)
+
+            def given(g_, h_, out):
+                tg = threshold_l1(g_, params.lambda_l1)
+                denom = h_ + params.lambda_l2 + KEPSILON
+                return -(2.0 * tg * out + denom * out * out)
+
+            g = given(left_g, left_h, out_l) + given(right_g, right_h, out_r) - gain_parent
+            mono = monotone_constraints[:, None]
+            viol = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
+            ok = ok & ~viol
         g = jnp.where(ok & (g > params.min_gain_to_split), g, KMIN_SCORE)
         return g, (left_g, left_h, left_c)
 

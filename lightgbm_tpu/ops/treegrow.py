@@ -71,6 +71,9 @@ class GrowState(NamedTuple):
     leaf_parent: jnp.ndarray  # (L,) i32 node the leaf hangs from (-1 for root)
     leaf_side: jnp.ndarray  # (L,) i32 0=left 1=right
     num_leaves_cur: jnp.ndarray  # i32
+    leaf_out_lo: jnp.ndarray  # (L,) f32 — monotone output lower bounds
+    leaf_out_hi: jnp.ndarray  # (L,) f32 — monotone output upper bounds
+    used_features: jnp.ndarray  # (L, F) bool or () — path features (interaction constraints)
     tree: TreeArrays
 
 
@@ -118,6 +121,9 @@ def grow_tree(
     num_bins_per_feature: jnp.ndarray,  # (F,) i32
     missing_bin_per_feature: jnp.ndarray,  # (F,) i32 (-1 = no missing bin)
     categorical_mask: jnp.ndarray = None,  # (F,) bool — categorical features
+    monotone_constraints: jnp.ndarray = None,  # (F,) i32 in {-1,0,1}
+    interaction_sets: jnp.ndarray = None,  # (S, F) bool — allowed feature sets
+    rng_key: jnp.ndarray = None,  # base PRNG key (extra_trees / bynode)
     *,
     num_leaves: int,
     num_bins: int,
@@ -145,7 +151,21 @@ def grow_tree(
         h = histogram(bins, grad, hess, mask, num_bins, strategy=hist_strategy)
         return psum(h)
 
-    def best_for(hist_leaf, sum_g, sum_h, count, depth):
+    def allowed_from_used(used):
+        """Features allowed at a leaf = union of interaction sets containing
+        ALL features already used on the leaf's path (reference:
+        col_sampler.hpp interaction-constraint filtering)."""
+        ok_s = ~jnp.any(used[None, :] & ~interaction_sets, axis=1)  # (S,)
+        return jnp.any(interaction_sets & ok_s[:, None], axis=0)  # (F,)
+
+    def best_for(hist_leaf, sum_g, sum_h, count, depth, out_lo=None, out_hi=None,
+                 used=None, node_id=None):
+        fmask = feature_mask
+        if interaction_sets is not None and used is not None:
+            fmask = fmask & allowed_from_used(used) if fmask is not None else allowed_from_used(used)
+        key = None
+        if rng_key is not None and node_id is not None:
+            key = jax.random.fold_in(rng_key, node_id)
         s = find_best_split(
             hist_leaf,
             sum_g,
@@ -154,8 +174,12 @@ def grow_tree(
             num_bins_per_feature,
             missing_bin_per_feature,
             params,
-            feature_mask=feature_mask,
+            feature_mask=fmask,
             categorical_mask=categorical_mask,
+            monotone_constraints=monotone_constraints,
+            out_lo=out_lo,
+            out_hi=out_hi,
+            rng_key=key,
         )
         # depth cap (reference: max_depth check in BeforeFindBestSplit)
         if max_depth > 0:
@@ -193,7 +217,12 @@ def grow_tree(
         hist=jnp.zeros((L, f, num_bins, 3), jnp.float32).at[0].set(hist0),
         best=_set_best(
             _empty_best(L, num_bins), jnp.asarray(0),
-            best_for(hist0, g0, h0, c0, jnp.asarray(0)),
+            best_for(
+                hist0, g0, h0, c0, jnp.asarray(0),
+                out_lo=jnp.float32(-jnp.inf), out_hi=jnp.float32(jnp.inf),
+                used=(jnp.zeros((f,), bool) if interaction_sets is not None else None),
+                node_id=jnp.asarray(0, jnp.int32),
+            ),
         ),
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
         leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(h0),
@@ -202,6 +231,11 @@ def grow_tree(
         leaf_parent=jnp.full((L,), -1, jnp.int32),
         leaf_side=jnp.zeros((L,), jnp.int32),
         num_leaves_cur=jnp.asarray(1, jnp.int32),
+        leaf_out_lo=jnp.full((L,), -jnp.inf, jnp.float32),
+        leaf_out_hi=jnp.full((L,), jnp.inf, jnp.float32),
+        used_features=(
+            jnp.zeros((L, f), bool) if interaction_sets is not None else jnp.zeros((), bool)
+        ),
         tree=tree0,
     )
 
@@ -277,9 +311,40 @@ def grow_tree(
         leaf_parent = state.leaf_parent.at[best_leaf].set(node).at[new_leaf].set(node)
         leaf_side = state.leaf_side.at[best_leaf].set(0).at[new_leaf].set(1)
 
+        # --- monotone bounds for the children (reference:
+        # BasicLeafConstraints::SetChildrenConstraints — after a split on a
+        # monotone feature the children's outputs are fenced at the midpoint
+        # of the two clipped outputs; non-monotone splits inherit bounds) ---
+        p_lo = state.leaf_out_lo[best_leaf]
+        p_hi = state.leaf_out_hi[best_leaf]
+        if monotone_constraints is not None:
+            mono_c = monotone_constraints[s.feature]
+            out_l = jnp.clip(leaf_output(s.left_sum_g, s.left_sum_h, params), p_lo, p_hi)
+            out_r = jnp.clip(leaf_output(s.right_sum_g, s.right_sum_h, params), p_lo, p_hi)
+            mid = 0.5 * (out_l + out_r)
+            l_hi = jnp.where(mono_c > 0, jnp.minimum(p_hi, mid), p_hi)
+            r_lo = jnp.where(mono_c > 0, jnp.maximum(p_lo, mid), p_lo)
+            l_lo = jnp.where(mono_c < 0, jnp.maximum(p_lo, mid), p_lo)
+            r_hi = jnp.where(mono_c < 0, jnp.minimum(p_hi, mid), p_hi)
+        else:
+            l_lo, l_hi, r_lo, r_hi = p_lo, p_hi, p_lo, p_hi
+        leaf_out_lo = state.leaf_out_lo.at[best_leaf].set(l_lo).at[new_leaf].set(r_lo)
+        leaf_out_hi = state.leaf_out_hi.at[best_leaf].set(l_hi).at[new_leaf].set(r_hi)
+
+        if interaction_sets is not None:
+            used_child = state.used_features[best_leaf].at[s.feature].set(True)
+            used_features = (
+                state.used_features.at[best_leaf].set(used_child).at[new_leaf].set(used_child)
+            )
+        else:
+            used_features = state.used_features
+            used_child = None
+
         # --- best splits for the two fresh leaves ---
-        bl = best_for(hist_left, s.left_sum_g, s.left_sum_h, s.left_count, depth_child)
-        br = best_for(hist_right, s.right_sum_g, s.right_sum_h, s.right_count, depth_child)
+        bl = best_for(hist_left, s.left_sum_g, s.left_sum_h, s.left_count, depth_child,
+                      out_lo=l_lo, out_hi=l_hi, used=used_child, node_id=2 * node + 1)
+        br = best_for(hist_right, s.right_sum_g, s.right_sum_h, s.right_count, depth_child,
+                      out_lo=r_lo, out_hi=r_hi, used=used_child, node_id=2 * node + 2)
         best = _set_best(_set_best(state.best, best_leaf, bl), new_leaf, br)
 
         return GrowState(
@@ -293,6 +358,9 @@ def grow_tree(
             leaf_parent=leaf_parent,
             leaf_side=leaf_side,
             num_leaves_cur=state.num_leaves_cur + 1,
+            leaf_out_lo=leaf_out_lo,
+            leaf_out_hi=leaf_out_hi,
+            used_features=used_features,
             tree=tree,
         )
 
@@ -305,6 +373,8 @@ def grow_tree(
     # finalize leaf values (reference: leaf outputs are computed during growth;
     # equivalent here since sums are exact)
     leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
+    if monotone_constraints is not None:
+        leaf_value = jnp.clip(leaf_value, state.leaf_out_lo, state.leaf_out_hi)
     active = jnp.arange(L, dtype=jnp.int32) < state.num_leaves_cur
     tree = state.tree._replace(
         num_leaves=state.num_leaves_cur,

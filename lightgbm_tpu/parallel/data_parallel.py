@@ -76,6 +76,10 @@ def grow_tree_data_parallel(
     sample_weight: jnp.ndarray,
     feature_mask: jnp.ndarray,  # (F,) replicated
     categorical_mask: Optional[jnp.ndarray] = None,  # (F,) replicated
+    monotone_constraints: Optional[jnp.ndarray] = None,  # (F,) replicated
+    interaction_sets: Optional[jnp.ndarray] = None,  # (S, F) replicated
+    rng_key: Optional[jnp.ndarray] = None,  # replicated — identical per-node
+    # sampling on every shard keeps the SPMD trees in lockstep
     *,
     num_leaves: int,
     num_bins: int,
@@ -89,18 +93,30 @@ def grow_tree_data_parallel(
     §4.4) with psum in place of ReduceScatter/Allreduce.
     """
     mesh = sharded.mesh
+    opt = {
+        "categorical_mask": categorical_mask,
+        "monotone_constraints": monotone_constraints,
+        "interaction_sets": interaction_sets,
+        "rng_key": rng_key,
+    }
+    extra_names = [k for k, v in opt.items() if v is not None]
+    extra_vals = tuple(opt[k] for k in extra_names)
+
+    def wrapped(bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_, *extras):
+        return grow_tree(
+            bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_,
+            **dict(zip(extra_names, extras)),
+            num_leaves=num_leaves,
+            num_bins=num_bins,
+            max_depth=max_depth,
+            params=params,
+            hist_strategy=hist_strategy,
+            axis_name=DATA_AXIS,
+        )
 
     fn = jax.jit(
         jax.shard_map(
-            functools.partial(
-                grow_tree,
-                num_leaves=num_leaves,
-                num_bins=num_bins,
-                max_depth=max_depth,
-                params=params,
-                hist_strategy=hist_strategy,
-                axis_name=DATA_AXIS,
-            ),
+            wrapped,
             mesh=mesh,
             in_specs=(
                 P(DATA_AXIS),  # bins
@@ -111,7 +127,7 @@ def grow_tree_data_parallel(
                 P(),  # feature_mask
                 P(),  # num_bins_pf
                 P(),  # missing_bin_pf
-            ) + ((P(),) if categorical_mask is not None else ()),
+            ) + tuple(P() for _ in extra_vals),  # replicated optional extras
             out_specs=(
                 TreeArrays(*([P()] * len(TreeArrays._fields))),  # tree replicated
                 P(DATA_AXIS),  # leaf_id
@@ -119,10 +135,9 @@ def grow_tree_data_parallel(
             check_vma=False,
         )
     )
-    extra = (categorical_mask,) if categorical_mask is not None else ()
     return fn(
         sharded.bins, grad, hess, row_mask, sample_weight, feature_mask,
-        sharded.num_bins_pf, sharded.missing_bin_pf, *extra,
+        sharded.num_bins_pf, sharded.missing_bin_pf, *extra_vals,
     )
 
 

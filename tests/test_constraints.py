@@ -1,0 +1,151 @@
+"""Monotone & interaction constraints, extra_trees, feature_fraction_bynode.
+
+Mirrors reference coverage in tests/python_package_test/test_engine.py
+(test_monotone_constraints: pointwise monotonicity of predictions;
+test_interaction_constraints: only allowed feature pairs co-occur on paths).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _is_monotone(bst, f_idx, sign, n_grid=50, n_probe=20, seed=0):
+    """Check predictions are monotone in feature f_idx pointwise on a grid."""
+    rng = np.random.RandomState(seed)
+    f = bst.num_feature()
+    base = rng.randn(n_probe, f)
+    grid = np.linspace(-2.5, 2.5, n_grid)
+    for i in range(n_probe):
+        rows = np.repeat(base[i : i + 1], n_grid, axis=0)
+        rows[:, f_idx] = grid
+        p = bst.predict(rows)
+        d = np.diff(p)
+        if sign > 0 and (d < -1e-10).any():
+            return False
+        if sign < 0 and (d > 1e-10).any():
+            return False
+    return True
+
+
+def _make_monotone_data(n=4000, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 3)
+    # y increasing in x0, decreasing in x1, arbitrary in x2
+    y = (
+        2.0 * X[:, 0]
+        + np.sin(3 * X[:, 0])
+        - 1.5 * X[:, 1]
+        - np.cos(2 * X[:, 1])
+        + 1.0 * np.sin(2 * X[:, 2])
+        + 0.1 * rng.randn(n)
+    )
+    return X, y
+
+
+def test_monotone_constraints_enforced():
+    X, y = _make_monotone_data()
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 10},
+        train, num_boost_round=30,
+    )
+    assert _is_monotone(bst, 0, +1)
+    assert _is_monotone(bst, 1, -1)
+    # the unconstrained model should NOT be monotone on this data (sanity)
+    bst_free = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "min_data_in_leaf": 10},
+        lgb.Dataset(X, label=y), num_boost_round=30,
+    )
+    assert not (_is_monotone(bst_free, 0, +1) and _is_monotone(bst_free, 1, -1))
+
+
+def test_monotone_constraints_still_learn():
+    X, y = _make_monotone_data(seed=1)
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 10},
+        train, num_boost_round=40,
+    )
+    pred = bst.predict(X)
+    r2 = 1 - np.mean((pred - y) ** 2) / np.var(y)
+    assert r2 > 0.8, r2
+
+
+def _paths_features(tree):
+    """Set of feature-index frozensets, one per root->leaf path."""
+    paths = []
+
+    def walk(node, feats):
+        if node < 0:
+            paths.append(frozenset(feats))
+            return
+        f = int(tree.split_feature[node])
+        walk(int(tree.left_child[node]), feats | {f})
+        walk(int(tree.right_child[node]), feats | {f})
+
+    if tree.num_leaves > 1:
+        walk(0, set())
+    return paths
+
+
+def test_interaction_constraints_respected():
+    rng = np.random.RandomState(2)
+    n = 4000
+    X = rng.randn(n, 4)
+    y = X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3] + 0.1 * rng.randn(n)
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "interaction_constraints": [[0, 1], [2, 3]], "min_data_in_leaf": 10},
+        train, num_boost_round=20,
+    )
+    allowed = [frozenset({0, 1}), frozenset({2, 3})]
+    for t in bst._gbdt.models:
+        for path in _paths_features(t):
+            assert any(path <= a for a in allowed), path
+
+
+def test_interaction_constraints_string_form():
+    rng = np.random.RandomState(3)
+    X = rng.randn(2000, 3)
+    y = X[:, 0] + X[:, 1] + X[:, 2] + 0.1 * rng.randn(2000)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+         "interaction_constraints": "[0],[1,2]"},
+        lgb.Dataset(X, label=y), num_boost_round=10,
+    )
+    allowed = [frozenset({0}), frozenset({1, 2})]
+    for t in bst._gbdt.models:
+        for path in _paths_features(t):
+            assert any(path <= a for a in allowed), path
+
+
+def test_extra_trees_trains_and_differs():
+    rng = np.random.RandomState(4)
+    X = rng.randn(3000, 8)
+    y = X @ rng.randn(8) + 0.2 * rng.randn(3000)
+    p = {"objective": "regression", "num_leaves": 31, "verbosity": -1}
+    bst = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=15)
+    bst_x = lgb.train(dict(p, extra_trees=True), lgb.Dataset(X, label=y), num_boost_round=15)
+    pred, pred_x = bst.predict(X), bst_x.predict(X)
+    assert not np.allclose(pred, pred_x)  # random thresholds change the model
+    r2 = 1 - np.mean((pred_x - y) ** 2) / np.var(y)
+    assert r2 > 0.7, r2
+
+
+def test_feature_fraction_bynode():
+    rng = np.random.RandomState(5)
+    X = rng.randn(3000, 10)
+    y = X @ rng.randn(10) + 0.2 * rng.randn(3000)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "feature_fraction_bynode": 0.5},
+        lgb.Dataset(X, label=y), num_boost_round=15,
+    )
+    r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.6, r2
