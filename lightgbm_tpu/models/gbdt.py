@@ -162,20 +162,32 @@ class GBDT:
         # distributed tree learner over the device mesh (reference:
         # TreeLearner::CreateTreeLearner picking {serial,data,feature,voting})
         self._dp = None
+        self._fp = None
         if self.cfg.tree_learner in ("data", "feature", "voting"):
             import jax as _jax
 
             if _jax.device_count() > 1:
-                from ..parallel.data_parallel import ShardedData
                 from ..parallel.mesh import make_mesh
 
                 mesh = make_mesh()
-                self._dp = ShardedData(
-                    mesh,
-                    np.asarray(train_set.bins),
-                    np.asarray(train_set.binner.num_bins_per_feature),
-                    np.asarray(train_set.binner.missing_bin_per_feature),
-                )
+                if self.cfg.tree_learner == "feature":
+                    from ..parallel.feature_parallel import FeatureShardedData
+
+                    self._fp = FeatureShardedData(
+                        mesh,
+                        np.asarray(train_set.bins),
+                        np.asarray(train_set.binner.num_bins_per_feature),
+                        np.asarray(train_set.binner.missing_bin_per_feature),
+                    )
+                else:
+                    from ..parallel.data_parallel import ShardedData
+
+                    self._dp = ShardedData(
+                        mesh,
+                        np.asarray(train_set.bins),
+                        np.asarray(train_set.binner.num_bins_per_feature),
+                        np.asarray(train_set.binner.missing_bin_per_feature),
+                    )
 
     def reset_split_params(self) -> None:
         """Refresh jit-static split hyperparams after a config mutation
@@ -314,7 +326,30 @@ class GBDT:
         for c in range(k):
             gc = g if k == 1 else g[:, c]
             hc = h if k == 1 else h[:, c]
-            if self._dp is not None:
+            node_rng = (
+                jax.random.PRNGKey(self.cfg.extra_seed + self.iter_ * 131 + c)
+                if self._needs_node_rng else None
+            )
+            if self._fp is not None:
+                from ..parallel.feature_parallel import grow_tree_feature_parallel
+
+                arrays, leaf_id = grow_tree_feature_parallel(
+                    self._fp,
+                    jnp.asarray(gc, jnp.float32),
+                    jnp.asarray(hc, jnp.float32),
+                    jnp.asarray(row_mask, bool),
+                    jnp.asarray(sample_weight, jnp.float32),
+                    np.asarray(feature_mask, bool),
+                    self._categorical_mask,
+                    self._monotone,
+                    self._interaction_sets,
+                    node_rng,
+                    num_leaves=self.cfg.num_leaves,
+                    num_bins=ts.max_num_bins,
+                    max_depth=self.cfg.max_depth,
+                    params=self._split_params,
+                )
+            elif self._dp is not None:
                 from ..parallel.data_parallel import grow_tree_data_parallel
 
                 dp = self._dp
@@ -328,19 +363,16 @@ class GBDT:
                     self._categorical_mask,
                     self._monotone,
                     self._interaction_sets,
-                    (jax.random.PRNGKey(self.cfg.extra_seed + self.iter_ * 131 + c)
-                     if self._needs_node_rng else None),
+                    node_rng,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
                     params=self._split_params,
+                    parallel_mode=("voting" if self.cfg.tree_learner == "voting" else "data"),
+                    top_k=self.cfg.top_k,
                 )
                 leaf_id = leaf_id_pad[: ts.num_data()]
             else:
-                node_key = (
-                    jax.random.PRNGKey(self.cfg.extra_seed + self.iter_ * 131 + c)
-                    if self._needs_node_rng else None
-                )
                 arrays, leaf_id = grow_tree(
                     ts.bins_device,
                     gc,
@@ -353,7 +385,7 @@ class GBDT:
                     self._categorical_mask,
                     self._monotone,
                     self._interaction_sets,
-                    node_key,
+                    node_rng,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,

@@ -102,7 +102,7 @@ def _gain_l2(sum_g, sum_h, l1, l2, max_delta_step):
     return tg * tg / denom
 
 
-def find_best_split(
+def gain_plane(
     hist: jnp.ndarray,  # (F, B, 3) f32 — per-feature histograms for ONE leaf
     parent_sum_g: jnp.ndarray,
     parent_sum_h: jnp.ndarray,
@@ -116,8 +116,12 @@ def find_best_split(
     out_lo: jnp.ndarray | None = None,  # scalar — leaf output lower bound
     out_hi: jnp.ndarray | None = None,  # scalar — leaf output upper bound
     rng_key: jnp.ndarray | None = None,  # per-node key (extra_trees / bynode)
-) -> BestSplit:
-    """Evaluate every (feature, threshold, missing-direction) candidate.
+):
+    """Evaluate every (feature, threshold, missing-direction) candidate and
+    return `(gain (F, B), ctx)` — the full candidate-gain plane plus the
+    context needed to materialize the winner (select_from_plane).  Split out
+    from the selection so the voting-parallel learner can vote on per-feature
+    local gains (reference: VotingParallelTreeLearner's local SplitInfo ranks).
 
     Numerical split semantics: rows with bin <= t go left; missing rows go to
     the default direction.  Missing bin sits at index (num_bins-1) when
@@ -291,6 +295,34 @@ def find_best_split(
             cat_col = cat_col & feature_mask[:, None]
         gain = jnp.where(cat_col, gain_cat, gain)
 
+    ctx = dict(
+        use_left=use_left,
+        stats_l=stats_l,
+        stats_r=stats_r,
+        parent_g=parent_g,
+        parent_h=parent_h,
+        parent_count=parent_count,
+        categorical_mask=categorical_mask,
+    )
+    if categorical_mask is not None:
+        ctx.update(
+            variant=variant, rank_asc=rank_asc, rank_desc=rank_desc,
+            st_asc=st_asc, st_desc=st_desc, oh_l=oh_l,
+        )
+    return gain, ctx
+
+
+def select_from_plane(gain: jnp.ndarray, ctx: dict) -> BestSplit:
+    """Materialize the argmax candidate of a gain plane into a BestSplit."""
+    f, b = gain.shape
+    bins_idx = jnp.arange(b, dtype=jnp.int32)
+    use_left = ctx["use_left"]
+    stats_l, stats_r = ctx["stats_l"], ctx["stats_r"]
+    parent_g, parent_h, parent_count = (
+        ctx["parent_g"], ctx["parent_h"], ctx["parent_count"]
+    )
+    categorical_mask = ctx["categorical_mask"]
+
     flat = gain.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -308,6 +340,8 @@ def find_best_split(
     best_cat_mask = jnp.zeros((b,), dtype=bool)
 
     if categorical_mask is not None:
+        variant, rank_asc, rank_desc = ctx["variant"], ctx["rank_asc"], ctx["rank_desc"]
+        st_asc, st_desc, oh_l = ctx["st_asc"], ctx["st_desc"], ctx["oh_l"]
         best_is_cat = categorical_mask[best_f]
         v = variant.reshape(-1)[best]
         mask_oh = bins_idx == best_t
@@ -350,3 +384,32 @@ def find_best_split(
         right_sum_h=parent_h - lh,
         right_count=parent_count - lc,
     )
+
+
+def find_best_split(
+    hist: jnp.ndarray,
+    parent_sum_g: jnp.ndarray,
+    parent_sum_h: jnp.ndarray,
+    parent_count: jnp.ndarray,
+    num_bins_per_feature: jnp.ndarray,
+    missing_bin_per_feature: jnp.ndarray,
+    params: SplitParams,
+    feature_mask: jnp.ndarray | None = None,
+    categorical_mask: jnp.ndarray | None = None,
+    monotone_constraints: jnp.ndarray | None = None,
+    out_lo: jnp.ndarray | None = None,
+    out_hi: jnp.ndarray | None = None,
+    rng_key: jnp.ndarray | None = None,
+) -> BestSplit:
+    """gain_plane + select_from_plane (reference: FindBestThreshold)."""
+    gain, ctx = gain_plane(
+        hist, parent_sum_g, parent_sum_h, parent_count,
+        num_bins_per_feature, missing_bin_per_feature, params,
+        feature_mask=feature_mask,
+        categorical_mask=categorical_mask,
+        monotone_constraints=monotone_constraints,
+        out_lo=out_lo,
+        out_hi=out_hi,
+        rng_key=rng_key,
+    )
+    return select_from_plane(gain, ctx)

@@ -30,7 +30,10 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import histogram
-from .split import BestSplit, SplitParams, find_best_split, leaf_output, KMIN_SCORE
+from .split import (
+    BestSplit, SplitParams, find_best_split, gain_plane, select_from_plane,
+    leaf_output, KMIN_SCORE,
+)
 
 
 class TreeArrays(NamedTuple):
@@ -109,6 +112,8 @@ def _set_best(best: BestSplit, i: jnp.ndarray, s: BestSplit) -> BestSplit:
         "params",
         "hist_strategy",
         "axis_name",
+        "parallel_mode",
+        "top_k",
     ),
 )
 def grow_tree(
@@ -131,6 +136,8 @@ def grow_tree(
     params: SplitParams = SplitParams(),
     hist_strategy: str = "auto",
     axis_name: Optional[str] = None,
+    parallel_mode: str = "data",  # with axis_name: data | feature | voting
+    top_k: int = 20,  # voting mode: per-shard feature votes (reference: top_k)
 ) -> tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -143,19 +150,27 @@ def grow_tree(
     grad = grad.astype(jnp.float32) * sample_weight
     hess = hess.astype(jnp.float32) * sample_weight
     L = num_leaves
+    mode = parallel_mode if axis_name is not None else "serial"
 
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
     def leaf_hist(mask):
         h = histogram(bins, grad, hess, mask, num_bins, strategy=hist_strategy)
-        return psum(h)
+        # data-parallel: rows sharded, merge now (reference ReduceScatter).
+        # feature-parallel: each shard sees ALL rows for ITS features — local
+        # hist is already complete.  voting: keep local, merge per-vote later.
+        return psum(h) if mode == "data" else h
 
     def allowed_from_used(used):
         """Features allowed at a leaf = union of interaction sets containing
         ALL features already used on the leaf's path (reference:
         col_sampler.hpp interaction-constraint filtering)."""
         ok_s = ~jnp.any(used[None, :] & ~interaction_sets, axis=1)  # (S,)
+        if mode == "feature":
+            # a set qualifies only if no shard's local feature block used a
+            # feature outside it (used/sets are column-sharded)
+            ok_s = jax.lax.pmin(ok_s.astype(jnp.int32), axis_name) > 0
         return jnp.any(interaction_sets & ok_s[:, None], axis=0)  # (F,)
 
     def best_for(hist_leaf, sum_g, sum_h, count, depth, out_lo=None, out_hi=None,
@@ -166,14 +181,7 @@ def grow_tree(
         key = None
         if rng_key is not None and node_id is not None:
             key = jax.random.fold_in(rng_key, node_id)
-        s = find_best_split(
-            hist_leaf,
-            sum_g,
-            sum_h,
-            count,
-            num_bins_per_feature,
-            missing_bin_per_feature,
-            params,
+        kw = dict(
             feature_mask=fmask,
             categorical_mask=categorical_mask,
             monotone_constraints=monotone_constraints,
@@ -181,6 +189,71 @@ def grow_tree(
             out_hi=out_hi,
             rng_key=key,
         )
+        if mode == "voting":
+            # PV-Tree (reference: voting_parallel_tree_learner.cpp): each
+            # shard votes its top_k features by LOCAL gain; the global tally
+            # elects ~2*top_k features whose histograms alone are merged.
+            loc = jnp.sum(hist_leaf[0], axis=0)  # local leaf totals (3,)
+            local_gain, _ = gain_plane(
+                hist_leaf, loc[0], loc[1], loc[2],
+                num_bins_per_feature, missing_bin_per_feature, params, **kw,
+            )
+            per_f = jnp.max(local_gain, axis=1)  # (F,)
+            kth = jax.lax.top_k(per_f, min(top_k, f))[0][-1]
+            vote = (per_f >= kth) & (per_f > KMIN_SCORE / 2)
+            tally = jax.lax.psum(vote.astype(jnp.int32), axis_name)
+            # deterministic top-2k election, ties to the lower feature index
+            score = tally.astype(jnp.int32) * (f + 1) - jnp.arange(f, dtype=jnp.int32)
+            n_elect = min(2 * top_k, f)
+            thr = jax.lax.top_k(score, n_elect)[0][-1]
+            winners = score >= thr
+            ghist = jax.lax.psum(
+                jnp.where(winners[:, None, None], hist_leaf, 0.0), axis_name
+            )
+            kw["feature_mask"] = (
+                winners if kw["feature_mask"] is None else kw["feature_mask"] & winners
+            )
+            s = find_best_split(
+                ghist, sum_g, sum_h, count,
+                num_bins_per_feature, missing_bin_per_feature, params, **kw,
+            )
+        else:
+            s = find_best_split(
+                hist_leaf, sum_g, sum_h, count,
+                num_bins_per_feature, missing_bin_per_feature, params, **kw,
+            )
+        if mode == "feature":
+            # feature-parallel merge (reference:
+            # FeatureParallelTreeLearner::SyncUpGlobalBestSplit — Allreduce
+            # with a max-gain reducer over serialized SplitInfo): winner rank
+            # = lowest shard achieving the max gain; its SplitInfo (with the
+            # feature index globalized) is broadcast by psum-masking.
+            ax = jax.lax.axis_index(axis_name)
+            nshards = jax.lax.psum(1, axis_name)
+            gmax = jax.lax.pmax(s.gain, axis_name)
+            cand = jnp.where(s.gain >= gmax, ax, nshards)
+            wrank = jax.lax.pmin(cand, axis_name)
+            sel = ax == wrank
+
+            def bc(x):
+                masked = jnp.where(sel, x, jnp.zeros_like(x))
+                out = jax.lax.psum(masked.astype(jnp.float32) if x.dtype == bool else masked, axis_name)
+                return (out > 0) if x.dtype == bool else out
+
+            s = BestSplit(
+                gain=gmax,
+                feature=bc(s.feature + ax * f),
+                threshold_bin=bc(s.threshold_bin),
+                default_left=bc(s.default_left),
+                is_cat=bc(s.is_cat),
+                cat_mask=bc(s.cat_mask),
+                left_sum_g=bc(s.left_sum_g),
+                left_sum_h=bc(s.left_sum_h),
+                left_count=bc(s.left_count),
+                right_sum_g=bc(s.right_sum_g),
+                right_sum_h=bc(s.right_sum_h),
+                right_count=bc(s.right_count),
+            )
         # depth cap (reference: max_depth check in BeforeFindBestSplit)
         if max_depth > 0:
             s = s._replace(gain=jnp.where(depth >= max_depth, KMIN_SCORE, s.gain))
@@ -190,6 +263,8 @@ def grow_tree(
     mask0 = row_mask.astype(jnp.float32)
     hist0 = leaf_hist(mask0)
     sum0 = jnp.sum(hist0[0], axis=0)  # totals from feature 0's hist: (3,)
+    if mode == "voting":
+        sum0 = psum(sum0)  # local hists in voting mode; leaf stats are global
     g0, h0, c0 = sum0[0], sum0[1], sum0[2]
 
     tree0 = TreeArrays(
@@ -247,12 +322,27 @@ def grow_tree(
 
         # --- partition: pure elementwise leaf_id update (reference:
         # DataPartition::Split, but with no data movement) ---
-        fcol = bins[:, s.feature]
-        is_missing = fcol == missing_bin_per_feature[s.feature]
-        go_left_num = jnp.where(is_missing, s.default_left, fcol <= s.threshold_bin)
-        # categorical: bin in the winning subset -> left (missing/unseen bins
-        # are never in the subset, mirroring CategoricalDecision -> right)
-        go_left = jnp.where(s.is_cat, s.cat_mask[fcol], go_left_num)
+        if mode == "feature":
+            # only the shard owning the winning feature can evaluate the
+            # decision; rows are replicated, so broadcast go_left by psum
+            # (reference: all machines apply the identical split after
+            # SyncUpGlobalBestSplit because data is replicated)
+            ax = jax.lax.axis_index(axis_name)
+            local_f = s.feature - ax * f
+            owned = (local_f >= 0) & (local_f < f)
+            lf = jnp.clip(local_f, 0, f - 1)
+            fcol = bins[:, lf]
+            is_missing = fcol == missing_bin_per_feature[lf]
+            gl_num = jnp.where(is_missing, s.default_left, fcol <= s.threshold_bin)
+            gl = jnp.where(s.is_cat, s.cat_mask[fcol], gl_num) & owned
+            go_left = jax.lax.psum(gl.astype(jnp.int32), axis_name) > 0
+        else:
+            fcol = bins[:, s.feature]
+            is_missing = fcol == missing_bin_per_feature[s.feature]
+            go_left_num = jnp.where(is_missing, s.default_left, fcol <= s.threshold_bin)
+            # categorical: bin in the winning subset -> left (missing/unseen
+            # bins never enter the subset: CategoricalDecision -> right)
+            go_left = jnp.where(s.is_cat, s.cat_mask[fcol], go_left_num)
         in_leaf = state.leaf_id == best_leaf
         leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_id)
 
@@ -318,7 +408,16 @@ def grow_tree(
         p_lo = state.leaf_out_lo[best_leaf]
         p_hi = state.leaf_out_hi[best_leaf]
         if monotone_constraints is not None:
-            mono_c = monotone_constraints[s.feature]
+            if mode == "feature":
+                ax_m = jax.lax.axis_index(axis_name)
+                lf_m = s.feature - ax_m * f
+                owned_m = (lf_m >= 0) & (lf_m < f)
+                mono_c = jax.lax.psum(
+                    jnp.where(owned_m, monotone_constraints[jnp.clip(lf_m, 0, f - 1)], 0),
+                    axis_name,
+                )
+            else:
+                mono_c = monotone_constraints[s.feature]
             out_l = jnp.clip(leaf_output(s.left_sum_g, s.left_sum_h, params), p_lo, p_hi)
             out_r = jnp.clip(leaf_output(s.right_sum_g, s.right_sum_h, params), p_lo, p_hi)
             mid = 0.5 * (out_l + out_r)
@@ -332,7 +431,16 @@ def grow_tree(
         leaf_out_hi = state.leaf_out_hi.at[best_leaf].set(l_hi).at[new_leaf].set(r_hi)
 
         if interaction_sets is not None:
-            used_child = state.used_features[best_leaf].at[s.feature].set(True)
+            if mode == "feature":
+                ax = jax.lax.axis_index(axis_name)
+                local_f = s.feature - ax * f
+                owned = (local_f >= 0) & (local_f < f)
+                marked = state.used_features[best_leaf].at[
+                    jnp.clip(local_f, 0, f - 1)
+                ].set(True)
+                used_child = jnp.where(owned, marked, state.used_features[best_leaf])
+            else:
+                used_child = state.used_features[best_leaf].at[s.feature].set(True)
             used_features = (
                 state.used_features.at[best_leaf].set(used_child).at[new_leaf].set(used_child)
             )

@@ -86,6 +86,8 @@ def grow_tree_data_parallel(
     max_depth: int = -1,
     params: SplitParams = SplitParams(),
     hist_strategy: str = "auto",
+    parallel_mode: str = "data",  # "data" or "voting" (rows sharded in both)
+    top_k: int = 20,
 ) -> Tuple[TreeArrays, jnp.ndarray]:
     """SPMD tree growth: identical trees on every shard, shard-local leaf ids.
 
@@ -112,6 +114,8 @@ def grow_tree_data_parallel(
             params=params,
             hist_strategy=hist_strategy,
             axis_name=DATA_AXIS,
+            parallel_mode=parallel_mode,
+            top_k=top_k,
         )
 
     fn = jax.jit(

@@ -108,3 +108,89 @@ def test_entry_compiles():
     fn, args = ge.entry()
     out = jax.jit(fn)(*args)
     assert np.asarray(out).shape == (args[0].shape[0],)
+
+
+def test_fp_tree_matches_serial(synth):
+    """Feature-parallel growth (features sharded, rows replicated) must equal
+    the serial tree (reference: FeatureParallelTreeLearner applies the
+    identical split on every machine)."""
+    from lightgbm_tpu.parallel.feature_parallel import (
+        FeatureShardedData, grow_tree_feature_parallel,
+    )
+
+    X, y = synth
+    n, f = X.shape
+    binner = DatasetBinner.fit(X, max_bin=63)
+    bins = binner.transform(X)
+    rng = np.random.RandomState(2)
+    grad = (0.5 - y + 0.1 * rng.rand(n)).astype(np.float32)
+    hess = np.full(n, 0.25, np.float32)
+    params = SplitParams(min_data_in_leaf=10)
+
+    tree_s, leaf_s = grow_tree(
+        jnp.asarray(bins.astype(np.int32)), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, bool), jnp.ones(n, jnp.float32), jnp.ones(f, bool),
+        jnp.asarray(binner.num_bins_per_feature), jnp.asarray(binner.missing_bin_per_feature),
+        num_leaves=15, num_bins=binner.max_num_bins, params=params,
+    )
+
+    mesh = make_mesh(8)
+    fsh = FeatureShardedData(mesh, bins, binner.num_bins_per_feature,
+                             binner.missing_bin_per_feature)
+    tree_f, leaf_f = grow_tree_feature_parallel(
+        fsh, jnp.asarray(grad), jnp.asarray(hess), jnp.ones(n, bool),
+        jnp.ones(n, jnp.float32), np.ones(f, bool),
+        num_leaves=15, num_bins=binner.max_num_bins, params=params,
+    )
+    assert int(tree_s.num_leaves) == int(tree_f.num_leaves)
+    m = int(tree_s.num_leaves) - 1
+    np.testing.assert_array_equal(
+        np.asarray(tree_s.split_feature)[:m], np.asarray(tree_f.split_feature)[:m]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tree_s.threshold_bin)[:m], np.asarray(tree_f.threshold_bin)[:m]
+    )
+    np.testing.assert_allclose(
+        np.asarray(tree_s.leaf_value)[: m + 1], np.asarray(tree_f.leaf_value)[: m + 1],
+        rtol=2e-3, atol=2e-3,
+    )
+    np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_f)[:n])
+
+
+def test_end_to_end_feature_parallel(synth):
+    X, y = synth
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 10, "max_bin": 63}
+    b_serial = lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=8)
+    b_fp = lgb.train(dict(params, tree_learner="feature"), lgb.Dataset(X, label=y), num_boost_round=8)
+    assert b_fp._gbdt._fp is not None, "feature-parallel path not engaged"
+    np.testing.assert_allclose(
+        b_serial.predict(X, raw_score=True), b_fp.predict(X, raw_score=True),
+        rtol=5e-3, atol=5e-3,
+    )
+
+
+def test_end_to_end_voting_parallel(synth):
+    """Voting-parallel (PV-Tree): with top_k >= num_features the election is
+    exhaustive, so the model must match data-parallel/serial closely; with a
+    small top_k it must still train a usable model (reference:
+    VotingParallelTreeLearner is an approximation by design)."""
+    X, y = synth
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 10, "max_bin": 63}
+    b_serial = lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=8)
+    b_vp_full = lgb.train(
+        dict(params, tree_learner="voting", top_k=X.shape[1]),
+        lgb.Dataset(X, label=y), num_boost_round=8,
+    )
+    np.testing.assert_allclose(
+        b_serial.predict(X, raw_score=True), b_vp_full.predict(X, raw_score=True),
+        rtol=5e-3, atol=5e-3,
+    )
+    b_vp = lgb.train(
+        dict(params, tree_learner="voting", top_k=3),
+        lgb.Dataset(X, label=y), num_boost_round=8,
+    )
+    pred = b_vp.predict(X)
+    acc = float(((pred > 0.5) == (y > 0.5)).mean())
+    assert acc > 0.8, acc
