@@ -1,0 +1,175 @@
+"""Text data parsers: CSV / TSV / LibSVM with auto-detection.
+
+Reference: src/io/parser.cpp (Parser::CreateParser auto-detect, CSVParser/
+TSVParser/LibSVMParser), src/io/dataset_loader.cpp (label/weight/group column
+remap, ignore_column, side files `<data>.weight` / `<data>.query`).
+
+The hot tokenizing loop runs in the native C++ loader (src/native/loader.cpp,
+OpenMP) when available; a numpy fallback keeps the package dependency-free.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..native import parse_file_native
+
+
+def _detect_format(first_line: str) -> str:
+    head = first_line.strip()
+    toks = head.split()
+    if len(toks) >= 2 and ":" in toks[1]:
+        return "libsvm"
+    if "\t" in head:
+        return "tsv"
+    return "csv"
+
+
+def parse_text(text: str, fmt: str = "auto") -> Tuple[np.ndarray, np.ndarray, str]:
+    """Parse raw text -> (values (N, C) with NaN for missing, first-col array,
+    detected format).  For libsvm returns (label, dense features)."""
+    lines = [l for l in text.splitlines() if l.strip() and not l.startswith("#")]
+    if not lines:
+        return np.zeros((0, 0)), np.zeros(0), "csv"
+    if fmt == "auto":
+        fmt = _detect_format(lines[0])
+    if fmt == "libsvm":
+        labels = np.zeros(len(lines))
+        rows = []
+        maxf = -1
+        for i, line in enumerate(lines):
+            toks = line.split()
+            labels[i] = float(toks[0])
+            pairs = []
+            for t in toks[1:]:
+                if ":" not in t:
+                    continue
+                k, v = t.split(":", 1)
+                k = int(k)
+                pairs.append((k, float(v)))
+                maxf = max(maxf, k)
+            rows.append(pairs)
+        data = np.zeros((len(lines), maxf + 1))
+        for i, pairs in enumerate(rows):
+            for k, v in pairs:
+                data[i, k] = v
+        return data, labels, fmt
+    delim = "\t" if fmt == "tsv" else ","
+    ncol = lines[0].count(delim) + 1
+    data = np.full((len(lines), ncol), np.nan)
+    for i, line in enumerate(lines):
+        for j, tok in enumerate(line.rstrip("\r").split(delim)[:ncol]):
+            tok = tok.strip()
+            if tok and tok.lower() not in ("na", "nan", "null", ""):
+                try:
+                    data[i, j] = float(tok)
+                except ValueError:
+                    data[i, j] = np.nan
+    return data, data[:, 0].copy(), fmt
+
+
+def _resolve_column(spec: str, header_names: Optional[List[str]]) -> int:
+    """LightGBM column spec: integer index, or `name:<col>` against the
+    header (reference: DatasetLoader::SetHeader label_idx resolution)."""
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if header_names and name in header_names:
+            return header_names.index(name)
+        raise ValueError(f"column name {name!r} not found in header")
+    return int(spec)
+
+
+def load_data_file(
+    path: str,
+    header: bool = False,
+    label_column: str = "",
+    weight_column: str = "",
+    group_column: str = "",
+    ignore_column: str = "",
+    fmt: str = "auto",
+):
+    """Load a training/prediction text file.
+
+    Returns dict(data, label, weight, group, feature_names).
+    Side files `<path>.weight` and `<path>.query` are honored like the
+    reference (Metadata::LoadWeights/LoadQueryBoundaries).
+    """
+    with open(path, "r") as fh:
+        first = fh.readline()
+    fmt_detected = fmt if fmt != "auto" else _detect_format(first)
+
+    header_names: Optional[List[str]] = None
+    if header and fmt_detected != "libsvm":
+        delim = "\t" if fmt_detected == "tsv" else ","
+        header_names = [t.strip() for t in first.rstrip("\n\r").split(delim)]
+
+    label_idx = 0
+    if label_column:
+        label_idx = _resolve_column(label_column, header_names)
+    weight_idx = _resolve_column(weight_column, header_names) if weight_column else -1
+    group_idx = _resolve_column(group_column, header_names) if group_column else -1
+    ignore_idxs: List[int] = []
+    if ignore_column:
+        ignore_idxs = [
+            _resolve_column(t, header_names) for t in ignore_column.split(",") if t
+        ]
+
+    if fmt_detected == "libsvm":
+        native = parse_file_native(path, "libsvm", False, 0)
+        if native is not None:
+            data, label = native
+        else:
+            with open(path) as fh:
+                data, label, _ = parse_text(fh.read(), "libsvm")
+        weight = group = None
+        names = [f"Column_{i}" for i in range(data.shape[1])]
+    else:
+        # parse ALL columns (native path keeps the label inline at label_idx=-1
+        # so weight/group columns survive), then slice label/weight/group out
+        native = parse_file_native(path, fmt_detected, header, -1)
+        if native is not None:
+            cols, _ = native
+        else:
+            with open(path) as fh:
+                text = fh.read()
+            if header:
+                text = text.split("\n", 1)[1] if "\n" in text else ""
+            cols, _, _ = parse_text(text, fmt_detected)
+        ncol = cols.shape[1]
+        label = cols[:, label_idx].copy() if 0 <= label_idx < ncol else np.zeros(len(cols))
+        weight = cols[:, weight_idx].copy() if 0 <= weight_idx < ncol else None
+        group = cols[:, group_idx].copy() if 0 <= group_idx < ncol else None
+        drop = {label_idx, *ignore_idxs}
+        if weight_idx >= 0:
+            drop.add(weight_idx)
+        if group_idx >= 0:
+            drop.add(group_idx)
+        keep = [j for j in range(ncol) if j not in drop]
+        data = cols[:, keep]
+        if header_names:
+            names = [header_names[j] for j in keep]
+        else:
+            names = [f"Column_{j}" for j in keep]
+
+    # side files (reference: Metadata::LoadWeights / LoadQueryBoundaries)
+    if weight is None and os.path.exists(path + ".weight"):
+        weight = np.loadtxt(path + ".weight", dtype=np.float64).reshape(-1)
+    query = None
+    if os.path.exists(path + ".query"):
+        query = np.loadtxt(path + ".query", dtype=np.int64).reshape(-1)
+    elif group is not None:
+        # group column holds a query id per row -> convert to group sizes
+        _, counts = np.unique(group, return_counts=True)
+        # preserve file order of query ids
+        ids, idx = np.unique(group, return_index=True)
+        order = np.argsort(idx)
+        sizes = np.zeros(len(ids), np.int64)
+        for rank, o in enumerate(order):
+            sizes[rank] = counts[o]
+        query = sizes
+
+    return dict(data=data, label=label, weight=weight, group=query,
+                feature_names=names)

@@ -600,6 +600,54 @@ class GBDT:
         trees = self._trees_for_export(start_iteration, num_iteration)
         return tree_shap_ensemble(trees, np.asarray(X, np.float64), k)
 
+    def to_if_else(self) -> str:
+        """Standalone C++ predictor source (reference: task=convert_model,
+        GBDT::SaveModelToIfElse + Tree::ToIfElse in src/io/tree.cpp)."""
+        from .tree import tree_to_if_else
+
+        trees = self._trees_for_export(0, -1)
+        k = self.num_tree_per_iteration
+        parts = [
+            "// Generated by lightgbm_tpu task=convert_model",
+            "#include <cmath>",
+            "",
+        ]
+        for i, t in enumerate(trees):
+            parts.append(tree_to_if_else(t, i))
+            parts.append("")
+        n_per_class = max(len(trees) // k, 1) if trees else 1
+        scale = (1.0 / n_per_class) if self.average_output else 1.0
+        calls = " + ".join(f"PredictTree{i}(x)" for i in range(len(trees))) or "0.0"
+        if k == 1:
+            parts.append("extern \"C\" double PredictRaw(const double* x) {")
+            parts.append(f"  return ({calls}) * {scale:.17g};")
+            parts.append("}")
+            obj = self._objective_string()
+            if obj.startswith("binary"):
+                parts.append("extern \"C\" double Predict(const double* x) {")
+                parts.append("  return 1.0 / (1.0 + std::exp(-PredictRaw(x)));")
+                parts.append("}")
+            else:
+                parts.append("extern \"C\" double Predict(const double* x) {")
+                parts.append("  return PredictRaw(x);")
+                parts.append("}")
+        else:
+            parts.append(f"static const int kNumClass = {k};")
+            parts.append("extern \"C\" void PredictRaw(const double* x, double* out) {")
+            for c in range(k):
+                terms = " + ".join(
+                    f"PredictTree{i}(x)" for i in range(c, len(trees), k)
+                ) or "0.0"
+                parts.append(f"  out[{c}] = ({terms}) * {scale:.17g};")
+            parts.append("}")
+            parts.append("extern \"C\" void Predict(const double* x, double* out) {")
+            parts.append("  PredictRaw(x, out);")
+            parts.append("  double m = out[0]; for (int c = 1; c < kNumClass; ++c) if (out[c] > m) m = out[c];")
+            parts.append("  double s = 0.0; for (int c = 0; c < kNumClass; ++c) { out[c] = std::exp(out[c] - m); s += out[c]; }")
+            parts.append("  for (int c = 0; c < kNumClass; ++c) out[c] /= s;")
+            parts.append("}")
+        return "\n".join(parts) + "\n"
+
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
         """reference: GBDT::FeatureImportance."""

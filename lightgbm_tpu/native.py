@@ -1,0 +1,99 @@
+"""ctypes binding to the native C++ data loader (src/native/loader.cpp).
+
+The .so is compiled lazily with g++ on first use and cached next to the
+source (reference analogue: lib_lightgbm.so built by CMake; here the only
+native stage is text parsing — see loader.cpp header).  Binding is plain
+ctypes because pybind11 is not in this image (per environment constraints).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src", "native")
+_SRC = os.path.join(_NATIVE_DIR, "loader.cpp")
+_SO = os.path.join(_NATIVE_DIR, "_loader.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-fPIC", "-shared", "-fopenmp", "-std=c++17",
+        "-o", _SO, _SRC,
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=240)
+        return r.returncode == 0 and os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native loader; None if unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+            ):
+                if not os.path.exists(_SRC) or not _build():
+                    _lib_failed = True
+                    return None
+            lib = ctypes.CDLL(_SO)
+            lib.lgbmtpu_parse_file.restype = ctypes.c_int
+            lib.lgbmtpu_parse_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.lgbmtpu_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+    return _lib
+
+
+_FORMAT_CODE = {"auto": -1, "csv": 0, "tsv": 1, "libsvm": 2}
+
+
+def parse_file_native(
+    path: str, fmt: str = "auto", has_header: bool = False, label_idx: int = 0
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Parse with the native loader; returns (data (N,F) f64, label (N,))
+    or None when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    pd = ctypes.POINTER(ctypes.c_double)()
+    pl = ctypes.POINTER(ctypes.c_double)()
+    n = ctypes.c_int64()
+    f = ctypes.c_int64()
+    rc = lib.lgbmtpu_parse_file(
+        path.encode(), _FORMAT_CODE.get(fmt, -1), int(has_header), label_idx,
+        ctypes.byref(pd), ctypes.byref(pl), ctypes.byref(n), ctypes.byref(f),
+    )
+    if rc != 0:
+        return None
+    try:
+        data = np.ctypeslib.as_array(pd, shape=(n.value, f.value)).copy()
+        label = np.ctypeslib.as_array(pl, shape=(n.value,)).copy()
+    finally:
+        lib.lgbmtpu_free(pd)
+        lib.lgbmtpu_free(pl)
+    return data, label

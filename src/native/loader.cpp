@@ -1,0 +1,255 @@
+// Native data loader: fast CSV/TSV/LibSVM text parsing to a dense matrix.
+//
+// TPU-native equivalent of the reference's C++ data-loading path
+// (reference: src/io/parser.cpp -> CSVParser/TSVParser/LibSVMParser +
+// src/io/dataset_loader.cpp -> DatasetLoader::LoadFromFile and
+// include/LightGBM/utils/text_reader.h -> TextReader chunked reads).
+// The heavy lifting — tokenizing millions of text rows — stays native and
+// OpenMP-parallel exactly as in the reference; binning + device transfer
+// happen in Python/JAX afterwards (host binning is numpy-vectorized and the
+// training hot path is on-device, so parsing is the only text-speed-critical
+// stage).
+//
+// Exposed as a tiny C ABI consumed via ctypes (no pybind11 in this image).
+//
+// Build: g++ -O3 -fPIC -shared -fopenmp -o _loader.so loader.cpp
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+// Fast double parse (reference: Common::Atof / fast_double_parser vendored
+// lib).  strtod is locale-dependent but this tool always writes C locale.
+inline double parse_double(const char* p, const char** end) {
+  return std::strtod(p, const_cast<char**>(end));
+}
+
+struct ParseResult {
+  std::vector<double> data;  // row-major n x f
+  std::vector<double> label;
+  int64_t n = 0;
+  int64_t f = 0;
+  std::string error;
+};
+
+// Find the byte offset of each line start.
+std::vector<size_t> line_offsets(const std::string& buf) {
+  std::vector<size_t> offs;
+  offs.push_back(0);
+  for (size_t i = 0; i < buf.size(); ++i) {
+    if (buf[i] == '\n' && i + 1 < buf.size()) offs.push_back(i + 1);
+  }
+  return offs;
+}
+
+inline bool is_blank_line(const char* p, const char* lend) {
+  while (p < lend && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p == lend;
+}
+
+// format: 0=csv, 1=tsv, 2=libsvm
+int detect_format(const std::string& buf, size_t start) {
+  const char* p = buf.c_str() + start;
+  const char* e = buf.c_str() + buf.size();
+  const char* lend = static_cast<const char*>(memchr(p, '\n', e - p));
+  if (!lend) lend = e;
+  // libsvm: second token contains ':'
+  const char* q = p;
+  while (q < lend && *q != ' ' && *q != '\t' && *q != ',') ++q;
+  const char* r = q;
+  while (r < lend && (*r == ' ' || *r == '\t')) ++r;
+  const char* colon = static_cast<const char*>(memchr(r, ':', lend - r));
+  const char* space = static_cast<const char*>(memchr(r, ' ', lend - r));
+  if (colon && (!space || colon < space)) return 2;
+  if (memchr(p, '\t', lend - p)) return 1;
+  return 0;
+}
+
+void parse_delim(const std::string& buf, const std::vector<size_t>& lines,
+                 char delim, int label_idx, ParseResult* out) {
+  const int64_t n = static_cast<int64_t>(lines.size());
+  // column count from the first line
+  {
+    const char* p = buf.c_str() + lines[0];
+    const char* e = buf.c_str() + buf.size();
+    const char* lend = static_cast<const char*>(memchr(p, '\n', e - p));
+    if (!lend) lend = e;
+    int64_t cols = 1;
+    for (const char* q = p; q < lend; ++q)
+      if (*q == delim) ++cols;
+    out->f = (label_idx >= 0 && label_idx < cols) ? cols - 1 : cols;
+  }
+  out->n = n;
+  out->data.assign(static_cast<size_t>(n) * out->f, 0.0);
+  out->label.assign(n, 0.0);
+  const int64_t f = out->f;
+  bool ok = true;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const char* p = buf.c_str() + lines[i];
+    const char* e = buf.c_str() + buf.size();
+    const char* lend = static_cast<const char*>(memchr(p, '\n', e - p));
+    if (!lend) lend = e;
+    double* row = out->data.data() + i * f;
+    int64_t col = 0, feat = 0;
+    while (p < lend && feat <= f) {
+      const char* tend;
+      double v;
+      // empty field or NA -> NaN
+      const char* q = p;
+      while (q < lend && *q != delim) ++q;
+      if (q == p || (q - p >= 2 && (p[0] == 'N' || p[0] == 'n') &&
+                     (p[1] == 'A' || p[1] == 'a'))) {
+        v = std::nan("");
+        tend = q;
+      } else {
+        v = parse_double(p, &tend);
+        if (tend == p) v = std::nan("");
+      }
+      if (col == label_idx) {
+        out->label[i] = v;
+      } else if (feat < f) {
+        row[feat++] = v;
+      }
+      ++col;
+      p = q + (q < lend ? 1 : 0);
+    }
+    (void)ok;
+  }
+}
+
+void parse_libsvm(const std::string& buf, const std::vector<size_t>& lines,
+                  ParseResult* out) {
+  const int64_t n = static_cast<int64_t>(lines.size());
+  out->n = n;
+  out->label.assign(n, 0.0);
+  // pass 1: max feature index (1-based in libsvm files; 0-based accepted)
+  int64_t maxf = -1;
+#pragma omp parallel for schedule(static) reduction(max : maxf)
+  for (int64_t i = 0; i < n; ++i) {
+    const char* p = buf.c_str() + lines[i];
+    const char* e = buf.c_str() + buf.size();
+    const char* lend = static_cast<const char*>(memchr(p, '\n', e - p));
+    if (!lend) lend = e;
+    // skip label
+    while (p < lend && *p != ' ' && *p != '\t') ++p;
+    while (p < lend) {
+      while (p < lend && (*p == ' ' || *p == '\t')) ++p;
+      if (p >= lend) break;
+      const char* tend;
+      long idx = std::strtol(p, const_cast<char**>(&tend), 10);
+      if (tend == p) break;
+      if (idx > maxf) maxf = idx;
+      p = tend;
+      if (p < lend && *p == ':') {
+        ++p;
+        parse_double(p, &tend);
+        p = tend;
+      }
+    }
+  }
+  out->f = maxf + 1;
+  out->data.assign(static_cast<size_t>(n) * out->f, 0.0);
+  const int64_t f = out->f;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const char* p = buf.c_str() + lines[i];
+    const char* e = buf.c_str() + buf.size();
+    const char* lend = static_cast<const char*>(memchr(p, '\n', e - p));
+    if (!lend) lend = e;
+    const char* tend;
+    out->label[i] = parse_double(p, &tend);
+    p = tend;
+    double* row = out->data.data() + i * f;
+    while (p < lend) {
+      while (p < lend && (*p == ' ' || *p == '\t')) ++p;
+      if (p >= lend || *p == '#') break;
+      long idx = std::strtol(p, const_cast<char**>(&tend), 10);
+      if (tend == p) break;
+      p = tend;
+      double v = 1.0;
+      if (p < lend && *p == ':') {
+        ++p;
+        v = parse_double(p, &tend);
+        p = tend;
+      }
+      if (idx >= 0 && idx < f) row[idx] = v;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a text file.  format: -1 auto, 0 csv, 1 tsv, 2 libsvm.
+// label_idx: column index of the label for csv/tsv (-1 = no label column).
+// has_header: skip the first non-comment line.
+// Returns 0 on success.  Caller frees *out_data / *out_label via lgbmtpu_free.
+int lgbmtpu_parse_file(const char* path, int format, int has_header,
+                       int label_idx, double** out_data, double** out_label,
+                       int64_t* out_n, int64_t* out_f) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) return 1;
+  std::string buf((std::istreambuf_iterator<char>(in)),
+                  std::istreambuf_iterator<char>());
+  if (buf.empty()) return 2;
+
+  // line starts, skipping comments ('#') and blank lines
+  std::vector<size_t> lines;
+  for (size_t off : line_offsets(buf)) {
+    const char* p = buf.c_str() + off;
+    const char* e = buf.c_str() + buf.size();
+    const char* lend = static_cast<const char*>(memchr(p, '\n', e - p));
+    if (!lend) lend = e;
+    if (is_blank_line(p, lend) || *p == '#') continue;
+    lines.push_back(off);
+  }
+  if (lines.empty()) return 2;
+  if (format < 0) format = detect_format(buf, lines[0]);
+  if (has_header && format != 2 && lines.size() > 1)
+    lines.erase(lines.begin());
+
+  ParseResult res;
+  if (format == 2) {
+    parse_libsvm(buf, lines, &res);
+  } else {
+    parse_delim(buf, lines, format == 1 ? '\t' : ',', label_idx, &res);
+  }
+  *out_n = res.n;
+  *out_f = res.f;
+  double* d = static_cast<double*>(malloc(sizeof(double) * res.data.size()));
+  double* l = static_cast<double*>(malloc(sizeof(double) * res.label.size()));
+  if (!d || !l) {
+    free(d);
+    free(l);
+    return 3;
+  }
+  memcpy(d, res.data.data(), sizeof(double) * res.data.size());
+  memcpy(l, res.label.data(), sizeof(double) * res.label.size());
+  *out_data = d;
+  *out_label = l;
+  return 0;
+}
+
+void lgbmtpu_free(double* p) { free(p); }
+
+int lgbmtpu_num_threads() {
+#ifdef _OPENMP
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"
