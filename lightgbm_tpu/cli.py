@@ -184,8 +184,10 @@ def _task_refit(cfg: Config, params: Dict) -> int:
         weight_column=cfg.weight_column, group_column=cfg.group_column,
         ignore_column=cfg.ignore_column,
     )
+    # CLI-only keys (task/data/input_model/...) must not reach refit(); the
+    # refitted booster keeps the loaded model's own hyperparameters.
     new_bst = bst.refit(
-        loaded["data"], loaded["label"], decay_rate=cfg.refit_decay_rate, **params
+        data=loaded["data"], label=loaded["label"], decay_rate=cfg.refit_decay_rate
     )
     new_bst.save_model(cfg.output_model)
     log_info(f"refitted model written to {cfg.output_model}")

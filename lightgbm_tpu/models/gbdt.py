@@ -602,7 +602,14 @@ class GBDT:
 
     def to_if_else(self) -> str:
         """Standalone C++ predictor source (reference: task=convert_model,
-        GBDT::SaveModelToIfElse + Tree::ToIfElse in src/io/tree.cpp)."""
+        GBDT::SaveModelToIfElse + Tree::ToIfElse in src/io/tree.cpp).
+
+        Precision contract: the emitted code evaluates in float64 and
+        bit-matches the host f64 tree walk (Tree.predict summed over
+        exported trees).  Booster.predict runs the f32 device path, so the
+        two agree only to ~1e-6 relative — same as the reference, whose
+        ToIfElse output is double while GPU predict paths are float.
+        """
         from .tree import tree_to_if_else
 
         trees = self._trees_for_export(0, -1)

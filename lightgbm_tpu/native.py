@@ -30,10 +30,19 @@ def _build() -> bool:
         "g++", "-O3", "-fPIC", "-shared", "-fopenmp", "-std=c++17",
         "-o", _SO, _SRC,
     ]
+    from .utils.log import log_warning
+
     try:
-        r = subprocess.run(cmd, capture_output=True, timeout=240)
-        return r.returncode == 0 and os.path.exists(_SO)
-    except Exception:
+        r = subprocess.run(cmd, capture_output=True, timeout=240, text=True)
+        ok = r.returncode == 0 and os.path.exists(_SO)
+        if not ok:
+            log_warning(
+                "native loader build failed (falling back to numpy parser):\n"
+                + (r.stderr or "")[-2000:]
+            )
+        return ok
+    except Exception as exc:
+        log_warning(f"native loader build failed ({exc!r}); numpy fallback in use")
         return False
 
 

@@ -256,8 +256,13 @@ def gain_plane(
             k_len = bins_idx[None, :] + 1  # (1, B) prefix length at index b
             lg_, lh_, lc_ = cum[..., 0], cum[..., 1], cum[..., 2]
             rg_, rh_, rc_ = parent_g - lg_, parent_h - lh_, parent_count - lc_
+            # reference additionally caps each scan direction at half the
+            # used bins ((used_bin + 1) / 2 in
+            # FindBestThresholdCategoricalInner), so both-direction scans
+            # never consider the same partition twice.
             ok = (
                 (k_len <= params.max_cat_threshold)
+                & (k_len <= (num_used[:, None] + 1) // 2)
                 & (k_len < num_used[:, None])
                 & cat_ok(lc_, rc_, lh_, rh_)
             )

@@ -15,9 +15,11 @@
 // Build: g++ -O3 -fPIC -shared -fopenmp -o _loader.so loader.cpp
 
 #include <cctype>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <limits>
 #include <fstream>
 #include <string>
 #include <vector>
@@ -90,7 +92,10 @@ void parse_delim(const std::string& buf, const std::vector<size_t>& lines,
     out->f = (label_idx >= 0 && label_idx < cols) ? cols - 1 : cols;
   }
   out->n = n;
-  out->data.assign(static_cast<size_t>(n) * out->f, 0.0);
+  // NaN-init so trailing/absent delimited fields read as missing, matching
+  // the numpy fallback (np.full(..., nan)); LibSVM below stays 0.0 (sparse).
+  out->data.assign(static_cast<size_t>(n) * out->f,
+                   std::numeric_limits<double>::quiet_NaN());
   out->label.assign(n, 0.0);
   const int64_t f = out->f;
   bool ok = true;

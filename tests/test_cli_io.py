@@ -51,7 +51,8 @@ def test_native_csv_matches_numpy(csv_files):
     with open(csv_files["train"]) as fh:
         data_p, _, fmt = parse_text(fh.read(), "csv")
     assert fmt == "csv"
-    np.testing.assert_allclose(data_n, data_p[:, :], rtol=0, atol=0)
+    # label_idx=0: column 0 becomes the label and is excluded from data
+    np.testing.assert_allclose(data_n, data_p[:, 1:], rtol=0, atol=0)
     np.testing.assert_allclose(label_n, data_p[:, 0])
 
 
@@ -146,11 +147,18 @@ def test_cli_convert_model_compiles_and_matches(csv_files, tmp_path):
     lib = ctypes.CDLL(str(so_p))
     lib.Predict.restype = ctypes.c_double
     lib.Predict.argtypes = [ctypes.POINTER(ctypes.c_double)]
-    api = bst.predict(X[:64])
+    # Contract (see GBDT.to_if_else): generated C++ is float64 and must
+    # bit-match the host f64 tree walk; the f32 device predict path agrees
+    # only to float32 roundoff.
+    trees = bst._gbdt._trees_for_export(0, -1)
+    raw64 = np.sum([t.predict(X[:64]) for t in trees], axis=0)
+    host64 = 1.0 / (1.0 + np.exp(-raw64))
+    api32 = bst.predict(X[:64])
     for i in range(64):
         row = np.ascontiguousarray(X[i], dtype=np.float64)
         got = lib.Predict(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
-        assert abs(got - api[i]) < 1e-10, (i, got, api[i])
+        assert abs(got - host64[i]) < 1e-12, (i, got, host64[i])
+        assert abs(got - api32[i]) < 1e-5, (i, got, api32[i])
 
 
 def test_cli_refit(csv_files):
@@ -175,3 +183,16 @@ def test_cli_refit(csv_files):
     assert out_p.exists()
     refitted = lgb.Booster(model_file=str(out_p))
     assert np.isfinite(refitted.predict(X[:10])).all()
+
+
+def test_native_trailing_empty_fields_are_nan(tmp_path):
+    """Trailing empty delimited fields must parse as NaN (missing), matching
+    the numpy fallback's np.full(..., nan) init."""
+    path = tmp_path / "trail.csv"
+    path.write_text("1,2.5,\n0,,4.5\n1,5.5,6.5\n")
+    out = parse_file_native(str(path), "csv", False, 0)
+    assert out is not None
+    data, label = out
+    np.testing.assert_array_equal(label, [1, 0, 1])
+    assert np.isnan(data[0, 1]) and np.isnan(data[1, 0])
+    np.testing.assert_allclose(data[2], [5.5, 6.5])
