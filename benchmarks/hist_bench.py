@@ -1,0 +1,122 @@
+"""Microbenchmark of histogram strategies on the current backend.
+
+Usage: python benchmarks/hist_bench.py [N] [F] [B]
+Measures ms/histogram for each strategy and checks correctness vs a numpy
+reference.  Drives the measured strategy table in ops/histogram.py.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, reps=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1e3, out
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    f = int(sys.argv[2]) if len(sys.argv) > 2 else 28
+    b = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+
+    rng = np.random.RandomState(0)
+    bins = rng.randint(0, b, size=(n, f)).astype(np.int32)
+    grad = rng.randn(n).astype(np.float32)
+    hess = rng.rand(n).astype(np.float32)
+    mask = (rng.rand(n) < 0.7)
+
+    # numpy reference
+    ref = np.zeros((f, b, 3), np.float64)
+    m = mask.astype(np.float64)
+    for j in range(f):
+        ref[j, :, 0] = np.bincount(bins[:, j], weights=grad * m, minlength=b)
+        ref[j, :, 1] = np.bincount(bins[:, j], weights=hess * m, minlength=b)
+        ref[j, :, 2] = np.bincount(bins[:, j], weights=m, minlength=b)
+
+    db = jnp.asarray(bins)
+    dg = jnp.asarray(grad)
+    dh = jnp.asarray(hess)
+    dm = jnp.asarray(mask)
+
+    from lightgbm_tpu.ops.histogram import histogram_scatter, histogram_onehot_matmul
+    from lightgbm_tpu.ops import hist_pallas as hp
+
+    results = {}
+
+    def check(name, out, tol):
+        out = np.asarray(out, np.float64)
+        err = np.max(np.abs(out - ref) / (np.abs(ref) + 1.0))
+        ok = err < tol
+        print(f"  {name}: rel_err={err:.2e} {'OK' if ok else 'FAIL'}")
+        return ok
+
+    variants = sys.argv[4].split(",") if len(sys.argv) > 4 else [
+        "onehot_xla", "direct_bf16_2048", "hilo_bf16_2048", "hilo_f32_2048",
+        "q8_hilo_2048",
+    ]
+
+    refq = None
+    for name in variants:
+        try:
+            if name == "scatter":
+                fn = jax.jit(lambda: histogram_scatter(db, dg, dh, dm, b))
+                ms, out = timeit(fn, reps=3)
+                results[name] = ms
+                check(name, out, 1e-4)
+            elif name == "onehot_xla":
+                fn = jax.jit(lambda: histogram_onehot_matmul(db, dg, dh, dm, b))
+                ms, out = timeit(fn, reps=3)
+                results[name] = ms
+                check(name, out, 1e-4)
+            elif name.startswith("q8_"):
+                _, kind, rt = name.split("_")
+                gq = jnp.asarray(np.clip(np.round(grad * 15), -31, 31).astype(np.int8))
+                hq = jnp.asarray(np.clip(np.round(hess * 31), 0, 31).astype(np.int8))
+                fn = jax.jit(
+                    lambda k=kind, r=int(rt): hp.histogram_pallas_quantized(
+                        db, gq, hq, dm, b, kind=k, row_tile=r
+                    )
+                )
+                ms, out = timeit(fn)
+                results[name] = ms
+                if refq is None:
+                    refq = np.zeros((f, b, 3), np.int64)
+                    mq = mask.astype(np.int64)
+                    gqn = np.asarray(gq, np.int64)
+                    hqn = np.asarray(hq, np.int64)
+                    for j in range(f):
+                        refq[j, :, 0] = np.bincount(bins[:, j], weights=gqn * mq, minlength=b)
+                        refq[j, :, 1] = np.bincount(bins[:, j], weights=hqn * mq, minlength=b)
+                        refq[j, :, 2] = np.bincount(bins[:, j], weights=mq, minlength=b)
+                exact = np.array_equal(np.asarray(out, np.int64), refq)
+                print(f"  {name}: exact={'OK' if exact else 'FAIL'}")
+            else:
+                kind, prec, rt = name.split("_")
+                fn = jax.jit(
+                    lambda k=kind, p=prec, r=int(rt): hp.histogram_pallas(
+                        db, dg, dh, dm, b, kind=k, precision=p, row_tile=r
+                    )
+                )
+                ms, out = timeit(fn)
+                results[f"pallas_{name}"] = ms
+                check(name, out, 5e-3 if prec == "bf16" else 1e-4)
+        except Exception as e:
+            print(f"  {name}: ERROR {type(e).__name__}: {str(e)[:300]}", flush=True)
+
+    print(f"\nN={n} F={f} B={b} on {jax.devices()[0].platform}")
+    for k, v in sorted(results.items(), key=lambda kv: kv[1]):
+        print(f"  {k:32s} {v:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -237,6 +237,20 @@ class Config:
     device_type: str = "tpu"
     seed: int = 0
     deterministic: bool = False
+    # TPU-specific growth scheduling (ops/treegrow_fast.py): "auto" uses the
+    # round-batched grower on TPU backends and the strict best-first grower
+    # elsewhere; "strict" / "rounds" force one.  Split formulas are shared
+    # (ops/split.py), but the rounds grower differs from the reference in
+    # leaf expansion ORDER and in histogram payload precision (see
+    # hist_precision), so trees can differ from strict/CPU ones — the same
+    # class of deviation the reference documents for its CUDA-vs-CPU
+    # learners.
+    tree_growth_mode: str = "auto"
+    # histogram payload precision on the TPU MXU path: "f32" = bf16x2 split
+    # payloads (~17-bit mantissa products, f32 accumulation — between the
+    # reference's float and double hist modes); "bf16" = single bf16
+    # payloads (~8-bit mantissa, cheapest)
+    hist_precision: str = "f32"
 
     # --- learning control ---
     force_col_wise: bool = False
@@ -406,6 +420,14 @@ class Config:
         if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
             raise ValueError(
                 "Number of classes should be specified and greater than 1 for multiclass training"
+            )
+        if self.tree_growth_mode not in ("auto", "strict", "rounds"):
+            raise ValueError(
+                f"tree_growth_mode must be auto/strict/rounds, got {self.tree_growth_mode!r}"
+            )
+        if self.hist_precision not in ("f32", "bf16"):
+            raise ValueError(
+                f"hist_precision must be f32/bf16, got {self.hist_precision!r}"
             )
 
     def to_dict(self) -> Dict[str, Any]:

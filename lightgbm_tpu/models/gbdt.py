@@ -59,7 +59,11 @@ class GBDT:
         self.cfg = cfg
         self.objective = objective if objective is not None else create_objective(cfg)
         self.train_set = None
-        self.models: List[Tree] = []  # flattened: iter-major, class-minor
+        self._models: List[Tree] = []  # flattened: iter-major, class-minor
+        # device trees not yet materialized to host (fast async path): the
+        # round-batched grower runs whole iterations without host syncs and
+        # trees are converted lazily on first host access (save/predict/...)
+        self._pending: List[tuple] = []
         self.iter_ = 0
         self.num_tree_per_iteration = cfg.num_tree_per_iteration
         self.init_scores = [0.0] * self.num_tree_per_iteration
@@ -75,6 +79,28 @@ class GBDT:
         self.rng = np.random.RandomState(cfg.seed)
         if train_set is not None:
             self.reset_training_data(train_set)
+
+    # ------------------------------------------------------------------
+    @property
+    def models(self) -> List[Tree]:
+        """Host trees; converts any pending device trees first (the fast
+        grower defers tree_from_device so training never blocks on the
+        host<->device round-trip — reference keeps trees host-side always)."""
+        self._flush_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value) -> None:
+        self._pending = []
+        self._models = value
+
+    def _flush_pending(self) -> None:
+        if self._pending:
+            pending, self._pending = self._pending, []
+            for arrays, shrink in pending:
+                tree = tree_from_device(arrays, self.binner)
+                tree.apply_shrinkage(shrink)
+                self._models.append(tree)
 
     # ------------------------------------------------------------------
     def reset_training_data(self, train_set) -> None:
@@ -159,6 +185,13 @@ class GBDT:
         self._needs_node_rng = bool(
             self.cfg.extra_trees or self.cfg.feature_fraction_bynode < 1.0
         )
+        # growth scheduling: round-batched grower on TPU (tree_growth_mode)
+        self._on_tpu = jax.devices()[0].platform == "tpu"
+        mode = self.cfg.tree_growth_mode
+        self._use_fast = (
+            self.cfg.tree_learner == "serial"
+            and (mode == "rounds" or (mode == "auto" and self._on_tpu))
+        )
         # distributed tree learner over the device mesh (reference:
         # TreeLearner::CreateTreeLearner picking {serial,data,feature,voting})
         self._dp = None
@@ -207,6 +240,15 @@ class GBDT:
             feature_fraction_bynode=self.cfg.feature_fraction_bynode,
             extra_trees=bool(self.cfg.extra_trees),
         )
+
+    def _valid_bins_device(self, valid_set) -> jnp.ndarray:
+        """Device-resident binned matrix of a valid set (cached) for the
+        async scoring path."""
+        cached = getattr(valid_set, "_bins_dev_cache", None)
+        if cached is None:
+            cached = jnp.asarray(np.asarray(valid_set.bins), jnp.int32)
+            valid_set._bins_dev_cache = cached
+        return cached
 
     def add_valid(self, valid_set, name: str) -> None:
         valid_set.construct(reference=self.train_set)
@@ -372,6 +414,30 @@ class GBDT:
                     top_k=self.cfg.top_k,
                 )
                 leaf_id = leaf_id_pad[: ts.num_data()]
+            elif self._use_fast:
+                from ..ops.treegrow_fast import grow_tree_fast
+
+                arrays, leaf_id = grow_tree_fast(
+                    ts.bins_device,
+                    gc,
+                    hc,
+                    row_mask,
+                    sample_weight,
+                    feature_mask,
+                    ts.num_bins_pf_device,
+                    ts.missing_bin_pf_device,
+                    self._categorical_mask,
+                    self._monotone,
+                    self._interaction_sets,
+                    node_rng,
+                    num_leaves=self.cfg.num_leaves,
+                    num_bins=ts.max_num_bins,
+                    max_depth=self.cfg.max_depth,
+                    params=self._split_params,
+                    leaf_tile=min(16, self.cfg.num_leaves),
+                    hist_precision=self.cfg.hist_precision,
+                    use_pallas=self._on_tpu,
+                )
             else:
                 arrays, leaf_id = grow_tree(
                     ts.bins_device,
@@ -403,6 +469,33 @@ class GBDT:
                     active = jnp.arange(self.cfg.num_leaves) < arrays.num_leaves
                     leaf_values = jnp.where(active, renewed, 0.0)
                     arrays = arrays._replace(leaf_value=leaf_values)
+            if self._use_fast:
+                # async path: no host materialization — score/valid updates
+                # run on device from the TreeArrays; the host Tree is built
+                # lazily (self.models property) so iterations pipeline freely
+                shrinkage = 1.0 if self.average_output else self.cfg.learning_rate
+                all_const = jnp.logical_and(
+                    jnp.asarray(all_const, dtype=bool), arrays.num_leaves <= 1
+                )
+                self._pending.append((arrays, shrinkage))
+                delta = arrays.leaf_value * jnp.float32(shrinkage)
+                if k == 1:
+                    self._score = self._score + delta[leaf_id]
+                else:
+                    self._score = self._score.at[:, c].add(delta[leaf_id])
+                for vi, vs in enumerate(self.valid_sets):
+                    from ..ops.treegrow_fast import predict_leaf_arrays
+
+                    leaf_v = predict_leaf_arrays(
+                        arrays, self._valid_bins_device(vs),
+                        ts.missing_bin_pf_device,
+                    )
+                    vals = delta[leaf_v]
+                    if k == 1:
+                        self._valid_scores[vi] = self._valid_scores[vi] + vals
+                    else:
+                        self._valid_scores[vi] = self._valid_scores[vi].at[:, c].add(vals)
+                continue
             tree = tree_from_device(arrays, self.binner)
             if tree.num_leaves > 1:
                 all_const = False
@@ -436,6 +529,16 @@ class GBDT:
                     self._valid_scores[vi] = self._valid_scores[vi].at[:, c].add(vals)
         self.iter_ += 1
         self._pred_cache = None
+        if not isinstance(all_const, bool):
+            # fast path: keep the cannot-split flag on device and only force
+            # it to host every 32 iterations, so callers doing
+            # `if train_one_iter(): break` don't serialize the pipeline
+            # (reference stops the moment a constant tree appears; we detect
+            # it within 32 iterations)
+            self._finished_dev = all_const
+            if (self.iter_ % 32) == 0:
+                return bool(all_const)
+            return False
         return all_const
 
     def rollback_one_iter(self) -> None:

@@ -1,0 +1,253 @@
+"""Pallas TPU histogram kernels — the hot op of GBDT training.
+
+TPU-native replacement for the reference's histogram inner loops
+(reference: src/treelearner/cuda/cuda_histogram_constructor.cu,
+src/io/dense_bin.hpp -> DenseBin::ConstructHistogram).  The CUDA kernel
+accumulates into shared-memory atomics; TPUs have no atomics, so the
+histogram is a one-hot matmul on the MXU with a VMEM accumulator that lives
+across a sequential row-tile grid (SURVEY.md §10.1 strategy 2): per feature,
+onehot(bin) in {0,1}^(T,B) is contracted against a (T, NC) payload.
+
+Measured design notes (microbenchmarks on a v5e chip, N=1M F=28 B=256,
+see benchmarks/hist_bench.py):
+
+* The kernel is VPU-bound on one-hot CONSTRUCTION (~6 ms/pass), not
+  MXU-bound: a hi/lo bin-decomposition variant that packs 4 features into
+  one 128x128 MXU tile (8x fewer MXU passes) measured 3x SLOWER because its
+  broadcast-select chains cost more VPU than they save MXU.  Hence the
+  direct formulation only.
+* Payload lanes are nearly free up to the 128-lane MXU tile: the (NC, B)
+  output occupies the same MXU tiles for NC in 4..128.  Near-f32 precision
+  therefore costs the same as bf16: the payload is split hi+lo bfloat16
+  (bf16x2) into 8 lanes and recombined after accumulation.  hi is exact in
+  bf16; lo is rounded to bf16, so products carry ~16-17 mantissa bits (vs 8
+  for plain bf16, 24 for true f32) and accumulation is f32 — between the
+  reference's float-hist and double-hist modes in practice.
+* The same free-lane property batches MULTIPLE histograms in one pass:
+  `histogram_pallas_multi` computes per-leaf histograms for up to 15 leaves
+  (channels = leaf one-hot x payload) in a single data pass — the engine of
+  the level-batched grower.
+* Mosaic on this toolchain rejects bf16/int8 broadcast-selects and tiles
+  >= (1024, lanes) in some kernels; everything is built in 32-bit dtypes,
+  cast at the dot, with a 512-row default tile.
+
+Channels convention of the package: (F, B, 3) = sum_grad, sum_hess, count.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _direct_kernel(bins_ref, pay_ref, out_ref, acc_ref, *, F, B, NC, dtype):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pay = pay_ref[...].astype(dtype)  # (T, NC)
+    T = pay.shape[0]
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (T, B), 1)
+    for f in range(F):
+        binf = bins_ref[:, f][:, None]  # (T, 1)
+        oh = (binf == iota_b).astype(dtype)  # (T, B)
+        h = jax.lax.dot_general(
+            pay, oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=acc_ref.dtype,
+        )  # (NC, B)
+        acc_ref[f] += h
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[...] = acc_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile", "matmul_dtype"))
+def _hist_pallas_raw(
+    bins: jnp.ndarray,  # (N, F) int32
+    payload: jnp.ndarray,  # (N, NC) f32 or int8
+    *,
+    num_bins: int,
+    row_tile: int,
+    matmul_dtype,
+):
+    n, f = bins.shape
+    nc = payload.shape[1]
+    B = _round_up(max(num_bins, 8), 8)
+    acc_dtype = jnp.int32 if payload.dtype == jnp.int8 else jnp.float32
+
+    n_pad = _round_up(n, row_tile)
+    if n_pad != n:
+        bins = jnp.pad(bins, ((0, n_pad - n), (0, 0)))
+        payload = jnp.pad(payload, ((0, n_pad - n), (0, 0)))
+    grid = (n_pad // row_tile,)
+
+    out_dims = (f, nc, B)
+    return pl.pallas_call(
+        functools.partial(_direct_kernel, F=f, B=B, NC=nc, dtype=matmul_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((row_tile, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_tile, nc), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(out_dims, lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(out_dims, acc_dtype),
+        scratch_shapes=[pltpu.VMEM(out_dims, acc_dtype)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_pad * f * B * nc,
+            bytes_accessed=n_pad * f * 4 + n_pad * nc * 4,
+            transcendentals=0,
+        ),
+    )(bins, payload)
+
+
+def _split_bf16x2(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """x == hi + lo with both halves exactly representable in bfloat16."""
+    hi = x.astype(jnp.bfloat16).astype(jnp.float32)
+    return hi, x - hi
+
+
+def histogram_pallas(
+    bins: jnp.ndarray,  # (N, F) int
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    mask: jnp.ndarray,
+    num_bins: int,
+    *,
+    precision: str = "f32",
+    row_tile: int = 512,
+) -> jnp.ndarray:
+    """Masked histogram -> (F, B, 3) f32, MXU-accumulated on device.
+
+    precision 'f32' packs bf16x2-split grad/hess into 8 payload lanes (same
+    MXU cost as bf16; ~17-bit-mantissa products — see module docstring);
+    'bf16' uses rounded payloads in 4 lanes (~8-bit mantissa).
+    """
+    bins = bins.astype(jnp.int32)
+    m = mask.astype(jnp.float32)
+    g = grad.astype(jnp.float32) * m
+    h = hess.astype(jnp.float32) * m
+    if precision == "f32":
+        g_hi, g_lo = _split_bf16x2(g)
+        h_hi, h_lo = _split_bf16x2(h)
+        pay = jnp.stack([g_hi, h_hi, m, jnp.zeros_like(m), g_lo, h_lo,
+                         jnp.zeros_like(m), jnp.zeros_like(m)], axis=-1)
+    elif precision == "bf16":
+        pay = jnp.stack([g, h, m, jnp.zeros_like(m)], axis=-1)
+    else:
+        raise ValueError(precision)
+    out = _hist_pallas_raw(
+        bins, pay, num_bins=num_bins, row_tile=row_tile,
+        matmul_dtype=jnp.bfloat16,
+    )  # (F, NC, B)
+    if precision == "f32":
+        out3 = jnp.stack(
+            [out[:, 0] + out[:, 4], out[:, 1] + out[:, 5], out[:, 2]], axis=-1
+        )  # (F, B, 3)
+    else:
+        out3 = out[:, :3, :].transpose(0, 2, 1)
+    if out3.shape[1] != num_bins:
+        out3 = out3[:, :num_bins, :]
+    return out3
+
+
+def histogram_pallas_multi(
+    bins: jnp.ndarray,  # (N, F) int
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    mask: jnp.ndarray,  # (N,) in-bag mask
+    leaf_id: jnp.ndarray,  # (N,) int32 current leaf per row
+    leaf_base: int,
+    num_leaves_tile: int,  # histograms for leaves [leaf_base, leaf_base + tile)
+    num_bins: int,
+    *,
+    precision: str = "f32",
+    row_tile: int = 512,
+) -> jnp.ndarray:
+    """Per-leaf histograms for a tile of leaves in ONE data pass.
+
+    Returns (L_tile, F, B, 3).  Channels are leaf-onehot x payload: lane
+    l*NCL + c holds payload channel c masked to leaf leaf_base+l.  With
+    NCL=8 (f32 precision) a 128-lane payload covers 16 leaves per pass.
+    This is the TPU replacement for per-leaf row-index histogramming
+    (reference: Dataset::ConstructHistograms over DataPartition indices).
+    """
+    bins = bins.astype(jnp.int32)
+    m = mask.astype(jnp.float32)
+    g = grad.astype(jnp.float32) * m
+    h = hess.astype(jnp.float32) * m
+    if precision == "f32":
+        g_hi, g_lo = _split_bf16x2(g)
+        h_hi, h_lo = _split_bf16x2(h)
+        chans = [g_hi, h_hi, m, g_lo, h_lo, jnp.zeros_like(m)]
+    elif precision == "bf16":
+        chans = [g, h, m]
+    else:
+        raise ValueError(precision)
+    ncl = len(chans)
+    base = jnp.stack(chans, axis=-1)  # (N, ncl)
+    lid = leaf_id.astype(jnp.int32) - leaf_base
+    onehot = (
+        lid[:, None] == jnp.arange(num_leaves_tile, dtype=jnp.int32)[None, :]
+    ).astype(jnp.float32)  # (N, L_tile)
+    pay = (onehot[:, :, None] * base[:, None, :]).reshape(
+        bins.shape[0], num_leaves_tile * ncl
+    )
+    nc_pad = _round_up(num_leaves_tile * ncl, 4)
+    if nc_pad != pay.shape[1]:
+        pay = jnp.pad(pay, ((0, 0), (0, nc_pad - pay.shape[1])))
+    out = _hist_pallas_raw(
+        bins, pay, num_bins=num_bins, row_tile=row_tile,
+        matmul_dtype=jnp.bfloat16,
+    )  # (F, nc_pad, B)
+    out = out[:, : num_leaves_tile * ncl, :].reshape(
+        bins.shape[1], num_leaves_tile, ncl, -1
+    )
+    if precision == "f32":
+        out3 = jnp.stack(
+            [out[:, :, 0] + out[:, :, 3], out[:, :, 1] + out[:, :, 4], out[:, :, 2]],
+            axis=-1,
+        )  # (F, L_tile, B, 3)
+    else:
+        out3 = jnp.moveaxis(out[:, :, :3, :], 2, 3)
+    out3 = jnp.moveaxis(out3, 0, 1)  # (L_tile, F, B, 3)
+    if out3.shape[2] != num_bins:
+        out3 = out3[:, :, :num_bins, :]
+    return out3
+
+
+def histogram_pallas_quantized(
+    bins: jnp.ndarray,
+    grad_q: jnp.ndarray,  # (N,) int8 — discretized gradients
+    hess_q: jnp.ndarray,  # (N,) int8 — discretized hessians (non-negative)
+    mask: jnp.ndarray,
+    num_bins: int,
+    *,
+    row_tile: int = 512,
+) -> jnp.ndarray:
+    """Quantized histogram -> (F, B, 3) int32 (grad_sum, hess_sum, count):
+    exact int32 accumulation on the int8 MXU (reference:
+    src/treelearner/gradient_discretizer.cpp quantized-training path)."""
+    bins = bins.astype(jnp.int32)
+    m8 = mask.astype(jnp.int8)
+    pay = jnp.stack(
+        [grad_q.astype(jnp.int8) * m8, hess_q.astype(jnp.int8) * m8, m8,
+         jnp.zeros_like(m8)],
+        axis=-1,
+    )
+    out = _hist_pallas_raw(bins, pay, num_bins=num_bins, row_tile=row_tile,
+                           matmul_dtype=jnp.int8)
+    out = out[:, :3, :].transpose(0, 2, 1)
+    if out.shape[1] != num_bins:
+        out = out[:, :num_bins, :]
+    return out
