@@ -192,6 +192,14 @@ class GBDT:
             self.cfg.tree_learner == "serial"
             and (mode == "rounds" or (mode == "auto" and self._on_tpu))
         )
+        if self.cfg.use_quantized_grad and not self._use_fast:
+            from ..utils.log import log_warning
+
+            log_warning(
+                "use_quantized_grad is implemented on the rounds grower "
+                "(tree_growth_mode=rounds / auto-on-TPU) only; this run "
+                "trains UNQUANTIZED on the strict grower."
+            )
         # distributed tree learner over the device mesh (reference:
         # TreeLearner::CreateTreeLearner picking {serial,data,feature,voting})
         self._dp = None
@@ -240,15 +248,6 @@ class GBDT:
             feature_fraction_bynode=self.cfg.feature_fraction_bynode,
             extra_trees=bool(self.cfg.extra_trees),
         )
-
-    def _valid_bins_device(self, valid_set) -> jnp.ndarray:
-        """Device-resident binned matrix of a valid set (cached) for the
-        async scoring path."""
-        cached = getattr(valid_set, "_bins_dev_cache", None)
-        if cached is None:
-            cached = jnp.asarray(np.asarray(valid_set.bins), jnp.int32)
-            valid_set._bins_dev_cache = cached
-        return cached
 
     def add_valid(self, valid_set, name: str) -> None:
         valid_set.construct(reference=self.train_set)
@@ -417,6 +416,7 @@ class GBDT:
             elif self._use_fast:
                 from ..ops.treegrow_fast import grow_tree_fast
 
+                quant = self.cfg.use_quantized_grad
                 arrays, leaf_id = grow_tree_fast(
                     ts.bins_device,
                     gc,
@@ -430,6 +430,8 @@ class GBDT:
                     self._monotone,
                     self._interaction_sets,
                     node_rng,
+                    (jax.random.PRNGKey(self.cfg.seed * 1000003 + self.iter_ * 31 + c)
+                     if quant else None),
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -437,6 +439,9 @@ class GBDT:
                     leaf_tile=min(16, self.cfg.num_leaves),
                     hist_precision=self.cfg.hist_precision,
                     use_pallas=self._on_tpu,
+                    quantize_bins=(self.cfg.num_grad_quant_bins if quant else 0),
+                    stochastic_rounding=bool(self.cfg.stochastic_rounding),
+                    quant_renew=bool(self.cfg.quant_train_renew_leaf),
                 )
             else:
                 arrays, leaf_id = grow_tree(
@@ -487,8 +492,7 @@ class GBDT:
                     from ..ops.treegrow_fast import predict_leaf_arrays
 
                     leaf_v = predict_leaf_arrays(
-                        arrays, self._valid_bins_device(vs),
-                        ts.missing_bin_pf_device,
+                        arrays, vs.bins_device, ts.missing_bin_pf_device,
                     )
                     vals = delta[leaf_v]
                     if k == 1:
@@ -530,12 +534,12 @@ class GBDT:
         self.iter_ += 1
         self._pred_cache = None
         if not isinstance(all_const, bool):
-            # fast path: keep the cannot-split flag on device and only force
-            # it to host every 32 iterations, so callers doing
-            # `if train_one_iter(): break` don't serialize the pipeline
-            # (reference stops the moment a constant tree appears; we detect
-            # it within 32 iterations)
-            self._finished_dev = all_const
+            # fast path: only force the cannot-split flag to host every 32
+            # iterations, so callers doing `if train_one_iter(): break` don't
+            # serialize the pipeline.  The reference stops the moment a
+            # constant tree appears; we detect within 32 iterations (once an
+            # iteration is constant the score stops changing, so every later
+            # iteration is constant too and the next check catches it).
             if (self.iter_ % 32) == 0:
                 return bool(all_const)
             return False

@@ -226,6 +226,50 @@ def histogram_pallas_multi(
     return out3
 
 
+def histogram_pallas_multi_quantized(
+    bins: jnp.ndarray,  # (N, F) int
+    grad_q: jnp.ndarray,  # (N,) int8 — discretized gradients
+    hess_q: jnp.ndarray,  # (N,) int8 — discretized hessians (non-negative)
+    mask: jnp.ndarray,  # (N,) in-bag mask
+    leaf_id: jnp.ndarray,  # (N,) int32 current leaf per row
+    leaf_base: int,
+    num_leaves_tile: int,
+    num_bins: int,
+    *,
+    row_tile: int = 512,
+) -> jnp.ndarray:
+    """Quantized per-leaf histograms for a tile of leaves in one pass ->
+    (L_tile, F, B, 3) int32: exact integer accumulation on the int8 MXU
+    (reference: gradient_discretizer.cpp + per-leaf ConstructHistograms).
+    Lanes are leaf-onehot x (grad_q, hess_q, count) int8 payload."""
+    bins = bins.astype(jnp.int32)
+    m8 = mask.astype(jnp.int8)
+    base = jnp.stack(
+        [grad_q.astype(jnp.int8) * m8, hess_q.astype(jnp.int8) * m8, m8], axis=-1
+    )  # (N, 3)
+    lid = leaf_id.astype(jnp.int32) - leaf_base
+    onehot = (
+        lid[:, None] == jnp.arange(num_leaves_tile, dtype=jnp.int32)[None, :]
+    ).astype(jnp.int8)  # (N, L_tile)
+    ncl = 3
+    pay = (onehot[:, :, None] * base[:, None, :]).reshape(
+        bins.shape[0], num_leaves_tile * ncl
+    )
+    nc_pad = _round_up(num_leaves_tile * ncl, 4)
+    if nc_pad != pay.shape[1]:
+        pay = jnp.pad(pay, ((0, 0), (0, nc_pad - pay.shape[1])))
+    out = _hist_pallas_raw(
+        bins, pay, num_bins=num_bins, row_tile=row_tile, matmul_dtype=jnp.int8
+    )  # (F, nc_pad, B) int32
+    out = out[:, : num_leaves_tile * ncl, :].reshape(
+        bins.shape[1], num_leaves_tile, ncl, -1
+    )
+    out = jnp.moveaxis(jnp.moveaxis(out, 2, 3), 0, 1)  # (L_tile, F, B, 3)
+    if out.shape[2] != num_bins:
+        out = out[:, :, :num_bins, :]
+    return out
+
+
 def histogram_pallas_quantized(
     bins: jnp.ndarray,
     grad_q: jnp.ndarray,  # (N,) int8 — discretized gradients

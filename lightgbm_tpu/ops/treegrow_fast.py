@@ -37,7 +37,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from .hist_pallas import histogram_pallas_multi
+from .hist_pallas import histogram_pallas_multi, histogram_pallas_multi_quantized
 from .histogram import histogram
 from .split import (
     BestSplit, SplitParams, find_best_split, leaf_output, KMIN_SCORE,
@@ -127,7 +127,8 @@ def _batched_best(
     jax.jit,
     static_argnames=(
         "num_leaves", "num_bins", "max_depth", "params", "axis_name",
-        "leaf_tile", "hist_precision", "use_pallas",
+        "leaf_tile", "hist_precision", "use_pallas", "quantize_bins",
+        "stochastic_rounding", "quant_renew",
     ),
 )
 def grow_tree_fast(
@@ -143,6 +144,7 @@ def grow_tree_fast(
     monotone_constraints: jnp.ndarray = None,
     interaction_sets: jnp.ndarray = None,
     rng_key: jnp.ndarray = None,
+    quant_key: jnp.ndarray = None,
     *,
     num_leaves: int,
     num_bins: int,
@@ -152,43 +154,85 @@ def grow_tree_fast(
     leaf_tile: int = 16,
     hist_precision: str = "f32",
     use_pallas: bool = True,
+    quantize_bins: int = 0,
+    stochastic_rounding: bool = True,
+    quant_renew: bool = False,
 ) -> tuple[TreeArrays, jnp.ndarray]:
-    """Grow one tree in rounds; returns (tree, final leaf_id per row)."""
+    """Grow one tree in rounds; returns (tree, final leaf_id per row).
+
+    quantize_bins > 0 enables quantized training (reference:
+    src/treelearner/gradient_discretizer.cpp): gradients/hessians are
+    discretized to ints (stochastic rounding), histograms accumulate
+    exactly in int32 on the int8 MXU, and split evaluation sees the
+    rescaled sums.  quant_renew recomputes leaf outputs from the true f32
+    gradients after growth (reference: RenewIntGradTreeOutput).
+    """
     n, f = bins.shape
     bins = bins.astype(jnp.int32)
     grad = grad.astype(jnp.float32) * sample_weight
     hess = hess.astype(jnp.float32) * sample_weight
+    grad_true, hess_true = grad, hess
     L = num_leaves
 
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
-    def multi_hist(leaf_slot):
-        """(N,)-slot -> (leaf_tile, F, B, 3): per-slot histograms, one pass."""
-        if use_pallas:
+    if quantize_bins:
+        # discretize: grad in [-half, half], hess in [0, quantize_bins]
+        # (reference: GradientDiscretizer::DiscretizeGradients)
+        half = max(quantize_bins // 2, 1)
+        inbag = row_mask.astype(jnp.float32)
+
+        def pmax(x):
+            return jax.lax.pmax(x, axis_name) if axis_name is not None else x
+
+        g_scale = jnp.maximum(pmax(jnp.max(jnp.abs(grad) * inbag)) / half, 1e-30)
+        h_scale = jnp.maximum(pmax(jnp.max(hess * inbag)) / quantize_bins, 1e-30)
+        gs = grad / g_scale
+        hs = hess / h_scale
+        if stochastic_rounding:
+            if quant_key is None:
+                quant_key = jax.random.PRNGKey(0)
+            kg, kh = jax.random.split(quant_key)
+            gq = jnp.floor(gs + jax.random.uniform(kg, gs.shape))
+            hq = jnp.floor(hs + jax.random.uniform(kh, hs.shape))
+        else:
+            gq = jnp.round(gs)
+            hq = jnp.round(hs)
+        gq = jnp.clip(gq, -127, 127).astype(jnp.int8)
+        hq = jnp.clip(hq, 0, 127).astype(jnp.int8)
+        # everything downstream sees the dequantized values so leaf stats,
+        # subtraction and split eval are consistent with the int histograms
+        grad = gq.astype(jnp.float32) * g_scale
+        hess = hq.astype(jnp.float32) * h_scale
+        quant_scale = jnp.stack([g_scale, h_scale, jnp.float32(1.0)])
+
+    def multi_hist(leaf_slot, tile):
+        """(N,)-slot -> (tile, F, B, 3) f32: per-slot histograms, one pass."""
+        if use_pallas and quantize_bins:
+            hi = histogram_pallas_multi_quantized(
+                bins, gq, hq, row_mask & (leaf_slot >= 0),
+                jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
+            )
+            h = hi.astype(jnp.float32) * quant_scale
+        elif use_pallas:
             h = histogram_pallas_multi(
                 bins, grad, hess, row_mask & (leaf_slot >= 0),
-                jnp.maximum(leaf_slot, 0), 0, leaf_tile, num_bins,
+                jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
                 precision=hist_precision,
             )
         else:
-            # CPU/test fallback: per-slot masked scatter histograms
+            # CPU/test fallback: per-slot masked scatter histograms (uses the
+            # dequantized grad/hess, so results match the int path's scaling)
             def one(s):
                 m = row_mask & (leaf_slot == s)
                 return histogram(bins, grad, hess, m.astype(jnp.float32),
                                  num_bins, strategy="scatter")
-            h = jax.vmap(one)(jnp.arange(leaf_tile, dtype=jnp.int32))
+            h = jax.vmap(one)(jnp.arange(tile, dtype=jnp.int32))
         return psum(h)
 
     # ---- root ----
-    mask0 = row_mask.astype(jnp.float32)
-    hist0 = psum(histogram(bins, grad, hess, mask0, num_bins, strategy="auto")
-                 if not use_pallas else
-                 histogram_pallas_multi(
-                     bins, grad, hess, row_mask,
-                     jnp.zeros((n,), jnp.int32), 0, 1, num_bins,
-                     precision=hist_precision,
-                 )[0])
+    hist0 = multi_hist(jnp.where(row_mask, 0, -1).astype(jnp.int32), 1)[0]
     sum0 = jnp.sum(hist0[0], axis=0)
     g0, h0, c0 = sum0[0], sum0[1], sum0[2]
 
@@ -434,7 +478,7 @@ def grow_tree_fast(
             leaf_r = jnp.argmax(has_r).astype(jnp.int32)
             exists = jnp.any(has_r)
             leaf_slot = jnp.where(exists & (lid == leaf_r), r, leaf_slot)
-        fresh_hists = multi_hist(leaf_slot)  # (leaf_tile, F, B, 3)
+        fresh_hists = multi_hist(leaf_slot, leaf_tile)  # (leaf_tile, F, B, 3)
         idx = jnp.arange(L, dtype=jnp.int32)
         is_small = state.small_slot >= 0
         # write small-child hists
@@ -491,7 +535,15 @@ def grow_tree_fast(
 
     state = jax.lax.while_loop(cond, body, state)
 
-    leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
+    if quant_renew and quantize_bins:
+        # recompute leaf outputs from the TRUE f32 gradients (reference:
+        # GBDT::Train -> RenewIntGradTreeOutput after quantized growth)
+        mrow = row_mask.astype(jnp.float32)
+        Gt = psum(jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(grad_true * mrow))
+        Ht = psum(jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(hess_true * mrow))
+        leaf_value = leaf_output(Gt, Ht, params)
+    else:
+        leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
     if monotone_constraints is not None:
         leaf_value = jnp.clip(leaf_value, state.leaf_out_lo, state.leaf_out_hi)
     active = jnp.arange(L, dtype=jnp.int32) < state.num_leaves_cur

@@ -155,3 +155,23 @@ def test_config_rejects_bad_growth_mode():
             params={"tree_growth_mode": "round"},
             train_set=lgb.Dataset(np.zeros((10, 2)), label=np.zeros(10)),
         )
+
+
+def test_quantized_training_matches_fp32_quality():
+    """Quantized (int-histogram) training must track fp32 AUC (reference:
+    quantized-training paper's parity claim; gradient_discretizer.cpp)."""
+    X, y = _data(n=6000, f=10, seed=5)
+    aucs = {}
+    for quant in (False, True):
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.Booster(
+            params={"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                    "tree_growth_mode": "rounds", "use_quantized_grad": quant,
+                    "num_grad_quant_bins": 8, "quant_train_renew_leaf": True},
+            train_set=ds,
+        )
+        for _ in range(15):
+            bst.update()
+        aucs[quant] = _auc(y, bst.predict(X))
+    assert aucs[True] > 0.9
+    assert abs(aucs[True] - aucs[False]) < 0.02
