@@ -99,6 +99,8 @@ def train(
 
     train_in_valids = any(vs is train_set for vs in (valid_sets or []))
 
+    snapshot_freq = int(cfg_probe.snapshot_freq)
+
     try:
         for i in range(num_boost_round):
             for cb in callbacks_before:
@@ -110,6 +112,13 @@ def train(
             evaluation_result_list.extend(booster.eval_valid(feval))
             for cb in callbacks_after:
                 cb(CallbackEnv(booster, params, i, 0, num_boost_round, evaluation_result_list))
+            if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+                # periodic failure-recovery snapshot (reference: CLI
+                # snapshot_freq / save_period — GBDT::Train saves
+                # model_output_path.snapshot_iter_<n> every freq iterations)
+                snap = f"{cfg_probe.output_model}.snapshot_iter_{i + 1}"
+                booster.save_model(snap)
+                log_info(f"Saved snapshot to {snap}")
             if finished:
                 log_info("Stopped training because there are no more leaves that meet the split requirements")
                 break

@@ -140,6 +140,11 @@ class GBDT:
         self._score = jnp.asarray(init)
         if self.objective is not None and hasattr(self.objective, "set_query") and train_set.query_boundaries is not None:
             self.objective.set_query(train_set.query_boundaries, np.asarray(train_set.label))
+            if (
+                hasattr(self.objective, "set_positions")
+                and getattr(train_set, "position", None) is not None
+            ):
+                self.objective.set_positions(train_set.position)
         self._split_params = SplitParams(
             lambda_l1=self.cfg.lambda_l1,
             lambda_l2=self.cfg.lambda_l2,
@@ -154,6 +159,9 @@ class GBDT:
             max_cat_to_onehot=self.cfg.max_cat_to_onehot,
             feature_fraction_bynode=self.cfg.feature_fraction_bynode,
             extra_trees=bool(self.cfg.extra_trees),
+            monotone_penalty=self.cfg.monotone_penalty,
+            cegb_tradeoff=self.cfg.cegb_tradeoff,
+            cegb_penalty_split=self.cfg.cegb_penalty_split,
         )
         cat_mask = np.asarray(self.binner.categorical_mask)
         self._allowed_features = jnp.ones(cat_mask.shape, dtype=bool)
@@ -192,9 +200,38 @@ class GBDT:
             self.cfg.tree_learner == "serial"
             and (mode == "rounds" or (mode == "auto" and self._on_tpu))
         )
+        # CEGB coupled per-feature penalties (reference: cegb.hpp); the
+        # across-trees "feature already used anywhere" state lives here and
+        # is updated on device after every tree
+        if any(p != 0 for p in (self.cfg.cegb_penalty_feature_coupled or [])):
+            pen = np.zeros(f, np.float32)
+            for i, v in enumerate((self.cfg.cegb_penalty_feature_coupled or [])[:f]):
+                pen[i] = self.cfg.cegb_tradeoff * float(v)
+            self._cegb_coupled = jnp.asarray(pen)
+            self._cegb_used_global = jnp.zeros((f,), bool)
+        else:
+            self._cegb_coupled = None
+            self._cegb_used_global = None
+        from ..utils.log import log_warning
+        if self.cfg.forcedsplits_filename:
+            log_warning(
+                "forcedsplits_filename is not implemented yet; the file is "
+                "IGNORED and splits are chosen by gain."
+            )
+        if any(p != 0 for p in (self.cfg.cegb_penalty_feature_lazy or [])):
+            log_warning(
+                "cegb_penalty_feature_lazy is not implemented (per-row feature "
+                "charge state); coupled + split penalties are. The lazy "
+                "penalty is IGNORED."
+            )
+        if self._monotone is not None and self.cfg.monotone_constraints_method in (
+            "intermediate", "advanced"
+        ):
+            log_warning(
+                f"monotone_constraints_method={self.cfg.monotone_constraints_method!r} "
+                "is not implemented; falling back to 'basic'."
+            )
         if self.cfg.use_quantized_grad and not self._use_fast:
-            from ..utils.log import log_warning
-
             log_warning(
                 "use_quantized_grad is implemented on the rounds grower "
                 "(tree_growth_mode=rounds / auto-on-TPU) only; this run "
@@ -247,6 +284,9 @@ class GBDT:
             max_cat_to_onehot=self.cfg.max_cat_to_onehot,
             feature_fraction_bynode=self.cfg.feature_fraction_bynode,
             extra_trees=bool(self.cfg.extra_trees),
+            monotone_penalty=self.cfg.monotone_penalty,
+            cegb_tradeoff=self.cfg.cegb_tradeoff,
+            cegb_penalty_split=self.cfg.cegb_penalty_split,
         )
 
     def add_valid(self, valid_set, name: str) -> None:
@@ -365,6 +405,12 @@ class GBDT:
 
         all_const = True
         for c in range(k):
+            # recomputed per class tree: a feature used by an earlier class's
+            # tree this iteration is no longer charged (reference: cegb.hpp
+            # updates coupled state sequentially across trees)
+            cegb_pen = None
+            if self._cegb_coupled is not None:
+                cegb_pen = jnp.where(self._cegb_used_global, 0.0, self._cegb_coupled)
             gc = g if k == 1 else g[:, c]
             hc = h if k == 1 else h[:, c]
             node_rng = (
@@ -432,6 +478,7 @@ class GBDT:
                     node_rng,
                     (jax.random.PRNGKey(self.cfg.seed * 1000003 + self.iter_ * 31 + c)
                      if quant else None),
+                    cegb_pen,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -457,12 +504,20 @@ class GBDT:
                     self._monotone,
                     self._interaction_sets,
                     node_rng,
+                    cegb_pen,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
                     params=self._split_params,
                     hist_strategy="auto",
                 )
+            if self._cegb_coupled is not None:
+                valid_nodes = (
+                    jnp.arange(self.cfg.num_leaves - 1) < arrays.num_leaves - 1
+                )
+                self._cegb_used_global = self._cegb_used_global.at[
+                    jnp.where(valid_nodes, arrays.split_feature, 2 * self.cfg.num_leaves + self._cegb_used_global.shape[0])
+                ].set(True, mode="drop")
             leaf_values = arrays.leaf_value
             if self.objective is not None and self.objective.need_renew:
                 renewed = self.objective.renew_tree_output(
@@ -690,10 +745,53 @@ class GBDT:
             return np.stack([t.predict_leaf(X) for t in self.models[lo:hi]], axis=1)
         if pred_contrib:
             return self.predict_contrib(X, start_iteration, num_iteration)
-        raw = self.predict_raw(X, start_iteration, num_iteration)
+        if (
+            self.cfg.pred_early_stop
+            and not self.average_output  # RF averages trees; chunked sums break it
+            and self.objective is not None
+            and getattr(self.objective, "name", "") in ("binary", "multiclass", "multiclassova")
+        ):
+            raw = self._predict_raw_early_stop(X, start_iteration, num_iteration)
+        else:
+            raw = self.predict_raw(X, start_iteration, num_iteration)
         if raw_score or self.objective is None:
             return raw
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    def _predict_raw_early_stop(self, X, start_iteration=0, num_iteration=-1):
+        """Prediction early stopping (reference: include/LightGBM/
+        prediction_early_stop.h + predictor.hpp): every pred_early_stop_freq
+        trees, rows whose margin (|raw| for binary, top1-top2 for multiclass)
+        exceeds pred_early_stop_margin stop accumulating further trees."""
+        k = self.num_tree_per_iteration
+        total = len(self.models) // k
+        if num_iteration is not None and num_iteration >= 0:
+            total = min(total, start_iteration + num_iteration)
+        freq = max(int(self.cfg.pred_early_stop_freq), 1)
+        margin = float(self.cfg.pred_early_stop_margin)
+        X = np.asarray(X)
+        n = X.shape[0]
+        raw = None
+        active = np.ones(n, dtype=bool)
+        it = start_iteration
+        while it < total:
+            chunk = min(freq, total - it)
+            if raw is None:
+                raw = self.predict_raw(X, it, chunk)
+            else:
+                # only still-active rows traverse further trees (the point of
+                # prediction early stopping)
+                raw[active] += self.predict_raw(X[active], it, chunk)
+            it += chunk
+            if raw.ndim == 1:
+                m = np.abs(raw)
+            else:
+                top2 = np.partition(raw, -2, axis=1)[:, -2:]
+                m = top2[:, 1] - top2[:, 0]
+            active &= m < margin
+            if not active.any():
+                break
+        return raw if raw is not None else self.predict_raw(X, start_iteration, 0)
 
     def predict_contrib(self, X, start_iteration=0, num_iteration=-1) -> np.ndarray:
         """SHAP values via the per-tree path algorithm (reference:

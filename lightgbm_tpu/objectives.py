@@ -444,15 +444,47 @@ class LambdarankNDCG(_RankingObjective):
             inv[q] = 1.0 / m if m > 0 else 0.0
         self.inverse_max_dcg = inv
 
+    def set_positions(self, positions: np.ndarray):
+        """Enable position-bias correction (reference: rank_objective.hpp —
+        positions_/pos_biases_ and UpdatePositionBiasFactors).  The model
+        score is augmented with a learned additive per-position bias during
+        lambda computation; the biases themselves are refit each iteration
+        with a Newton step regularized by
+        lambdarank_position_bias_regularization, so the TREES learn the
+        position-debiased ranking while the biases absorb presentation
+        effects (unbiased LambdaRank)."""
+        positions = np.asarray(positions, np.int64).ravel()
+        idx = np.asarray(self._pad_idx)
+        self._pos_pad = jnp.asarray(positions[idx])  # (Q, S)
+        self.num_positions = int(positions.max()) + 1
+        self.pos_bias = jnp.zeros((self.num_positions,), jnp.float32)
+        self.pos_reg = float(getattr(self.cfg, "lambdarank_position_bias_regularization", 0.0))
+
+    _pos_pad = None
+
     def get_gradients(self, score, label, weight):
         idx, msk = self._pad_idx, self._pad_mask
         s = score[idx.reshape(-1)].reshape(idx.shape)
         l = label[idx.reshape(-1)].reshape(idx.shape)
+        if self._pos_pad is not None:
+            # scores seen by the lambda computation include the position bias
+            s = s + jnp.where(msk, self.pos_bias[self._pos_pad], 0.0)
         gains = jnp.asarray(self.label_gain, dtype=jnp.float32)
         inv_mdcg = jnp.asarray(self.inverse_max_dcg, dtype=jnp.float32)
         g, h = _lambdarank_pairwise(
             s, l, msk, gains, inv_mdcg, self.sigmoid, self.truncation, self.norm
         )
+        if self._pos_pad is not None:
+            # Newton refit of the biases from this iteration's lambdas
+            # (reference: UpdatePositionBiasFactors once per iteration)
+            P = self.num_positions
+            gm = jnp.where(msk, g, 0.0).reshape(-1)
+            hm = jnp.where(msk, h, 0.0).reshape(-1)
+            pp = self._pos_pad.reshape(-1)
+            Gp = jnp.zeros((P,), jnp.float32).at[pp].add(gm)
+            Hp = jnp.zeros((P,), jnp.float32).at[pp].add(hm)
+            reg = self.pos_reg
+            self.pos_bias = self.pos_bias - (Gp + reg * self.pos_bias) / (Hp + reg + 1e-9)
         # .add, not .set: pad_idx's padding lanes all alias row 0 and carry
         # masked-out zeros — a duplicate-index .set would zero row 0's grads
         grad = jnp.zeros_like(score).at[idx.reshape(-1)].add(g.reshape(-1))

@@ -45,6 +45,14 @@ class SplitParams(NamedTuple):
     # node-level sampling (reference: ColSampler bynode / extra_trees)
     feature_fraction_bynode: float = 1.0
     extra_trees: bool = False
+    # monotone split gain penalty (reference: config monotone_penalty ->
+    # ComputeMonotoneSplitGainPenalty in monotone_constraints.hpp)
+    monotone_penalty: float = 0.0
+    # CEGB (reference: src/treelearner/cost_effective_gradient_boosting.hpp):
+    # split gain is charged cegb_tradeoff * cegb_penalty_split * num_data
+    # plus per-feature penalties (passed per-leaf via cegb_feature_penalty)
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
 
 
 class BestSplit(NamedTuple):
@@ -76,6 +84,36 @@ def leaf_output(sum_g, sum_h, p: SplitParams):
     if p.max_delta_step > 0:
         out = jnp.clip(out, -p.max_delta_step, p.max_delta_step)
     return out
+
+
+def leaf_output_smoothed(sum_g, sum_h, count, parent_output, p: SplitParams):
+    """Path-smoothed leaf output (reference: CalculateSplittedLeafOutput with
+    USE_SMOOTHING: ret = raw * n/(n+smooth) + parent_output * smooth/(n+smooth),
+    written there as (n/s)/(n/s + 1) with s = path_smooth)."""
+    raw = leaf_output(sum_g, sum_h, p)
+    if p.path_smooth <= 0:
+        return raw
+    alpha = count / (count + p.path_smooth)
+    return raw * alpha + parent_output * (1.0 - alpha)
+
+
+def gain_given_output(sum_g, sum_h, l1, l2, out):
+    """reference: GetLeafGainGivenOutput (x-0.5 factor dropped as elsewhere)."""
+    tg = threshold_l1(sum_g, l1)
+    return -(2.0 * tg * out + (sum_h + l2 + KEPSILON) * out * out)
+
+
+def monotone_split_gain_penalty(depth, penalization):
+    """reference: LeafConstraintsBase::ComputeMonotoneSplitGainPenalty —
+    forbids monotone splits on the first floor(penalization) levels and
+    continuously penalizes beyond (returns the multiplicative factor)."""
+    depth = depth.astype(jnp.float32) if hasattr(depth, "astype") else jnp.float32(depth)
+    eps = 1e-10
+    full = penalization >= depth + 1.0
+    small = penalization <= 1.0
+    f_small = 1.0 - penalization / jnp.exp2(depth) + eps
+    f_big = 1.0 - jnp.exp2(penalization - 1.0 - depth) + eps
+    return jnp.where(full, eps, jnp.where(small, f_small, f_big))
 
 
 def leaf_gain(sum_g, sum_h, p: SplitParams):
@@ -116,6 +154,9 @@ def gain_plane(
     out_lo: jnp.ndarray | None = None,  # scalar — leaf output lower bound
     out_hi: jnp.ndarray | None = None,  # scalar — leaf output upper bound
     rng_key: jnp.ndarray | None = None,  # per-node key (extra_trees / bynode)
+    depth: jnp.ndarray | None = None,  # scalar — leaf depth (monotone_penalty)
+    parent_output: jnp.ndarray | None = None,  # scalar — this leaf's output (path_smooth)
+    cegb_feature_penalty: jnp.ndarray | None = None,  # (F,) pre-scaled coupled penalty
 ):
     """Evaluate every (feature, threshold, missing-direction) candidate and
     return `(gain (F, B), ctx)` — the full candidate-gain plane plus the
@@ -163,7 +204,16 @@ def gain_plane(
 
     parent_g = parent_sum_g
     parent_h = parent_sum_h
-    gain_parent = leaf_gain(parent_g, parent_h, params)
+    use_smooth = params.path_smooth > 0 and parent_output is not None
+    if use_smooth:
+        # with path smoothing all gains are evaluated at actual (smoothed)
+        # outputs; the parent term uses the leaf's stored output
+        # (reference: the USE_SMOOTHING instantiations of GetSplitGains)
+        gain_parent = gain_given_output(
+            parent_g, parent_h, params.lambda_l1, params.lambda_l2, parent_output
+        )
+    else:
+        gain_parent = leaf_gain(parent_g, parent_h, params)
 
     def eval_direction(missing_left: bool):
         add = miss if missing_left else jnp.zeros_like(miss)
@@ -180,28 +230,35 @@ def gain_plane(
             & (left_h >= params.min_sum_hessian_in_leaf)
             & (right_h >= params.min_sum_hessian_in_leaf)
         )
-        if monotone_constraints is None:
+        if monotone_constraints is None and not use_smooth:
             g = leaf_gain(left_g, left_h, params) + leaf_gain(right_g, right_h, params) - gain_parent
         else:
-            # basic monotone method (reference: monotone_constraints.hpp ->
-            # BasicLeafConstraints): outputs clipped to the leaf's inherited
-            # [out_lo, out_hi] band, gain evaluated at the clipped outputs
-            # (GetSplitGainGivenOutput) and ordering violations rejected.
-            lo = jnp.float32(-jnp.inf) if out_lo is None else out_lo
-            hi = jnp.float32(jnp.inf) if out_hi is None else out_hi
-            out_l = jnp.clip(leaf_output(left_g, left_h, params), lo, hi)
-            out_r = jnp.clip(leaf_output(right_g, right_h, params), lo, hi)
-
-            def given(g_, h_, out):
-                tg = threshold_l1(g_, params.lambda_l1)
-                denom = h_ + params.lambda_l2 + KEPSILON
-                return -(2.0 * tg * out + denom * out * out)
-
-            g = given(left_g, left_h, out_l) + given(right_g, right_h, out_r) - gain_parent
-            mono = monotone_constraints[:, None]
-            viol = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
-            ok = ok & ~viol
-        g = jnp.where(ok & (g > params.min_gain_to_split), g, KMIN_SCORE)
+            # output-based gains: smoothing shrinks child outputs towards
+            # the parent's; the basic monotone method additionally clips to
+            # the inherited [out_lo, out_hi] band and rejects ordering
+            # violations (reference: monotone_constraints.hpp
+            # BasicLeafConstraints + GetSplitGainGivenOutput).
+            if use_smooth:
+                out_l = leaf_output_smoothed(left_g, left_h, left_c, parent_output, params)
+                out_r = leaf_output_smoothed(right_g, right_h, right_c, parent_output, params)
+            else:
+                out_l = leaf_output(left_g, left_h, params)
+                out_r = leaf_output(right_g, right_h, params)
+            if monotone_constraints is not None:
+                lo = jnp.float32(-jnp.inf) if out_lo is None else out_lo
+                hi = jnp.float32(jnp.inf) if out_hi is None else out_hi
+                out_l = jnp.clip(out_l, lo, hi)
+                out_r = jnp.clip(out_r, lo, hi)
+            g = (
+                gain_given_output(left_g, left_h, params.lambda_l1, params.lambda_l2, out_l)
+                + gain_given_output(right_g, right_h, params.lambda_l1, params.lambda_l2, out_r)
+                - gain_parent
+            )
+            if monotone_constraints is not None:
+                mono = monotone_constraints[:, None]
+                viol = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
+                ok = ok & ~viol
+        g = jnp.where(ok, g, KMIN_SCORE)
         return g, (left_g, left_h, left_c)
 
     gain_r, stats_r = eval_direction(False)  # missing -> right
@@ -267,7 +324,7 @@ def gain_plane(
                 & cat_ok(lc_, rc_, lh_, rh_)
             )
             g_ = cgain(lg_, lh_) + cgain(rg_, rh_) - gain_parent_cat
-            g_ = jnp.where(ok & (g_ > params.min_gain_to_split), g_, KMIN_SCORE)
+            g_ = jnp.where(ok, g_, KMIN_SCORE)
             return g_, rank, (lg_, lh_, lc_)
 
         gain_asc, rank_asc, st_asc = eval_sorted(ratio)
@@ -288,7 +345,7 @@ def gain_plane(
             + cgain(parent_g - oh_l[..., 0], parent_h - oh_l[..., 1])
             - gain_parent_cat
         )
-        gain_oh = jnp.where(oh_ok & (gain_oh > params.min_gain_to_split), gain_oh, KMIN_SCORE)
+        gain_oh = jnp.where(oh_ok, gain_oh, KMIN_SCORE)
 
         onehot_mode = (num_used <= params.max_cat_to_onehot)[:, None]  # (F, 1)
         gain_mvm = jnp.maximum(gain_asc, gain_desc)
@@ -299,6 +356,28 @@ def gain_plane(
         if feature_mask is not None:
             cat_col = cat_col & feature_mask[:, None]
         gain = jnp.where(cat_col, gain_cat, gain)
+
+    # ------------------------------------------------------------------
+    # gain adjustments applied BEFORE the min_gain_to_split gate, matching
+    # the reference's ordering (penalized gain must beat min_gain_shift)
+    # ------------------------------------------------------------------
+    live = gain > KMIN_SCORE / 2
+    if (
+        params.monotone_penalty > 0
+        and monotone_constraints is not None
+        and depth is not None
+    ):
+        factor = monotone_split_gain_penalty(depth, params.monotone_penalty)
+        is_mono = (monotone_constraints != 0)[:, None]
+        gain = jnp.where(live & is_mono, gain * factor, gain)
+    if params.cegb_penalty_split > 0 or cegb_feature_penalty is not None:
+        pen = jnp.zeros((f,), jnp.float32)
+        if params.cegb_penalty_split > 0:
+            pen = pen + params.cegb_tradeoff * params.cegb_penalty_split * parent_count
+        if cegb_feature_penalty is not None:
+            pen = pen + cegb_feature_penalty
+        gain = jnp.where(live, gain - pen[:, None], gain)
+    gain = jnp.where(live & (gain > params.min_gain_to_split), gain, KMIN_SCORE)
 
     ctx = dict(
         use_left=use_left,
@@ -405,6 +484,9 @@ def find_best_split(
     out_lo: jnp.ndarray | None = None,
     out_hi: jnp.ndarray | None = None,
     rng_key: jnp.ndarray | None = None,
+    depth: jnp.ndarray | None = None,
+    parent_output: jnp.ndarray | None = None,
+    cegb_feature_penalty: jnp.ndarray | None = None,
 ) -> BestSplit:
     """gain_plane + select_from_plane (reference: FindBestThreshold)."""
     gain, ctx = gain_plane(
@@ -416,5 +498,8 @@ def find_best_split(
         out_lo=out_lo,
         out_hi=out_hi,
         rng_key=rng_key,
+        depth=depth,
+        parent_output=parent_output,
+        cegb_feature_penalty=cegb_feature_penalty,
     )
     return select_from_plane(gain, ctx)

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from .histogram import histogram
 from .split import (
     BestSplit, SplitParams, find_best_split, gain_plane, select_from_plane,
-    leaf_output, KMIN_SCORE,
+    leaf_output, leaf_output_smoothed, KMIN_SCORE,
 )
 
 
@@ -76,6 +76,8 @@ class GrowState(NamedTuple):
     num_leaves_cur: jnp.ndarray  # i32
     leaf_out_lo: jnp.ndarray  # (L,) f32 — monotone output lower bounds
     leaf_out_hi: jnp.ndarray  # (L,) f32 — monotone output upper bounds
+    leaf_out: jnp.ndarray  # (L,) f32 — each leaf's (smoothed/clipped) output
+    cegb_used: jnp.ndarray  # (F,) bool — features already split on in this tree
     used_features: jnp.ndarray  # (L, F) bool or () — path features (interaction constraints)
     tree: TreeArrays
 
@@ -129,6 +131,7 @@ def grow_tree(
     monotone_constraints: jnp.ndarray = None,  # (F,) i32 in {-1,0,1}
     interaction_sets: jnp.ndarray = None,  # (S, F) bool — allowed feature sets
     rng_key: jnp.ndarray = None,  # base PRNG key (extra_trees / bynode)
+    cegb_feature_penalty: jnp.ndarray = None,  # (F,) pre-scaled coupled penalties
     *,
     num_leaves: int,
     num_bins: int,
@@ -174,13 +177,16 @@ def grow_tree(
         return jnp.any(interaction_sets & ok_s[:, None], axis=0)  # (F,)
 
     def best_for(hist_leaf, sum_g, sum_h, count, depth, out_lo=None, out_hi=None,
-                 used=None, node_id=None):
+                 used=None, node_id=None, parent_out=None, cegb_used=None):
         fmask = feature_mask
         if interaction_sets is not None and used is not None:
             fmask = fmask & allowed_from_used(used) if fmask is not None else allowed_from_used(used)
         key = None
         if rng_key is not None and node_id is not None:
             key = jax.random.fold_in(rng_key, node_id)
+        cegb_pen = None
+        if cegb_feature_penalty is not None:
+            cegb_pen = jnp.where(cegb_used, 0.0, cegb_feature_penalty)
         kw = dict(
             feature_mask=fmask,
             categorical_mask=categorical_mask,
@@ -188,6 +194,9 @@ def grow_tree(
             out_lo=out_lo,
             out_hi=out_hi,
             rng_key=key,
+            depth=depth.astype(jnp.float32) if hasattr(depth, 'astype') else jnp.float32(depth),
+            parent_output=parent_out,
+            cegb_feature_penalty=cegb_pen,
         )
         if mode == "voting":
             # PV-Tree (reference: voting_parallel_tree_learner.cpp): each
@@ -267,6 +276,9 @@ def grow_tree(
         sum0 = psum(sum0)  # local hists in voting mode; leaf stats are global
     g0, h0, c0 = sum0[0], sum0[1], sum0[2]
 
+    leaf_out0 = leaf_output(g0, h0, params)
+    cegb_used0 = jnp.zeros((f,), bool)
+
     tree0 = TreeArrays(
         num_leaves=jnp.asarray(1, jnp.int32),
         split_feature=jnp.zeros((L - 1,), jnp.int32),
@@ -297,6 +309,7 @@ def grow_tree(
                 out_lo=jnp.float32(-jnp.inf), out_hi=jnp.float32(jnp.inf),
                 used=(jnp.zeros((f,), bool) if interaction_sets is not None else None),
                 node_id=jnp.asarray(0, jnp.int32),
+                parent_out=leaf_out0, cegb_used=cegb_used0,
             ),
         ),
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
@@ -308,6 +321,8 @@ def grow_tree(
         num_leaves_cur=jnp.asarray(1, jnp.int32),
         leaf_out_lo=jnp.full((L,), -jnp.inf, jnp.float32),
         leaf_out_hi=jnp.full((L,), jnp.inf, jnp.float32),
+        leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(leaf_out0),
+        cegb_used=cegb_used0,
         used_features=(
             jnp.zeros((L, f), bool) if interaction_sets is not None else jnp.zeros((), bool)
         ),
@@ -358,8 +373,10 @@ def grow_tree(
         hist = state.hist.at[best_leaf].set(hist_left).at[new_leaf].set(hist_right)
 
         # --- record the node (reference: Tree::Split) ---
-        parent_out = leaf_output(
-            state.leaf_sum_g[best_leaf], state.leaf_sum_h[best_leaf], params
+        parent_out = state.leaf_out[best_leaf]
+        cegb_used = (
+            state.cegb_used.at[s.feature].set(True)
+            if cegb_feature_penalty is not None else state.cegb_used
         )
         old_parent = state.leaf_parent[best_leaf]
         old_side = state.leaf_side[best_leaf]
@@ -407,6 +424,10 @@ def grow_tree(
         # of the two clipped outputs; non-monotone splits inherit bounds) ---
         p_lo = state.leaf_out_lo[best_leaf]
         p_hi = state.leaf_out_hi[best_leaf]
+        out_l_c = leaf_output_smoothed(s.left_sum_g, s.left_sum_h, s.left_count,
+                                       parent_out, params)
+        out_r_c = leaf_output_smoothed(s.right_sum_g, s.right_sum_h, s.right_count,
+                                       parent_out, params)
         if monotone_constraints is not None:
             if mode == "feature":
                 ax_m = jax.lax.axis_index(axis_name)
@@ -418,8 +439,9 @@ def grow_tree(
                 )
             else:
                 mono_c = monotone_constraints[s.feature]
-            out_l = jnp.clip(leaf_output(s.left_sum_g, s.left_sum_h, params), p_lo, p_hi)
-            out_r = jnp.clip(leaf_output(s.right_sum_g, s.right_sum_h, params), p_lo, p_hi)
+            out_l = jnp.clip(out_l_c, p_lo, p_hi)
+            out_r = jnp.clip(out_r_c, p_lo, p_hi)
+            out_l_c, out_r_c = out_l, out_r
             mid = 0.5 * (out_l + out_r)
             l_hi = jnp.where(mono_c > 0, jnp.minimum(p_hi, mid), p_hi)
             r_lo = jnp.where(mono_c > 0, jnp.maximum(p_lo, mid), p_lo)
@@ -429,6 +451,7 @@ def grow_tree(
             l_lo, l_hi, r_lo, r_hi = p_lo, p_hi, p_lo, p_hi
         leaf_out_lo = state.leaf_out_lo.at[best_leaf].set(l_lo).at[new_leaf].set(r_lo)
         leaf_out_hi = state.leaf_out_hi.at[best_leaf].set(l_hi).at[new_leaf].set(r_hi)
+        leaf_out = state.leaf_out.at[best_leaf].set(out_l_c).at[new_leaf].set(out_r_c)
 
         if interaction_sets is not None:
             if mode == "feature":
@@ -450,9 +473,11 @@ def grow_tree(
 
         # --- best splits for the two fresh leaves ---
         bl = best_for(hist_left, s.left_sum_g, s.left_sum_h, s.left_count, depth_child,
-                      out_lo=l_lo, out_hi=l_hi, used=used_child, node_id=2 * node + 1)
+                      out_lo=l_lo, out_hi=l_hi, used=used_child, node_id=2 * node + 1,
+                      parent_out=out_l_c, cegb_used=cegb_used)
         br = best_for(hist_right, s.right_sum_g, s.right_sum_h, s.right_count, depth_child,
-                      out_lo=r_lo, out_hi=r_hi, used=used_child, node_id=2 * node + 2)
+                      out_lo=r_lo, out_hi=r_hi, used=used_child, node_id=2 * node + 2,
+                      parent_out=out_r_c, cegb_used=cegb_used)
         best = _set_best(_set_best(state.best, best_leaf, bl), new_leaf, br)
 
         return GrowState(
@@ -468,6 +493,8 @@ def grow_tree(
             num_leaves_cur=state.num_leaves_cur + 1,
             leaf_out_lo=leaf_out_lo,
             leaf_out_hi=leaf_out_hi,
+            leaf_out=leaf_out,
+            cegb_used=cegb_used,
             used_features=used_features,
             tree=tree,
         )
@@ -480,9 +507,12 @@ def grow_tree(
 
     # finalize leaf values (reference: leaf outputs are computed during growth;
     # equivalent here since sums are exact)
-    leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
-    if monotone_constraints is not None:
-        leaf_value = jnp.clip(leaf_value, state.leaf_out_lo, state.leaf_out_hi)
+    if params.path_smooth > 0:
+        leaf_value = state.leaf_out  # smoothed (and monotone-clipped) at creation
+    else:
+        leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
+        if monotone_constraints is not None:
+            leaf_value = jnp.clip(leaf_value, state.leaf_out_lo, state.leaf_out_hi)
     active = jnp.arange(L, dtype=jnp.int32) < state.num_leaves_cur
     tree = state.tree._replace(
         num_leaves=state.num_leaves_cur,

@@ -40,7 +40,8 @@ import jax.numpy as jnp
 from .hist_pallas import histogram_pallas_multi, histogram_pallas_multi_quantized
 from .histogram import histogram
 from .split import (
-    BestSplit, SplitParams, find_best_split, leaf_output, KMIN_SCORE,
+    BestSplit, SplitParams, find_best_split, leaf_output, leaf_output_smoothed,
+    KMIN_SCORE,
 )
 from .treegrow import TreeArrays, _empty_best, _set_best
 
@@ -87,6 +88,8 @@ class FastState(NamedTuple):
     num_leaves_cur: jnp.ndarray  # i32
     leaf_out_lo: jnp.ndarray
     leaf_out_hi: jnp.ndarray
+    leaf_out: jnp.ndarray  # (L,) f32 — each leaf's (smoothed/clipped) output
+    cegb_used: jnp.ndarray  # (F,) bool — features split on in this tree
     used_features: jnp.ndarray  # (L, F) bool or () placeholder
     fresh: jnp.ndarray  # (L,) bool — leaves created this round, need hist+eval
     small_slot: jnp.ndarray  # (L,) i32 — pass slot of each fresh SMALL child, -1 otherwise
@@ -101,10 +104,15 @@ def _batched_best(
     num_bins_pf, missing_bin_pf, params,
     feature_mask, categorical_mask, monotone, interaction_sets,
     out_lo, out_hi, used, node_ids, rng_key,
+    depth=None, parent_out=None, cegb_pen=None,
 ):
     """find_best_split vmapped over leaves."""
+    if depth is None:
+        depth = jnp.zeros_like(sum_g)
+    if parent_out is None:
+        parent_out = jnp.zeros_like(sum_g)
 
-    def one(hist, g, h, c, lo, hi, u, nid):
+    def one(hist, g, h, c, lo, hi, u, nid, dep, pout):
         fmask = feature_mask
         if interaction_sets is not None and u is not None:
             ok_s = ~jnp.any(u[None, :] & ~interaction_sets, axis=1)
@@ -115,11 +123,14 @@ def _batched_best(
             hist, g, h, c, num_bins_pf, missing_bin_pf, params,
             feature_mask=fmask, categorical_mask=categorical_mask,
             monotone_constraints=monotone, out_lo=lo, out_hi=hi, rng_key=key,
+            depth=dep.astype(jnp.float32), parent_output=pout,
+            cegb_feature_penalty=cegb_pen,
         )
 
-    in_axes = (0, 0, 0, 0, 0, 0, 0 if used is not None else None, 0)
+    in_axes = (0, 0, 0, 0, 0, 0, 0 if used is not None else None, 0, 0, 0)
     return jax.vmap(one, in_axes=in_axes)(
-        hist_batch, sum_g, sum_h, count, out_lo, out_hi, used, node_ids
+        hist_batch, sum_g, sum_h, count, out_lo, out_hi, used, node_ids,
+        depth, parent_out,
     )
 
 
@@ -145,6 +156,7 @@ def grow_tree_fast(
     interaction_sets: jnp.ndarray = None,
     rng_key: jnp.ndarray = None,
     quant_key: jnp.ndarray = None,
+    cegb_feature_penalty: jnp.ndarray = None,  # (F,) pre-scaled coupled penalties
     *,
     num_leaves: int,
     num_bins: int,
@@ -258,6 +270,12 @@ def grow_tree_fast(
 
     use_used = interaction_sets is not None
     used0 = jnp.zeros((L, f), bool) if use_used else jnp.zeros((), bool)
+    leaf_out0 = leaf_output(g0, h0, params)
+    cegb_used0 = jnp.zeros((f,), bool)
+    cegb_pen0 = (
+        jnp.where(cegb_used0, 0.0, cegb_feature_penalty)
+        if cegb_feature_penalty is not None else None
+    )
 
     best0 = _set_best(
         _empty_best(L, num_bins), jnp.asarray(0),
@@ -272,6 +290,9 @@ def grow_tree_fast(
                 jnp.asarray([jnp.inf], jnp.float32),
                 used0[:1] if use_used else None,
                 jnp.asarray([0], jnp.int32), rng_key,
+                depth=jnp.asarray([0.0], jnp.float32),
+                parent_out=jnp.asarray([leaf_out0]),
+                cegb_pen=cegb_pen0,
             ),
         ),
     )
@@ -289,6 +310,8 @@ def grow_tree_fast(
         num_leaves_cur=jnp.asarray(1, jnp.int32),
         leaf_out_lo=jnp.full((L,), -jnp.inf, jnp.float32),
         leaf_out_hi=jnp.full((L,), jnp.inf, jnp.float32),
+        leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(leaf_out0),
+        cegb_used=cegb_used0,
         used_features=used0,
         fresh=jnp.zeros((L,), bool),
         small_slot=jnp.full((L,), -1, jnp.int32),
@@ -344,7 +367,7 @@ def grow_tree_fast(
         safe_node = jnp.clip(node_of, 0, L - 2)
 
         t = state.tree
-        parent_out = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
+        parent_out = state.leaf_out
         old_parent = state.leaf_parent
         old_side = state.leaf_side
         # re-point grandparent child slots from ~leaf to the new node
@@ -394,13 +417,17 @@ def grow_tree_fast(
         leaf_side = jnp.where(accept, 0, state.leaf_side)
         leaf_side = leaf_side.at[right_pos].set(1, mode="drop")
 
-        # ---------- monotone bounds ----------
+        # ---------- children outputs (path-smoothed) + monotone bounds ----------
         p_lo, p_hi = state.leaf_out_lo, state.leaf_out_hi
+        out_l_c = leaf_output_smoothed(s.left_sum_g, s.left_sum_h, s.left_count,
+                                       state.leaf_out, params)
+        out_r_c = leaf_output_smoothed(s.right_sum_g, s.right_sum_h, s.right_count,
+                                       state.leaf_out, params)
         if monotone_constraints is not None:
             mono_c = monotone_constraints[s.feature]
-            out_l = jnp.clip(leaf_output(s.left_sum_g, s.left_sum_h, params), p_lo, p_hi)
-            out_r = jnp.clip(leaf_output(s.right_sum_g, s.right_sum_h, params), p_lo, p_hi)
-            mid = 0.5 * (out_l + out_r)
+            out_l_c = jnp.clip(out_l_c, p_lo, p_hi)
+            out_r_c = jnp.clip(out_r_c, p_lo, p_hi)
+            mid = 0.5 * (out_l_c + out_r_c)
             l_hi = jnp.where(mono_c > 0, jnp.minimum(p_hi, mid), p_hi)
             r_lo = jnp.where(mono_c > 0, jnp.maximum(p_lo, mid), p_lo)
             l_lo = jnp.where(mono_c < 0, jnp.maximum(p_lo, mid), p_lo)
@@ -411,6 +438,13 @@ def grow_tree_fast(
         leaf_out_lo = leaf_out_lo.at[right_pos].set(r_lo, mode="drop")
         leaf_out_hi = jnp.where(accept, l_hi, state.leaf_out_hi)
         leaf_out_hi = leaf_out_hi.at[right_pos].set(r_hi, mode="drop")
+        leaf_out = jnp.where(accept, out_l_c, state.leaf_out)
+        leaf_out = leaf_out.at[right_pos].set(out_r_c, mode="drop")
+        cegb_used = state.cegb_used
+        if cegb_feature_penalty is not None:
+            cegb_used = cegb_used.at[
+                jnp.where(accept, s.feature, 2 * f)
+            ].set(True, mode="drop")
 
         if use_used:
             used_child = jnp.where(
@@ -459,6 +493,8 @@ def grow_tree_fast(
             num_leaves_cur=state.num_leaves_cur + k_acc,
             leaf_out_lo=leaf_out_lo,
             leaf_out_hi=leaf_out_hi,
+            leaf_out=leaf_out,
+            cegb_used=cegb_used,
             used_features=used_features,
             fresh=fresh,
             small_slot=small_slot,
@@ -502,6 +538,10 @@ def grow_tree_fast(
         fr_idx = jnp.argsort(jnp.where(frm, idx, L + idx))[:m_slots]  # fresh first
         fr_ok = frm[fr_idx]  # padding slots carry non-fresh leaves
         node_ids = jnp.clip(state.leaf_parent, 0, None) * 2 + state.leaf_side + 1
+        cegb_pen = (
+            jnp.where(state.cegb_used, 0.0, cegb_feature_penalty)
+            if cegb_feature_penalty is not None else None
+        )
         bb = _batched_best(
             hist[fr_idx], state.leaf_sum_g[fr_idx], state.leaf_sum_h[fr_idx],
             state.leaf_count[fr_idx],
@@ -510,6 +550,8 @@ def grow_tree_fast(
             interaction_sets, state.leaf_out_lo[fr_idx], state.leaf_out_hi[fr_idx],
             state.used_features[fr_idx] if use_used else None,
             node_ids[fr_idx], rng_key,
+            depth=state.leaf_depth[fr_idx], parent_out=state.leaf_out[fr_idx],
+            cegb_pen=cegb_pen,
         )
         scatter_pos = jnp.where(fr_ok, fr_idx, 2 * L)  # drop padding slots
 
@@ -542,10 +584,14 @@ def grow_tree_fast(
         Gt = psum(jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(grad_true * mrow))
         Ht = psum(jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(hess_true * mrow))
         leaf_value = leaf_output(Gt, Ht, params)
+        if monotone_constraints is not None:
+            leaf_value = jnp.clip(leaf_value, state.leaf_out_lo, state.leaf_out_hi)
+    elif params.path_smooth > 0:
+        leaf_value = state.leaf_out  # smoothed (and clipped) at creation
     else:
         leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
-    if monotone_constraints is not None:
-        leaf_value = jnp.clip(leaf_value, state.leaf_out_lo, state.leaf_out_hi)
+        if monotone_constraints is not None:
+            leaf_value = jnp.clip(leaf_value, state.leaf_out_lo, state.leaf_out_hi)
     active = jnp.arange(L, dtype=jnp.int32) < state.num_leaves_cur
     tree = state.tree._replace(
         num_leaves=state.num_leaves_cur,

@@ -1,0 +1,144 @@
+"""Tests that previously-no-op parameters now change behavior:
+path_smooth, monotone_penalty, CEGB, snapshot_freq, pred_early_stop,
+lambdarank position bias.  (VERDICT round 1, items 7/9/10.)"""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=4000, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f)
+    y = X @ w + 0.3 * rng.randn(n)
+    return X, y
+
+
+def _train(params, X, y, rounds=5):
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    base.update(params)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params=base, train_set=ds)
+    for _ in range(rounds):
+        bst.update()
+    return bst
+
+
+@pytest.mark.parametrize("mode", ["strict", "rounds"])
+def test_path_smooth_shrinks_leaves_towards_parent(mode):
+    X, y = _data()
+    plain = _train({"tree_growth_mode": mode}, X, y, rounds=1)
+    smooth = _train({"tree_growth_mode": mode, "path_smooth": 100.0}, X, y, rounds=1)
+    lv_plain = np.asarray(plain._gbdt.models[0].leaf_value)
+    lv_smooth = np.asarray(smooth._gbdt.models[0].leaf_value)
+    # smoothing pulls outputs towards ancestors: leaf value spread shrinks
+    assert np.std(lv_smooth) < np.std(lv_plain)
+    # and a tiny smoothing factor is a no-op-sized change, not a rewrite
+    tiny = _train({"tree_growth_mode": mode, "path_smooth": 1e-6}, X, y, rounds=1)
+    lv_tiny = np.asarray(tiny._gbdt.models[0].leaf_value)
+    if lv_tiny.shape == lv_plain.shape:
+        assert np.allclose(lv_tiny, lv_plain, atol=1e-3)
+
+
+def test_monotone_penalty_forbids_root_monotone_split():
+    rng = np.random.RandomState(1)
+    n = 4000
+    x0 = rng.randn(n)
+    X = np.stack([x0, 0.3 * rng.randn(n)], axis=1).astype(np.float32)
+    y = 2.0 * x0 + 0.1 * rng.randn(n)  # x0 dominates
+    base = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+            "min_data_in_leaf": 5, "monotone_constraints": [1, 0]}
+    b0 = _train(base, X, y, rounds=1)
+    assert int(b0._gbdt.models[0].split_feature[0]) == 0  # sanity: x0 wins at root
+    # penalty >= depth+1 forbids monotone splits at the root level entirely
+    b1 = _train({**base, "monotone_penalty": 1.0}, X, y, rounds=1)
+    t = b1._gbdt.models[0]
+    assert t.num_internal == 0 or int(t.split_feature[0]) != 0
+
+
+def test_cegb_split_penalty_prunes_tree():
+    X, y = _data()
+    big = _train({}, X, y, rounds=1)
+    pruned = _train({"cegb_penalty_split": 1.0, "cegb_tradeoff": 10.0}, X, y, rounds=1)
+    assert pruned._gbdt.models[0].num_leaves < big._gbdt.models[0].num_leaves
+
+
+def test_cegb_coupled_feature_penalty_avoids_feature():
+    rng = np.random.RandomState(2)
+    n = 4000
+    x0 = rng.randn(n)
+    x1 = x0 + 0.01 * rng.randn(n)  # near-duplicate of x0
+    X = np.stack([x0, x1], axis=1).astype(np.float32)
+    y = x0 + 0.1 * rng.randn(n)
+    free = _train({}, X, y, rounds=2)
+    feats_free = {int(v) for t in free._gbdt.models for v in t.split_feature}
+    pen = _train({"cegb_penalty_feature_coupled": [1e6, 0.0],
+                  "cegb_tradeoff": 1.0}, X, y, rounds=2)
+    feats_pen = {int(v) for t in pen._gbdt.models for v in t.split_feature}
+    assert 0 not in feats_pen  # feature 0 priced out
+    assert 1 in feats_pen
+
+
+def test_snapshot_freq_writes_periodic_models(tmp_path):
+    X, y = _data()
+    out = str(tmp_path / "model.txt")
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "regression", "verbosity": -1, "snapshot_freq": 2,
+               "output_model": out, "num_leaves": 7},
+              ds, num_boost_round=5)
+    snap = f"{out}.snapshot_iter_4"
+    assert os.path.exists(snap)
+    bst = lgb.Booster(model_file=snap)
+    assert bst.current_iteration() == 4
+
+
+def test_pred_early_stop_freezes_confident_rows():
+    rng = np.random.RandomState(3)
+    X = rng.randn(3000, 6).astype(np.float32)
+    y = ((X[:, 0] + 0.05 * rng.randn(3000)) > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params={"objective": "binary", "verbosity": -1,
+                              "num_leaves": 15}, train_set=ds)
+    for _ in range(30):
+        bst.update()
+    full = bst.predict(X)
+    g = bst._gbdt
+    g.cfg.pred_early_stop = True
+    g.cfg.pred_early_stop_freq = 5
+    g.cfg.pred_early_stop_margin = 2.0
+    es = bst.predict(X)
+    # rows that stopped early still classify identically
+    assert np.mean((es > 0.5) == (full > 0.5)) > 0.999
+    # and with a huge margin nothing stops: bitwise equal to the full path
+    g.cfg.pred_early_stop_margin = 1e9
+    assert np.allclose(bst.predict(X), full, atol=1e-7)
+    g.cfg.pred_early_stop = False
+
+
+def test_lambdarank_position_bias_learns_bias():
+    rng = np.random.RandomState(4)
+    nq, qlen = 80, 10
+    n = nq * qlen
+    X = rng.randn(n, 5).astype(np.float32)
+    rel = (X[:, 0] > 0.5).astype(np.float64) + (X[:, 1] > 1.0)
+    # presentation positions 0..qlen-1, with clicks biased to early positions
+    pos = np.tile(np.arange(qlen), nq)
+    ds = lgb.Dataset(X, label=rel, group=[qlen] * nq)
+    ds.set_field("position", pos)
+    bst = lgb.Booster(
+        params={"objective": "lambdarank", "verbosity": -1, "num_leaves": 7,
+                "lambdarank_position_bias_regularization": 1.0},
+        train_set=ds,
+    )
+    for _ in range(5):
+        bst.update()
+    obj = bst._gbdt.objective
+    bias = np.asarray(obj.pos_bias)
+    assert bias.shape == (qlen,)
+    assert np.all(np.isfinite(bias))
+    assert np.any(bias != 0.0)  # the EM/Newton update actually ran
