@@ -122,11 +122,53 @@ class Dataset:
         self.num_bins_pf_device = jnp.asarray(self.binner.num_bins_per_feature)
         self.missing_bin_pf_device = jnp.asarray(self.binner.missing_bin_per_feature)
         self.max_num_bins = int(self.binner.max_num_bins)
+        # EFB (reference: DatasetLoader::FindGroups/FastFeatureBundling):
+        # bundle sparse exclusive features so histogram passes scan fewer
+        # columns; split search / trees stay in original-feature space
+        self.efb = None
+        self._efb_device = None
+        if ref is not None:
+            if getattr(ref, "efb", None) is not None:
+                # aligned binning: reuse the plan; the bundled matrix for THIS
+                # data is encoded lazily (valid sets never need it — only the
+                # train set's histogram passes do)
+                self.efb = ref.efb._replace(bundled_bins=None)
+        elif cfg.enable_bundle:
+            from .io.efb import find_bundles
+
+            self.efb = find_bundles(
+                self.bins,
+                self.binner.num_bins_per_feature,
+                self.max_num_bins,
+                categorical_mask=np.asarray(self.binner.categorical_mask),
+                seed=cfg.data_random_seed,
+            )
         self._num_data, self._num_feature = raw.shape
         if self.free_raw_data:
             self.data = None
         self._constructed = True
         return self
+
+    def efb_device_tables(self):
+        """Lazy device tables for EFB training: (bundled_bins, gather,
+        default_mask) — encoded/uploaded on first use (train set only)."""
+        if self.efb is None:
+            return None
+        if self._efb_device is None:
+            bundled = self.efb.bundled_bins
+            if bundled is None:
+                from .io.efb import apply_bundles
+
+                bundled = apply_bundles(
+                    self.efb, self.bins, self.binner.num_bins_per_feature
+                )
+                self.efb = self.efb._replace(bundled_bins=bundled)
+            self._efb_device = (
+                jnp.asarray(bundled),
+                jnp.asarray(self.efb.gather_idx),
+                jnp.asarray(self.efb.default_mask),
+            )
+        return self._efb_device
 
     @property
     def query_boundaries(self) -> Optional[np.ndarray]:
@@ -196,6 +238,9 @@ class Dataset:
         sub.__dict__.update({k: v for k, v in self.__dict__.items()})
         sub.bins = self.bins[idx]
         sub.bins_device = jnp.asarray(sub.bins)
+        if getattr(self, "efb", None) is not None:
+            sub.efb = self.efb._replace(bundled_bins=None)  # re-encoded lazily
+            sub._efb_device = None
         sub.label = None if self.label is None else self.label[idx]
         sub.weight = None if self.weight is None else self.weight[idx]
         sub.init_score = None if self.init_score is None else self.init_score[idx]

@@ -463,6 +463,7 @@ class GBDT:
                 from ..ops.treegrow_fast import grow_tree_fast
 
                 quant = self.cfg.use_quantized_grad
+                efb_tabs = ts.efb_device_tables() if getattr(ts, "efb", None) is not None else None
                 arrays, leaf_id = grow_tree_fast(
                     ts.bins_device,
                     gc,
@@ -479,11 +480,17 @@ class GBDT:
                     (jax.random.PRNGKey(self.cfg.seed * 1000003 + self.iter_ * 31 + c)
                      if quant else None),
                     cegb_pen,
+                    efb_tabs[0] if efb_tabs else None,
+                    efb_tabs[1] if efb_tabs else None,
+                    efb_tabs[2] if efb_tabs else None,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
                     params=self._split_params,
-                    leaf_tile=min(16, self.cfg.num_leaves),
+                    # measured on-chip: 10 leaves/pass (60 f32 payload lanes)
+                    # beats 16 (96 lanes) — wider payloads slow the Mosaic
+                    # kernel more than the extra admission round costs
+                    leaf_tile=min(10, self.cfg.num_leaves),
                     hist_precision=self.cfg.hist_precision,
                     use_pallas=self._on_tpu,
                     quantize_bins=(self.cfg.num_grad_quant_bins if quant else 0),

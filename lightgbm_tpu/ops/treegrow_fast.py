@@ -157,6 +157,9 @@ def grow_tree_fast(
     rng_key: jnp.ndarray = None,
     quant_key: jnp.ndarray = None,
     cegb_feature_penalty: jnp.ndarray = None,  # (F,) pre-scaled coupled penalties
+    efb_bins: jnp.ndarray = None,  # (N, F_b) bundled bin matrix (io/efb.py)
+    efb_gather: jnp.ndarray = None,  # (F, B) int32 into flat (F_b*B)+zero-pad
+    efb_default: jnp.ndarray = None,  # (F, B) bool default slots
     *,
     num_leaves: int,
     num_bins: int,
@@ -219,28 +222,51 @@ def grow_tree_fast(
         hess = hq.astype(jnp.float32) * h_scale
         quant_scale = jnp.stack([g_scale, h_scale, jnp.float32(1.0)])
 
+    hist_bins = bins if efb_bins is None else efb_bins
+
+    def unbundle(h):
+        """(tile, F_b, B, 3) bundle hists -> (tile, F, B, 3) per-feature
+        hists: gather each feature's non-default slots; its default-bin row
+        is leaf_total - sum(non-default) (reference most-freq-bin
+        subtraction; see io/efb.py)."""
+        if efb_gather is None:
+            return h
+        tile = h.shape[0]
+        flat = h.reshape(tile, -1, 3)
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((tile, 1, 3), h.dtype)], axis=1
+        )
+        hf = flat[:, efb_gather.reshape(-1), :].reshape(tile, f, num_bins, 3)
+        leaf_tot = jnp.sum(h[:, 0, :, :], axis=1)  # (tile, 3)
+        nondef = jnp.sum(hf, axis=2)  # (tile, F, 3)
+        fill = leaf_tot[:, None, :] - nondef
+        return hf + jnp.where(
+            efb_default[None, :, :, None], fill[:, :, None, :], jnp.zeros((), h.dtype)
+        )
+
     def multi_hist(leaf_slot, tile):
         """(N,)-slot -> (tile, F, B, 3) f32: per-slot histograms, one pass."""
         if use_pallas and quantize_bins:
             hi = histogram_pallas_multi_quantized(
-                bins, gq, hq, row_mask & (leaf_slot >= 0),
+                hist_bins, gq, hq, row_mask & (leaf_slot >= 0),
                 jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
             )
-            h = hi.astype(jnp.float32) * quant_scale
+            h = unbundle(hi).astype(jnp.float32) * quant_scale
         elif use_pallas:
             h = histogram_pallas_multi(
-                bins, grad, hess, row_mask & (leaf_slot >= 0),
+                hist_bins, grad, hess, row_mask & (leaf_slot >= 0),
                 jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
                 precision=hist_precision,
             )
+            h = unbundle(h)
         else:
             # CPU/test fallback: per-slot masked scatter histograms (uses the
             # dequantized grad/hess, so results match the int path's scaling)
             def one(s):
                 m = row_mask & (leaf_slot == s)
-                return histogram(bins, grad, hess, m.astype(jnp.float32),
+                return histogram(hist_bins, grad, hess, m.astype(jnp.float32),
                                  num_bins, strategy="scatter")
-            h = jax.vmap(one)(jnp.arange(tile, dtype=jnp.int32))
+            h = unbundle(jax.vmap(one)(jnp.arange(tile, dtype=jnp.int32)))
         return psum(h)
 
     # ---- root ----
