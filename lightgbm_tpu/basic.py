@@ -144,6 +144,10 @@ class Dataset:
                 seed=cfg.data_random_seed,
             )
         self._num_data, self._num_feature = raw.shape
+        if cfg.linear_tree or (ref is not None and getattr(ref, "raw_device", None) is not None):
+            # linear trees need raw feature values at fit/score time
+            # (reference: linear_tree_learner.cpp keeps a raw-data view)
+            self.raw_device = jnp.asarray(raw.astype(np.float32))
         if self.free_raw_data:
             self.data = None
         self._constructed = True
@@ -241,6 +245,8 @@ class Dataset:
         if getattr(self, "efb", None) is not None:
             sub.efb = self.efb._replace(bundled_bins=None)  # re-encoded lazily
             sub._efb_device = None
+        if getattr(self, "raw_device", None) is not None:
+            sub.raw_device = self.raw_device[jnp.asarray(idx)]
         sub.label = None if self.label is None else self.label[idx]
         sub.weight = None if self.weight is None else self.weight[idx]
         sub.init_score = None if self.init_score is None else self.init_score[idx]

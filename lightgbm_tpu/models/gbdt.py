@@ -97,8 +97,8 @@ class GBDT:
     def _flush_pending(self) -> None:
         if self._pending:
             pending, self._pending = self._pending, []
-            for arrays, shrink in pending:
-                tree = tree_from_device(arrays, self.binner)
+            for arrays, shrink, linear_fit in pending:
+                tree = tree_from_device(arrays, self.binner, linear=linear_fit)
                 tree.apply_shrinkage(shrink)
                 self._models.append(tree)
 
@@ -231,6 +231,31 @@ class GBDT:
                 f"monotone_constraints_method={self.cfg.monotone_constraints_method!r} "
                 "is not implemented; falling back to 'basic'."
             )
+        self._linear = bool(self.cfg.linear_tree) and self.cfg.tree_learner == "serial"
+        if self.cfg.linear_tree and not self._linear:
+            log_warning(
+                "linear_tree is implemented for tree_learner=serial only; "
+                "training proceeds with CONSTANT leaves."
+            )
+        if self._linear and self.cfg.boosting == "dart":
+            log_warning(
+                "linear_tree is not supported with boosting=dart (drop/renorm "
+                "assumes constant leaves); training with CONSTANT leaves."
+            )
+            self._linear = False
+        if self._linear and self.objective is not None and self.objective.need_renew:
+            # reference: Config::CheckParamConflict forbids linear trees with
+            # objectives that renew leaf outputs (l1/huber/quantile/mape)
+            raise ValueError(
+                f"linear_tree is not supported with objective="
+                f"{self.objective.name} (leaf-output renewal)"
+            )
+        if self._linear and getattr(train_set, "raw_device", None) is None:
+            raise ValueError(
+                "linear_tree requires raw feature values: the Dataset was "
+                "constructed without linear_tree in its params (or raw data "
+                "was freed). Pass params={'linear_tree': True} to Dataset."
+            )
         if self.cfg.use_quantized_grad and not self._use_fast:
             log_warning(
                 "use_quantized_grad is implemented on the rounds grower "
@@ -305,8 +330,14 @@ class GBDT:
         score = jnp.asarray(init)
         for i, tree in enumerate(self.models):
             c = i % k
-            leaf = valid_set.predict_leaf_binned_tree(tree)
-            vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf]
+            if tree.is_linear:
+                vals = jnp.asarray(
+                    tree.predict_batch(np.asarray(valid_set.raw_device)),
+                    jnp.float32,
+                )
+            else:
+                leaf = valid_set.predict_leaf_binned_tree(tree)
+                vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf]
             if k == 1:
                 score = score + vals
             else:
@@ -496,6 +527,7 @@ class GBDT:
                     quantize_bins=(self.cfg.num_grad_quant_bins if quant else 0),
                     stochastic_rounding=bool(self.cfg.stochastic_rounding),
                     quant_renew=bool(self.cfg.quant_train_renew_leaf),
+                    track_path=self._linear,
                 )
             else:
                 arrays, leaf_id = grow_tree(
@@ -517,7 +549,28 @@ class GBDT:
                     max_depth=self.cfg.max_depth,
                     params=self._split_params,
                     hist_strategy="auto",
+                    track_path=self._linear,
                 )
+            linear_fit = None
+            if self._linear and arrays.path_features is not None:
+                from ..ops.linear import fit_linear_leaves
+
+                used_path = arrays.path_features
+                if self._categorical_mask is not None:
+                    used_path = used_path & ~self._categorical_mask[None, :]
+                coef, const, fidx, nf, lin_pred, _good = fit_linear_leaves(
+                    ts.raw_device, leaf_id,
+                    gc * sample_weight, hc * sample_weight, row_mask,
+                    used_path, arrays.leaf_value,
+                    jnp.float32(self.cfg.linear_lambda),
+                    # cap on path features per leaf model (reference fits
+                    # ALL path features; 24 covers any tree this package
+                    # grows at default depths — deeper paths are truncated
+                    # to the lowest-indexed features)
+                    K=min(24, ts.num_feature()),
+                    num_leaves=self.cfg.num_leaves,
+                )
+                linear_fit = (coef, const, fidx, nf)
             if self._cegb_coupled is not None:
                 valid_nodes = (
                     jnp.arange(self.cfg.num_leaves - 1) < arrays.num_leaves - 1
@@ -544,25 +597,36 @@ class GBDT:
                 all_const = jnp.logical_and(
                     jnp.asarray(all_const, dtype=bool), arrays.num_leaves <= 1
                 )
-                self._pending.append((arrays, shrinkage))
-                delta = arrays.leaf_value * jnp.float32(shrinkage)
-                if k == 1:
-                    self._score = self._score + delta[leaf_id]
+                self._pending.append((arrays, shrinkage, linear_fit))
+                if linear_fit is not None:
+                    row_delta = lin_pred * jnp.float32(shrinkage)
                 else:
-                    self._score = self._score.at[:, c].add(delta[leaf_id])
+                    row_delta = (arrays.leaf_value * jnp.float32(shrinkage))[leaf_id]
+                if k == 1:
+                    self._score = self._score + row_delta
+                else:
+                    self._score = self._score.at[:, c].add(row_delta)
                 for vi, vs in enumerate(self.valid_sets):
                     from ..ops.treegrow_fast import predict_leaf_arrays
 
                     leaf_v = predict_leaf_arrays(
                         arrays, vs.bins_device, ts.missing_bin_pf_device,
                     )
-                    vals = delta[leaf_v]
+                    if linear_fit is not None:
+                        from ..ops.linear import predict_linear_rows
+
+                        vals = predict_linear_rows(
+                            vs.raw_device, leaf_v, coef, const, fidx, nf,
+                            arrays.leaf_value,
+                        ) * jnp.float32(shrinkage)
+                    else:
+                        vals = (arrays.leaf_value * jnp.float32(shrinkage))[leaf_v]
                     if k == 1:
                         self._valid_scores[vi] = self._valid_scores[vi] + vals
                     else:
                         self._valid_scores[vi] = self._valid_scores[vi].at[:, c].add(vals)
                 continue
-            tree = tree_from_device(arrays, self.binner)
+            tree = tree_from_device(arrays, self.binner, linear=linear_fit)
             if tree.num_leaves > 1:
                 all_const = False
             # RF (average_output) takes unscaled deltas regardless of which
@@ -580,15 +644,27 @@ class GBDT:
             if pad > 0:
                 dev_leaf_vals = jnp.concatenate([dev_leaf_vals, jnp.zeros(pad, jnp.float32)])
             delta = dev_leaf_vals
-            if k == 1:
-                self._score = self._score + delta[leaf_id]
+            if linear_fit is not None:
+                row_delta = lin_pred * jnp.float32(tree.shrinkage)
             else:
-                self._score = self._score.at[:, c].add(delta[leaf_id])
+                row_delta = delta[leaf_id]
+            if k == 1:
+                self._score = self._score + row_delta
+            else:
+                self._score = self._score.at[:, c].add(row_delta)
             self.models.append(tree)
             # valid scores
             for vi, vs in enumerate(self.valid_sets):
                 leaf_v = vs.predict_leaf_binned_tree(tree)
-                vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf_v]
+                if linear_fit is not None:
+                    from ..ops.linear import predict_linear_rows
+
+                    vals = predict_linear_rows(
+                        vs.raw_device, jnp.asarray(leaf_v), coef, const, fidx, nf,
+                        arrays.leaf_value,
+                    ) * jnp.float32(tree.shrinkage)
+                else:
+                    vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf_v]
                 if k == 1:
                     self._valid_scores[vi] = self._valid_scores[vi] + vals
                 else:
@@ -614,8 +690,14 @@ class GBDT:
         k = self.num_tree_per_iteration
         for c in reversed(range(k)):
             tree = self.models.pop()
-            leaf_id = self.train_set.predict_leaf_binned_tree(tree)
-            vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf_id]
+            if tree.is_linear:
+                vals = jnp.asarray(
+                    tree.predict_batch(np.asarray(self.train_set.raw_device)),
+                    jnp.float32,
+                )
+            else:
+                leaf_id = self.train_set.predict_leaf_binned_tree(tree)
+                vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf_id]
             if k == 1:
                 self._score = self._score - vals
             else:
@@ -710,9 +792,9 @@ class GBDT:
             init = np.asarray(self.init_scores, dtype=np.float64)
             base = np.zeros((n, k), dtype=np.float64) + init[None, :]
             return base[:, 0] if k == 1 else base
-        if any(t.num_cat > 0 for t in trees):
-            # categorical bitset decisions: vectorized host walk (the device
-            # traversal handles numerical nodes only for now)
+        if any(t.num_cat > 0 or t.is_linear for t in trees):
+            # categorical bitset decisions and linear leaves: vectorized host
+            # walk (the device traversal handles constant numerical nodes)
             Xh = np.asarray(X, dtype=np.float64)
             n_per_class = max(len(trees) // k, 1)
             scale = (1.0 / n_per_class) if self.average_output else 1.0
@@ -803,6 +885,9 @@ class GBDT:
     def predict_contrib(self, X, start_iteration=0, num_iteration=-1) -> np.ndarray:
         """SHAP values via the per-tree path algorithm (reference:
         Tree::PredictContrib / TreeSHAP in tree.cpp)."""
+        if any(t.is_linear for t in self.models):
+            # reference: Predictor raises a fatal for contrib on linear trees
+            raise ValueError("predict_contrib is not supported for linear trees")
         from .shap import tree_shap_ensemble
 
         k = self.num_tree_per_iteration
@@ -917,6 +1002,9 @@ class GBDT:
             t = _copy.deepcopy(trees[i])
             t.leaf_value = t.leaf_value + self.init_scores[c]
             t.internal_value = t.internal_value + self.init_scores[c]
+            if t.is_linear and t.leaf_const is not None:
+                # linear prediction reads leaf_const, not leaf_value
+                t.leaf_const = t.leaf_const + self.init_scores[c]
             trees[i] = t
         return trees
 

@@ -49,6 +49,12 @@ class Tree:
     # replay of categorical nodes within the training session
     cat_bin_masks: Optional[dict] = None
     is_linear: bool = False
+    # linear-tree leaf models (reference: linear_tree_learner.cpp storage:
+    # leaf_const_/leaf_coeff_/leaf_features_): leaf value = leaf_const +
+    # sum(coeff * raw[feature]); NaN in any used feature -> leaf_value
+    leaf_const: Optional[np.ndarray] = None  # (L,)
+    leaf_features: Optional[list] = None  # per-leaf list of feature ids
+    leaf_coeff: Optional[list] = None  # per-leaf list of coefficients
 
     def is_categorical_node(self) -> np.ndarray:
         return (self.decision_type & K_CATEGORICAL_MASK) != 0
@@ -80,6 +86,9 @@ class Tree:
         """reference: Tree::Shrinkage."""
         self.leaf_value = self.leaf_value * rate
         self.internal_value = self.internal_value * rate
+        if self.is_linear and self.leaf_const is not None:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [np.asarray(c) * rate for c in self.leaf_coeff]
         self.shrinkage *= rate
 
     # ------------------------------------------------------------------
@@ -162,7 +171,24 @@ class Tree:
         return (-node - 1).astype(np.int32)
 
     def predict_batch(self, x: np.ndarray) -> np.ndarray:
-        return self.leaf_value[self.predict_leaf_batch(x)]
+        leaf = self.predict_leaf_batch(x)
+        if not self.is_linear or self.leaf_const is None:
+            return self.leaf_value[leaf]
+        x = np.asarray(x, np.float64)
+        out = np.empty(len(leaf), np.float64)
+        for l in range(self.num_leaves):
+            rows = leaf == l
+            if not rows.any():
+                continue
+            feats = np.asarray(self.leaf_features[l], np.int64)
+            if len(feats) == 0:
+                out[rows] = self.leaf_value[l]
+                continue
+            vals = x[np.ix_(rows, feats)]
+            ok = np.isfinite(vals).all(axis=1)
+            lin = self.leaf_const[l] + vals @ np.asarray(self.leaf_coeff[l], np.float64)
+            out[rows] = np.where(ok, lin, self.leaf_value[l])
+        return out
 
     def predict_leaf_binned_batch(self, bins: np.ndarray, binner) -> np.ndarray:
         """Vectorized walk on BINNED data (host; handles categorical nodes via
@@ -280,6 +306,16 @@ class Tree:
             lines.append("cat_boundaries=" + _join_arr(self.cat_boundaries, "{:d}"))
             lines.append("cat_threshold=" + _join_arr(self.cat_threshold, "{:d}"))
         lines.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear and self.leaf_const is not None:
+            L = self.num_leaves
+            lines.append("leaf_const=" + _join_arr(self.leaf_const[:L], "{:.17g}"))
+            lines.append(
+                "num_features=" + " ".join(str(len(self.leaf_features[l])) for l in range(L))
+            )
+            flat_f = [str(int(v)) for l in range(L) for v in self.leaf_features[l]]
+            flat_c = ["{:.17g}".format(float(v)) for l in range(L) for v in self.leaf_coeff[l]]
+            lines.append("leaf_features=" + " ".join(flat_f))
+            lines.append("leaf_coeff=" + " ".join(flat_c))
         lines.append(f"shrinkage={self.shrinkage:g}")
         lines.append("")
         return "\n".join(lines)
@@ -326,6 +362,18 @@ class Tree:
         if num_cat > 0:
             tree.cat_boundaries = parse_list("cat_boundaries", np.float64, num_cat + 1).astype(np.int32)
             tree.cat_threshold = parse_list("cat_threshold", np.float64, 0).astype(np.uint32)
+        if tree.is_linear and "leaf_const" in kv:
+            tree.leaf_const = parse_list("leaf_const", np.float64, num_leaves)
+            counts = parse_list("num_features", np.float64, num_leaves).astype(np.int64)
+            flat_f = parse_list("leaf_features", np.float64, 0).astype(np.int64)
+            flat_c = parse_list("leaf_coeff", np.float64, 0)
+            tree.leaf_features, tree.leaf_coeff = [], []
+            pos = 0
+            for l in range(num_leaves):
+                c = int(counts[l]) if l < len(counts) else 0
+                tree.leaf_features.append(flat_f[pos:pos + c])
+                tree.leaf_coeff.append(flat_c[pos:pos + c])
+                pos += c
         return tree
 
 
@@ -337,6 +385,7 @@ def tree_from_device(
     arrays,  # ops.treegrow.TreeArrays (device or host)
     binner,  # binning.DatasetBinner
     missing_types: Optional[np.ndarray] = None,
+    linear=None,  # (coef (L,K), const (L,), feat_idx (L,K), nfeat (L,))
 ) -> Tree:
     """Trim fixed-shape device TreeArrays to an exact host Tree, converting
     bin thresholds to real values via the per-feature BinMapper
@@ -413,6 +462,23 @@ def tree_from_device(
         leaf_value=np.asarray(arrays.leaf_value)[:num_leaves].astype(np.float64),
         leaf_weight=np.asarray(arrays.leaf_weight)[:num_leaves].astype(np.float64),
         leaf_count=np.asarray(arrays.leaf_count)[:num_leaves].astype(np.int64),
+        **_linear_fields(linear, num_leaves),
+    )
+
+
+def _linear_fields(linear, num_leaves: int) -> dict:
+    if linear is None:
+        return {}
+    coef, const, fidx, nfeat = (np.asarray(a) for a in linear)
+    return dict(
+        is_linear=True,
+        leaf_const=const[:num_leaves].astype(np.float64),
+        leaf_features=[
+            fidx[l, : int(nfeat[l])].astype(np.int64) for l in range(num_leaves)
+        ],
+        leaf_coeff=[
+            coef[l, : int(nfeat[l])].astype(np.float64) for l in range(num_leaves)
+        ],
     )
 
 
@@ -427,6 +493,22 @@ def tree_to_if_else(tree: "Tree", idx: int) -> str:
     def emit(node: int, indent: int) -> None:
         pad = "  " * indent
         if node < 0:
+            l = -node - 1
+            if tree.is_linear and tree.leaf_const is not None:
+                feats = list(np.asarray(tree.leaf_features[l], np.int64))
+                if feats:
+                    nan_chk = " || ".join(f"std::isnan(x[{fi}])" for fi in feats)
+                    terms = " + ".join(
+                        f"{float(c):.17g} * x[{fi}]"
+                        for fi, c in zip(feats, np.asarray(tree.leaf_coeff[l]))
+                    )
+                    lines.append(
+                        f"{pad}return ({nan_chk}) ? {tree.leaf_value[l]:.17g} : "
+                        f"({tree.leaf_const[l]:.17g} + {terms});"
+                    )
+                    return
+                lines.append(f"{pad}return {tree.leaf_value[l]:.17g};")
+                return
             lines.append(f"{pad}return {tree.leaf_value[-node - 1]:.17g};")
             return
         f = int(tree.split_feature[node])

@@ -61,6 +61,7 @@ class TreeArrays(NamedTuple):
     leaf_depth: jnp.ndarray  # (L,) i32
     is_cat: jnp.ndarray  # (L-1,) bool — node is a categorical (bitset) split
     cat_mask: jnp.ndarray  # (L-1, B) bool — bins going left at cat nodes
+    path_features: Optional[jnp.ndarray] = None  # (L, F) bool (linear trees)
 
 
 class GrowState(NamedTuple):
@@ -116,6 +117,7 @@ def _set_best(best: BestSplit, i: jnp.ndarray, s: BestSplit) -> BestSplit:
         "axis_name",
         "parallel_mode",
         "top_k",
+        "track_path",
     ),
 )
 def grow_tree(
@@ -141,6 +143,7 @@ def grow_tree(
     axis_name: Optional[str] = None,
     parallel_mode: str = "data",  # with axis_name: data | feature | voting
     top_k: int = 20,  # voting mode: per-shard feature votes (reference: top_k)
+    track_path: bool = False,  # maintain per-leaf path features (linear trees)
 ) -> tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -324,7 +327,9 @@ def grow_tree(
         leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(leaf_out0),
         cegb_used=cegb_used0,
         used_features=(
-            jnp.zeros((L, f), bool) if interaction_sets is not None else jnp.zeros((), bool)
+            jnp.zeros((L, f), bool)
+            if (interaction_sets is not None or track_path)
+            else jnp.zeros((), bool)
         ),
         tree=tree0,
     )
@@ -453,7 +458,7 @@ def grow_tree(
         leaf_out_hi = state.leaf_out_hi.at[best_leaf].set(l_hi).at[new_leaf].set(r_hi)
         leaf_out = state.leaf_out.at[best_leaf].set(out_l_c).at[new_leaf].set(out_r_c)
 
-        if interaction_sets is not None:
+        if interaction_sets is not None or track_path:
             if mode == "feature":
                 ax = jax.lax.axis_index(axis_name)
                 local_f = s.feature - ax * f
@@ -467,6 +472,8 @@ def grow_tree(
             used_features = (
                 state.used_features.at[best_leaf].set(used_child).at[new_leaf].set(used_child)
             )
+            if interaction_sets is None:
+                used_child = None  # path tracking only — not a split filter
         else:
             used_features = state.used_features
             used_child = None
@@ -521,5 +528,6 @@ def grow_tree(
         leaf_count=jnp.where(active, state.leaf_count, 0.0),
         leaf_sum_g=jnp.where(active, state.leaf_sum_g, 0.0),
         leaf_depth=state.leaf_depth,
+        path_features=(state.used_features if track_path else None),
     )
     return tree, state.leaf_id

@@ -139,7 +139,7 @@ def _batched_best(
     static_argnames=(
         "num_leaves", "num_bins", "max_depth", "params", "axis_name",
         "leaf_tile", "hist_precision", "use_pallas", "quantize_bins",
-        "stochastic_rounding", "quant_renew",
+        "stochastic_rounding", "quant_renew", "track_path",
     ),
 )
 def grow_tree_fast(
@@ -172,6 +172,7 @@ def grow_tree_fast(
     quantize_bins: int = 0,
     stochastic_rounding: bool = True,
     quant_renew: bool = False,
+    track_path: bool = False,
 ) -> tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree in rounds; returns (tree, final leaf_id per row).
 
@@ -294,7 +295,7 @@ def grow_tree_fast(
         cat_mask=jnp.zeros((L - 1, num_bins), bool),
     )
 
-    use_used = interaction_sets is not None
+    use_used = interaction_sets is not None or track_path
     used0 = jnp.zeros((L, f), bool) if use_used else jnp.zeros((), bool)
     leaf_out0 = leaf_output(g0, h0, params)
     cegb_used0 = jnp.zeros((f,), bool)
@@ -314,7 +315,7 @@ def grow_tree_fast(
                 categorical_mask, monotone_constraints, interaction_sets,
                 jnp.asarray([-jnp.inf], jnp.float32),
                 jnp.asarray([jnp.inf], jnp.float32),
-                used0[:1] if use_used else None,
+                used0[:1] if interaction_sets is not None else None,
                 jnp.asarray([0], jnp.int32), rng_key,
                 depth=jnp.asarray([0.0], jnp.float32),
                 parent_out=jnp.asarray([leaf_out0]),
@@ -574,7 +575,7 @@ def grow_tree_fast(
             num_bins_per_feature, missing_bin_per_feature, params,
             feature_mask, categorical_mask, monotone_constraints,
             interaction_sets, state.leaf_out_lo[fr_idx], state.leaf_out_hi[fr_idx],
-            state.used_features[fr_idx] if use_used else None,
+            state.used_features[fr_idx] if interaction_sets is not None else None,
             node_ids[fr_idx], rng_key,
             depth=state.leaf_depth[fr_idx], parent_out=state.leaf_out[fr_idx],
             cegb_pen=cegb_pen,
@@ -626,5 +627,6 @@ def grow_tree_fast(
         leaf_count=jnp.where(active, state.leaf_count, 0.0),
         leaf_sum_g=jnp.where(active, state.leaf_sum_g, 0.0),
         leaf_depth=state.leaf_depth,
+        path_features=(state.used_features if track_path else None),
     )
     return tree, state.leaf_id
