@@ -105,6 +105,14 @@ class GBDT:
     # ------------------------------------------------------------------
     def reset_training_data(self, train_set) -> None:
         """reference: GBDT::ResetTrainingData."""
+        if self.cfg.num_machines > 1:
+            # multi-host bring-up (reference: Network::Init from machine
+            # list).  MUST run before the first JAX computation — so before
+            # Dataset.construct uploads anything (jax.distributed.initialize
+            # rejects an already-initialized backend).
+            from ..parallel.distributed import init_distributed
+
+            init_distributed(self.cfg)
         self.train_set = train_set
         train_set.construct()
         self.binner = train_set.binner

@@ -217,18 +217,30 @@ def grow_tree(
             # deterministic top-2k election, ties to the lower feature index
             score = tally.astype(jnp.int32) * (f + 1) - jnp.arange(f, dtype=jnp.int32)
             n_elect = min(2 * top_k, f)
-            thr = jax.lax.top_k(score, n_elect)[0][-1]
-            winners = score >= thr
-            ghist = jax.lax.psum(
-                jnp.where(winners[:, None, None], hist_leaf, 0.0), axis_name
-            )
-            kw["feature_mask"] = (
-                winners if kw["feature_mask"] is None else kw["feature_mask"] & winners
-            )
+            # DCN-frugal merge (the point of PV-Tree, reference:
+            # VotingParallelTreeLearner: only elected features' histograms
+            # cross the wire): gather the top-2k slice and psum THAT —
+            # n_elect/F of the full-width bytes.  `score` is replicated
+            # (built from the psum'd tally), so el_idx is identical on every
+            # shard and the collective stays congruent.
+            _, el_idx = jax.lax.top_k(score, n_elect)
+            sub_hist = jax.lax.psum(hist_leaf[el_idx], axis_name)  # (E, B, 3)
+
+            def sub(arr):
+                return None if arr is None else arr[el_idx]
+
+            kw_sub = dict(kw)
+            kw_sub["feature_mask"] = sub(kw["feature_mask"])
+            kw_sub["categorical_mask"] = sub(kw_sub.get("categorical_mask"))
+            kw_sub["monotone_constraints"] = sub(kw_sub.get("monotone_constraints"))
+            if kw_sub.get("cegb_feature_penalty") is not None:
+                kw_sub["cegb_feature_penalty"] = kw_sub["cegb_feature_penalty"][el_idx]
             s = find_best_split(
-                ghist, sum_g, sum_h, count,
-                num_bins_per_feature, missing_bin_per_feature, params, **kw,
+                sub_hist, sum_g, sum_h, count,
+                num_bins_per_feature[el_idx], missing_bin_per_feature[el_idx],
+                params, **kw_sub,
             )
+            s = s._replace(feature=el_idx[s.feature])
         else:
             s = find_best_split(
                 hist_leaf, sum_g, sum_h, count,

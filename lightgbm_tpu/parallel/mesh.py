@@ -39,17 +39,3 @@ def make_mesh_2d(n_data: int, n_feature: int, devices: Optional[Sequence] = None
     devices = np.asarray(devices[: n_data * n_feature]).reshape(n_data, n_feature)
     return Mesh(devices, (DATA_AXIS, FEATURE_AXIS))
 
-
-def initialize_distributed(coordinator_address: Optional[str] = None,
-                           num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> None:
-    """Multi-host bring-up (reference analogue: Network::Init from
-    machine_list — here jax.distributed over the TPU pod's control plane)."""
-    kwargs = {}
-    if coordinator_address is not None:
-        kwargs = dict(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    jax.distributed.initialize(**kwargs)
