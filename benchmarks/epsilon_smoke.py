@@ -1,0 +1,42 @@
+"""Epsilon-shape capacity smoke (BASELINE.md config 2; VERDICT item 6):
+400k x 2000 dense, 255 leaves, 255 bins must train on ONE chip without OOM.
+Prints iters/sec for a few iterations."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    n = int(os.environ.get("EPS_ROWS", 400_000))
+    f = int(os.environ.get("EPS_COLS", 2000))
+    iters = int(os.environ.get("EPS_ITERS", 3))
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f) / np.sqrt(f)
+    y = ((X @ w + 0.5 * rng.randn(n)) > 0).astype(np.float64)
+
+    import jax
+    import lightgbm_tpu as lgb
+
+    train = lgb.Dataset(X, label=y)
+    del X
+    bst = lgb.Booster(
+        params={"objective": "binary", "num_leaves": 255, "max_bin": 255,
+                "verbosity": -1, "min_data_in_leaf": 20},
+        train_set=train,
+    )
+    bst.update()
+    jax.block_until_ready(bst._gbdt._score)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        bst.update()
+    float(np.asarray(bst._gbdt._score)[0])
+    dt = time.perf_counter() - t0
+    print(f"epsilon-shape: {iters/dt:.3f} iters/sec ({n}x{f}, 255 leaves) OK")
+
+
+if __name__ == "__main__":
+    main()

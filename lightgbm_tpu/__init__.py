@@ -10,13 +10,14 @@ socket/MPI Network layer.
 Public API mirrors python-package/lightgbm/__init__.py.
 """
 
-from .basic import Booster, Dataset, LightGBMError
+from .basic import Booster, Dataset, LightGBMError, Sequence_ as Sequence
 from .callback import EarlyStopException, early_stopping, log_evaluation, record_evaluation, reset_parameter
 from .engine import CVBooster, cv, train
 from .utils.log import register_logger
 
 __all__ = [
     "Dataset",
+    "Sequence",
     "Booster",
     "CVBooster",
     "LightGBMError",

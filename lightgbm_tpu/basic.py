@@ -31,12 +31,66 @@ class LightGBMError(Exception):
 
 
 def _to_2d_float(data) -> np.ndarray:
-    if hasattr(data, "values"):  # pandas
+    """Accepts numpy arrays, pandas DataFrames (incl. category dtypes),
+    scipy CSR/CSC matrices, Sequence objects, and lists thereof (reference:
+    the c_api ingestion surface — DenseToCSR, CSR/CSC handlers, pandas
+    categorical encoding in python-package/lightgbm/basic.py, and the
+    Sequence streaming interface)."""
+    if isinstance(data, Sequence_):
+        data = _from_sequences([data])
+    elif isinstance(data, list) and data and isinstance(data[0], Sequence_):
+        data = _from_sequences(data)
+    if hasattr(data, "dtypes") and hasattr(data, "columns"):  # pandas frame
+        import pandas as pd  # local: pandas is optional
+
+        cols = []
+        for c in data.columns:
+            col = data[c]
+            if isinstance(col.dtype, pd.CategoricalDtype):
+                codes = col.cat.codes.to_numpy().astype(np.float64)
+                codes[codes < 0] = np.nan  # NA category -> missing
+                cols.append(codes)
+            else:
+                cols.append(col.to_numpy(dtype=np.float64, na_value=np.nan))
+        arr = np.stack(cols, axis=1)
+        return arr
+    if hasattr(data, "values"):  # pandas series
         data = data.values
+    if hasattr(data, "tocsr") and hasattr(data, "toarray"):  # scipy sparse
+        data = data.toarray()
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
     return arr
+
+
+class Sequence_:
+    """Generic row-chunk data source (reference: lightgbm.Sequence —
+    python-package/lightgbm/basic.py Sequence ABC + the push-rows streaming
+    C API).  Subclass with __len__ and __getitem__ (row slice -> ndarray);
+    `batch_size` bounds peak memory during construction."""
+
+    batch_size = 65536
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __getitem__(self, idx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def _from_sequences(seqs) -> np.ndarray:
+    chunks = []
+    for seq in seqs:
+        n = len(seq)
+        bs = max(int(getattr(seq, "batch_size", 65536)), 1)
+        for lo in range(0, n, bs):
+            chunk = np.asarray(seq[slice(lo, min(lo + bs, n))], np.float64)
+            if chunk.ndim == 1:
+                # a 1-D slice is a batch of single-feature ROWS
+                chunk = chunk.reshape(-1, 1)
+            chunks.append(chunk)
+    return np.concatenate(chunks, axis=0)
 
 
 def _feature_names_of(data, num_features: int) -> List[str]:
@@ -118,7 +172,9 @@ class Dataset:
                 seed=cfg.data_random_seed,
             )
         self.bins = self.binner.transform(raw)
-        self.bins_device = jnp.asarray(self.bins)
+        # int16 on device: half the HBM of int32 at Epsilon scale (max_bin
+        # caps at 65535 by far); compute casts per tile
+        self.bins_device = jnp.asarray(self.bins, jnp.int16)
         self.num_bins_pf_device = jnp.asarray(self.binner.num_bins_per_feature)
         self.missing_bin_pf_device = jnp.asarray(self.binner.missing_bin_per_feature)
         self.max_num_bins = int(self.binner.max_num_bins)
@@ -168,7 +224,7 @@ class Dataset:
                 )
                 self.efb = self.efb._replace(bundled_bins=bundled)
             self._efb_device = (
-                jnp.asarray(bundled),
+                jnp.asarray(bundled, jnp.int16),
                 jnp.asarray(self.efb.gather_idx),
                 jnp.asarray(self.efb.default_mask),
             )
@@ -241,7 +297,7 @@ class Dataset:
         sub = Dataset.__new__(Dataset)
         sub.__dict__.update({k: v for k, v in self.__dict__.items()})
         sub.bins = self.bins[idx]
-        sub.bins_device = jnp.asarray(sub.bins)
+        sub.bins_device = jnp.asarray(sub.bins, jnp.int16)
         if getattr(self, "efb", None) is not None:
             sub.efb = self.efb._replace(bundled_bins=None)  # re-encoded lazily
             sub._efb_device = None

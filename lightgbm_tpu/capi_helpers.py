@@ -1,0 +1,66 @@
+"""Python side of the C API shim (src/capi/lightgbm_tpu_c_api.cpp).
+
+The C layer passes raw pointers as integers; numpy wraps them zero-copy via
+ctypes, mirroring the reference's c_api.cpp which operates directly on the
+caller's buffers.  Kept deliberately thin: every function takes/returns
+plain scalars, strings or Booster objects so the C side needs no numpy ABI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .basic import Booster
+
+_PREDICT_NORMAL = 0
+_PREDICT_RAW_SCORE = 1
+_PREDICT_LEAF_INDEX = 2
+_PREDICT_CONTRIB = 3
+
+
+def booster_from_file(filename: str) -> Booster:
+    return Booster(model_file=filename)
+
+
+def booster_from_string(model_str: str) -> Booster:
+    return Booster(model_str=model_str)
+
+
+def num_classes(bst: Booster) -> int:
+    return int(getattr(bst._gbdt, "num_tree_per_iteration", 1))
+
+
+def save_model(bst: Booster, filename: str, start_iteration: int,
+               num_iteration: int) -> bool:
+    bst.save_model(filename, num_iteration=num_iteration,
+                   start_iteration=start_iteration)
+    return True
+
+
+def _wrap(addr: int, shape, dtype=np.float64) -> np.ndarray:
+    size = int(np.prod(shape))
+    ctype = ctypes.c_double if dtype == np.float64 else ctypes.c_float
+    buf = (ctype * size).from_address(addr)
+    return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
+def predict_into(bst: Booster, data_addr: int, nrow: int, ncol: int,
+                 is_row_major: int, predict_type: int, out_addr: int) -> int:
+    if is_row_major:
+        x = _wrap(data_addr, (nrow, ncol))
+    else:
+        x = _wrap(data_addr, (ncol, nrow)).T
+    if predict_type == _PREDICT_LEAF_INDEX:
+        out = bst.predict(x, pred_leaf=True).astype(np.float64)
+    elif predict_type == _PREDICT_CONTRIB:
+        out = bst.predict(x, pred_contrib=True)
+    elif predict_type == _PREDICT_RAW_SCORE:
+        out = bst.predict(x, raw_score=True)
+    else:
+        out = bst.predict(x)
+    out = np.ascontiguousarray(out, np.float64).ravel()
+    dest = _wrap(out_addr, (out.size,))
+    dest[:] = out
+    return int(out.size)

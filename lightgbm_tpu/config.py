@@ -429,6 +429,10 @@ class Config:
             raise ValueError(
                 f"hist_precision must be f32/bf16, got {self.hist_precision!r}"
             )
+        if self.max_bin >= 32768:
+            # device bin storage is int16 (basic.py); the reference's uint16
+            # caps at 65535 — far above any practical histogram width
+            raise ValueError(f"max_bin must be < 32768, got {self.max_bin}")
 
     def to_dict(self) -> Dict[str, Any]:
         out = {}

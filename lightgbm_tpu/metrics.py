@@ -228,6 +228,67 @@ class CrossEntropyMetric(_Pointwise):
         return -(y * np.log(p) + (1 - y) * np.log(1 - p))
 
 
+class XentLambdaMetric(Metric):
+    """reference: CrossEntropyLambdaMetric in xentropy_metric.hpp: the
+    lambda-parameterized cross entropy, where a weight scales the intensity
+    lambda = w * log1p(e^f) rather than the loss (differs from plain
+    xentropy only when weights are present)."""
+
+    name = "xentropy_lambda"
+
+    def eval(self, pred, label, weight, query_boundaries=None):
+        p = np.clip(np.asarray(pred, np.float64), EPS, 1 - EPS)
+        t = np.asarray(label, np.float64)
+        f = np.log(p / (1 - p))
+        w = np.ones_like(p) if weight is None else np.asarray(weight, np.float64)
+        lam = w * np.log1p(np.exp(f))
+        loss = (1 - t) * lam - t * np.log(-np.expm1(-np.maximum(lam, 1e-300)))
+        return [(self.name, float(np.mean(loss)), False)]
+
+
+class AucMuMetric(Metric):
+    """Multiclass AUC-mu (reference: auc_mu in src/metric/multiclass_metric.hpp,
+    Kleiman & Page 2019): average over ordered class pairs (i, j) of the AUC
+    separating class i from class j by the decision margin
+    pred[:, i] - pred[:, j], optionally weighted by the auc_mu_weights
+    misclassification-cost matrix."""
+
+    name = "auc_mu"
+    is_higher_better = True
+
+    def __init__(self, cfg=None):
+        self.weights = None
+        w = list(getattr(cfg, "auc_mu_weights", []) or []) if cfg is not None else []
+        if w:
+            k = int(round(len(w) ** 0.5))
+            if k * k == len(w):
+                self.weights = np.asarray(w, np.float64).reshape(k, k)
+
+    def eval(self, pred, label, weight, query_boundaries=None):
+        p = np.asarray(pred)
+        y = np.asarray(label).astype(np.int64)
+        k = p.shape[1]
+        total, wsum = 0.0, 0.0
+        for i in range(k):
+            for j in range(i + 1, k):
+                # AUC(i vs j by margin) == AUC(j vs i by -margin): one sort
+                # per unordered pair (reference iterates i < j too)
+                rows = (y == i) | (y == j)
+                if not rows.any() or (y[rows] == i).all() or (y[rows] == j).all():
+                    continue
+                margin = p[rows, i] - p[rows, j]
+                lab = (y[rows] == i).astype(np.float64)
+                wrow = None if weight is None else np.asarray(weight)[rows]
+                a = _auc(margin, lab, wrow)
+                pw = (
+                    2.0 if self.weights is None
+                    else float(self.weights[i, j] + self.weights[j, i])
+                )
+                total += pw * a
+                wsum += pw
+        return [(self.name, total / max(wsum, 1e-30), True)]
+
+
 class MultiLoglossMetric(Metric):
     name = "multi_logloss"
 
@@ -323,6 +384,8 @@ _METRICS: Dict[str, Callable[[Config], Metric]] = {
     "auc": AUCMetric,
     "cross_entropy": CrossEntropyMetric,
     "xentropy": CrossEntropyMetric,
+    "auc_mu": AucMuMetric,
+    "xentropy_lambda": XentLambdaMetric,
     "multi_logloss": MultiLoglossMetric,
     "multiclass": MultiLoglossMetric,
     "softmax": MultiLoglossMetric,
@@ -349,7 +412,8 @@ _DEFAULT_METRIC_FOR_OBJECTIVE: Dict[str, str] = {
     "multiclass": "multi_logloss",
     "multiclassova": "multi_logloss",
     "cross_entropy": "cross_entropy",
-    "cross_entropy_lambda": "cross_entropy",
+    "cross_entropy_lambda": "xentropy_lambda",
+    "xentlambda": "xentropy_lambda",
     "lambdarank": "ndcg",
     "rank_xendcg": "ndcg",
 }

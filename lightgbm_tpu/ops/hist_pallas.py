@@ -50,8 +50,15 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _direct_kernel(bins_ref, pay_ref, out_ref, acc_ref, *, F, B, NC, dtype):
-    i = pl.program_id(0)
+_FEAT_BLOCK = 128  # feature-block width for wide datasets (Epsilon-class);
+# Mosaic requires trailing block dims divisible by 128 (or the full array
+# width, which covers every narrow dataset)
+
+
+def _direct_kernel(bins_ref, pay_ref, out_ref, acc_ref, *, FB, B, NC, dtype):
+    """Grid (feature_blocks, row_tiles); row tiles iterate fastest, so the
+    accumulator lives across the row sweep of one feature block."""
+    i = pl.program_id(1)
 
     @pl.when(i == 0)
     def _():
@@ -60,8 +67,8 @@ def _direct_kernel(bins_ref, pay_ref, out_ref, acc_ref, *, F, B, NC, dtype):
     pay = pay_ref[...].astype(dtype)  # (T, NC)
     T = pay.shape[0]
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (T, B), 1)
-    for f in range(F):
-        binf = bins_ref[:, f][:, None]  # (T, 1)
+    for f in range(FB):
+        binf = bins_ref[:, f].astype(jnp.int32)[:, None]  # (T, 1)
         oh = (binf == iota_b).astype(dtype)  # (T, B)
         h = jax.lax.dot_general(
             pay, oh, (((0,), (0,)), ((), ())),
@@ -69,14 +76,14 @@ def _direct_kernel(bins_ref, pay_ref, out_ref, acc_ref, *, F, B, NC, dtype):
         )  # (NC, B)
         acc_ref[f] += h
 
-    @pl.when(i == pl.num_programs(0) - 1)
+    @pl.when(i == pl.num_programs(1) - 1)
     def _():
         out_ref[...] = acc_ref[...]
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile", "matmul_dtype"))
 def _hist_pallas_raw(
-    bins: jnp.ndarray,  # (N, F) int32
+    bins: jnp.ndarray,  # (N, F) int16/int32
     payload: jnp.ndarray,  # (N, NC) f32 or int8
     *,
     num_bins: int,
@@ -88,29 +95,33 @@ def _hist_pallas_raw(
     B = _round_up(max(num_bins, 8), 8)
     acc_dtype = jnp.int32 if payload.dtype == jnp.int8 else jnp.float32
 
+    FB = f if f <= _FEAT_BLOCK else _FEAT_BLOCK
+    f_pad = _round_up(f, FB)
     n_pad = _round_up(n, row_tile)
+    if n_pad != n or f_pad != f:
+        bins = jnp.pad(bins, ((0, n_pad - n), (0, f_pad - f)))
     if n_pad != n:
-        bins = jnp.pad(bins, ((0, n_pad - n), (0, 0)))
         payload = jnp.pad(payload, ((0, n_pad - n), (0, 0)))
-    grid = (n_pad // row_tile,)
+    grid = (f_pad // FB, n_pad // row_tile)
 
-    out_dims = (f, nc, B)
-    return pl.pallas_call(
-        functools.partial(_direct_kernel, F=f, B=B, NC=nc, dtype=matmul_dtype),
+    out_dims = (f_pad, nc, B)
+    out = pl.pallas_call(
+        functools.partial(_direct_kernel, FB=FB, B=B, NC=nc, dtype=matmul_dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((row_tile, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((row_tile, nc), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_tile, FB), lambda j, i: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_tile, nc), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(out_dims, lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((FB, nc, B), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(out_dims, acc_dtype),
-        scratch_shapes=[pltpu.VMEM(out_dims, acc_dtype)],
+        scratch_shapes=[pltpu.VMEM((FB, nc, B), acc_dtype)],
         cost_estimate=pl.CostEstimate(
-            flops=2 * n_pad * f * B * nc,
-            bytes_accessed=n_pad * f * 4 + n_pad * nc * 4,
+            flops=2 * n_pad * f_pad * B * nc,
+            bytes_accessed=n_pad * f_pad * bins.dtype.itemsize + n_pad * nc * 4,
             transcendentals=0,
         ),
     )(bins, payload)
+    return out[:f] if f_pad != f else out
 
 
 def _split_bf16x2(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -135,7 +146,6 @@ def histogram_pallas(
     MXU cost as bf16; ~17-bit-mantissa products — see module docstring);
     'bf16' uses rounded payloads in 4 lanes (~8-bit mantissa).
     """
-    bins = bins.astype(jnp.int32)
     m = mask.astype(jnp.float32)
     g = grad.astype(jnp.float32) * m
     h = hess.astype(jnp.float32) * m
@@ -184,7 +194,6 @@ def histogram_pallas_multi(
     This is the TPU replacement for per-leaf row-index histogramming
     (reference: Dataset::ConstructHistograms over DataPartition indices).
     """
-    bins = bins.astype(jnp.int32)
     m = mask.astype(jnp.float32)
     g = grad.astype(jnp.float32) * m
     h = hess.astype(jnp.float32) * m
@@ -244,7 +253,6 @@ def histogram_pallas_multi_quantized(
     (L_tile, F, B, 3) int32: exact integer accumulation on the int8 MXU
     (reference: gradient_discretizer.cpp + per-leaf ConstructHistograms).
     Lanes are leaf-onehot x (grad_q, hess_q, count) int8 payload."""
-    bins = bins.astype(jnp.int32)
     m8 = mask.astype(jnp.int8)
     base = jnp.stack(
         [grad_q.astype(jnp.int8) * m8, hess_q.astype(jnp.int8) * m8, m8], axis=-1
@@ -284,7 +292,6 @@ def histogram_pallas_quantized(
     """Quantized histogram -> (F, B, 3) int32 (grad_sum, hess_sum, count):
     exact int32 accumulation on the int8 MXU (reference:
     src/treelearner/gradient_discretizer.cpp quantized-training path)."""
-    bins = bins.astype(jnp.int32)
     m8 = mask.astype(jnp.int8)
     pay = jnp.stack(
         [grad_q.astype(jnp.int8) * m8, hess_q.astype(jnp.int8) * m8, m8,

@@ -184,7 +184,8 @@ def grow_tree_fast(
     gradients after growth (reference: RenewIntGradTreeOutput).
     """
     n, f = bins.shape
-    bins = bins.astype(jnp.int32)
+    # bins stay in their storage dtype (int16 on device — half the HBM of
+    # int32 at Epsilon scale); kernels and column slices upcast per tile
     grad = grad.astype(jnp.float32) * sample_weight
     hess = hess.astype(jnp.float32) * sample_weight
     grad_true, hess_true = grad, hess
@@ -381,7 +382,9 @@ def grow_tree_fast(
             leaf_r = inv_rank[r]
             live = accept[leaf_r]  # rank r admitted?
             feat_r = s.feature[leaf_r]
-            fcol = jax.lax.dynamic_index_in_dim(bins, feat_r, axis=1, keepdims=False)
+            fcol = jax.lax.dynamic_index_in_dim(
+                bins, feat_r, axis=1, keepdims=False
+            ).astype(jnp.int32)
             miss_r = fcol == missing_bin_per_feature[feat_r]
             gl = jnp.where(miss_r, s.default_left[leaf_r], fcol <= s.threshold_bin[leaf_r])
             if categorical_mask is not None:

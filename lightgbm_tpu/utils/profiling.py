@@ -1,0 +1,70 @@
+"""Tracing / profiling harness.
+
+Reference: the TIMETAG-gated wall-clock tallies in src/treelearner/*.cpp
+(global_timer) and the CLI's per-phase timing logs.  TPU-native analogue:
+`jax.profiler` device traces (viewable in TensorBoard/Perfetto) plus a
+host-side section timer with the reference's "Time for X: Y s" log style.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+import jax
+
+from .log import log_info
+
+_section_totals: Dict[str, float] = defaultdict(float)
+_section_counts: Dict[str, int] = defaultdict(int)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a device trace (XLA ops, Pallas kernels, transfers) for the
+    enclosed block; open `log_dir` with TensorBoard or Perfetto.
+    TPU analogue of nvprof over the reference's CUDA learner."""
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Label the enclosed dispatches in device traces
+    (jax.profiler.TraceAnnotation)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def timed_section(name: str, sync: bool = False) -> Iterator[None]:
+    """Host wall-clock tally per section (reference: global_timer's
+    start/stop pairs).  With sync=True the section waits for outstanding
+    device work first, attributing async dispatch correctly."""
+    if sync:
+        (jax.device_put(0.0) + 0).block_until_ready()
+    t0 = time.perf_counter()
+    try:
+        with annotate(name):
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        _section_totals[name] += dt
+        _section_counts[name] += 1
+
+
+def log_timings(reset: bool = True) -> Dict[str, float]:
+    """Emit the accumulated section tallies (reference: the TIMETAG summary
+    printed at the end of training)."""
+    out = dict(_section_totals)
+    for name in sorted(_section_totals, key=_section_totals.get, reverse=True):
+        log_info(
+            f"Time for {name}: {_section_totals[name]:.6f} s "
+            f"({_section_counts[name]} calls)"
+        )
+    if reset:
+        _section_totals.clear()
+        _section_counts.clear()
+    return out

@@ -1,0 +1,186 @@
+/* C API shim implementation — see lightgbm_tpu_c_api.h.
+ *
+ * Design (vs reference src/c_api.cpp): the reference's C API *is* its core;
+ * here the core is Python/JAX, so the C ABI embeds CPython and forwards to
+ * lightgbm_tpu.capi_helpers.  All entry points hold the GIL for their
+ * duration (PyGILState_Ensure), so the library is usable both from plain C
+ * programs (the embedded interpreter is initialized on first use) and from
+ * inside an existing Python process via ctypes.
+ */
+#include "lightgbm_tpu_c_api.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_err_mutex;
+std::string g_last_error = "ok";
+
+void set_last_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lk(g_err_mutex);
+  g_last_error = msg;
+}
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_last_error(msg);
+}
+
+struct GilGuard {
+  PyGILState_STATE state;
+  GilGuard() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+    }
+    state = PyGILState_Ensure();
+  }
+  ~GilGuard() { PyGILState_Release(state); }
+};
+
+PyObject* helpers() {
+  // borrowed-module pattern: import once per call; cheap after first import
+  return PyImport_ImportModule("lightgbm_tpu.capi_helpers");
+}
+
+int call_create(const char* kind, const char* arg, int* out_num_iterations,
+                BoosterHandle* out) {
+  GilGuard gil;
+  PyObject* mod = helpers();
+  if (mod == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* bst = PyObject_CallMethod(mod, kind, "s", arg);
+  Py_DECREF(mod);
+  if (bst == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  if (out_num_iterations != nullptr) {
+    PyObject* it = PyObject_CallMethod(bst, "current_iteration", nullptr);
+    if (it == nullptr) {
+      Py_DECREF(bst);
+      set_error_from_python();
+      return -1;
+    }
+    *out_num_iterations = static_cast<int>(PyLong_AsLong(it));
+    Py_DECREF(it);
+  }
+  *out = static_cast<BoosterHandle>(bst);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* LGBM_GetLastError(void) {
+  std::lock_guard<std::mutex> lk(g_err_mutex);
+  return g_last_error.c_str();
+}
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  return call_create("booster_from_file", filename, out_num_iterations, out);
+}
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  return call_create("booster_from_string", model_str, out_num_iterations, out);
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  if (handle == nullptr) return 0;
+  GilGuard gil;
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
+  GilGuard gil;
+  PyObject* mod = helpers();
+  if (mod == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(mod, "num_classes", "O",
+                                    static_cast<PyObject*>(handle));
+  Py_DECREF(mod);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out_len = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          const char* filename) {
+  (void)feature_importance_type;
+  GilGuard gil;
+  PyObject* mod = helpers();
+  if (mod == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(
+      mod, "save_model", "Osii", static_cast<PyObject*>(handle), filename,
+      start_iteration, num_iteration);
+  Py_DECREF(mod);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const double* data,
+                              int32_t nrow, int32_t ncol,
+                              int32_t is_row_major, int32_t predict_type,
+                              int64_t* out_len, double* out_result) {
+  GilGuard gil;
+  PyObject* mod = helpers();
+  if (mod == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(
+      mod, "predict_into", "OKiiiiK", static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(data), static_cast<int>(nrow),
+      static_cast<int>(ncol), static_cast<int>(is_row_major),
+      static_cast<int>(predict_type),
+      reinterpret_cast<unsigned long long>(out_result));
+  Py_DECREF(mod);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,63 @@
+/* C API for lightgbm_tpu — the reference's `LGBM_*` FFI surface
+ * (reference: include/LightGBM/c_api.h, src/c_api.cpp) re-hosted over the
+ * TPU-native Python/JAX core.  The shim embeds CPython: handles are
+ * refcounted lightgbm_tpu.Booster objects, array arguments cross as raw
+ * pointers wrapped zero-copy by numpy on the Python side
+ * (lightgbm_tpu/capi_helpers.py).
+ *
+ * Return convention matches the reference: 0 = success, -1 = failure with
+ * the message available via LGBM_GetLastError().
+ */
+#ifndef LIGHTGBM_TPU_C_API_H_
+#define LIGHTGBM_TPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* BoosterHandle;
+
+#define C_API_PREDICT_NORMAL 0
+#define C_API_PREDICT_RAW_SCORE 1
+#define C_API_PREDICT_LEAF_INDEX 2
+#define C_API_PREDICT_CONTRIB 3
+
+const char* LGBM_GetLastError(void);
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+
+int LGBM_BoosterFree(BoosterHandle handle);
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+
+int LGBM_BoosterSaveModel(BoosterHandle handle,
+                          int start_iteration,
+                          int num_iteration,
+                          int feature_importance_type,
+                          const char* filename);
+
+/* data: row-major (nrow x ncol) float64 matrix. out_result must hold
+ * nrow (normal/raw), nrow*num_class (multiclass), or nrow*num_trees
+ * (leaf index) doubles; *out_len receives the count written. */
+int LGBM_BoosterPredictForMat(BoosterHandle handle,
+                              const double* data,
+                              int32_t nrow,
+                              int32_t ncol,
+                              int32_t is_row_major,
+                              int32_t predict_type,
+                              int64_t* out_len,
+                              double* out_result);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* LIGHTGBM_TPU_C_API_H_ */

@@ -1,0 +1,59 @@
+"""Tracing/profiling harness + NaN-sanitizer analog (SURVEY §6.1/§6.2:
+the reference's TIMETAG timers and its sanitizer CI jobs)."""
+
+import glob
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.profiling import device_trace, log_timings, timed_section
+
+
+def _tiny_train(extra=None):
+    rng = np.random.RandomState(0)
+    X = rng.randn(800, 5).astype(np.float32)
+    y = ((X @ rng.randn(5)) > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    params.update(extra or {})
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(3):
+        bst.update()
+    return bst, X, y
+
+
+def test_device_trace_writes_profile(tmp_path):
+    logdir = str(tmp_path / "trace")
+    with device_trace(logdir):
+        with timed_section("train"):
+            _tiny_train()
+    files = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
+    assert any("trace" in f or f.endswith(".pb") or f.endswith(".json.gz") for f in files), files
+    totals = log_timings()
+    assert totals["train"] > 0
+
+
+def test_training_is_nan_clean_under_debug_nans():
+    """jax debug_nans is the sanitizer-CI analog: any NaN produced inside a
+    jitted training op raises immediately."""
+    import jax
+
+    jax.config.update("jax_debug_nans", True)
+    try:
+        bst, X, y = _tiny_train()
+        p = bst.predict(X)
+        assert np.isfinite(p).all()
+        # missing values must stay NaN-clean too
+        Xn = X.copy()
+        Xn[::7, 0] = np.nan
+        ds = lgb.Dataset(Xn, label=y)
+        bst2 = lgb.Booster(
+            params={"objective": "binary", "num_leaves": 7, "verbosity": -1},
+            train_set=ds,
+        )
+        for _ in range(2):
+            bst2.update()
+        assert np.isfinite(bst2.predict(Xn)).all()
+    finally:
+        jax.config.update("jax_debug_nans", False)

@@ -142,3 +142,50 @@ def test_lambdarank_position_bias_learns_bias():
     assert bias.shape == (qlen,)
     assert np.all(np.isfinite(bias))
     assert np.any(bias != 0.0)  # the EM/Newton update actually ran
+
+
+def test_ingestion_scipy_sparse_and_sequence():
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    X, y = _data(n=1000)
+    Xs = scipy_sparse.csr_matrix(np.where(np.abs(X) < 1.0, 0.0, X))
+    ds = lgb.Dataset(Xs, label=y)
+    bst = lgb.Booster(params={"objective": "regression", "verbosity": -1,
+                              "num_leaves": 7}, train_set=ds)
+    bst.update()
+    assert np.isfinite(bst.predict(Xs.toarray())).all()
+
+    class Seq(lgb.Sequence):
+        def __init__(self, arr):
+            self.arr = arr
+            self.batch_size = 100
+        def __len__(self):
+            return len(self.arr)
+        def __getitem__(self, idx):
+            return self.arr[idx]
+
+    ds2 = lgb.Dataset(Seq(X), label=y)
+    ds2.construct()
+    assert ds2.num_data() == len(X)
+    # two sequences concatenate
+    ds3 = lgb.Dataset([Seq(X[:500]), Seq(X[500:])], label=y)
+    ds3.construct()
+    assert ds3.num_data() == len(X)
+
+
+def test_ingestion_pandas_categorical():
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(0)
+    n = 2000
+    df = pd.DataFrame({
+        "num": rng.randn(n),
+        "cat": pd.Categorical(rng.choice(["a", "b", "c"], n)),
+    })
+    y = (df["num"].to_numpy() + (df["cat"] == "b") * 2.0 + 0.1 * rng.randn(n))
+    ds = lgb.Dataset(df, label=y, categorical_feature=["cat"])
+    bst = lgb.Booster(params={"objective": "regression", "verbosity": -1,
+                              "num_leaves": 7}, train_set=ds)
+    for _ in range(5):
+        bst.update()
+    p = bst.predict(df)
+    r = np.corrcoef(p, y)[0, 1]
+    assert r > 0.9
