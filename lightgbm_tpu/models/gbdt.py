@@ -208,6 +208,12 @@ class GBDT:
             self.cfg.tree_learner == "serial"
             and (mode == "rounds" or (mode == "auto" and self._on_tpu))
         )
+        # rounds grower under SPMD data parallelism (voting/feature modes
+        # stay on the strict grower — their cost is comms-shaped)
+        self._use_fast_dp = (
+            self.cfg.tree_learner == "data"
+            and (mode == "rounds" or (mode == "auto" and self._on_tpu))
+        )
         # CEGB coupled per-feature penalties (reference: cegb.hpp); the
         # across-trees "feature already used anywhere" state lives here and
         # is updated on device after every tree
@@ -264,7 +270,7 @@ class GBDT:
                 "constructed without linear_tree in its params (or raw data "
                 "was freed). Pass params={'linear_tree': True} to Dataset."
             )
-        if self.cfg.use_quantized_grad and not self._use_fast:
+        if self.cfg.use_quantized_grad and not (self._use_fast or self._use_fast_dp):
             log_warning(
                 "use_quantized_grad is implemented on the rounds grower "
                 "(tree_growth_mode=rounds / auto-on-TPU) only; this run "
@@ -425,6 +431,18 @@ class GBDT:
         mask[chosen] = True
         return jnp.asarray(mask) & self._allowed_features
 
+    def _leaf_tile(self, ts, use_efb: bool = True) -> int:
+        f_eff = (
+            ts.efb.num_bundled
+            if use_efb and getattr(ts, "efb", None) is not None
+            else ts.num_feature()
+        )
+        f_pad = max((f_eff + 127) // 128 * 128, 1) if f_eff > 128 else f_eff
+        budget = 64_000_000  # bytes; measured Mosaic ceiling ~100MB, with margin
+        bpad = (max(ts.max_num_bins, 8) + 7) // 8 * 8  # kernel pads B to 8
+        per_leaf = f_pad * bpad * 4 * 6  # ncl=6 f32 lanes
+        return max(1, min(10, budget // max(per_leaf, 1), self.cfg.num_leaves))
+
     _last_mask = None
 
     # ------------------------------------------------------------------
@@ -475,6 +493,38 @@ class GBDT:
                     max_depth=self.cfg.max_depth,
                     params=self._split_params,
                 )
+            elif self._dp is not None and self._use_fast_dp:
+                from ..parallel.data_parallel import grow_tree_fast_data_parallel
+
+                dp = self._dp
+                quant = self.cfg.use_quantized_grad
+                arrays, leaf_id_pad = grow_tree_fast_data_parallel(
+                    dp,
+                    dp.pad_rows(np.asarray(gc, np.float32)),
+                    dp.pad_rows(np.asarray(hc, np.float32)),
+                    dp.pad_rows(np.asarray(row_mask, bool), fill=False),
+                    dp.pad_rows(np.asarray(sample_weight, np.float32), fill=1.0),
+                    feature_mask,
+                    self._categorical_mask,
+                    self._monotone,
+                    self._interaction_sets,
+                    node_rng,
+                    (jax.random.PRNGKey(self.cfg.seed * 1000003 + self.iter_ * 31 + c)
+                     if quant else None),
+                    cegb_pen,
+                    num_leaves=self.cfg.num_leaves,
+                    num_bins=ts.max_num_bins,
+                    max_depth=self.cfg.max_depth,
+                    params=self._split_params,
+                    leaf_tile=self._leaf_tile(ts, use_efb=False),
+                    hist_precision=self.cfg.hist_precision,
+                    use_pallas=self._on_tpu,
+                    quantize_bins=(self.cfg.num_grad_quant_bins if quant else 0),
+                    stochastic_rounding=bool(self.cfg.stochastic_rounding),
+                    quant_renew=bool(self.cfg.quant_train_renew_leaf),
+                    track_path=self._linear,
+                )
+                leaf_id = leaf_id_pad[: ts.num_data()]
             elif self._dp is not None:
                 from ..parallel.data_parallel import grow_tree_data_parallel
 
@@ -528,8 +578,12 @@ class GBDT:
                     params=self._split_params,
                     # measured on-chip: 10 leaves/pass (60 f32 payload lanes)
                     # beats 16 (96 lanes) — wider payloads slow the Mosaic
-                    # kernel more than the extra admission round costs
-                    leaf_tile=min(10, self.cfg.num_leaves),
+                    # kernel more than the extra admission round costs.
+                    # Wide datasets cap further: the Mosaic toolchain rejects
+                    # kernels whose output tensor F_pad*lanes*B*4 exceeds
+                    # ~100MB (measured), so Epsilon-shape runs use fewer
+                    # leaves per pass.
+                    leaf_tile=self._leaf_tile(ts),
                     hist_precision=self.cfg.hist_precision,
                     use_pallas=self._on_tpu,
                     quantize_bins=(self.cfg.num_grad_quant_bins if quant else 0),

@@ -96,6 +96,12 @@ def _hist_pallas_raw(
     acc_dtype = jnp.int32 if payload.dtype == jnp.int8 else jnp.float32
 
     FB = f if f <= _FEAT_BLOCK else _FEAT_BLOCK
+    if f > _FEAT_BLOCK:
+        # wide data: the accumulator + revisited output block dominate
+        # scoped VMEM (16MB hard cap, and while_loop bodies get less slack
+        # than standalone kernels — measured 512KB over at T=1024); halve
+        # the row tile to stay inside
+        row_tile = min(row_tile, 512)
     f_pad = _round_up(f, FB)
     n_pad = _round_up(n, row_tile)
     if n_pad != n or f_pad != f:

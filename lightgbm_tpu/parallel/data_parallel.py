@@ -68,6 +68,49 @@ class ShardedData:
         return jax.device_put(arr, self.row_sharding)
 
 
+@functools.lru_cache(maxsize=64)
+def _sharded_grower(mesh, grower, extra_names: tuple, grower_kwargs: tuple):
+    """Cached jitted shard_map wrapper around a grower function.  Cached so
+    repeated boosting iterations reuse one trace/compile (the closure would
+    otherwise key a fresh jit every call); shared by the strict and rounds
+    growers so the shard_map plumbing cannot diverge."""
+    kwargs = dict(grower_kwargs)
+
+    def wrapped(bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_, *extras):
+        return grower(
+            bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_,
+            **dict(zip(extra_names, extras)), **kwargs,
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            wrapped,
+            mesh=mesh,
+            in_specs=(
+                P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                P(DATA_AXIS), P(), P(), P(),
+            ) + tuple(P() for _ in extra_names),
+            out_specs=(
+                TreeArrays(*([P()] * len(TreeArrays._fields))),  # replicated
+                P(DATA_AXIS),  # leaf_id
+            ),
+            check_vma=False,
+        )
+    )
+
+
+def _run_sharded(sharded, grower, opt, grower_kwargs, grad, hess, row_mask,
+                 sample_weight, feature_mask):
+    extra_names = tuple(k for k, v in opt.items() if v is not None)
+    extra_vals = tuple(opt[k] for k in extra_names)
+    fn = _sharded_grower(sharded.mesh, grower, extra_names,
+                         tuple(sorted(grower_kwargs.items())))
+    return fn(
+        sharded.bins, grad, hess, row_mask, sample_weight, feature_mask,
+        sharded.num_bins_pf, sharded.missing_bin_pf, *extra_vals,
+    )
+
+
 def grow_tree_data_parallel(
     sharded: ShardedData,
     grad: jnp.ndarray,  # (Npad,) sharded over DATA_AXIS
@@ -75,9 +118,9 @@ def grow_tree_data_parallel(
     row_mask: jnp.ndarray,  # (Npad,) bool sharded — bagging AND validity
     sample_weight: jnp.ndarray,
     feature_mask: jnp.ndarray,  # (F,) replicated
-    categorical_mask: Optional[jnp.ndarray] = None,  # (F,) replicated
-    monotone_constraints: Optional[jnp.ndarray] = None,  # (F,) replicated
-    interaction_sets: Optional[jnp.ndarray] = None,  # (S, F) replicated
+    categorical_mask: Optional[jnp.ndarray] = None,
+    monotone_constraints: Optional[jnp.ndarray] = None,
+    interaction_sets: Optional[jnp.ndarray] = None,
     rng_key: Optional[jnp.ndarray] = None,  # replicated — identical per-node
     # sampling on every shard keeps the SPMD trees in lockstep
     *,
@@ -94,55 +137,71 @@ def grow_tree_data_parallel(
     reference call-stack analogue: DataParallelTreeLearner::Train (SURVEY.md
     §4.4) with psum in place of ReduceScatter/Allreduce.
     """
-    mesh = sharded.mesh
     opt = {
         "categorical_mask": categorical_mask,
         "monotone_constraints": monotone_constraints,
         "interaction_sets": interaction_sets,
         "rng_key": rng_key,
     }
-    extra_names = [k for k, v in opt.items() if v is not None]
-    extra_vals = tuple(opt[k] for k in extra_names)
-
-    def wrapped(bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_, *extras):
-        return grow_tree(
-            bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_,
-            **dict(zip(extra_names, extras)),
-            num_leaves=num_leaves,
-            num_bins=num_bins,
-            max_depth=max_depth,
-            params=params,
-            hist_strategy=hist_strategy,
-            axis_name=DATA_AXIS,
-            parallel_mode=parallel_mode,
-            top_k=top_k,
-        )
-
-    fn = jax.jit(
-        jax.shard_map(
-            wrapped,
-            mesh=mesh,
-            in_specs=(
-                P(DATA_AXIS),  # bins
-                P(DATA_AXIS),  # grad
-                P(DATA_AXIS),  # hess
-                P(DATA_AXIS),  # row_mask
-                P(DATA_AXIS),  # sample_weight
-                P(),  # feature_mask
-                P(),  # num_bins_pf
-                P(),  # missing_bin_pf
-            ) + tuple(P() for _ in extra_vals),  # replicated optional extras
-            out_specs=(
-                TreeArrays(*([P()] * len(TreeArrays._fields))),  # tree replicated
-                P(DATA_AXIS),  # leaf_id
-            ),
-            check_vma=False,
-        )
+    kw = dict(
+        num_leaves=num_leaves, num_bins=num_bins, max_depth=max_depth,
+        params=params, hist_strategy=hist_strategy, axis_name=DATA_AXIS,
+        parallel_mode=parallel_mode, top_k=top_k,
     )
-    return fn(
-        sharded.bins, grad, hess, row_mask, sample_weight, feature_mask,
-        sharded.num_bins_pf, sharded.missing_bin_pf, *extra_vals,
+    return _run_sharded(sharded, grow_tree, opt, kw, grad, hess, row_mask,
+                        sample_weight, feature_mask)
+
+
+def grow_tree_fast_data_parallel(
+    sharded: ShardedData,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    row_mask: jnp.ndarray,
+    sample_weight: jnp.ndarray,
+    feature_mask: jnp.ndarray,
+    categorical_mask: Optional[jnp.ndarray] = None,
+    monotone_constraints: Optional[jnp.ndarray] = None,
+    interaction_sets: Optional[jnp.ndarray] = None,
+    rng_key: Optional[jnp.ndarray] = None,
+    quant_key: Optional[jnp.ndarray] = None,
+    cegb_feature_penalty: Optional[jnp.ndarray] = None,
+    *,
+    num_leaves: int,
+    num_bins: int,
+    max_depth: int = -1,
+    params: SplitParams = SplitParams(),
+    leaf_tile: int = 10,
+    hist_precision: str = "f32",
+    use_pallas: bool = True,
+    quantize_bins: int = 0,
+    stochastic_rounding: bool = True,
+    quant_renew: bool = False,
+    track_path: bool = False,
+) -> Tuple[TreeArrays, jnp.ndarray]:
+    """Round-batched grower under SPMD data parallelism: each shard runs the
+    multi-leaf histogram pass over its rows, one psum per round merges the
+    (tile, F, B, 3) block, and every shard applies the identical splits
+    (reference analogue: DataParallelTreeLearner with the multi-leaf pass
+    replacing per-split ReduceScatter rounds)."""
+    from ..ops.treegrow_fast import grow_tree_fast
+
+    opt = {
+        "categorical_mask": categorical_mask,
+        "monotone_constraints": monotone_constraints,
+        "interaction_sets": interaction_sets,
+        "rng_key": rng_key,
+        "quant_key": quant_key,
+        "cegb_feature_penalty": cegb_feature_penalty,
+    }
+    kw = dict(
+        num_leaves=num_leaves, num_bins=num_bins, max_depth=max_depth,
+        params=params, axis_name=DATA_AXIS, leaf_tile=leaf_tile,
+        hist_precision=hist_precision, use_pallas=use_pallas,
+        quantize_bins=quantize_bins, stochastic_rounding=stochastic_rounding,
+        quant_renew=quant_renew, track_path=track_path,
     )
+    return _run_sharded(sharded, grow_tree_fast, opt, kw, grad, hess,
+                        row_mask, sample_weight, feature_mask)
 
 
 @functools.partial(jax.jit, static_argnames=("axis_name",))

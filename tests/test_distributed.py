@@ -194,3 +194,52 @@ def test_end_to_end_voting_parallel(synth):
     pred = b_vp.predict(X)
     acc = float(((pred > 0.5) == (y > 0.5)).mean())
     assert acc > 0.8, acc
+
+
+def test_rounds_grower_serial_equals_data_parallel():
+    """The round-batched grower must produce the identical tree under SPMD
+    data parallelism (per-round psum merge) as serially."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.ops.treegrow_fast import grow_tree_fast
+    from lightgbm_tpu.parallel.data_parallel import (
+        ShardedData, grow_tree_fast_data_parallel,
+    )
+    from lightgbm_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.RandomState(11)
+    n, f, B = 4096, 6, 32
+    bins = rng.randint(0, B - 1, size=(n, f)).astype(np.int32)
+    y = (bins[:, 0] + bins[:, 1] > B).astype(np.float32)
+    grad = (0.5 - y).astype(np.float32)
+    hess = np.full(n, 0.25, np.float32)
+    nbpf = np.full(f, B, np.int32)
+    mbpf = np.full(f, -1, np.int32)
+    params = SplitParams(min_data_in_leaf=5)
+
+    t_serial, _ = grow_tree_fast(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones((n,), bool), jnp.ones((n,), jnp.float32),
+        jnp.ones((f,), bool), jnp.asarray(nbpf), jnp.asarray(mbpf),
+        num_leaves=15, num_bins=B, params=params, use_pallas=False,
+    )
+
+    mesh = make_mesh()
+    sd = ShardedData(mesh, bins, nbpf, mbpf)
+    t_dp, _ = grow_tree_fast_data_parallel(
+        sd, sd.pad_rows(grad), sd.pad_rows(hess),
+        sd.pad_rows(np.ones(n, bool), fill=False),
+        sd.pad_rows(np.ones(n, np.float32), fill=1.0),
+        jnp.ones((f,), bool),
+        num_leaves=15, num_bins=B, params=params, use_pallas=False,
+    )
+    assert int(t_serial.num_leaves) == int(t_dp.num_leaves)
+    for name in ("split_feature", "threshold_bin", "left_child", "right_child"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_serial, name)), np.asarray(getattr(t_dp, name))
+        )
+    np.testing.assert_allclose(
+        np.asarray(t_serial.leaf_value), np.asarray(t_dp.leaf_value),
+        rtol=1e-5, atol=1e-5,
+    )
