@@ -54,6 +54,9 @@ def _to_2d_float(data) -> np.ndarray:
                 cols.append(col.to_numpy(dtype=np.float64, na_value=np.nan))
         arr = np.stack(cols, axis=1)
         return arr
+    if hasattr(data, "to_pandas") and hasattr(data, "schema"):  # pyarrow Table
+        data = data.to_pandas()
+        return _to_2d_float(data)
     if hasattr(data, "values"):  # pandas series
         data = data.values
     if hasattr(data, "tocsr") and hasattr(data, "toarray"):  # scipy sparse

@@ -213,6 +213,7 @@ class GBDT:
         self._use_fast_dp = (
             self.cfg.tree_learner == "data"
             and (mode == "rounds" or (mode == "auto" and self._on_tpu))
+            and jax.device_count() > 1  # matches the _dp construction gate
         )
         # CEGB coupled per-feature penalties (reference: cegb.hpp); the
         # across-trees "feature already used anywhere" state lives here and
@@ -441,7 +442,7 @@ class GBDT:
         budget = 64_000_000  # bytes; measured Mosaic ceiling ~100MB, with margin
         bpad = (max(ts.max_num_bins, 8) + 7) // 8 * 8  # kernel pads B to 8
         per_leaf = f_pad * bpad * 4 * 6  # ncl=6 f32 lanes
-        return max(1, min(10, budget // max(per_leaf, 1), self.cfg.num_leaves))
+        return max(1, min(8, budget // max(per_leaf, 1), self.cfg.num_leaves))
 
     _last_mask = None
 
@@ -500,10 +501,10 @@ class GBDT:
                 quant = self.cfg.use_quantized_grad
                 arrays, leaf_id_pad = grow_tree_fast_data_parallel(
                     dp,
-                    dp.pad_rows(np.asarray(gc, np.float32)),
-                    dp.pad_rows(np.asarray(hc, np.float32)),
-                    dp.pad_rows(np.asarray(row_mask, bool), fill=False),
-                    dp.pad_rows(np.asarray(sample_weight, np.float32), fill=1.0),
+                    dp.pad_rows_device(gc, jnp.float32),
+                    dp.pad_rows_device(hc, jnp.float32),
+                    dp.pad_rows_device(row_mask, bool, fill=False),
+                    dp.pad_rows_device(sample_weight, jnp.float32, fill=1.0),
                     feature_mask,
                     self._categorical_mask,
                     self._monotone,
@@ -576,9 +577,9 @@ class GBDT:
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
                     params=self._split_params,
-                    # measured on-chip: 10 leaves/pass (60 f32 payload lanes)
-                    # beats 16 (96 lanes) — wider payloads slow the Mosaic
-                    # kernel more than the extra admission round costs.
+                    # measured on-chip (bench.py sweep): 8 leaves/pass is
+                    # the optimum — wider payload lanes slow the Mosaic
+                    # kernel more than the saved admission rounds buy.
                     # Wide datasets cap further: the Mosaic toolchain rejects
                     # kernels whose output tensor F_pad*lanes*B*4 exceeds
                     # ~100MB (measured), so Epsilon-shape runs use fewer

@@ -67,6 +67,17 @@ class ShardedData:
             arr = np.concatenate([np.asarray(arr), np.full((pad,) + np.shape(arr)[1:], fill, np.asarray(arr).dtype)])
         return jax.device_put(arr, self.row_sharding)
 
+    def pad_rows_device(self, arr, dtype, fill=0.0) -> jnp.ndarray:
+        """Pad + reshard WITHOUT a host round-trip (the async rounds-grower
+        path: grad/hess/masks are already device arrays)."""
+        arr = jnp.asarray(arr, dtype)
+        pad = self.padded - self.num_data
+        if pad:
+            arr = jnp.concatenate(
+                [arr, jnp.full((pad,) + arr.shape[1:], fill, dtype)]
+            )
+        return jax.device_put(arr, self.row_sharding)
+
 
 @functools.lru_cache(maxsize=64)
 def _sharded_grower(mesh, grower, extra_names: tuple, grower_kwargs: tuple):
@@ -170,7 +181,7 @@ def grow_tree_fast_data_parallel(
     num_bins: int,
     max_depth: int = -1,
     params: SplitParams = SplitParams(),
-    leaf_tile: int = 10,
+    leaf_tile: int = 8,
     hist_precision: str = "f32",
     use_pallas: bool = True,
     quantize_bins: int = 0,

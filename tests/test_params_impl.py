@@ -189,3 +189,14 @@ def test_ingestion_pandas_categorical():
     p = bst.predict(df)
     r = np.corrcoef(p, y)[0, 1]
     assert r > 0.9
+
+
+def test_ingestion_pyarrow_table():
+    pa = pytest.importorskip("pyarrow")
+    X, y = _data(n=800, f=3)
+    table = pa.table({f"f{i}": X[:, i] for i in range(3)})
+    ds = lgb.Dataset(table, label=y)
+    bst = lgb.Booster(params={"objective": "regression", "verbosity": -1,
+                              "num_leaves": 7}, train_set=ds)
+    bst.update()
+    assert np.isfinite(bst.predict(X[:, :3])).all()
