@@ -1,0 +1,54 @@
+"""Tests for the leaf-ordered partition op (ops/partition.py — the
+DataPartition analogue that round 3's windowed histogram passes build on)."""
+
+import numpy as np
+
+from lightgbm_tpu.ops.partition import stable_partition_ranges
+
+
+def _ref_partition(order, seg_id, seg_start, seg_len, go_left):
+    out = order.copy()
+    lefts = np.zeros(len(seg_start), np.int32)
+    for s in range(len(seg_start)):
+        lo, ln = seg_start[s], seg_len[s]
+        if ln == 0:
+            continue
+        pos = np.arange(lo, lo + ln)
+        gl = go_left[pos]
+        out[lo:lo + ln] = np.concatenate([order[pos][gl], order[pos][~gl]])
+        lefts[s] = gl.sum()
+    return out, lefts
+
+
+def test_stable_partition_matches_reference_semantics():
+    rng = np.random.RandomState(0)
+    n = 10_000
+    order = rng.permutation(n).astype(np.int32)
+    # carve 4 disjoint segments; the rest untouched
+    seg_start = np.asarray([0, 3000, 5000, 9000], np.int32)
+    seg_len = np.asarray([1500, 800, 2500, 1000], np.int32)
+    seg_id = np.full(n, -1, np.int32)
+    for s, (lo, ln) in enumerate(zip(seg_start, seg_len)):
+        seg_id[lo:lo + ln] = s
+    go_left = rng.rand(n) < 0.4
+
+    got, got_l = stable_partition_ranges(order, seg_id, seg_start, seg_len, go_left)
+    want, want_l = _ref_partition(order, seg_id, seg_start, seg_len, go_left)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    np.testing.assert_array_equal(np.asarray(got_l), want_l)
+
+
+def test_stable_partition_all_one_side_and_empty_segments():
+    order = np.arange(100, dtype=np.int32)
+    seg_start = np.asarray([10, 50], np.int32)
+    seg_len = np.asarray([20, 0], np.int32)
+    seg_id = np.full(100, -1, np.int32)
+    seg_id[10:30] = 0
+    go_left = np.zeros(100, bool)  # everything right
+    got, lefts = stable_partition_ranges(order, seg_id, seg_start, seg_len, go_left)
+    np.testing.assert_array_equal(np.asarray(got), order)
+    assert int(lefts[0]) == 0 and int(lefts[1]) == 0
+    go_left[:] = True  # everything left
+    got, lefts = stable_partition_ranges(order, seg_id, seg_start, seg_len, go_left)
+    np.testing.assert_array_equal(np.asarray(got), order)
+    assert int(lefts[0]) == 20
