@@ -56,9 +56,11 @@ def stable_partition_ranges(
     cr0 = jnp.where(start_pos > 0, cr[jnp.maximum(start_pos - 1, 0)], 0)
     rank_l = cl - cl0  # 1-based among left rows of my segment
     rank_r = cr - cr0
-    n_left_seg = jnp.zeros(seg_start.shape, jnp.int32).at[sid].max(
-        jnp.where(in_seg, rank_l, 0)
-    )
+    # per-segment left counts from the cumsum endpoints — O(S), and the
+    # reason seg_len is a parameter
+    seg_end = seg_start + jnp.maximum(seg_len - 1, 0)
+    cl0_seg = jnp.where(seg_start > 0, cl[jnp.maximum(seg_start - 1, 0)], 0)
+    n_left_seg = jnp.where(seg_len > 0, cl[seg_end] - cl0_seg, 0).astype(jnp.int32)
 
     dest = jnp.where(
         go_left,
