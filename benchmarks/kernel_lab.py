@@ -1,0 +1,270 @@
+"""On-chip lab for histogram-kernel variants (round-2 perf campaign).
+
+Measures ms/pass at the bench shape for experimental one-hot formulations
+vs the shipped `ops/hist_pallas.py` kernels.  Variants that win graduate
+into the shipped kernel; variants that lose get recorded in
+docs/PERF_NOTES.md so they aren't re-derived.
+
+Usage: python benchmarks/kernel_lab.py [variants-comma-list] [N] [F] [B]
+"""
+
+import functools
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+def timeit(fn, *args, reps=8):
+    out = fn(*args)
+    _ = np.asarray(out).ravel()[0]
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    host = np.asarray(out)
+    dt = (time.perf_counter() - t0) / reps * 1e3
+    return dt, host
+
+
+# ---------------------------------------------------------------- variants
+
+def make_direct(nc, *, cmp_dtype=jnp.int32, row_tile=1024, B=256, F=28,
+                matmul_dtype=jnp.bfloat16):
+    """Current shipped formulation: per-feature (T,B) one-hot + dot.
+    cmp_dtype controls the iota/compare dtype (int32 today; int16 lab)."""
+
+    def kernel(bins_ref, pay_ref, out_ref, acc_ref):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        pay = pay_ref[...].astype(matmul_dtype)
+        T = pay.shape[0]
+        iota_b = jax.lax.broadcasted_iota(cmp_dtype, (T, B), 1)
+        for f in range(F):
+            binf = bins_ref[:, f].astype(cmp_dtype)[:, None]
+            oh = (binf == iota_b).astype(matmul_dtype)
+            acc_ref[f] += jax.lax.dot_general(
+                pay, oh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(i == pl.num_programs(1) - 1)
+        def _():
+            out_ref[...] = acc_ref[...]
+
+    @jax.jit
+    def run(bins, pay):
+        n = bins.shape[0]
+        grid = (1, n // row_tile)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((row_tile, F), lambda j, i: (i, j), memory_space=pltpu.VMEM),
+                pl.BlockSpec((row_tile, nc), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((F, nc, B), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((F, nc, B), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((F, nc, B), jnp.float32)],
+            cost_estimate=pl.CostEstimate(
+                flops=2 * n * F * B * nc,
+                bytes_accessed=n * F * bins.dtype.itemsize + n * nc * 4,
+                transcendentals=0,
+            ),
+        )(bins, pay)
+
+    return run
+
+
+def make_fused(nc, *, row_tile=256, B=256, F=28, matmul_dtype=jnp.bfloat16,
+               cmp_dtype=jnp.int32):
+    """One (T, F*B) one-hot + ONE dot for all features (bigger ops,
+    fewer of them).  VMEM for the one-hot bounds the row tile."""
+
+    def kernel(bins_ref, pay_ref, out_ref, acc_ref):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        pay = pay_ref[...].astype(matmul_dtype)
+        T = pay.shape[0]
+        iota = jax.lax.broadcasted_iota(cmp_dtype, (T, F, B), 2)
+        binf = bins_ref[...].astype(cmp_dtype)[:, :, None]
+        oh = (binf == iota).astype(matmul_dtype).reshape(T, F * B)
+        acc_ref[...] += jax.lax.dot_general(
+            pay, oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(i == pl.num_programs(1) - 1)
+        def _():
+            out_ref[...] = acc_ref[...]
+
+    @jax.jit
+    def run(bins, pay):
+        n = bins.shape[0]
+        grid = (1, n // row_tile)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((row_tile, F), lambda j, i: (i, j), memory_space=pltpu.VMEM),
+                pl.BlockSpec((row_tile, nc), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((nc, F * B), lambda j, i: (0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((nc, F * B), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((nc, F * B), jnp.float32)],
+            cost_estimate=pl.CostEstimate(
+                flops=2 * n * F * B * nc,
+                bytes_accessed=n * F * bins.dtype.itemsize + n * nc * 4,
+                transcendentals=0,
+            ),
+        )(bins, pay)
+
+    return run
+
+
+def make_inkernel_multi(ncl, lt, *, row_tile=1024, B=256, F=28,
+                        matmul_dtype=jnp.bfloat16):
+    """Multi-leaf pass with IN-KERNEL leaf-onehot x base expansion:
+    reads base (N, ncl) + slot (N, 1) instead of a materialized
+    (N, lt*ncl) payload."""
+    NC = _round_up(lt * ncl, 8)
+
+    def kernel(bins_ref, base_ref, slot_ref, out_ref, acc_ref):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        base = base_ref[...]  # (T, ncl) f32
+        slot = slot_ref[...]  # (T, 1) i32
+        T = base.shape[0]
+        iota_c = jax.lax.broadcasted_iota(jnp.int32, (T, NC), 1)
+        # pay[t, j] = base[t, j % ncl] * (slot[t] == j // ncl)
+        sel = (iota_c // ncl) == slot  # (T, NC)
+        base_tiled = jnp.concatenate(
+            [base] * (NC // ncl + 1), axis=1)[:, :NC]  # cols j -> base[:, j % ncl]
+        pay = jnp.where(sel, base_tiled, 0.0).astype(matmul_dtype)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (T, B), 1)
+        for f in range(F):
+            binf = bins_ref[:, f].astype(jnp.int32)[:, None]
+            oh = (binf == iota_b).astype(matmul_dtype)
+            acc_ref[f] += jax.lax.dot_general(
+                pay, oh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(i == pl.num_programs(1) - 1)
+        def _():
+            out_ref[...] = acc_ref[...]
+
+    @jax.jit
+    def run(bins, base, slot):
+        n = bins.shape[0]
+        grid = (1, n // row_tile)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((row_tile, F), lambda j, i: (i, j), memory_space=pltpu.VMEM),
+                pl.BlockSpec((row_tile, ncl), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((row_tile, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((F, NC, B), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((F, NC, B), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((F, NC, B), jnp.float32)],
+        )(bins, base, slot)
+
+    return run
+
+
+def main():
+    variants = sys.argv[1].split(",") if len(sys.argv) > 1 else [
+        "direct48", "direct48_i16", "direct48_t2048", "fused48_256",
+        "inkernel8x6", "direct8", "direct8_i16", "lane_sweep",
+    ]
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000_000
+    F = int(sys.argv[3]) if len(sys.argv) > 3 else 28
+    B = int(sys.argv[4]) if len(sys.argv) > 4 else 256
+
+    n = n // 4096 * 4096  # lab kernels do not pad; keep N tile-divisible
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, B, size=(n, F)).astype(np.int16))
+    base8 = jnp.asarray(rng.randn(n, 8).astype(np.float32))
+    slot = jnp.asarray(rng.randint(0, 8, size=(n, 1)).astype(np.int32))
+    pay48 = jnp.asarray(rng.randn(n, 48).astype(np.float32))
+    pay8 = base8
+
+    results = {}
+    for name in variants:
+        try:
+            if name == "lane_sweep":
+                for nc in (8, 16, 24, 32, 40, 48, 64, 96):
+                    fn = make_direct(nc)
+                    pay = pay48[:, :nc] if nc <= 48 else jnp.tile(pay48, (1, 2))[:, :nc]
+                    ms, _ = timeit(fn, bins, pay)
+                    results[f"direct_nc{nc}"] = ms
+                continue
+            if name == "direct48":
+                fn, args = make_direct(48), (bins, pay48)
+            elif name == "direct48_i16":
+                fn, args = make_direct(48, cmp_dtype=jnp.int16), (bins, pay48)
+            elif name == "direct48_t2048":
+                fn, args = make_direct(48, row_tile=2048), (bins, pay48)
+            elif name == "fused48i16_256":
+                fn, args = make_fused(48, row_tile=256, cmp_dtype=jnp.int16), (bins, pay48)
+            elif name.startswith("fused48"):
+                rt = int(name.split("_")[1])
+                fn, args = make_fused(48, row_tile=rt), (bins, pay48)
+            elif name == "inkernel8x6":
+                fn, args = make_inkernel_multi(6, 8), (bins, base8[:, :6], slot)
+            elif name == "direct8":
+                fn, args = make_direct(8), (bins, pay8)
+            elif name == "direct8_i16":
+                fn, args = make_direct(8, cmp_dtype=jnp.int16), (bins, pay8)
+            else:
+                print(f"  {name}: unknown")
+                continue
+            ms, out = timeit(fn, *args)
+            results[name] = ms
+            # correctness probe (first feature, first channel)
+            if name.startswith("fused"):
+                got = out.reshape(-1, F, B)[0, 0]
+            elif name.startswith("inkernel"):
+                ref1 = np.bincount(
+                    np.asarray(bins)[:, 0],
+                    weights=np.where(np.asarray(slot)[:, 0] == 0,
+                                     np.asarray(base8)[:, 0], 0.0).astype(np.float64),
+                    minlength=B)
+                err = np.max(np.abs(out[0, 0] - ref1) / (np.abs(ref1) + 1))
+                print(f"  {name}: rel_err={err:.2e}", flush=True)
+                continue
+            else:
+                got = out[0, 0]
+            ref1 = np.bincount(np.asarray(bins)[:, 0],
+                               weights=np.asarray(args[1][:, 0], np.float64),
+                               minlength=B)
+            err = np.max(np.abs(got - ref1) / (np.abs(ref1) + 1))
+            print(f"  {name}: rel_err={err:.2e}", flush=True)
+        except Exception as e:
+            print(f"  {name}: ERROR {type(e).__name__}: {str(e)[:240]}", flush=True)
+
+    print(f"\nN={n} F={F} B={B} on {jax.devices()[0].platform}")
+    for k, v in sorted(results.items(), key=lambda kv: kv[1]):
+        print(f"  {k:28s} {v:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

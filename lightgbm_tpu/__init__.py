@@ -39,3 +39,21 @@ try:  # sklearn wrappers are optional at import time (mirrors compat.py)
     __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
 except ImportError:  # pragma: no cover
     pass
+
+# plotting imports matplotlib/graphviz only at call time, so the module
+# itself is always importable
+from .plotting import (  # noqa: F401
+    create_tree_digraph,
+    plot_importance,
+    plot_metric,
+    plot_split_value_histogram,
+    plot_tree,
+)
+
+__all__ += [
+    "plot_importance",
+    "plot_split_value_histogram",
+    "plot_metric",
+    "plot_tree",
+    "create_tree_digraph",
+]

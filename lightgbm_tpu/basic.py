@@ -587,6 +587,35 @@ class Booster:
     def feature_importance(self, importance_type: str = "split", iteration=None) -> np.ndarray:
         return self._gbdt.feature_importance(importance_type)
 
+    def get_split_value_histogram(self, feature, bins=None, xgboost_style: bool = False):
+        """Histogram of a feature's split thresholds across the model
+        (reference: basic.py Booster.get_split_value_histogram)."""
+        if isinstance(feature, str):
+            names = self.feature_name()
+            if feature not in names:
+                raise ValueError(f"Unknown feature name {feature!r}")
+            feature = names.index(feature)
+        values = []
+        for tree in self._gbdt.models:
+            is_cat = tree.is_categorical_node()
+            for node in range(tree.num_internal):
+                if int(tree.split_feature[node]) == feature and not is_cat[node]:
+                    values.append(float(tree.threshold[node]))
+        values = np.array(values, dtype=np.float64)
+        if bins is None or (isinstance(bins, int) and bins > len(values)):
+            bins = max(len(values), 1)
+        hist, bin_edges = np.histogram(values, bins=bins)
+        if xgboost_style:
+            ret = np.column_stack((bin_edges[1:], hist))
+            ret = ret[ret[:, 1] > 0]
+            try:
+                import pandas as pd
+
+                return pd.DataFrame(ret, columns=["SplitValue", "Count"])
+            except ImportError:
+                return ret
+        return hist, bin_edges
+
     # network API compatibility (collectives are XLA's job on TPU)
     def set_network(self, *args, **kwargs) -> "Booster":
         return self

@@ -226,6 +226,14 @@ class LGBMModel(BaseEstimator):
 
         if self._fobj is not None:
             params["objective"] = self._fobj
+        # record eval curves like the reference wrapper (sklearn.py:
+        # LGBMModel.fit wires a record_evaluation callback -> evals_result_)
+        self._evals_result = {}
+        callbacks = list(callbacks) if callbacks else []
+        if valid_sets:
+            from .callback import record_evaluation
+
+            callbacks.append(record_evaluation(self._evals_result))
         self._Booster = _train(
             params,
             train_set,
@@ -239,7 +247,6 @@ class LGBMModel(BaseEstimator):
         self._n_features = train_set.num_feature()
         self.n_features_in_ = self._n_features
         self.fitted_ = True
-        self._evals_result = {}
         self._best_iteration = self._Booster.best_iteration
         self._best_score = self._Booster.best_score
         return self
@@ -275,6 +282,11 @@ class LGBMModel(BaseEstimator):
     def best_score_(self):
         self._check_fitted()
         return self._best_score
+
+    @property
+    def evals_result_(self):
+        self._check_fitted()
+        return self._evals_result
 
     @property
     def feature_importances_(self) -> np.ndarray:

@@ -1,0 +1,89 @@
+"""Plotting + eval-recording surface (reference: tests cover plotting via
+test_plotting.py in the python package)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def model():
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        d, num_boost_round=5,
+    )
+    return bst
+
+
+def test_plot_importance(model):
+    ax = lgb.plot_importance(model)
+    labels = [t.get_text() for t in ax.get_yticklabels()]
+    assert "Column_0" in labels
+    assert ax.get_title() == "Feature importance"
+
+
+def test_plot_split_value_histogram(model):
+    ax = lgb.plot_split_value_histogram(model, feature=0)
+    assert ax is not None
+    with pytest.raises(ValueError):
+        # feature 4 may or may not be used; an unknown name must raise
+        lgb.plot_split_value_histogram(model, feature="nope")
+
+
+def test_get_split_value_histogram(model):
+    hist, edges = model.get_split_value_histogram(0)
+    assert hist.sum() == int(model.feature_importance("split")[0])
+    assert len(edges) == len(hist) + 1
+
+
+def test_plot_metric_from_record():
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] > 0).astype(float)
+    d = lgb.Dataset(X, label=y)
+    ev = {}
+    lgb.train(
+        {"objective": "binary", "metric": "binary_logloss", "verbosity": -1},
+        d, num_boost_round=5, valid_sets=[d], valid_names=["train"],
+        callbacks=[lgb.record_evaluation(ev)],
+    )
+    ax = lgb.plot_metric(ev)
+    assert ax.get_ylabel() == "binary_logloss"
+    with pytest.raises(TypeError):
+        lgb.plot_metric(lgb.Booster.__new__(lgb.Booster))
+
+
+def test_plot_tree_and_digraph(model):
+    g = lgb.create_tree_digraph(model, tree_index=0, show_info=["internal_count", "leaf_count"])
+    src = g.source
+    assert "split0" in src and "leaf" in src
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(model, tree_index=99)
+    # plot_tree renders through graphviz's dot binary; skip if absent
+    import shutil
+
+    if shutil.which("dot") is None:
+        pytest.skip("graphviz dot binary not installed")
+    ax = lgb.plot_tree(model)
+    assert ax is not None
+
+
+def test_sklearn_evals_result():
+    rng = np.random.RandomState(2)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] > 0).astype(int)
+    clf = lgb.LGBMClassifier(n_estimators=5, verbosity=-1)
+    clf.fit(X, y, eval_set=[(X, y)], eval_metric="binary_logloss")
+    assert "valid_0" in clf.evals_result_
+    assert len(clf.evals_result_["valid_0"]["binary_logloss"]) == 5
+    ax = lgb.plot_metric(clf)
+    assert ax is not None
