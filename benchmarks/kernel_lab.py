@@ -135,6 +135,58 @@ def make_fused(nc, *, row_tile=256, B=256, F=28, matmul_dtype=jnp.bfloat16,
     return run
 
 
+def make_transposed(nc, *, row_tile=1024, B=256, F=28,
+                    matmul_dtype=jnp.bfloat16):
+    """Feature-major bins (F, N); one-hot built TRANSPOSED (B, T) with the
+    bin ids broadcast along sublanes (cheap) instead of lanes, dot
+    contracts over the lane dim.  Tests whether the shipped kernel's
+    per-feature column extraction/relayout is a hidden cost."""
+
+    def kernel(binsT_ref, pay_ref, out_ref, acc_ref):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        pay = pay_ref[...].astype(matmul_dtype)  # (T, nc)
+        T = pay.shape[0]
+        iota_s = jax.lax.broadcasted_iota(jnp.int32, (B, T), 0)
+        for f in range(F):
+            binf = binsT_ref[f, :].astype(jnp.int32)[None, :]  # (1, T)
+            ohT = (binf == iota_s).astype(matmul_dtype)  # (B, T)
+            acc_ref[f] += jax.lax.dot_general(
+                ohT, pay, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (B, nc)
+
+        @pl.when(i == pl.num_programs(1) - 1)
+        def _():
+            out_ref[...] = acc_ref[...]
+
+    @jax.jit
+    def run(binsT, pay):
+        n = binsT.shape[1]
+        grid = (1, n // row_tile)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((F, row_tile), lambda j, i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((row_tile, nc), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((F, B, nc), lambda j, i: (0, 0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((F, B, nc), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((F, B, nc), jnp.float32)],
+            cost_estimate=pl.CostEstimate(
+                flops=2 * n * F * B * nc,
+                bytes_accessed=n * F * binsT.dtype.itemsize + n * nc * 4,
+                transcendentals=0,
+            ),
+        )(binsT, pay)
+
+    return run
+
+
 def make_inkernel_multi(ncl, lt, *, row_tile=1024, B=256, F=28,
                         matmul_dtype=jnp.bfloat16):
     """Multi-leaf pass with IN-KERNEL leaf-onehot x base expansion:
@@ -230,6 +282,10 @@ def main():
                 fn, args = make_fused(48, row_tile=rt), (bins, pay48)
             elif name == "inkernel8x6":
                 fn, args = make_inkernel_multi(6, 8), (bins, base8[:, :6], slot)
+            elif name.startswith("transposed"):
+                nc = int(name.split("_")[0][10:])
+                fn, args = make_transposed(nc), (
+                    jnp.asarray(np.asarray(bins).T.copy()), pay48[:, :nc])
             elif name == "direct8":
                 fn, args = make_direct(8), (bins, pay8)
             elif name == "direct8_i16":
@@ -242,6 +298,8 @@ def main():
             # correctness probe (first feature, first channel)
             if name.startswith("fused"):
                 got = out.reshape(-1, F, B)[0, 0]
+            elif name.startswith("transposed"):
+                got = out[0, :, 0]
             elif name.startswith("inkernel"):
                 ref1 = np.bincount(
                     np.asarray(bins)[:, 0],

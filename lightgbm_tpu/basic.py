@@ -163,6 +163,18 @@ class Dataset:
             # bin alignment with the reference dataset (reference= semantics)
             self.binner = ref.binner
         else:
+            forced_bins = None
+            if cfg.forcedbins_filename:
+                # reference: DatasetLoader reads the forced-bins JSON
+                # ([{"feature": idx, "bin_upper_bound": [...]}]) and routes
+                # each entry into BinMapper::FindBin as forced boundaries
+                import json as _json
+
+                with open(cfg.forcedbins_filename) as fh:
+                    forced_bins = {
+                        int(e["feature"]): [float(v) for v in e["bin_upper_bound"]]
+                        for e in _json.load(fh)
+                    }
             self.binner = DatasetBinner.fit(
                 raw,
                 max_bin=cfg.max_bin,
@@ -173,6 +185,7 @@ class Dataset:
                 categorical_features=cats,
                 max_bin_by_feature=cfg.max_bin_by_feature,
                 seed=cfg.data_random_seed,
+                forced_bins=forced_bins,
             )
         self.bins = self.binner.transform(raw)
         # int16 on device: half the HBM of int32 at Epsilon scale (max_bin
@@ -279,10 +292,102 @@ class Dataset:
     set_weight = lambda self, weight: self.set_field("weight", weight)
     set_group = lambda self, group: self.set_field("group", group)
     set_init_score = lambda self, s: self.set_field("init_score", s)
+    set_position = lambda self, p: self.set_field("position", p)
     get_label = lambda self: self.label
     get_weight = lambda self: self.weight
     get_group = lambda self: self.group
     get_init_score = lambda self: self.init_score
+    get_position = lambda self: self.position
+
+    def get_data(self):
+        """reference: Dataset.get_data — the raw data (None once freed)."""
+        return self.data
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self.feature_names)
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        """reference: Dataset.set_feature_name."""
+        if feature_name is not None and feature_name != "auto":
+            names = list(feature_name)
+            if self._constructed and len(names) != self.num_feature():
+                raise LightGBMError(
+                    f"Length of feature names {len(names)} does not equal "
+                    f"number of features {self.num_feature()}"
+                )
+            self.feature_name = names
+            if self._constructed:
+                self.feature_names = names
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """reference: Dataset.set_categorical_feature — must happen before
+        construction (bin mappers depend on it)."""
+        if self.categorical_feature == categorical_feature:
+            return self
+        if self._constructed:
+            raise LightGBMError(
+                "Cannot set categorical feature after freed raw data, "
+                "set free_raw_data=False when construct Dataset to avoid this."
+            )
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """reference: Dataset.set_reference — align bins to another dataset."""
+        if self._constructed:
+            if self.reference is reference:
+                return self
+            raise LightGBMError(
+                "Cannot set reference after Dataset was constructed."
+            )
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """reference: Dataset.get_ref_chain — set of datasets along the
+        reference= chain."""
+        head = self
+        ref_chain = set()
+        while len(ref_chain) < ref_limit:
+            if isinstance(head, Dataset):
+                ref_chain.add(head)
+                if head.reference is not None and head.reference not in ref_chain:
+                    head = head.reference
+                else:
+                    break
+            else:
+                break
+        return ref_chain
+
+    def feature_num_bin(self, feature: Union[int, str]) -> int:
+        """reference: Dataset.feature_num_bin (LGBM_DatasetGetFeatureNumBin)."""
+        self.construct()
+        if isinstance(feature, str):
+            feature = self.feature_names.index(feature)
+        return int(self.binner.mappers[feature].num_bins)
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Column-concatenate another constructed dataset (reference:
+        Dataset::AddFeaturesFrom)."""
+        self.construct()
+        other.construct()
+        if self.num_data() != other.num_data():
+            raise LightGBMError("Cannot add features from Dataset with a different number of rows")
+        self.binner = DatasetBinner(mappers=list(self.binner.mappers) + list(other.binner.mappers))
+        self.bins = np.concatenate([self.bins, other.bins], axis=1)
+        self.bins_device = jnp.asarray(self.bins, jnp.int16)
+        self.num_bins_pf_device = jnp.asarray(self.binner.num_bins_per_feature)
+        self.missing_bin_pf_device = jnp.asarray(self.binner.missing_bin_per_feature)
+        self.max_num_bins = int(self.binner.max_num_bins)
+        self.feature_names = list(self.feature_names) + list(other.feature_names)
+        self._num_feature = len(self.feature_names)
+        if self.data is not None and other.data is not None:
+            self.data = np.column_stack([_to_2d_float(self.data), _to_2d_float(other.data)])
+        self.efb = None  # bundling plan is stale after adding columns
+        self._efb_device = None
+        return self
 
     def create_valid(self, data, label=None, weight=None, group=None, init_score=None,
                      params=None) -> "Dataset":
@@ -437,6 +542,108 @@ class Booster:
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         self._gbdt.add_valid(data, name)
         return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Mutate runtime-resettable params (reference: Booster.reset_parameter
+        -> LGBM_BoosterResetParameter -> GBDT::ResetConfig)."""
+        self.params.update(params)
+        self._gbdt.cfg.update(params)
+        self._gbdt.reset_split_params()
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """reference: Booster.set_train_data_name (eval printing label)."""
+        self._train_data_name = name
+        return self
+
+    def shuffle_models(self, start_iteration: int = 0, end_iteration: int = -1) -> "Booster":
+        """Shuffle tree order in [start, end) (reference:
+        Booster.shuffle_models -> GBDT ShuffleModels)."""
+        models = self._gbdt.models
+        end = len(models) if end_iteration < 0 else min(end_iteration, len(models))
+        seg = models[start_iteration:end]
+        np.random.shuffle(seg)
+        self._gbdt.models[start_iteration:end] = seg
+        return self
+
+    def _init_score_offset(self) -> float:
+        scores = getattr(self._gbdt, "init_scores", None) or [0.0]
+        return float(scores[0]) if len(scores) == 1 else 0.0
+
+    def lower_bound(self) -> float:
+        """Minimum possible model output (reference: Booster.lower_bound ->
+        GBDT::GetLowerBoundValue: sum over trees of min leaf value)."""
+        return float(sum(
+            float(np.min(t.leaf_value[: t.num_leaves])) for t in self._gbdt.models
+        ) + self._init_score_offset())
+
+    def upper_bound(self) -> float:
+        """Maximum possible model output (reference: Booster.upper_bound)."""
+        return float(sum(
+            float(np.max(t.leaf_value[: t.num_leaves])) for t in self._gbdt.models
+        ) + self._init_score_offset())
+
+    def trees_to_dataframe(self):
+        """Flatten the model into a pandas DataFrame, one row per node/leaf
+        (reference: Booster.trees_to_dataframe)."""
+        import pandas as pd
+
+        def node_rows(tree_idx, struct, parent, depth, rows):
+            if "split_index" in struct:
+                idx = f"{tree_idx}-S{struct['split_index']}"
+                rows.append({
+                    "tree_index": tree_idx,
+                    "node_depth": depth,
+                    "node_index": idx,
+                    "left_child": None,
+                    "right_child": None,
+                    "parent_index": parent,
+                    "split_feature": struct["split_feature"],
+                    "split_gain": struct["split_gain"],
+                    "threshold": struct["threshold"],
+                    "decision_type": struct["decision_type"],
+                    "missing_direction": "left" if struct["default_left"] else "right",
+                    "missing_type": struct["missing_type"],
+                    "value": struct["internal_value"],
+                    "weight": struct["internal_weight"],
+                    "count": struct["internal_count"],
+                })
+                me = len(rows) - 1
+                rows[me]["left_child"] = node_rows(
+                    tree_idx, struct["left_child"], idx, depth + 1, rows)
+                rows[me]["right_child"] = node_rows(
+                    tree_idx, struct["right_child"], idx, depth + 1, rows)
+                return idx
+            idx = f"{tree_idx}-L{struct['leaf_index']}"
+            rows.append({
+                "tree_index": tree_idx,
+                "node_depth": depth,
+                "node_index": idx,
+                "left_child": None,
+                "right_child": None,
+                "parent_index": parent,
+                "split_feature": None,
+                "split_gain": None,
+                "threshold": None,
+                "decision_type": None,
+                "missing_direction": None,
+                "missing_type": None,
+                "value": struct["leaf_value"],
+                "weight": struct.get("leaf_weight"),
+                "count": struct.get("leaf_count"),
+            })
+            return idx
+
+        model = self.dump_model()
+        feature_names = model["feature_names"]
+        rows: List[Dict[str, Any]] = []
+        for t in model["tree_info"]:
+            node_rows(t["tree_index"], t["tree_structure"], None, 1, rows)
+        df = pd.DataFrame(rows)
+        df["split_feature"] = df["split_feature"].map(
+            lambda v: feature_names[int(v)] if v is not None and not pd.isna(v) else None
+        )
+        return df
 
     def current_iteration(self) -> int:
         return self._gbdt.iter_

@@ -153,6 +153,7 @@ def find_bin(
     zero_as_missing: bool = False,
     is_categorical: bool = False,
     min_data_per_group: int = 100,
+    forced_bounds: Sequence[float] = (),
 ) -> BinMapper:
     """Construct a BinMapper from (a sample of) one feature's values
     (reference: BinMapper::FindBin in src/io/bin.cpp)."""
@@ -193,9 +194,28 @@ def find_bin(
     sorted_vals, counts = np.unique(clean, return_counts=True)
     n_avail = max_bin - (1 if missing_type != MISSING_NONE else 0)
     n_avail = max(n_avail, 1)
-    bounds = _greedy_equal_count_bounds(
-        sorted_vals, counts, n_avail, min_data_in_bin, total_cnt=len(clean)
-    )
+    if len(forced_bounds):
+        # forced bin boundaries from forcedbins_filename (reference:
+        # bin.cpp BinMapper::FindBin forced_upper_bounds / DatasetLoader's
+        # forced-bins JSON): the listed bounds become boundaries verbatim
+        # and the remaining budget is filled greedily.
+        forced = np.unique(np.asarray(forced_bounds, dtype=np.float64))
+        forced = forced[: n_avail - 1]
+        rest = max(n_avail - len(forced), 1)
+        greedy = _greedy_equal_count_bounds(
+            sorted_vals, counts, rest, min_data_in_bin, total_cnt=len(clean)
+        )
+        bounds = np.unique(np.concatenate([forced, greedy]))
+        if len(bounds) > n_avail:
+            # keep all forced bounds + the largest greedy ones (incl. +inf)
+            extra = np.setdiff1d(bounds, forced)[-(n_avail - len(forced)):]
+            bounds = np.unique(np.concatenate([forced, extra]))
+        if not np.isinf(bounds[-1]):
+            bounds = np.append(bounds, np.inf)
+    else:
+        bounds = _greedy_equal_count_bounds(
+            sorted_vals, counts, n_avail, min_data_in_bin, total_cnt=len(clean)
+        )
     mapper = BinMapper(
         upper_bounds=bounds,
         missing_type=MISSING_NAN if missing_type == MISSING_NAN else missing_type,
@@ -249,6 +269,7 @@ class DatasetBinner:
         categorical_features: Sequence[int] = (),
         max_bin_by_feature: Sequence[int] = (),
         seed: int = 1,
+        forced_bins: Optional[dict] = None,
     ) -> "DatasetBinner":
         data = np.asarray(data, dtype=np.float64)
         n, f = data.shape
@@ -259,6 +280,7 @@ class DatasetBinner:
         else:
             sample = data
         cats = set(int(c) for c in categorical_features)
+        forced_bins = forced_bins or {}
         mappers = []
         for j in range(f):
             mb = int(max_bin_by_feature[j]) if len(max_bin_by_feature) == f else max_bin
@@ -270,6 +292,7 @@ class DatasetBinner:
                     use_missing=use_missing,
                     zero_as_missing=zero_as_missing,
                     is_categorical=j in cats,
+                    forced_bounds=forced_bins.get(j, ()),
                 )
             )
         return cls(mappers=mappers)

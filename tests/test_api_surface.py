@@ -1,0 +1,111 @@
+"""Booster/Dataset API-surface parity (reference: basic.py methods
+trees_to_dataframe, lower/upper_bound, reset_parameter, shuffle_models,
+Dataset get_/set_ helpers)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(float)
+    d = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                    d, num_boost_round=4)
+    return X, y, d, bst
+
+
+def test_trees_to_dataframe(trained):
+    _, _, _, bst = trained
+    df = bst.trees_to_dataframe()
+    assert set(df["tree_index"]) == {0, 1, 2, 3}
+    assert {"node_index", "parent_index", "split_feature", "value", "count"} <= set(df.columns)
+    roots = df[df["parent_index"].isna()]
+    assert len(roots) == 4  # one root per tree
+    # leaves have no split_feature; internals have feature NAMES
+    internal = df[df["split_feature"].notna()]
+    assert internal["split_feature"].str.startswith("Column_").all()
+    # per-tree node count = 2*num_leaves-1
+    m = bst.dump_model()
+    for t in m["tree_info"]:
+        nodes = df[df["tree_index"] == t["tree_index"]]
+        assert len(nodes) == 2 * t["num_leaves"] - 1
+
+
+def test_bounds(trained):
+    X, _, _, bst = trained
+    lo, hi = bst.lower_bound(), bst.upper_bound()
+    assert lo < hi
+    raw = bst.predict(X, raw_score=True)
+    assert raw.min() >= lo - 1e-6
+    assert raw.max() <= hi + 1e-6
+
+
+def test_reset_parameter(trained):
+    _, _, _, bst = trained
+    bst.reset_parameter({"learning_rate": 0.25})
+    assert bst._gbdt.cfg.learning_rate == 0.25
+
+
+def test_shuffle_models_prediction_invariant(trained):
+    X, _, _, bst = trained
+    before = bst.predict(X, raw_score=True)
+    bst.shuffle_models()
+    after = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+def test_dataset_getters_setters():
+    rng = np.random.RandomState(1)
+    X = rng.randn(100, 3)
+    y = rng.rand(100)
+    d = lgb.Dataset(X, label=y, free_raw_data=False)
+    assert d.get_data() is X
+    np.testing.assert_array_equal(d.get_label(), y)
+    d.set_weight(np.ones(100))
+    assert d.get_weight().sum() == 100
+    d.set_position(np.arange(100))
+    assert d.get_position()[-1] == 99
+    d.set_feature_name(["a", "b", "c"])
+    d.construct()
+    assert d.get_feature_name() == ["a", "b", "c"]
+    assert d.feature_num_bin("a") > 1
+    with pytest.raises(lgb.LightGBMError):
+        d.set_feature_name(["x"])  # wrong length after construction
+
+
+def test_dataset_ref_chain_and_set_reference():
+    rng = np.random.RandomState(2)
+    X = rng.randn(200, 3)
+    d1 = lgb.Dataset(X, label=(X[:, 0] > 0).astype(float))
+    d2 = lgb.Dataset(X + 0.1, label=(X[:, 0] > 0).astype(float))
+    d2.set_reference(d1)
+    d2.construct()
+    assert d2.binner is d1.binner
+    chain = d2.get_ref_chain()
+    assert d1 in chain and d2 in chain
+
+
+def test_add_features_from():
+    rng = np.random.RandomState(3)
+    X1 = rng.randn(150, 2)
+    X2 = rng.randn(150, 3)
+    d1 = lgb.Dataset(X1, label=(X1[:, 0] > 0).astype(float), free_raw_data=False)
+    d2 = lgb.Dataset(X2, free_raw_data=False)
+    d1.construct()
+    d1.add_features_from(d2)
+    assert d1.num_feature() == 5
+    assert len(d1.get_feature_name()) == 5
+    # still trainable after concat
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, d1, num_boost_round=2)
+    assert bst.num_trees() == 2
+
+
+def test_set_train_data_name(trained):
+    _, _, _, bst = trained
+    bst.set_train_data_name("my_train")
+    assert bst._train_data_name == "my_train"
