@@ -1,0 +1,115 @@
+"""Second in-jit probe round: B-dependence, NC-dependence, iota hoisting,
+and a no-onehot control (dot against a constant matrix) to separate
+one-hot construction cost from MXU/dot-issue cost."""
+
+import functools
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+K = 20
+FLOOR_MS = 23.4
+
+
+def make_kernel(nc, B, *, row_tile=1024, F=28, hoist_iota=False, no_onehot=False,
+                matmul_dtype=jnp.bfloat16):
+    def kernel(bins_ref, pay_ref, out_ref, acc_ref):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        pay = pay_ref[...].astype(matmul_dtype)
+        T = pay.shape[0]
+        if hoist_iota:
+            iota_b = jax.lax.broadcasted_iota(jnp.int32, (T, B), 1)
+        for f in range(F):
+            if no_onehot:
+                # control: same dot shape, one-hot replaced by a cheap
+                # constant matrix derived from bins (defeats CSE via f)
+                oh = (bins_ref[:, f].astype(jnp.int32)[:, None] +
+                      jnp.zeros((T, B), jnp.int32)).astype(matmul_dtype)
+            else:
+                if not hoist_iota:
+                    iota_b = jax.lax.broadcasted_iota(jnp.int32, (T, B), 1)
+                binf = bins_ref[:, f].astype(jnp.int32)[:, None]
+                oh = (binf == iota_b).astype(matmul_dtype)
+            acc_ref[f] += jax.lax.dot_general(
+                pay, oh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(i == pl.num_programs(1) - 1)
+        def _():
+            out_ref[...] = acc_ref[...]
+
+    @jax.jit
+    def run(bins, pay):
+        n = bins.shape[0]
+        grid = (1, n // row_tile)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((row_tile, F), lambda j, i: (i, j), memory_space=pltpu.VMEM),
+                pl.BlockSpec((row_tile, nc), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((F, nc, B), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((F, nc, B), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((F, nc, B), jnp.float32)],
+            cost_estimate=pl.CostEstimate(
+                flops=2 * n * F * B * nc,
+                bytes_accessed=n * F * bins.dtype.itemsize + n * nc * 4,
+                transcendentals=0,
+            ),
+        )(bins, pay)
+
+    return run
+
+
+def main():
+    n, F = 999424, 28
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, 64, size=(n, F)).astype(np.int16))
+    pay48 = jnp.asarray(rng.randn(n, 48).astype(np.float32))
+
+    which = sys.argv[1].split(",") if len(sys.argv) > 1 else [
+        "b64", "hoist", "noonehot", "nc8",
+    ]
+    cases = {
+        "b256": ("direct48 B256", make_kernel(48, 256), pay48),
+        "b64": ("direct48 B64", make_kernel(48, 64), pay48),
+        "hoist": ("direct48 B256 hoisted-iota", make_kernel(48, 256, hoist_iota=True), pay48),
+        "noonehot": ("direct48 B256 no-onehot", make_kernel(48, 256, no_onehot=True), pay48),
+        "nc8": ("direct8 B256", make_kernel(8, 256), pay48[:, :8]),
+        "nc8b64": ("direct8 B64", make_kernel(8, 64), pay48[:, :8]),
+    }
+
+    for key in which:
+        name, fn, pay = cases[key]
+
+        @jax.jit
+        def loop(fn=fn, pay=pay):
+            def body(i, acc):
+                p = pay * (1.0 + i.astype(jnp.float32) * 1e-9)
+                return acc + fn(bins, p)[0, 0, 0]
+            return jax.lax.fori_loop(0, K, body, jnp.float32(0))
+
+        t0 = time.perf_counter()
+        out = loop(); np.asarray(out).ravel()[:1]
+        print(f"{name} compile+first: {time.perf_counter()-t0:.0f}s", flush=True)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = loop()
+        np.asarray(out).ravel()[:1]
+        total = (time.perf_counter() - t0) / 5 * 1e3
+        print(f"{name:32s} per-iter ~{(total - FLOOR_MS)/K:6.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

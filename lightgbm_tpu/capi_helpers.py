@@ -12,12 +12,33 @@ import ctypes
 
 import numpy as np
 
-from .basic import Booster
+from .basic import Booster, Dataset
 
 _PREDICT_NORMAL = 0
 _PREDICT_RAW_SCORE = 1
 _PREDICT_LEAF_INDEX = 2
 _PREDICT_CONTRIB = 3
+
+# reference: C_API_DTYPE_* in include/LightGBM/c_api.h
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+_CTYPES = {0: ctypes.c_float, 1: ctypes.c_double, 2: ctypes.c_int32, 3: ctypes.c_int64}
+
+
+def _parse_params(parameters: str) -> dict:
+    """reference: Config::Str2Map — 'k1=v1 k2=v2' (space/newline separated)."""
+    out = {}
+    for tok in parameters.replace("\n", " ").split():
+        if "=" not in tok:
+            continue
+        k, v = tok.split("=", 1)
+        for cast in (int, float):
+            try:
+                v = cast(v)
+                break
+            except ValueError:
+                continue
+        out[k] = v
+    return out
 
 
 def booster_from_file(filename: str) -> Booster:
@@ -44,6 +65,152 @@ def _wrap(addr: int, shape, dtype=np.float64) -> np.ndarray:
     ctype = ctypes.c_double if dtype == np.float64 else ctypes.c_float
     buf = (ctype * size).from_address(addr)
     return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
+# -- dataset surface (reference: LGBM_Dataset*) --------------------------
+
+def _wrap_typed(addr: int, shape, dtype_code: int) -> np.ndarray:
+    size = int(np.prod(shape))
+    buf = (_CTYPES[dtype_code] * size).from_address(addr)
+    return np.frombuffer(buf, dtype=_DTYPES[dtype_code]).reshape(shape)
+
+
+def dataset_from_mat(data_addr: int, dtype_code: int, nrow: int, ncol: int,
+                     is_row_major: int, parameters: str, reference) -> Dataset:
+    if is_row_major:
+        x = _wrap_typed(data_addr, (nrow, ncol), dtype_code)
+    else:
+        x = _wrap_typed(data_addr, (ncol, nrow), dtype_code).T
+    # copy: the Dataset outlives the caller's buffer (reference copies into
+    # its own bins during construction as well)
+    ds = Dataset(np.array(x, np.float64), params=_parse_params(parameters),
+                 reference=reference if isinstance(reference, Dataset) else None,
+                 free_raw_data=False)
+    return ds
+
+
+def dataset_from_file(filename: str, parameters: str, reference) -> Dataset:
+    from .io.parser import load_data_file
+
+    params = _parse_params(parameters)
+    loaded = load_data_file(
+        filename,
+        header=bool(params.get("header", False)),
+        label_column=str(params.get("label_column", "")),
+        weight_column=str(params.get("weight_column", "")),
+        group_column=str(params.get("group_column", "")),
+        ignore_column=str(params.get("ignore_column", "")),
+    )
+    ds = Dataset(loaded["data"], label=loaded.get("label"),
+                 weight=loaded.get("weight"), group=loaded.get("group"),
+                 params=params,
+                 reference=reference if isinstance(reference, Dataset) else None,
+                 free_raw_data=False)
+    return ds
+
+
+def dataset_set_field(ds: Dataset, field_name: str, data_addr: int,
+                      num_element: int, dtype_code: int) -> bool:
+    arr = np.array(_wrap_typed(data_addr, (num_element,), dtype_code))
+    ds.set_field(field_name, arr)
+    return True
+
+
+def dataset_get_num_data(ds: Dataset) -> int:
+    return int(ds.num_data())
+
+
+def dataset_get_num_feature(ds: Dataset) -> int:
+    return int(ds.num_feature())
+
+
+# -- booster training surface (reference: LGBM_Booster*) ------------------
+
+def booster_create(train_set: Dataset, parameters: str) -> Booster:
+    return Booster(params=_parse_params(parameters), train_set=train_set)
+
+
+def booster_add_valid(bst: Booster, valid_set: Dataset) -> bool:
+    name = f"valid_{len(getattr(bst._gbdt, 'valid_sets', []))}"
+    bst.add_valid(valid_set, name)
+    return True
+
+
+def booster_update(bst: Booster) -> int:
+    return 1 if bst.update() else 0
+
+
+def booster_update_custom(bst: Booster, grad_addr: int, hess_addr: int) -> int:
+    n = bst._train_set.num_data() * num_classes(bst)
+    grad = np.array(_wrap_typed(grad_addr, (n,), 0), np.float64)
+    hess = np.array(_wrap_typed(hess_addr, (n,), 0), np.float64)
+    return 1 if bst._gbdt.train_one_iter(grad, hess) else 0
+
+
+def booster_rollback(bst: Booster) -> bool:
+    bst.rollback_one_iter()
+    return True
+
+
+def booster_current_iteration(bst: Booster) -> int:
+    return int(bst.current_iteration())
+
+
+def booster_num_total_model(bst: Booster) -> int:
+    return int(bst.num_trees())
+
+
+def booster_num_feature(bst: Booster) -> int:
+    return int(bst.num_feature())
+
+
+def booster_reset_parameter(bst: Booster, parameters: str) -> bool:
+    bst.reset_parameter(_parse_params(parameters))
+    return True
+
+
+def booster_eval_counts(bst: Booster) -> int:
+    res = bst.eval_train()
+    return len(res)
+
+
+def booster_get_eval_into(bst: Booster, data_idx: int, out_addr: int) -> int:
+    """data_idx 0 = train, i>0 = i-th valid set (reference:
+    LGBM_BoosterGetEval)."""
+    res = bst.eval_train() if data_idx == 0 else bst.eval_valid()
+    if data_idx > 0:
+        # filter to the requested valid set (eval_valid returns all)
+        names = sorted({r[0] for r in res})
+        if data_idx - 1 < len(names):
+            want = names[data_idx - 1]
+            res = [r for r in res if r[0] == want]
+    vals = np.asarray([r[2] for r in res], np.float64)
+    dest = _wrap(out_addr, (len(vals),))
+    dest[:] = vals
+    return len(vals)
+
+
+def booster_save_string(bst: Booster, start_iteration: int,
+                        num_iteration: int) -> str:
+    return bst.model_to_string(num_iteration=num_iteration,
+                               start_iteration=start_iteration)
+
+
+def booster_dump_json(bst: Booster, start_iteration: int,
+                      num_iteration: int) -> str:
+    import json
+
+    return json.dumps(bst.dump_model(num_iteration=num_iteration,
+                                     start_iteration=start_iteration),
+                      default=float)
+
+
+def booster_feature_importance_into(bst: Booster, importance_type: int,
+                                    out_addr: int) -> int:
+    imp = bst.feature_importance("gain" if importance_type == 1 else "split")
+    dest = _wrap(out_addr, (len(imp),))
+    dest[:] = np.asarray(imp, np.float64)
+    return len(imp)
 
 
 def predict_into(bst: Booster, data_addr: int, nrow: int, ncol: int,

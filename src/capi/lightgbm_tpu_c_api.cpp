@@ -11,6 +11,7 @@
 
 #include <Python.h>
 
+#include <cstdarg>
 #include <cstdio>
 #include <cstring>
 #include <mutex>
@@ -88,6 +89,59 @@ int call_create(const char* kind, const char* arg, int* out_num_iterations,
   return 0;
 }
 
+// Call helpers.<method>(args...) and return the result (nullptr = error
+// already recorded).  fmt/args as for PyObject_CallMethod.
+PyObject* call_helper(const char* method, const char* fmt, ...) {
+  PyObject* mod = helpers();
+  if (mod == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject* callable = PyObject_GetAttrString(mod, method);
+  Py_DECREF(mod);
+  if (callable == nullptr) {
+    va_end(va);
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  if (args == nullptr) {
+    Py_DECREF(callable);
+    set_error_from_python();
+    return nullptr;
+  }
+  if (!PyTuple_Check(args)) {
+    PyObject* t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+  }
+  PyObject* r = PyObject_CallObject(callable, args);
+  Py_DECREF(callable);
+  Py_DECREF(args);
+  if (r == nullptr) set_error_from_python();
+  return r;
+}
+
+// Copy a Python str into a caller buffer with the reference's
+// size-then-fill contract.
+int str_to_buffer(PyObject* s, int64_t buffer_len, int64_t* out_len,
+                  char* out_str) {
+  Py_ssize_t n = 0;
+  const char* c = PyUnicode_AsUTF8AndSize(s, &n);
+  if (c == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out_len = static_cast<int64_t>(n) + 1;
+  if (out_str != nullptr && buffer_len >= n + 1) {
+    std::memcpy(out_str, c, static_cast<size_t>(n) + 1);
+  }
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -95,6 +149,239 @@ extern "C" {
 const char* LGBM_GetLastError(void) {
   std::lock_guard<std::mutex> lk(g_err_mutex);
   return g_last_error.c_str();
+}
+
+/* ---- Dataset surface ---- */
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  GilGuard gil;
+  PyObject* ref = reference != nullptr ? static_cast<PyObject*>(reference)
+                                       : Py_None;
+  PyObject* r = call_helper(
+      "dataset_from_mat", "(KiiiisO)",
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<int>(nrow), static_cast<int>(ncol), is_row_major,
+      parameters, ref);
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out) {
+  GilGuard gil;
+  PyObject* ref = reference != nullptr ? static_cast<PyObject*>(reference)
+                                       : Py_None;
+  PyObject* r = call_helper("dataset_from_file", "(ssO)", filename,
+                            parameters, ref);
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+int LGBM_DatasetFree(DatasetHandle handle) {
+  if (handle == nullptr) return 0;
+  GilGuard gil;
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "dataset_set_field", "(OsKii)", static_cast<PyObject*>(handle),
+      field_name, reinterpret_cast<unsigned long long>(field_data),
+      num_element, type);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_get_num_data", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_get_num_feature", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Booster training surface ---- */
+
+int LGBM_BoosterCreate(const DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_create", "(Os)",
+                            static_cast<PyObject*>(train_data), parameters);
+  if (r == nullptr) return -1;
+  *out = static_cast<BoosterHandle>(r);
+  return 0;
+}
+
+int LGBM_BoosterAddValidData(BoosterHandle handle,
+                             const DatasetHandle valid_data) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_add_valid", "(OO)",
+                            static_cast<PyObject*>(handle),
+                            static_cast<PyObject*>(valid_data));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_update", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  *is_finished = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int* is_finished) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "booster_update_custom", "(OKK)", static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(grad),
+      reinterpret_cast<unsigned long long>(hess));
+  if (r == nullptr) return -1;
+  *is_finished = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_rollback", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                    int* out_iteration) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_current_iteration", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  *out_iteration = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_num_total_model", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  *out_models = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_num_feature", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterResetParameter(BoosterHandle handle, const char* parameters) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_reset_parameter", "(Os)",
+                            static_cast<PyObject*>(handle), parameters);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_eval_counts", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "booster_get_eval_into", "(OiK)", static_cast<PyObject*>(handle),
+      data_idx, reinterpret_cast<unsigned long long>(out_results));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
+                                  int num_iteration,
+                                  int feature_importance_type,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str) {
+  (void)feature_importance_type;
+  GilGuard gil;
+  PyObject* r = call_helper("booster_save_string", "(Oii)",
+                            static_cast<PyObject*>(handle), start_iteration,
+                            num_iteration);
+  if (r == nullptr) return -1;
+  int rc = str_to_buffer(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          int64_t buffer_len, int64_t* out_len,
+                          char* out_str) {
+  (void)feature_importance_type;
+  GilGuard gil;
+  PyObject* r = call_helper("booster_dump_json", "(Oii)",
+                            static_cast<PyObject*>(handle), start_iteration,
+                            num_iteration);
+  if (r == nullptr) return -1;
+  int rc = str_to_buffer(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results) {
+  (void)num_iteration;
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "booster_feature_importance_into", "(OiK)",
+      static_cast<PyObject*>(handle), importance_type,
+      reinterpret_cast<unsigned long long>(out_results));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
 }
 
 int LGBM_BoosterCreateFromModelfile(const char* filename,

@@ -18,13 +18,112 @@ extern "C" {
 #endif
 
 typedef void* BoosterHandle;
+typedef void* DatasetHandle;
 
 #define C_API_PREDICT_NORMAL 0
 #define C_API_PREDICT_RAW_SCORE 1
 #define C_API_PREDICT_LEAF_INDEX 2
 #define C_API_PREDICT_CONTRIB 3
 
+/* reference: C_API_DTYPE_* */
+#define C_API_DTYPE_FLOAT32 0
+#define C_API_DTYPE_FLOAT64 1
+#define C_API_DTYPE_INT32 2
+#define C_API_DTYPE_INT64 3
+
+#define C_API_FEATURE_IMPORTANCE_SPLIT 0
+#define C_API_FEATURE_IMPORTANCE_GAIN 1
+
 const char* LGBM_GetLastError(void);
+
+/* ---- Dataset surface (reference: LGBM_Dataset*) ---- */
+
+/* data: (nrow x ncol) matrix of `data_type`; parameters: "k=v k=v";
+ * reference: bin-alignment dataset or NULL. */
+int LGBM_DatasetCreateFromMat(const void* data,
+                              int data_type,
+                              int32_t nrow,
+                              int32_t ncol,
+                              int is_row_major,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+
+int LGBM_DatasetCreateFromFile(const char* filename,
+                               const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
+
+int LGBM_DatasetFree(DatasetHandle handle);
+
+/* field_name: label/weight/group/init_score/position. */
+int LGBM_DatasetSetField(DatasetHandle handle,
+                         const char* field_name,
+                         const void* field_data,
+                         int num_element,
+                         int type);
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
+
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
+
+/* ---- Booster training surface (reference: LGBM_Booster*) ---- */
+
+int LGBM_BoosterCreate(const DatasetHandle train_data,
+                       const char* parameters,
+                       BoosterHandle* out);
+
+int LGBM_BoosterAddValidData(BoosterHandle handle, const DatasetHandle valid_data);
+
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+
+/* grad/hess: float32[num_data * num_class], caller-computed objective. */
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                    const float* grad,
+                                    const float* hess,
+                                    int* is_finished);
+
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
+
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models);
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
+
+int LGBM_BoosterResetParameter(BoosterHandle handle, const char* parameters);
+
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
+
+/* data_idx: 0 = train, i = i-th validation set. */
+int LGBM_BoosterGetEval(BoosterHandle handle,
+                        int data_idx,
+                        int* out_len,
+                        double* out_results);
+
+/* out_str: caller buffer of buffer_len bytes; *out_len receives the
+ * required size incl. NUL (call twice to size, like the reference). */
+int LGBM_BoosterSaveModelToString(BoosterHandle handle,
+                                  int start_iteration,
+                                  int num_iteration,
+                                  int feature_importance_type,
+                                  int64_t buffer_len,
+                                  int64_t* out_len,
+                                  char* out_str);
+
+int LGBM_BoosterDumpModel(BoosterHandle handle,
+                          int start_iteration,
+                          int num_iteration,
+                          int feature_importance_type,
+                          int64_t buffer_len,
+                          int64_t* out_len,
+                          char* out_str);
+
+/* out_results: double[num_feature]. */
+int LGBM_BoosterFeatureImportance(BoosterHandle handle,
+                                  int num_iteration,
+                                  int importance_type,
+                                  double* out_results);
 
 int LGBM_BoosterCreateFromModelfile(const char* filename,
                                     int* out_num_iterations,

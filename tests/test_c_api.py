@@ -87,3 +87,142 @@ def test_c_api_roundtrip(tmp_path):
     assert lib.LGBM_GetLastError()
 
     assert lib.LGBM_BoosterFree(handle) == 0
+
+
+def test_c_api_training_workflow():
+    """Full train-from-C workflow: dataset from mat + set label + booster
+    create + update + eval + save-to-string (reference: the c_api_test
+    pattern tests/c_api_test/test_.py)."""
+    rng = np.random.RandomState(1)
+    X = np.ascontiguousarray(rng.randn(400, 5))
+    y = np.ascontiguousarray(((X[:, 0] + X[:, 1]) > 0).astype(np.float32))
+
+    lib = ctypes.CDLL(_build())
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    ds = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),  # FLOAT64
+        ctypes.c_int32(400), ctypes.c_int32(5), ctypes.c_int(1),
+        b"max_bin=63 min_data_in_leaf=5", None, ctypes.byref(ds))
+    assert rc == 0, lib.LGBM_GetLastError()
+
+    rc = lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(400), ctypes.c_int(0))  # FLOAT32
+    assert rc == 0, lib.LGBM_GetLastError()
+
+    nd, nf = ctypes.c_int32(), ctypes.c_int32()
+    assert lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)) == 0
+    assert lib.LGBM_DatasetGetNumFeature(ds, ctypes.byref(nf)) == 0
+    assert (nd.value, nf.value) == (400, 5)
+
+    bst = ctypes.c_void_p()
+    rc = lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1 metric=binary_logloss",
+        ctypes.byref(bst))
+    assert rc == 0, lib.LGBM_GetLastError()
+
+    fin = ctypes.c_int()
+    for _ in range(5):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+    it = ctypes.c_int()
+    assert lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)) == 0
+    assert it.value == 5
+
+    assert lib.LGBM_BoosterRollbackOneIter(bst) == 0
+    assert lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)) == 0
+    assert it.value == 4
+
+    ntot = ctypes.c_int()
+    assert lib.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(ntot)) == 0
+    assert ntot.value == 4
+    nfeat = ctypes.c_int()
+    assert lib.LGBM_BoosterGetNumFeature(bst, ctypes.byref(nfeat)) == 0
+    assert nfeat.value == 5
+
+    # eval on the training set
+    cnt = ctypes.c_int()
+    assert lib.LGBM_BoosterGetEvalCounts(bst, ctypes.byref(cnt)) == 0
+    assert cnt.value >= 1
+    vals = np.zeros(cnt.value, np.float64)
+    out_len = ctypes.c_int()
+    rc = lib.LGBM_BoosterGetEval(
+        bst, 0, ctypes.byref(out_len),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert out_len.value == cnt.value
+    assert 0 < vals[0] < 1.0  # logloss of a learning model
+
+    # model to string: size call then fill call (reference contract)
+    need = ctypes.c_int64()
+    rc = lib.LGBM_BoosterSaveModelToString(
+        bst, 0, -1, 0, ctypes.c_int64(0), ctypes.byref(need), None)
+    assert rc == 0, lib.LGBM_GetLastError()
+    buf = ctypes.create_string_buffer(need.value)
+    rc = lib.LGBM_BoosterSaveModelToString(
+        bst, 0, -1, 0, need, ctypes.byref(need), buf)
+    assert rc == 0
+    model_str = buf.value.decode()
+    assert model_str.startswith("tree")
+    bst_py = lgb.Booster(model_str=model_str)
+    assert bst_py.num_trees() == 4
+
+    # feature importance
+    imp = np.zeros(5, np.float64)
+    rc = lib.LGBM_BoosterFeatureImportance(
+        bst, 0, 0, imp.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0
+    assert imp.sum() > 0
+
+    # reset parameter
+    assert lib.LGBM_BoosterResetParameter(bst, b"learning_rate=0.25") == 0
+
+    # custom objective update
+    n = 400
+    pred = bst_py.predict(X, raw_score=True)
+    p = 1.0 / (1.0 + np.exp(-pred))
+    grad = np.ascontiguousarray((p - y).astype(np.float32))
+    hess = np.ascontiguousarray((p * (1 - p)).astype(np.float32))
+    rc = lib.LGBM_BoosterUpdateOneIterCustom(
+        bst, grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        hess.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.byref(fin))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)) == 0
+    assert it.value == 5
+
+    assert lib.LGBM_BoosterFree(bst) == 0
+    assert lib.LGBM_DatasetFree(ds) == 0
+
+
+def test_c_api_dump_model_json():
+    rng = np.random.RandomState(2)
+    X = np.ascontiguousarray(rng.randn(200, 3))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    lib = ctypes.CDLL(_build())
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 200, 3, 1, b"",
+        None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 200, 0) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary verbosity=-1", ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    need = ctypes.c_int64()
+    assert lib.LGBM_BoosterDumpModel(
+        bst, 0, -1, 0, ctypes.c_int64(0), ctypes.byref(need), None) == 0
+    buf = ctypes.create_string_buffer(need.value)
+    assert lib.LGBM_BoosterDumpModel(
+        bst, 0, -1, 0, need, ctypes.byref(need), buf) == 0
+    import json
+
+    model = json.loads(buf.value.decode())
+    assert model["num_class"] == 1 and len(model["tree_info"]) == 1
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
