@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from .hist_pallas import histogram_pallas_multi, histogram_pallas_multi_quantized
-from .histogram import histogram
+from .histogram import histogram, histogram_onehot_multi
 from .split import (
     BestSplit, SplitParams, find_best_split, leaf_output, leaf_output_smoothed,
     KMIN_SCORE,
@@ -254,6 +254,14 @@ def grow_tree_fast(
                 jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
             )
             h = unbundle(hi).astype(jnp.float32) * quant_scale
+        elif use_pallas and num_bins <= 64:
+            # measured strategy selection (ops/histogram.py docstring): at
+            # narrow bins XLA's fused one-hot einsum beats the Pallas kernel
+            h = histogram_onehot_multi(
+                hist_bins, grad, hess, row_mask & (leaf_slot >= 0),
+                jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
+            )
+            h = unbundle(h)
         elif use_pallas:
             h = histogram_pallas_multi(
                 hist_bins, grad, hess, row_mask & (leaf_slot >= 0),
