@@ -105,6 +105,8 @@ class GBDT:
     # ------------------------------------------------------------------
     def reset_training_data(self, train_set) -> None:
         """reference: GBDT::ResetTrainingData."""
+        self._fused_step = None
+        self._nobag_cache = None
         if self.cfg.num_machines > 1:
             # multi-host bring-up (reference: Network::Init from machine
             # list).  MUST run before the first JAX computation — so before
@@ -310,6 +312,7 @@ class GBDT:
     def reset_split_params(self) -> None:
         """Refresh jit-static split hyperparams after a config mutation
         (reference: GBDT::ResetConfig via reset_parameter callbacks)."""
+        old = getattr(self, "_split_params", None)
         self._split_params = SplitParams(
             lambda_l1=self.cfg.lambda_l1,
             lambda_l2=self.cfg.lambda_l2,
@@ -328,6 +331,15 @@ class GBDT:
             cegb_tradeoff=self.cfg.cegb_tradeoff,
             cegb_penalty_split=self.cfg.cegb_penalty_split,
         )
+        # the fused step bakes SplitParams (and sigmoid) as traced constants —
+        # but learning_rate is a runtime argument, so the common
+        # reset_parameter(learning_rate=...) schedule must NOT retrace every
+        # iteration; invalidate only when a baked constant really changed
+        if self._fused_step is not None and (
+            old != self._split_params
+            or getattr(self, "_fused_sigmoid", None) != self.cfg.sigmoid
+        ):
+            self._fused_step = None
 
     def add_valid(self, valid_set, name: str) -> None:
         valid_set.construct(reference=self.train_set)
@@ -375,7 +387,11 @@ class GBDT:
             or cfg.neg_bagging_fraction < 1.0
         )
         if not use_bagging:
-            return jnp.ones((n,), dtype=bool), jnp.ones((n,), jnp.float32)
+            if self._nobag_cache is None or self._nobag_cache[0].shape[0] != n:
+                self._nobag_cache = (
+                    jnp.ones((n,), dtype=bool), jnp.ones((n,), jnp.float32)
+                )
+            return self._nobag_cache
         if self._last_mask is not None and (self.iter_ % cfg.bagging_freq) != 0:
             # re-bag only every bagging_freq iterations (reference: bagging.hpp)
             return self._last_mask
@@ -445,6 +461,73 @@ class GBDT:
         return max(1, min(8, budget // max(per_leaf, 1), self.cfg.num_leaves))
 
     _last_mask = None
+    _nobag_cache = None
+    _fused_step = None
+
+    def _fused_eligible(self, grad) -> bool:
+        """The common hot path — single-class fast grower with a built-in
+        objective and no per-iteration host work — can run gradients + tree
+        + score update in ONE jit dispatch (the axon tunnel costs ~1-1.5 ms
+        per dispatch, ~16 ms/iter across the unfused ~12 dispatches)."""
+        return (
+            grad is None
+            and self.num_tree_per_iteration == 1
+            and self._use_fast
+            and self._fp is None
+            and self._dp is None
+            and not self._linear
+            and self.objective is not None
+            and not self.objective.need_renew
+            and getattr(self.objective, "fusable", False)
+            and self._cegb_coupled is None
+            and not self._needs_node_rng
+            and not self.cfg.use_quantized_grad
+            # GOSS samples by the CURRENT iteration's |grad|, which the host
+            # needs before growing — cannot fuse
+            and self.cfg.data_sample_strategy != "goss"
+            and self.cfg.boosting != "goss"
+        )
+
+    def _get_fused_step(self):
+        if self._fused_step is not None:
+            return self._fused_step
+        self._fused_sigmoid = self.cfg.sigmoid  # baked into the trace below
+        ts = self.train_set
+        obj = self.objective
+        label, weight = self._label, self._weight
+        bins = ts.bins_device
+        nbpf, mbpf = ts.num_bins_pf_device, ts.missing_bin_pf_device
+        cat_mask, mono = self._categorical_mask, self._monotone
+        inter = self._interaction_sets
+        efb_tabs = ts.efb_device_tables() if getattr(ts, "efb", None) is not None else None
+        from ..ops.treegrow_fast import grow_tree_fast
+
+        grow_kwargs = dict(
+            num_leaves=self.cfg.num_leaves,
+            num_bins=ts.max_num_bins,
+            max_depth=self.cfg.max_depth,
+            params=self._split_params,
+            leaf_tile=self._leaf_tile(ts),
+            hist_precision=self.cfg.hist_precision,
+            use_pallas=self._on_tpu,
+        )
+
+        @jax.jit
+        def step(score, row_mask, sample_weight, feature_mask, shrinkage):
+            g, h = obj.get_gradients(score, label, weight)
+            arrays, leaf_id = grow_tree_fast(
+                bins, g, h, row_mask, sample_weight, feature_mask,
+                nbpf, mbpf, cat_mask, mono, inter, None, None, None,
+                efb_tabs[0] if efb_tabs else None,
+                efb_tabs[1] if efb_tabs else None,
+                efb_tabs[2] if efb_tabs else None,
+                **grow_kwargs,
+            )
+            row_delta = (arrays.leaf_value * shrinkage)[leaf_id]
+            return arrays, leaf_id, score + row_delta, g, h
+
+        self._fused_step = step
+        return step
 
     # ------------------------------------------------------------------
     def train_one_iter(self, grad: Optional[np.ndarray] = None, hess: Optional[np.ndarray] = None) -> bool:
@@ -452,6 +535,31 @@ class GBDT:
         True when training cannot continue (all trees constant)."""
         ts = self.train_set
         k = self.num_tree_per_iteration
+        if self._fused_eligible(grad):
+            row_mask, sample_weight = self._bagging_mask()
+            feature_mask = self._feature_mask()
+            shrinkage = 1.0 if self.average_output else self.cfg.learning_rate
+            step = self._get_fused_step()
+            arrays, leaf_id, self._score, g, h = step(
+                self._score, row_mask, sample_weight,
+                jnp.asarray(feature_mask), jnp.float32(shrinkage),
+            )
+            self._cur_grad, self._cur_hess = g, h
+            self._pending.append((arrays, shrinkage, None))
+            for vi, vs in enumerate(self.valid_sets):
+                from ..ops.treegrow_fast import predict_leaf_arrays
+
+                leaf_v = predict_leaf_arrays(
+                    arrays, vs.bins_device, ts.missing_bin_pf_device,
+                )
+                self._valid_scores[vi] = self._valid_scores[vi] + (
+                    arrays.leaf_value * jnp.float32(shrinkage)
+                )[leaf_v]
+            self.iter_ += 1
+            self._pred_cache = None
+            if (self.iter_ % 32) == 0:
+                return bool(arrays.num_leaves <= 1)
+            return False
         if grad is None:
             g, h = self.objective.get_gradients(self._score, self._label, self._weight)
         else:

@@ -40,6 +40,10 @@ class Objective:
     num_model_per_iteration = 1
     need_renew = False
     is_constant_hessian = False
+    # get_gradients is a pure jnp function of (score, label, weight) and may
+    # be traced inside the fused training step (models/gbdt.py); objectives
+    # with per-iteration host state must set this False
+    fusable = True
 
     def __init__(self, cfg: Config):
         self.cfg = cfg
@@ -337,6 +341,10 @@ class _RankingObjective(Objective):
     rank_objective.hpp — per-query parallel gradient computation).  Queries
     are laid out as a dense (Q, S) block padded to the longest query; masked
     lanes contribute zeros (SURVEY.md §10.3 item 3)."""
+
+    # per-iteration host state (position-bias Newton update, xendcg RNG
+    # iteration counter) — must not be baked into a traced step
+    fusable = False
 
     def set_query(self, query_boundaries: np.ndarray, labels: np.ndarray):
         self.query_boundaries = np.asarray(query_boundaries)

@@ -88,11 +88,6 @@ def histogram_onehot_matmul(
     return hist
 
 
-def _split_bf16x2(x: jnp.ndarray):
-    hi = x.astype(jnp.bfloat16).astype(jnp.float32)
-    return hi, x - hi
-
-
 def histogram_onehot_multi(
     bins: jnp.ndarray,  # (N, F) int
     grad: jnp.ndarray,
@@ -103,6 +98,7 @@ def histogram_onehot_multi(
     num_leaves_tile: int,
     num_bins: int,
     *,
+    precision: str = "f32",
     row_tile: int = 8192,
 ) -> jnp.ndarray:
     """Per-leaf histograms for a tile of leaves in ONE data pass, pure-XLA
@@ -115,14 +111,21 @@ def histogram_onehot_multi(
     (~4 ms vs ~8-10 ms per 1M x 28 pass); at 256 bins the Pallas kernel
     wins (~10 ms vs ~25 ms) — histogram strategy is selected per max_bin
     by the grower (the TrainingShareStates cost-model analogue)."""
+    from .hist_pallas import _split_bf16x2
+
     n, f = bins.shape
     m = mask.astype(jnp.float32)
     g = grad.astype(jnp.float32) * m
     h = hess.astype(jnp.float32) * m
-    g_hi, g_lo = _split_bf16x2(g)
-    h_hi, h_lo = _split_bf16x2(h)
-    base = jnp.stack([g_hi, h_hi, m, g_lo, h_lo, jnp.zeros_like(m)], axis=-1)
-    ncl = 6
+    if precision == "f32":
+        g_hi, g_lo = _split_bf16x2(g)
+        h_hi, h_lo = _split_bf16x2(h)
+        base = jnp.stack([g_hi, h_hi, m, g_lo, h_lo, jnp.zeros_like(m)], axis=-1)
+    elif precision == "bf16":
+        base = jnp.stack([g, h, m], axis=-1)
+    else:
+        raise ValueError(precision)
+    ncl = base.shape[-1]
     lid = leaf_id.astype(jnp.int32) - leaf_base
     onehot_l = (
         lid[:, None] == jnp.arange(num_leaves_tile, dtype=jnp.int32)[None, :]
@@ -150,10 +153,13 @@ def histogram_onehot_multi(
     init = jnp.zeros((f, num_bins, c), jnp.float32)
     hist, _ = jax.lax.scan(body, init, (bins_t, pay_t))
     hist = hist.reshape(f, num_bins, num_leaves_tile, ncl)
-    out3 = jnp.stack(
-        [hist[..., 0] + hist[..., 3], hist[..., 1] + hist[..., 4], hist[..., 2]],
-        axis=-1,
-    )  # (F, B, L_tile, 3)
+    if precision == "f32":
+        out3 = jnp.stack(
+            [hist[..., 0] + hist[..., 3], hist[..., 1] + hist[..., 4], hist[..., 2]],
+            axis=-1,
+        )  # (F, B, L_tile, 3)
+    else:
+        out3 = hist
     return jnp.moveaxis(out3, 2, 0)  # (L_tile, F, B, 3)
 
 

@@ -260,6 +260,7 @@ def grow_tree_fast(
             h = histogram_onehot_multi(
                 hist_bins, grad, hess, row_mask & (leaf_slot >= 0),
                 jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
+                precision=hist_precision,
             )
             h = unbundle(h)
         elif use_pallas:
