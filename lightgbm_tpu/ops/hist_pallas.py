@@ -8,14 +8,16 @@ histogram is a one-hot matmul on the MXU with a VMEM accumulator that lives
 across a sequential row-tile grid (SURVEY.md §10.1 strategy 2): per feature,
 onehot(bin) in {0,1}^(T,B) is contracted against a (T, NC) payload.
 
-Measured design notes (microbenchmarks on a v5e chip, N=1M F=28 B=256,
-see benchmarks/hist_bench.py):
+Measured design notes (in-jit fori_loop probes on a v5e chip, N=1M F=28;
+methodology + full numbers in docs/PERF_NOTES.md):
 
-* The kernel is VPU-bound on one-hot CONSTRUCTION (~6 ms/pass), not
-  MXU-bound: a hi/lo bin-decomposition variant that packs 4 features into
-  one 128x128 MXU tile (8x fewer MXU passes) measured 3x SLOWER because its
-  broadcast-select chains cost more VPU than they save MXU.  Hence the
-  direct formulation only.
+* A full-N pass costs ~8-10 ms and is INVARIANT to num_bins, payload
+  lanes, row tile and bins layout — the floor is the per-(tile, feature)
+  dot on this toolchain, NOT the one-hot build.  A hi/lo bin-decomposition
+  variant (8x fewer MXU passes) measured 3x SLOWER; a pure-XLA one-hot
+  einsum (ops/histogram.py::histogram_onehot_multi) beats this kernel at
+  num_bins <= 64 (~3 ms) and loses above it — the grower selects per
+  max_bin.
 * Payload lanes are nearly free up to the 128-lane MXU tile: the (NC, B)
   output occupies the same MXU tiles for NC in 4..128.  Near-f32 precision
   therefore costs the same as bf16: the payload is split hi+lo bfloat16
