@@ -482,11 +482,11 @@ class GBDT:
             and self._cegb_coupled is None
             and not self._needs_node_rng
             and not self.cfg.use_quantized_grad
-            # GOSS samples by the CURRENT iteration's |grad|, which the host
-            # needs before growing — cannot fuse
-            and self.cfg.data_sample_strategy != "goss"
-            and self.cfg.boosting != "goss"
         )
+
+    @property
+    def _is_goss(self) -> bool:
+        return self.cfg.data_sample_strategy == "goss" or self.cfg.boosting == "goss"
 
     def _get_fused_step(self):
         if self._fused_step is not None:
@@ -512,9 +512,33 @@ class GBDT:
             use_pallas=self._on_tpu,
         )
 
+        use_goss = self._is_goss
+        n_rows = ts.num_data()
+        top_rate, other_rate = self.cfg.top_rate, self.cfg.other_rate
+
         @jax.jit
-        def step(score, row_mask, sample_weight, feature_mask, shrinkage):
+        def step(score, row_mask, sample_weight, feature_mask, shrinkage,
+                 goss_key, goss_warm):
             g, h = obj.get_gradients(score, label, weight)
+            if use_goss:
+                # GOSS in-trace (reference: goss.hpp): the mask depends on
+                # THIS iteration's gradients, so it must live inside the
+                # fused step; goss_warm (traced bool) selects the full-data
+                # warm-up behavior without retracing
+                score_abs = jnp.abs(g * h)
+                top_k = max(int(n_rows * top_rate), 1)
+                other_k = max(int(n_rows * other_rate), 1)
+                thresh = jnp.sort(score_abs)[-top_k]
+                top_mask = score_abs >= thresh
+                u = jax.random.uniform(goss_key, (n_rows,))
+                rest_prob = other_k / jnp.maximum(n_rows - top_k, 1)
+                rest_mask = (~top_mask) & (u < rest_prob)
+                amp = (1.0 - top_rate) / other_rate
+                row_mask = jnp.where(goss_warm, row_mask, top_mask | rest_mask)
+                sample_weight = jnp.where(
+                    goss_warm, sample_weight,
+                    jnp.where(rest_mask, amp, 1.0).astype(jnp.float32),
+                )
             arrays, leaf_id = grow_tree_fast(
                 bins, g, h, row_mask, sample_weight, feature_mask,
                 nbpf, mbpf, cat_mask, mono, inter, None, None, None,
@@ -536,13 +560,28 @@ class GBDT:
         ts = self.train_set
         k = self.num_tree_per_iteration
         if self._fused_eligible(grad):
-            row_mask, sample_weight = self._bagging_mask()
+            if self._is_goss:
+                # masks computed in-trace; pass full-data placeholders
+                if self._nobag_cache is None or self._nobag_cache[0].shape[0] != ts.num_data():
+                    self._nobag_cache = (
+                        jnp.ones((ts.num_data(),), bool),
+                        jnp.ones((ts.num_data(),), jnp.float32),
+                    )
+                row_mask, sample_weight = self._nobag_cache
+                goss_key = jax.random.PRNGKey(self.cfg.bagging_seed + self.iter_)
+                warmup = int(1.0 / max(self.cfg.learning_rate, 1e-12))
+                goss_warm = jnp.asarray(self.iter_ < warmup)
+            else:
+                row_mask, sample_weight = self._bagging_mask()
+                goss_key = jax.random.PRNGKey(0)
+                goss_warm = jnp.asarray(False)
             feature_mask = self._feature_mask()
             shrinkage = 1.0 if self.average_output else self.cfg.learning_rate
             step = self._get_fused_step()
             arrays, leaf_id, self._score, g, h = step(
                 self._score, row_mask, sample_weight,
                 jnp.asarray(feature_mask), jnp.float32(shrinkage),
+                goss_key, goss_warm,
             )
             self._cur_grad, self._cur_hess = g, h
             self._pending.append((arrays, shrinkage, None))
