@@ -191,6 +191,7 @@ class Dataset:
         # int16 on device: half the HBM of int32 at Epsilon scale (max_bin
         # caps at 65535 by far); compute casts per tile
         self.bins_device = jnp.asarray(self.bins, jnp.int16)
+        self._bins_device_t = None
         self.num_bins_pf_device = jnp.asarray(self.binner.num_bins_per_feature)
         self.missing_bin_pf_device = jnp.asarray(self.binner.missing_bin_per_feature)
         self.max_num_bins = int(self.binner.max_num_bins)
@@ -251,6 +252,16 @@ class Dataset:
         if self.group is None:
             return None
         return np.concatenate([[0], np.cumsum(self.group)]).astype(np.int64)
+
+    def bins_device_t(self) -> jnp.ndarray:
+        """(F, N) feature-major shadow of bins_device — the fast grower's
+        partition reads become contiguous row slices (docs/PERF_NOTES.md).
+        Built lazily: only TPU training paths request it."""
+        if getattr(self, "_bins_device_t", None) is None:
+            self._bins_device_t = jnp.asarray(
+                np.ascontiguousarray(self.bins.T), jnp.int16
+            )
+        return self._bins_device_t
 
     def num_data(self) -> int:
         if self._constructed:
@@ -378,6 +389,7 @@ class Dataset:
         self.binner = DatasetBinner(mappers=list(self.binner.mappers) + list(other.binner.mappers))
         self.bins = np.concatenate([self.bins, other.bins], axis=1)
         self.bins_device = jnp.asarray(self.bins, jnp.int16)
+        self._bins_device_t = None
         self.num_bins_pf_device = jnp.asarray(self.binner.num_bins_per_feature)
         self.missing_bin_pf_device = jnp.asarray(self.binner.missing_bin_per_feature)
         self.max_num_bins = int(self.binner.max_num_bins)
@@ -406,6 +418,7 @@ class Dataset:
         sub.__dict__.update({k: v for k, v in self.__dict__.items()})
         sub.bins = self.bins[idx]
         sub.bins_device = jnp.asarray(sub.bins, jnp.int16)
+        sub._bins_device_t = None
         if getattr(self, "efb", None) is not None:
             sub.efb = self.efb._replace(bundled_bins=None)  # re-encoded lazily
             sub._efb_device = None

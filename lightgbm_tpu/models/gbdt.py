@@ -500,6 +500,7 @@ class GBDT:
         cat_mask, mono = self._categorical_mask, self._monotone
         inter = self._interaction_sets
         efb_tabs = ts.efb_device_tables() if getattr(ts, "efb", None) is not None else None
+        bins_t = ts.bins_device_t() if self._on_tpu else None
         from ..ops.treegrow_fast import grow_tree_fast
 
         grow_kwargs = dict(
@@ -545,6 +546,7 @@ class GBDT:
                 efb_tabs[0] if efb_tabs else None,
                 efb_tabs[1] if efb_tabs else None,
                 efb_tabs[2] if efb_tabs else None,
+                bins_t,
                 **grow_kwargs,
             )
             row_delta = (arrays.leaf_value * shrinkage)[leaf_id]
@@ -720,6 +722,7 @@ class GBDT:
                     efb_tabs[0] if efb_tabs else None,
                     efb_tabs[1] if efb_tabs else None,
                     efb_tabs[2] if efb_tabs else None,
+                    ts.bins_device_t() if self._on_tpu else None,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,

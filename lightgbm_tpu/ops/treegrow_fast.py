@@ -160,6 +160,9 @@ def grow_tree_fast(
     efb_bins: jnp.ndarray = None,  # (N, F_b) bundled bin matrix (io/efb.py)
     efb_gather: jnp.ndarray = None,  # (F, B) int32 into flat (F_b*B)+zero-pad
     efb_default: jnp.ndarray = None,  # (F, B) bool default slots
+    bins_t: jnp.ndarray = None,  # (F, N) feature-major copy: partition's
+    # per-feature column reads become contiguous row slices (measured:
+    # 8 dynamic column slices of (N, F) cost ~1.1 ms/round on v5e)
     *,
     num_leaves: int,
     num_bins: int,
@@ -391,9 +394,14 @@ def grow_tree_fast(
             leaf_r = inv_rank[r]
             live = accept[leaf_r]  # rank r admitted?
             feat_r = s.feature[leaf_r]
-            fcol = jax.lax.dynamic_index_in_dim(
-                bins, feat_r, axis=1, keepdims=False
-            ).astype(jnp.int32)
+            if bins_t is not None:
+                fcol = jax.lax.dynamic_index_in_dim(
+                    bins_t, feat_r, axis=0, keepdims=False
+                ).astype(jnp.int32)
+            else:
+                fcol = jax.lax.dynamic_index_in_dim(
+                    bins, feat_r, axis=1, keepdims=False
+                ).astype(jnp.int32)
             miss_r = fcol == missing_bin_per_feature[feat_r]
             gl = jnp.where(miss_r, s.default_left[leaf_r], fcol <= s.threshold_bin[leaf_r])
             if categorical_mask is not None:
