@@ -471,7 +471,8 @@ class GBDT:
         per dispatch, ~16 ms/iter across the unfused ~12 dispatches)."""
         return (
             grad is None
-            and self.num_tree_per_iteration == 1
+            # each class tree inlines into the trace: cap the blowup
+            and self.num_tree_per_iteration <= 8
             and self._use_fast
             and self._fp is None
             and self._dp is None
@@ -516,6 +517,7 @@ class GBDT:
         use_goss = self._is_goss
         n_rows = ts.num_data()
         top_rate, other_rate = self.cfg.top_rate, self.cfg.other_rate
+        k = self.num_tree_per_iteration
 
         @jax.jit
         def step(score, row_mask, sample_weight, feature_mask, shrinkage,
@@ -527,6 +529,8 @@ class GBDT:
                 # fused step; goss_warm (traced bool) selects the full-data
                 # warm-up behavior without retracing
                 score_abs = jnp.abs(g * h)
+                if score_abs.ndim > 1:
+                    score_abs = jnp.sum(score_abs, axis=1)
                 top_k = max(int(n_rows * top_rate), 1)
                 other_k = max(int(n_rows * other_rate), 1)
                 thresh = jnp.sort(score_abs)[-top_k]
@@ -540,17 +544,28 @@ class GBDT:
                     goss_warm, sample_weight,
                     jnp.where(rest_mask, amp, 1.0).astype(jnp.float32),
                 )
-            arrays, leaf_id = grow_tree_fast(
-                bins, g, h, row_mask, sample_weight, feature_mask,
-                nbpf, mbpf, cat_mask, mono, inter, None, None, None,
-                efb_tabs[0] if efb_tabs else None,
-                efb_tabs[1] if efb_tabs else None,
-                efb_tabs[2] if efb_tabs else None,
-                bins_t,
-                **grow_kwargs,
-            )
-            row_delta = (arrays.leaf_value * shrinkage)[leaf_id]
-            return arrays, leaf_id, score + row_delta, g, h
+            arrays_all, leaf_all = [], []
+            new_score = score
+            for c in range(k):  # k static: multiclass trees inline in-trace
+                gc = g if k == 1 else g[:, c]
+                hc = h if k == 1 else h[:, c]
+                arrays, leaf_id = grow_tree_fast(
+                    bins, gc, hc, row_mask, sample_weight, feature_mask,
+                    nbpf, mbpf, cat_mask, mono, inter, None, None, None,
+                    efb_tabs[0] if efb_tabs else None,
+                    efb_tabs[1] if efb_tabs else None,
+                    efb_tabs[2] if efb_tabs else None,
+                    bins_t,
+                    **grow_kwargs,
+                )
+                row_delta = (arrays.leaf_value * shrinkage)[leaf_id]
+                if k == 1:
+                    new_score = new_score + row_delta
+                else:
+                    new_score = new_score.at[:, c].add(row_delta)
+                arrays_all.append(arrays)
+                leaf_all.append(leaf_id)
+            return tuple(arrays_all), tuple(leaf_all), new_score, g, h
 
         self._fused_step = step
         return step
@@ -580,26 +595,29 @@ class GBDT:
             feature_mask = self._feature_mask()
             shrinkage = 1.0 if self.average_output else self.cfg.learning_rate
             step = self._get_fused_step()
-            arrays, leaf_id, self._score, g, h = step(
+            arrays_all, leaf_all, self._score, g, h = step(
                 self._score, row_mask, sample_weight,
                 jnp.asarray(feature_mask), jnp.float32(shrinkage),
                 goss_key, goss_warm,
             )
             self._cur_grad, self._cur_hess = g, h
-            self._pending.append((arrays, shrinkage, None))
-            for vi, vs in enumerate(self.valid_sets):
-                from ..ops.treegrow_fast import predict_leaf_arrays
+            for c, arrays in enumerate(arrays_all):
+                self._pending.append((arrays, shrinkage, None))
+                for vi, vs in enumerate(self.valid_sets):
+                    from ..ops.treegrow_fast import predict_leaf_arrays
 
-                leaf_v = predict_leaf_arrays(
-                    arrays, vs.bins_device, ts.missing_bin_pf_device,
-                )
-                self._valid_scores[vi] = self._valid_scores[vi] + (
-                    arrays.leaf_value * jnp.float32(shrinkage)
-                )[leaf_v]
+                    leaf_v = predict_leaf_arrays(
+                        arrays, vs.bins_device, ts.missing_bin_pf_device,
+                    )
+                    vals = (arrays.leaf_value * jnp.float32(shrinkage))[leaf_v]
+                    if k == 1:
+                        self._valid_scores[vi] = self._valid_scores[vi] + vals
+                    else:
+                        self._valid_scores[vi] = self._valid_scores[vi].at[:, c].add(vals)
             self.iter_ += 1
             self._pred_cache = None
             if (self.iter_ % 32) == 0:
-                return bool(arrays.num_leaves <= 1)
+                return all(bool(a.num_leaves <= 1) for a in arrays_all)
             return False
         if grad is None:
             g, h = self.objective.get_gradients(self._score, self._label, self._weight)

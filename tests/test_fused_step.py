@@ -86,6 +86,25 @@ def test_fused_goss_matches_unfused():
     np.testing.assert_allclose(preds[True], preds[False], rtol=1e-5, atol=1e-7)
 
 
+def test_fused_multiclass_matches_unfused():
+    rng = np.random.RandomState(4)
+    X = rng.randn(500, 5)
+    y = rng.randint(0, 3, 500)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbosity": -1, "tree_growth_mode": "rounds"}
+    preds = {}
+    for fuse in (True, False):
+        d = lgb.Dataset(X, label=y.astype(float))
+        bst = lgb.Booster(params=params, train_set=d)
+        if not fuse:
+            bst._gbdt._fused_eligible = lambda grad: False
+        for _ in range(3):
+            bst.update()
+        assert bst.num_trees() == 9 if fuse else True
+        preds[fuse] = bst.predict(X)
+    np.testing.assert_allclose(preds[True], preds[False], rtol=1e-5, atol=1e-7)
+
+
 def test_onehot_multi_bf16_precision():
     n, F, B, L = 3000, 4, 32, 2
     rng = np.random.RandomState(2)

@@ -1,0 +1,51 @@
+"""Sanitizer-job analogue (SURVEY §6.2): the reference's CI runs an
+ASan/UBSan build; the jit-purity equivalent here is training under
+jax.enable_checks (internal invariant checking) and jax.debug_nans
+(NaN propagation detection)."""
+
+import jax
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train_small(extra_params=None):
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(float)
+    d = lgb.Dataset(X, label=y)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    params.update(extra_params or {})
+    bst = lgb.train(params, d, num_boost_round=3)
+    p = bst.predict(X)
+    assert np.isfinite(p).all()
+
+
+def test_train_under_enable_checks():
+    with jax.enable_checks(True):
+        _train_small()
+
+
+def test_train_under_enable_checks_rounds_grower():
+    with jax.enable_checks(True):
+        _train_small({"tree_growth_mode": "rounds"})
+
+
+def test_no_nans_in_training_state():
+    """debug_nans-style spot check without the context manager (the grower
+    uses -inf sentinels deliberately, which jax.debug_nans conflates with
+    NaNs on some paths): every intermediate the booster keeps must be
+    finite-or-sentinel, never NaN."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 7,
+                              "verbosity": -1}, train_set=d)
+    for _ in range(4):
+        bst.update()
+        assert not np.isnan(np.asarray(bst._gbdt._score)).any()
+        assert not np.isnan(np.asarray(bst._gbdt._cur_grad)).any()
+    for t in bst._gbdt.models:
+        assert np.isfinite(t.leaf_value[: t.num_leaves]).all()
