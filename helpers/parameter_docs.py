@@ -1,0 +1,59 @@
+"""Generate docs/Parameters.md from the config table (reference analogue:
+helpers/parameter_generator.py regenerating config_auto.cpp from
+docs/Parameters.rst — here the Python dataclass IS the single source of
+truth and the doc is generated FROM it, with an idempotency test keeping
+them in sync: tests/test_parameter_docs.py)."""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import fields, MISSING
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from lightgbm_tpu.config import _ALIASES, Config  # noqa: E402
+
+
+def generate() -> str:
+    alias_of = {}
+    for alias, canon in _ALIASES.items():
+        alias_of.setdefault(canon, []).append(alias)
+
+    lines = [
+        "# Parameters",
+        "",
+        "Generated from `lightgbm_tpu/config.py` by `helpers/parameter_docs.py`",
+        "(the config dataclass is the single source of truth — reference",
+        "analogue: docs/Parameters.rst <-> config_auto.cpp).",
+        "Do not edit by hand; run `python helpers/parameter_docs.py` to",
+        "regenerate.",
+        "",
+        "| parameter | default | type | aliases |",
+        "|---|---|---|---|",
+    ]
+    for f in fields(Config):
+        if f.default is not MISSING:
+            default = f.default
+        elif f.default_factory is not MISSING:  # type: ignore[misc]
+            default = f.default_factory()  # type: ignore[misc]
+        else:
+            default = ""
+        tname = getattr(f.type, "__name__", None) or str(f.type)
+        aliases = ", ".join(sorted(alias_of.get(f.name, [])))
+        default_s = repr(default) if default != "" or isinstance(default, str) else ""
+        lines.append(f"| `{f.name}` | `{default_s}` | {tname} | {aliases} |")
+    lines.append("")
+    lines.append(f"Total: {len(fields(Config))} parameters, {len(_ALIASES)} aliases.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    out = Path(__file__).resolve().parents[1] / "docs" / "Parameters.md"
+    out.write_text(generate())
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""Parameters.md <-> config table sync (reference analogue: the CI check
+that parameter_generator.py output is committed and current)."""
+
+from pathlib import Path
+
+from helpers.parameter_docs import generate
+
+
+def test_parameters_doc_is_current():
+    committed = Path(__file__).resolve().parents[1] / "docs" / "Parameters.md"
+    assert committed.read_text() == generate(), (
+        "docs/Parameters.md is stale; run python helpers/parameter_docs.py"
+    )
