@@ -251,6 +251,11 @@ class Config:
     # reference's float and double hist modes); "bf16" = single bf16
     # payloads (~8-bit mantissa, cheapest)
     hist_precision: str = "f32"
+    # fuse gradients + tree growth + score update into one jit dispatch
+    # (models/gbdt.py _fused_eligible).  Disable for very wide/deep shapes
+    # where the combined trace compiles slowly (e.g. Epsilon-scale
+    # num_leaves=255 x 2000 features)
+    fused_training: bool = True
 
     # --- learning control ---
     force_col_wise: bool = False

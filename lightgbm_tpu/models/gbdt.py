@@ -471,8 +471,14 @@ class GBDT:
         per dispatch, ~16 ms/iter across the unfused ~12 dispatches)."""
         return (
             grad is None
+            and self.cfg.fused_training
             # each class tree inlines into the trace: cap the blowup
             and self.num_tree_per_iteration <= 8
+            # very wide/deep shapes compile the combined trace pathologically
+            # (observed: 255 leaves x 2000 features never finished); the
+            # unfused path costs only ~16 ms/iter extra dispatch overhead,
+            # noise at shapes this slow per-iteration anyway
+            and self.cfg.num_leaves * self.train_set.num_feature() <= 100_000
             and self._use_fast
             and self._fp is None
             and self._dp is None
