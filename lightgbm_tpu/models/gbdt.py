@@ -449,6 +449,13 @@ class GBDT:
         return jnp.asarray(mask) & self._allowed_features
 
     def _leaf_tile(self, ts, use_efb: bool = True) -> int:
+        if ts.max_num_bins <= 64 and self._on_tpu:
+            # XLA einsum strategy (ops/histogram.py) — no Mosaic VMEM
+            # ceiling.  Measured: 8 is best at 31 leaves (pass cost grows
+            # with lanes); deep trees amortize per-round fixed costs, so
+            # go wider once rounds are leaf-count-bound.
+            tile = 16 if self.cfg.num_leaves > 63 else 8
+            return max(1, min(tile, self.cfg.num_leaves))
         f_eff = (
             ts.efb.num_bundled
             if use_efb and getattr(ts, "efb", None) is not None
