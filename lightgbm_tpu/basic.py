@@ -122,6 +122,7 @@ class Dataset:
         categorical_feature: Union[str, List[int]] = "auto",
         params: Optional[Dict[str, Any]] = None,
         free_raw_data: bool = True,
+        position=None,
     ):
         self.data = data
         self.label = None if label is None else np.asarray(label, dtype=np.float64).ravel()
@@ -137,7 +138,8 @@ class Dataset:
         self.binner: Optional[DatasetBinner] = None
         self.bins: Optional[np.ndarray] = None
         self.feature_names: List[str] = []
-        self.position = None  # rank position info (reference: Metadata positions_)
+        # rank position info (reference: Metadata positions_; Dataset(position=...))
+        self.position = None if position is None else np.asarray(position, np.int64).ravel()
         self._used_indices = None
 
     # -- construction ---------------------------------------------------

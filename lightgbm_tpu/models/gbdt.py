@@ -492,7 +492,7 @@ class GBDT:
             and not self._linear
             and self.objective is not None
             and not self.objective.need_renew
-            and getattr(self.objective, "fusable", False)
+            and self.objective.is_fusable()
             and self._cegb_coupled is None
             and not self._needs_node_rng
             and not self.cfg.use_quantized_grad

@@ -42,8 +42,12 @@ class Objective:
     is_constant_hessian = False
     # get_gradients is a pure jnp function of (score, label, weight) and may
     # be traced inside the fused training step (models/gbdt.py); objectives
-    # with per-iteration host state must set this False
+    # with per-iteration host state must set this False (or override
+    # is_fusable for instance-dependent purity)
     fusable = True
+
+    def is_fusable(self) -> bool:
+        return self.fusable
 
     def __init__(self, cfg: Config):
         self.cfg = cfg
@@ -501,6 +505,11 @@ class LambdarankNDCG(_RankingObjective):
         self.pos_reg = float(getattr(self.cfg, "lambdarank_position_bias_regularization", 0.0))
 
     _pos_pad = None
+
+    def is_fusable(self) -> bool:
+        # pure unless position-bias correction is on (its Newton refit
+        # mutates self.pos_bias every call)
+        return self._pos_pad is None
 
     def get_gradients(self, score, label, weight):
         idx, msk = self._pad_idx, self._pad_mask

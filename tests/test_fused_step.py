@@ -21,12 +21,41 @@ def _fit(params, n=400, rounds=3, rank=False):
     return bst
 
 
-def test_ranking_objectives_not_fused():
-    for obj in ("lambdarank", "rank_xendcg"):
-        bst = _fit({"objective": obj, "tree_growth_mode": "rounds"}, rank=True)
-        g = bst._gbdt
-        assert not g._fused_eligible(None), obj
-        assert bst.num_trees() == 3
+def test_stateful_ranking_objectives_not_fused():
+    # rank_xendcg draws fresh RNG per iteration -> never fusable
+    bst = _fit({"objective": "rank_xendcg", "tree_growth_mode": "rounds"}, rank=True)
+    assert not bst._gbdt._fused_eligible(None)
+    assert bst.num_trees() == 3
+    # lambdarank WITH position bias mutates pos_bias per call -> not fusable
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 4)
+    y = rng.randint(0, 3, 400).astype(float)
+    d = lgb.Dataset(X, label=y, group=np.full(20, 20),
+                    position=np.tile(np.arange(20), 20))
+    bst = lgb.train({"objective": "lambdarank", "verbosity": -1,
+                     "lambdarank_position_bias_regularization": 0.1,
+                     "tree_growth_mode": "rounds"}, d, num_boost_round=2)
+    assert not bst._gbdt._fused_eligible(None)
+
+
+def test_plain_lambdarank_fuses_and_matches():
+    rng = np.random.RandomState(5)
+    X = rng.randn(400, 4)
+    y = rng.randint(0, 3, 400).astype(float)
+    params = {"objective": "lambdarank", "verbosity": -1,
+              "num_leaves": 7, "tree_growth_mode": "rounds"}
+    preds = {}
+    for fuse in (True, False):
+        d = lgb.Dataset(X, label=y, group=np.full(20, 20))
+        bst = lgb.Booster(params=params, train_set=d)
+        if fuse:
+            assert bst._gbdt._fused_eligible(None)
+        else:
+            bst._gbdt._fused_eligible = lambda grad: False
+        for _ in range(3):
+            bst.update()
+        preds[fuse] = bst.predict(X)
+    np.testing.assert_allclose(preds[True], preds[False], rtol=1e-5, atol=1e-7)
 
 
 def test_reset_parameter_schedule_does_not_invalidate_fused_cache():
