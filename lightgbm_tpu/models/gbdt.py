@@ -1006,7 +1006,7 @@ class GBDT:
                 out[i, : len(a)] = a
             return jnp.asarray(out)
 
-        return dict(
+        out = dict(
             split_feature=pad(lambda t: t.split_feature, np.int32, m),
             threshold=pad(lambda t: _f32_threshold_upper(t.threshold), np.float32, m),
             default_left=pad(lambda t: t.default_left(), bool, m),
@@ -1020,6 +1020,32 @@ class GBDT:
             k=k,
             T=T,
         )
+        if any(t.num_cat > 0 for t in trees):
+            # flat bitset words + per-node (offset, word-count) so the device
+            # traversal can do Tree::CategoricalDecision with two gathers
+            is_cat_np = np.zeros((T, m), bool)
+            base_np = np.zeros((T, m), np.int32)
+            nw_np = np.zeros((T, m), np.int32)
+            words = []
+            off = 0
+            for i, t in enumerate(trees):
+                icm = np.asarray(t.is_categorical_node(), bool)
+                is_cat_np[i, : len(icm)] = icm
+                for ndx in np.nonzero(icm)[0]:
+                    ci = int(t.threshold[ndx])
+                    lo = int(t.cat_boundaries[ci])
+                    hi = int(t.cat_boundaries[ci + 1])
+                    base_np[i, ndx] = off + lo
+                    nw_np[i, ndx] = hi - lo
+                w = np.asarray(t.cat_threshold, np.uint32)
+                words.append(w)
+                off += len(w)
+            out["is_cat"] = jnp.asarray(is_cat_np)
+            out["cat_base"] = jnp.asarray(base_np)
+            out["cat_nwords"] = jnp.asarray(nw_np)
+            out["cat_words"] = jnp.asarray(
+                np.concatenate(words) if off else np.zeros(1, np.uint32))
+        return out
 
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0, num_iteration: int = -1) -> np.ndarray:
         """Raw margin prediction on raw feature values (device traversal).
@@ -1036,9 +1062,9 @@ class GBDT:
             init = np.asarray(self.init_scores, dtype=np.float64)
             base = np.zeros((n, k), dtype=np.float64) + init[None, :]
             return base[:, 0] if k == 1 else base
-        if any(t.num_cat > 0 or t.is_linear for t in trees):
-            # categorical bitset decisions and linear leaves: vectorized host
-            # walk (the device traversal handles constant numerical nodes)
+        if any(t.is_linear for t in trees):
+            # linear leaves evaluate per-leaf ridge models on raw features:
+            # vectorized host walk
             Xh = np.asarray(X, dtype=np.float64)
             n_per_class = max(len(trees) // k, 1)
             scale = (1.0 / n_per_class) if self.average_output else 1.0
@@ -1046,6 +1072,11 @@ class GBDT:
             for i, t in enumerate(trees):
                 outs[:, i % k] += t.predict_batch(Xh) * scale
             return outs[:, 0] if k == 1 else outs
+        # categorical bitset decisions ride the device traversal too
+        # (Tree::CategoricalDecision as two gathers over flat bitset words)
+        cat_kw = {}
+        if "is_cat" in s:
+            cat_kw = dict(cat_words=s["cat_words"])
         x = jnp.asarray(np.asarray(X, dtype=np.float32))
         n_per_class = max(s["T"] // k, 1)
         scale = (1.0 / n_per_class) if self.average_output else 1.0
@@ -1054,6 +1085,8 @@ class GBDT:
                 x, s["split_feature"], s["threshold"], s["default_left"],
                 s["missing_type"], s["left_child"], s["right_child"],
                 s["num_leaves"], s["leaf_value"],
+                is_cat=s.get("is_cat"), cat_base=s.get("cat_base"),
+                cat_nwords=s.get("cat_nwords"), **cat_kw,
             )
             return np.asarray(out, dtype=np.float64) * scale
         # multiclass: per-class sum over its trees
@@ -1064,6 +1097,10 @@ class GBDT:
                 x, s["split_feature"][sel], s["threshold"][sel], s["default_left"][sel],
                 s["missing_type"][sel], s["left_child"][sel], s["right_child"][sel],
                 s["num_leaves"][sel], s["leaf_value"][sel],
+                is_cat=(s["is_cat"][sel] if "is_cat" in s else None),
+                cat_base=(s["cat_base"][sel] if "is_cat" in s else None),
+                cat_nwords=(s["cat_nwords"][sel] if "is_cat" in s else None),
+                **cat_kw,
             )
             outs[:, c] += np.asarray(out) * scale
         return outs

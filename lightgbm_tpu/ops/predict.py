@@ -31,6 +31,10 @@ def _traverse_one_tree(
     left_child: jnp.ndarray,  # (M,) i32 (negative = ~leaf)
     right_child: jnp.ndarray,  # (M,) i32
     num_leaves: jnp.ndarray,  # i32 scalar
+    is_cat: jnp.ndarray = None,  # (M,) bool — categorical nodes
+    cat_base: jnp.ndarray = None,  # (M,) i32 word offset into cat_words
+    cat_nwords: jnp.ndarray = None,  # (M,) i32
+    cat_words: jnp.ndarray = None,  # (W,) uint32 flat bitsets
 ) -> jnp.ndarray:
     """Returns leaf index per row.
 
@@ -39,6 +43,8 @@ def _traverse_one_tree(
       NaN:  NaN -> default direction; else value <= threshold
       Zero: NaN or |value| <= kZeroThreshold -> default; else compare
       None: NaN treated as 0.0, then compare
+    Categorical nodes (reference: Tree::CategoricalDecision): value in the
+    node's bitset -> left; NaN/negative/out-of-range -> right.
     """
     n = feature_vals.shape[0]
     k_zero = jnp.float32(1e-35)
@@ -59,6 +65,14 @@ def _traverse_one_tree(
         )
         v_eff = jnp.where(miss, 0.0, v)  # mt 0/1 non-default path: NaN -> 0.0
         go_left = jnp.where(use_default, default_left[nd], v_eff <= threshold[nd])
+        if is_cat is not None:
+            iv = v_eff.astype(jnp.int32)  # C-cast truncation like the reference
+            w = iv >> 5
+            in_range = (~miss) & (iv >= 0) & (w < cat_nwords[nd])
+            widx = jnp.clip(cat_base[nd] + w, 0, cat_words.shape[0] - 1)
+            word = cat_words[widx]
+            bit = (word >> (iv & 31).astype(jnp.uint32)) & jnp.uint32(1)
+            go_left = jnp.where(is_cat[nd], in_range & (bit == 1), go_left)
         nxt = jnp.where(go_left, left_child[nd], right_child[nd])
         at_internal = node >= 0
         new_node = jnp.where(at_internal, nxt, node)
@@ -108,18 +122,34 @@ def predict_raw_values(
     right_child: jnp.ndarray,
     num_leaves: jnp.ndarray,
     leaf_value: jnp.ndarray,  # (T, L)
+    is_cat: jnp.ndarray = None,  # (T, M) bool
+    cat_base: jnp.ndarray = None,  # (T, M) i32 into cat_words
+    cat_nwords: jnp.ndarray = None,  # (T, M) i32
+    cat_words: jnp.ndarray = None,  # (W,) uint32
 ) -> jnp.ndarray:
     """Raw ensemble margin per row: sum over trees of leaf values (N,)."""
     x = x.astype(jnp.float32)
     miss = jnp.isnan(x)
     vals = jnp.where(miss, 0.0, x)
 
-    def one(sf, th, dl, mt, lc, rc, nl, lv):
-        leaf = _traverse_one_tree(vals, miss, sf, th.astype(jnp.float32), dl, mt, lc, rc, nl)
-        return lv[leaf]
+    if is_cat is None:
+        def one(sf, th, dl, mt, lc, rc, nl, lv):
+            leaf = _traverse_one_tree(vals, miss, sf, th.astype(jnp.float32), dl, mt, lc, rc, nl)
+            return lv[leaf]
 
-    per_tree = jax.vmap(one)(
-        split_feature, threshold, default_left, missing_type, left_child, right_child,
-        num_leaves, leaf_value,
-    )  # (T, N)
+        per_tree = jax.vmap(one)(
+            split_feature, threshold, default_left, missing_type, left_child,
+            right_child, num_leaves, leaf_value,
+        )  # (T, N)
+    else:
+        def one_cat(sf, th, dl, mt, lc, rc, nl, lv, ic, cb, cw):
+            leaf = _traverse_one_tree(
+                vals, miss, sf, th.astype(jnp.float32), dl, mt, lc, rc, nl,
+                is_cat=ic, cat_base=cb, cat_nwords=cw, cat_words=cat_words)
+            return lv[leaf]
+
+        per_tree = jax.vmap(one_cat)(
+            split_feature, threshold, default_left, missing_type, left_child,
+            right_child, num_leaves, leaf_value, is_cat, cat_base, cat_nwords,
+        )
     return jnp.sum(per_tree, axis=0)
