@@ -107,6 +107,7 @@ class GBDT:
         """reference: GBDT::ResetTrainingData."""
         self._fused_step = None
         self._nobag_cache = None
+        self._forced_cache = None
         if self.cfg.num_machines > 1:
             # multi-host bring-up (reference: Network::Init from machine
             # list).  MUST run before the first JAX computation — so before
@@ -230,10 +231,11 @@ class GBDT:
             self._cegb_coupled = None
             self._cegb_used_global = None
         from ..utils.log import log_warning
-        if self.cfg.forcedsplits_filename:
+        if self.cfg.forcedsplits_filename and self._use_fast:
             log_warning(
-                "forcedsplits_filename is not implemented yet; the file is "
-                "IGNORED and splits are chosen by gain."
+                "forcedsplits_filename is honored by the strict grower only; "
+                "the rounds grower (tree_growth_mode=rounds, the TPU default) "
+                "IGNORES it — set tree_growth_mode=strict to force splits."
             )
         if any(p != 0 for p in (self.cfg.cegb_penalty_feature_lazy or [])):
             log_warning(
@@ -501,6 +503,51 @@ class GBDT:
     @property
     def _is_goss(self) -> bool:
         return self.cfg.data_sample_strategy == "goss" or self.cfg.boosting == "goss"
+
+    _forced_cache = None
+
+    def _forced_schedule(self):
+        """Parse forcedsplits_filename into a (leaf, feature, bin) schedule
+        for the strict grower (reference: SerialTreeLearner::ForceSplits —
+        the JSON tree prefix is applied BFS before gain-driven growth;
+        thresholds map to bins through the train binner)."""
+        if not self.cfg.forcedsplits_filename:
+            return None
+        if self._forced_cache is not None:
+            return self._forced_cache
+        import json as _json
+        from collections import deque
+
+        with open(self.cfg.forcedsplits_filename) as fh:
+            root = _json.load(fh)
+        leaves, feats, bins_ = [], [], []
+        # BFS with the grower's leaf numbering: left child keeps the parent's
+        # leaf id; the right child of the s-th split gets leaf id s+1
+        queue = deque([(root, 0)])
+        step = 0
+        while queue:
+            node, leaf = queue.popleft()
+            fidx = int(node["feature"])
+            thr = float(node["threshold"])
+            mapper = self.binner.mappers[fidx]
+            # bin containing the threshold: value <= upper_bound semantics
+            b = int(mapper.transform(np.asarray([thr]))[0])
+            leaves.append(leaf)
+            feats.append(fidx)
+            bins_.append(b)
+            right_leaf = step + 1
+            if "left" in node and node["left"]:
+                queue.append((node["left"], leaf))
+            if "right" in node and node["right"]:
+                queue.append((node["right"], right_leaf))
+            step += 1
+        self._forced_cache = (
+            jnp.asarray(leaves, jnp.int32),
+            jnp.asarray(feats, jnp.int32),
+            jnp.asarray(bins_, jnp.int32),
+            len(leaves),
+        )
+        return self._forced_cache
 
     def _get_fused_step(self):
         if self._fused_step is not None:
@@ -774,6 +821,7 @@ class GBDT:
                     track_path=self._linear,
                 )
             else:
+                fs = self._forced_schedule()
                 arrays, leaf_id = grow_tree(
                     ts.bins_device,
                     gc,
@@ -788,12 +836,16 @@ class GBDT:
                     self._interaction_sets,
                     node_rng,
                     cegb_pen,
+                    fs[0] if fs else None,
+                    fs[1] if fs else None,
+                    fs[2] if fs else None,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
                     params=self._split_params,
                     hist_strategy="auto",
                     track_path=self._linear,
+                    n_forced=(fs[3] if fs else 0),
                 )
             linear_fit = None
             if self._linear and arrays.path_features is not None:

@@ -118,6 +118,7 @@ def _set_best(best: BestSplit, i: jnp.ndarray, s: BestSplit) -> BestSplit:
         "parallel_mode",
         "top_k",
         "track_path",
+        "n_forced",
     ),
 )
 def grow_tree(
@@ -134,6 +135,9 @@ def grow_tree(
     interaction_sets: jnp.ndarray = None,  # (S, F) bool — allowed feature sets
     rng_key: jnp.ndarray = None,  # base PRNG key (extra_trees / bynode)
     cegb_feature_penalty: jnp.ndarray = None,  # (F,) pre-scaled coupled penalties
+    forced_leaf: jnp.ndarray = None,  # (K,) i32 — forced-split schedule
+    forced_feature: jnp.ndarray = None,  # (K,) i32   (reference: ForceSplits
+    forced_bin: jnp.ndarray = None,  # (K,) i32        from forcedsplits JSON)
     *,
     num_leaves: int,
     num_bins: int,
@@ -144,6 +148,7 @@ def grow_tree(
     parallel_mode: str = "data",  # with axis_name: data | feature | voting
     top_k: int = 20,  # voting mode: per-shard feature votes (reference: top_k)
     track_path: bool = False,  # maintain per-leaf path features (linear trees)
+    n_forced: int = 0,
 ) -> tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -346,9 +351,45 @@ def grow_tree(
         tree=tree0,
     )
 
-    def do_split(state: GrowState) -> GrowState:
+    def _forced_candidate(state: GrowState, i):
+        """Materialize the i-th forced split (reference: ForceSplits —
+        SerialTreeLearner applies the JSON tree prefix through the standard
+        split evaluation, so constraints like min_data still gate it).
+        Returns (leaf, BestSplit, valid)."""
+        fi = jnp.minimum(i, n_forced - 1)
+        fl = jnp.clip(forced_leaf[fi], 0, L - 1)
+        ff = forced_feature[fi]
+        fb = forced_bin[fi]
+        plane, ctx = gain_plane(
+            state.hist[fl], state.leaf_sum_g[fl], state.leaf_sum_h[fl],
+            state.leaf_count[fl], num_bins_per_feature, missing_bin_per_feature,
+            params,
+            feature_mask=None, categorical_mask=categorical_mask,
+            monotone_constraints=monotone_constraints,
+            out_lo=state.leaf_out_lo[fl], out_hi=state.leaf_out_hi[fl],
+            rng_key=None, depth=state.leaf_depth[fl].astype(jnp.float32),
+            parent_output=state.leaf_out[fl], cegb_feature_penalty=None,
+        )
+        cell = (
+            (jnp.arange(f, dtype=jnp.int32)[:, None] == ff)
+            & (jnp.arange(num_bins, dtype=jnp.int32)[None, :] == fb)
+        )
+        s_f = select_from_plane(jnp.where(cell, plane, KMIN_SCORE), ctx)
+        # valid = the forced leaf exists and the cell is a legal split
+        valid = (forced_leaf[fi] < state.num_leaves_cur) & (s_f.gain > KMIN_SCORE / 2)
+        if max_depth > 0:
+            valid = valid & (state.leaf_depth[fl] < max_depth)
+        return fl, s_f, valid
+
+    def do_split(state: GrowState, forced=None) -> GrowState:
         best_leaf = jnp.argmax(state.best.gain).astype(jnp.int32)
         s = jax.tree.map(lambda a: a[best_leaf], state.best)
+        if forced is not None:
+            use_forced, f_leaf, s_f = forced
+            best_leaf = jnp.where(use_forced, f_leaf, best_leaf)
+            s = jax.tree.map(
+                lambda a, b: jnp.where(use_forced, a, b), s_f, s
+            )
         node = state.num_leaves_cur - 1  # next internal node slot
         new_leaf = state.num_leaves_cur  # right child's leaf index
 
@@ -518,8 +559,18 @@ def grow_tree(
             tree=tree,
         )
 
-    def body(_t, state: GrowState) -> GrowState:
+    def body(i, state: GrowState) -> GrowState:
         can_split = jnp.max(state.best.gain) > KMIN_SCORE / 2
+        if n_forced > 0:
+            f_leaf, s_f, f_valid = _forced_candidate(state, i)
+            use_forced = (i < n_forced) & f_valid
+            can_split = can_split | use_forced
+            return jax.lax.cond(
+                can_split,
+                lambda st: do_split(st, forced=(use_forced, f_leaf, s_f)),
+                lambda st: st,
+                state,
+            )
         return jax.lax.cond(can_split, do_split, lambda st: st, state)
 
     state = jax.lax.fori_loop(0, L - 1, body, state)
