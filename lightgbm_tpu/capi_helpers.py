@@ -109,28 +109,80 @@ def dataset_from_file(filename: str, parameters: str, reference) -> Dataset:
     return ds
 
 
-def dataset_set_field(ds: Dataset, field_name: str, data_addr: int,
+def dataset_set_field(ds, field_name: str, data_addr: int,
                       num_element: int, dtype_code: int) -> bool:
     arr = np.array(_wrap_typed(data_addr, (num_element,), dtype_code))
-    ds.set_field(field_name, arr)
+    ds.set_field(field_name, arr)  # Dataset and StreamingDataset both accept
     return True
 
 
-def dataset_get_num_data(ds: Dataset) -> int:
-    return int(ds.num_data())
+def dataset_get_num_data(ds) -> int:
+    return int(_as_dataset(ds).num_data())
 
 
-def dataset_get_num_feature(ds: Dataset) -> int:
-    return int(ds.num_feature())
+def dataset_get_num_feature(ds) -> int:
+    return int(_as_dataset(ds).num_feature())
+
+
+class StreamingDataset:
+    """Push-rows accumulator (reference: LGBM_DatasetCreateByReference +
+    LGBM_DatasetPushRows streaming construction).  Rows stream into a
+    preallocated buffer; the real Dataset materializes bin-aligned to the
+    reference once all rows have arrived."""
+
+    def __init__(self, reference: Dataset, num_total_row: int):
+        reference.construct()
+        self.reference = reference
+        self.num_total = int(num_total_row)
+        self.ncol = reference.num_feature()
+        self.buf = np.full((self.num_total, self.ncol), np.nan, np.float64)
+        self.fields = {}
+        self.pushed = 0
+        self._ds = None
+
+    def push(self, rows: np.ndarray, start_row: int) -> None:
+        n = rows.shape[0]
+        self.buf[start_row: start_row + n] = rows
+        self.pushed += n
+
+    def set_field(self, name, arr):
+        self.fields[name] = arr
+
+    def dataset(self) -> Dataset:
+        if self._ds is None:
+            if self.pushed < self.num_total:
+                raise ValueError(
+                    f"only {self.pushed}/{self.num_total} rows pushed")
+            self._ds = Dataset(self.buf, reference=self.reference,
+                              free_raw_data=False)
+            for k, v in self.fields.items():
+                self._ds.set_field(k, v)
+        return self._ds
+
+
+def _as_dataset(ds) -> Dataset:
+    return ds.dataset() if isinstance(ds, StreamingDataset) else ds
+
+
+def dataset_create_by_reference(reference: Dataset, num_total_row: int) -> StreamingDataset:
+    return StreamingDataset(_as_dataset(reference), num_total_row)
+
+
+def dataset_push_rows(ds: StreamingDataset, data_addr: int, dtype_code: int,
+                      nrow: int, ncol: int, start_row: int) -> bool:
+    rows = np.array(_wrap_typed(data_addr, (nrow, ncol), dtype_code), np.float64)
+    ds.push(rows, start_row)
+    return True
 
 
 # -- booster training surface (reference: LGBM_Booster*) ------------------
 
-def booster_create(train_set: Dataset, parameters: str) -> Booster:
-    return Booster(params=_parse_params(parameters), train_set=train_set)
+def booster_create(train_set, parameters: str) -> Booster:
+    return Booster(params=_parse_params(parameters), train_set=_as_dataset(train_set))
 
 
-def booster_add_valid(bst: Booster, valid_set: Dataset) -> bool:
+def booster_add_valid(bst: Booster, valid_set) -> bool:
+    valid_set = _as_dataset(valid_set)
     name = f"valid_{len(getattr(bst._gbdt, 'valid_sets', []))}"
     bst.add_valid(valid_set, name)
     return True

@@ -184,6 +184,31 @@ int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
   return 0;
 }
 
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_create_by_reference", "(OL)",
+                            static_cast<PyObject*>(reference),
+                            static_cast<long long>(num_total_row));
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+int LGBM_DatasetPushRows(DatasetHandle handle, const void* data, int data_type,
+                         int32_t nrow, int32_t ncol, int32_t start_row) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "dataset_push_rows", "(OKiiii)", static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<int>(nrow), static_cast<int>(ncol),
+      static_cast<int>(start_row));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 int LGBM_DatasetFree(DatasetHandle handle) {
   if (handle == nullptr) return 0;
   GilGuard gil;

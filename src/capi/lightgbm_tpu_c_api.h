@@ -54,6 +54,19 @@ int LGBM_DatasetCreateFromFile(const char* filename,
                                const DatasetHandle reference,
                                DatasetHandle* out);
 
+/* Streaming construction: preallocate by reference, push row blocks
+ * (reference: LGBM_DatasetCreateByReference / LGBM_DatasetPushRows). */
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out);
+
+int LGBM_DatasetPushRows(DatasetHandle handle,
+                         const void* data,
+                         int data_type,
+                         int32_t nrow,
+                         int32_t ncol,
+                         int32_t start_row);
+
 int LGBM_DatasetFree(DatasetHandle handle);
 
 /* field_name: label/weight/group/init_score/position. */

@@ -196,6 +196,67 @@ def test_c_api_training_workflow():
     assert lib.LGBM_DatasetFree(ds) == 0
 
 
+def test_c_api_push_rows_streaming():
+    """Streamed construction == bulk construction (reference:
+    tests/cpp_tests/test_stream.cpp pattern)."""
+    rng = np.random.RandomState(3)
+    X = np.ascontiguousarray(rng.randn(300, 4))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    lib = ctypes.CDLL(_build())
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    ref = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 300, 4, 1, b"max_bin=31",
+        None, ctypes.byref(ref)) == 0
+
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateByReference(
+        ref, ctypes.c_int64(300), ctypes.byref(ds)) == 0, lib.LGBM_GetLastError()
+    # push in 3 blocks of 100
+    for s in (0, 100, 200):
+        blk = np.ascontiguousarray(X[s:s + 100])
+        assert lib.LGBM_DatasetPushRows(
+            ds, blk.ctypes.data_as(ctypes.c_void_p), 1, 100, 4,
+            ctypes.c_int32(s)) == 0, lib.LGBM_GetLastError()
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 300, 0) == 0
+
+    nd = ctypes.c_int32()
+    assert lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)) == 0
+    assert nd.value == 300
+
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary verbosity=-1 num_leaves=7",
+        ctypes.byref(bst)) == 0, lib.LGBM_GetLastError()
+    fin = ctypes.c_int()
+    for _ in range(3):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    # model equals training on the bulk dataset with the same params
+    need = ctypes.c_int64()
+    assert lib.LGBM_BoosterSaveModelToString(
+        bst, 0, -1, 0, ctypes.c_int64(0), ctypes.byref(need), None) == 0
+    buf = ctypes.create_string_buffer(need.value)
+    assert lib.LGBM_BoosterSaveModelToString(
+        bst, 0, -1, 0, need, ctypes.byref(need), buf) == 0
+    streamed = buf.value.decode()
+
+    d_ref = lgb.Dataset(X, label=y.astype(np.float64), params={"max_bin": 31})
+    bst_py = lgb.train({"objective": "binary", "verbosity": -1,
+                        "num_leaves": 7, "max_bin": 31},
+                       lgb.Dataset(X, label=y.astype(np.float64),
+                                   reference=d_ref, params={"max_bin": 31}),
+                       num_boost_round=3)
+    np.testing.assert_allclose(
+        lgb.Booster(model_str=streamed).predict(X), bst_py.predict(X),
+        rtol=1e-6, atol=1e-8)
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
+    lib.LGBM_DatasetFree(ref)
+
+
 def test_c_api_dump_model_json():
     rng = np.random.RandomState(2)
     X = np.ascontiguousarray(rng.randn(200, 3))
