@@ -1,0 +1,74 @@
+"""Throughput smoke for the non-binary baseline workloads (BASELINE.md):
+LambdaRank (MSLR-like) and multiclass (Airline-like).  Prints iters/sec
+for each on the current backend."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def bench_rank(n, q_len, iters):
+    import jax
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    nq = n // q_len
+    n = nq * q_len
+    X = rng.randn(n, 64).astype(np.float32)
+    w = rng.randn(64) / 8
+    rel = X @ w + 0.7 * rng.randn(n)
+    # 0-4 relevance labels per query by rank within query
+    y = np.zeros(n)
+    for qi in range(nq):
+        s = slice(qi * q_len, (qi + 1) * q_len)
+        order = np.argsort(np.argsort(-rel[s]))
+        y[s] = np.clip(4 - order // (q_len // 5 + 1), 0, 4)
+    d = lgb.Dataset(X, label=y, group=np.full(nq, q_len))
+    bst = lgb.Booster(params={"objective": "lambdarank", "num_leaves": 31,
+                              "max_bin": 63, "verbosity": -1}, train_set=d)
+    bst.update()
+    jax.block_until_ready(bst._gbdt._score)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        bst.update()
+    jax.block_until_ready(bst._gbdt._score)
+    return iters / (time.perf_counter() - t0)
+
+
+def bench_multiclass(n, k, iters):
+    import jax
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(n, 28).astype(np.float32)
+    centers = rng.randn(k, 28)
+    y = np.argmax(X @ centers.T + rng.randn(n, k), axis=1).astype(np.float64)
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params={"objective": "multiclass", "num_class": k,
+                              "num_leaves": 31, "max_bin": 63,
+                              "verbosity": -1}, train_set=d)
+    bst.update()
+    jax.block_until_ready(bst._gbdt._score)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        bst.update()
+    jax.block_until_ready(bst._gbdt._score)
+    return iters / (time.perf_counter() - t0)
+
+
+def main():
+    n = int(os.environ.get("SMOKE_ROWS", 1_000_000))
+    iters = int(os.environ.get("SMOKE_ITERS", 10))
+    which = sys.argv[1].split(",") if len(sys.argv) > 1 else ["rank", "multiclass"]
+    if "rank" in which:
+        ips = bench_rank(n, q_len=128, iters=iters)
+        print(f"lambdarank {n//1000}k rows x64f q128 63bins: {ips:.2f} iters/sec", flush=True)
+    if "multiclass" in which:
+        ips = bench_multiclass(n, k=5, iters=iters)
+        print(f"multiclass5 {n//1000}k rows x28f 63bins: {ips:.2f} iters/sec (5 trees/iter)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
