@@ -1,0 +1,308 @@
+"""Round-3 probe: histogram formulations for the max_bin=255 regime.
+
+Goal (VERDICT r2 #1): a full-N pass at B=256, N=1M, F=28, C=48 in <= ~5 ms
+(today: Pallas ~10.4 ms, XLA bf16 one-hot einsum ~25 ms).
+
+Working theory from the round-2 invariances (time ~ N*F, invariant to B,
+lanes, row tile): the per-(tile, feature) dot is bound by operand staging
+(~128 lanes charged regardless of C), so packing K features into ONE dot
+should cut the cost ~K-fold.  Variants:
+
+  pallas_fpack{K}   - K features per dot: flat bins (bin*K + f_local),
+                      pltpu.repeat to (T, K*B), one compare, one dot.
+  pallas_base       - shipped kernel (baseline).
+  xla_flatdot       - one_hot (T,F,B) reshaped to (T, F*B), ONE dot per tile.
+  xla_hilo          - 4 x masked B=64 einsums (hi 2 bits mask the payload
+                      per-feature via onehot_lo * mask_hi product).
+  xla_fbatch        - batched dot_general over F with broadcast payload.
+  xla_base          - shipped histogram_onehot_multi-style einsum (baseline).
+
+Each variant is correctness-checked against numpy bincount at full N before
+timing (layout bugs are the norm here).  Timing = in-jit fori_loop K=20
+minus the ~23.4 ms dispatch floor (docs/PERF_NOTES.md methodology).
+"""
+
+import functools
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+K_LOOP = 20
+FLOOR_MS = 23.4
+N, F, B, NC = 999424, 28, 256, 48
+
+
+# ---------------------------------------------------------------- pallas fpack
+def make_fpack(kpack, *, row_tile=1024, dtype=jnp.bfloat16):
+    G = F // kpack
+    assert F % kpack == 0
+
+    def kernel(flat_ref, pay_ref, out_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        pay = pay_ref[...].astype(dtype)  # (T, NC)
+        T = pay.shape[0]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (T, kpack * B), 1)
+        flat = flat_ref[...].astype(jnp.int32)  # (T, F), values bin*kpack+f_local
+        for g in range(G):
+            fb = flat[:, g * kpack:(g + 1) * kpack]  # (T, kpack)
+            rep = pltpu.repeat(fb, B, axis=1)  # (T, kpack*B): rep[t,c]=fb[t,c%kpack]
+            oh = (rep == iota).astype(dtype)
+            acc_ref[g] += jax.lax.dot_general(
+                pay, oh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (NC, kpack*B)
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _():
+            out_ref[...] = acc_ref[...]
+
+    @jax.jit
+    def run(bins, pay):
+        n = bins.shape[0]
+        flat = (bins.astype(jnp.int32) * kpack
+                + (jnp.arange(F, dtype=jnp.int32) % kpack)[None, :]).astype(jnp.int16)
+        out = pl.pallas_call(
+            kernel,
+            grid=(n // row_tile,),
+            in_specs=[
+                pl.BlockSpec((row_tile, F), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((row_tile, NC), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((G, NC, kpack * B), lambda i: (0, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((G, NC, kpack * B), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((G, NC, kpack * B), jnp.float32)],
+            cost_estimate=pl.CostEstimate(
+                flops=2 * n * F * B * NC,
+                bytes_accessed=n * F * 2 + n * NC * 4,
+                transcendentals=0,
+            ),
+        )(flat, pay)
+        # (G, NC, kpack*B) -> (F, B, NC): column c = b*kpack + f_local
+        out = out.reshape(G, NC, B, kpack)
+        return jnp.transpose(out, (0, 3, 2, 1)).reshape(F, B, NC)
+
+    return run
+
+
+# ---------------------------------------------------------------- pallas base
+def make_pallas_base(*, row_tile=1024, dtype=jnp.bfloat16):
+    def kernel(bins_ref, pay_ref, out_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        pay = pay_ref[...].astype(dtype)
+        T = pay.shape[0]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (T, B), 1)
+        bins_i32 = bins_ref[...].astype(jnp.int32)
+        for f in range(F):
+            oh = (bins_i32[:, f][:, None] == iota).astype(dtype)
+            acc_ref[f] += jax.lax.dot_general(
+                pay, oh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _():
+            out_ref[...] = acc_ref[...]
+
+    @jax.jit
+    def run(bins, pay):
+        n = bins.shape[0]
+        out = pl.pallas_call(
+            kernel,
+            grid=(n // row_tile,),
+            in_specs=[
+                pl.BlockSpec((row_tile, F), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((row_tile, NC), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((F, NC, B), lambda i: (0, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((F, NC, B), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((F, NC, B), jnp.float32)],
+        )(bins, pay)
+        return jnp.transpose(out, (0, 2, 1))  # (F, B, NC)
+
+    return run
+
+
+# ------------------------------------------------------------------ xla forms
+def make_xla_base(*, row_tile=8192):
+    @jax.jit
+    def run(bins, pay):
+        n = bins.shape[0]
+        nt = n // row_tile
+        bins_t = bins.reshape(nt, row_tile, F)
+        pay_t = pay.astype(jnp.bfloat16).reshape(nt, row_tile, NC)
+
+        def body(acc, inp):
+            b_tile, p_tile = inp
+            onehot = jax.nn.one_hot(b_tile.T, B, dtype=jnp.bfloat16)  # (F, T, B)
+            hh = jnp.einsum("ftb,tc->fbc", onehot, p_tile,
+                            preferred_element_type=jnp.float32)
+            return acc + hh, None
+
+        init = jnp.zeros((F, B, NC), jnp.float32)
+        hist, _ = jax.lax.scan(body, init, (bins_t, pay_t))
+        return hist
+
+    return run
+
+
+def make_xla_flatdot(*, row_tile=1024):
+    @jax.jit
+    def run(bins, pay):
+        n = bins.shape[0]
+        nt = n // row_tile
+        bins_t = bins.reshape(nt, row_tile, F)
+        pay_t = pay.astype(jnp.bfloat16).reshape(nt, row_tile, NC)
+
+        def body(acc, inp):
+            b_tile, p_tile = inp
+            oh = jax.nn.one_hot(b_tile, B, dtype=jnp.bfloat16)  # (T, F, B)
+            oh = oh.reshape(row_tile, F * B)
+            hh = jax.lax.dot_general(
+                oh, p_tile, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (F*B, NC)
+            return acc + hh, None
+
+        init = jnp.zeros((F * B, NC), jnp.float32)
+        hist, _ = jax.lax.scan(body, init, (bins_t, pay_t))
+        return hist.reshape(F, B, NC)
+
+    return run
+
+
+def make_xla_hilo(*, row_tile=8192):
+    BLO = 64
+
+    @jax.jit
+    def run(bins, pay):
+        n = bins.shape[0]
+        nt = n // row_tile
+        bins_t = bins.reshape(nt, row_tile, F)
+        pay_t = pay.astype(jnp.bfloat16).reshape(nt, row_tile, NC)
+
+        def body(acc, inp):
+            b_tile, p_tile = inp
+            lo = (b_tile & (BLO - 1))
+            hi = (b_tile >> 6)  # (T, F) in 0..3
+            oh_lo = jax.nn.one_hot(lo.T, BLO, dtype=jnp.bfloat16)  # (F, T, 64)
+            outs = []
+            for v in range(B // BLO):
+                mask = (hi.T == v).astype(jnp.bfloat16)  # (F, T)
+                oh = oh_lo * mask[:, :, None]
+                outs.append(jnp.einsum("ftb,tc->fbc", oh, p_tile,
+                                       preferred_element_type=jnp.float32))
+            hh = jnp.concatenate(outs, axis=1)  # (F, 256, NC)
+            return acc + hh, None
+
+        init = jnp.zeros((F, B, NC), jnp.float32)
+        hist, _ = jax.lax.scan(body, init, (bins_t, pay_t))
+        return hist
+
+    return run
+
+
+def make_xla_fbatch(*, row_tile=2048):
+    @jax.jit
+    def run(bins, pay):
+        n = bins.shape[0]
+        nt = n // row_tile
+        bins_t = bins.reshape(nt, row_tile, F)
+        pay_t = pay.astype(jnp.bfloat16).reshape(nt, row_tile, NC)
+
+        def body(acc, inp):
+            b_tile, p_tile = inp
+            oh = jax.nn.one_hot(b_tile.T, B, dtype=jnp.bfloat16)  # (F, T, B)
+            pb = jnp.broadcast_to(p_tile[None], (F, row_tile, NC))
+            hh = jax.lax.dot_general(
+                oh, pb, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)  # (F, B, NC)
+            return acc + hh, None
+
+        init = jnp.zeros((F, B, NC), jnp.float32)
+        hist, _ = jax.lax.scan(body, init, (bins_t, pay_t))
+        return hist
+
+    return run
+
+
+# ---------------------------------------------------------------------- main
+def main():
+    rng = np.random.RandomState(0)
+    bins_np = rng.randint(0, B, size=(N, F)).astype(np.int16)
+    pay_np = (rng.randn(N, NC) * 0.1).astype(np.float32)
+
+    bins = jnp.asarray(bins_np)
+    pay = jnp.asarray(pay_np)
+
+    # numpy reference for correctness (channel 0 and NC-1 suffice)
+    ref = np.zeros((F, B, 2), np.float64)
+    for f in range(F):
+        ref[f, :, 0] = np.bincount(bins_np[:, f], weights=pay_np[:, 0], minlength=B)
+        ref[f, :, 1] = np.bincount(bins_np[:, f], weights=pay_np[:, NC - 1], minlength=B)
+
+    cases = {
+        "pallas_base_t1024": make_pallas_base(row_tile=1024),
+        "pallas_fpack4_t1024": make_fpack(4, row_tile=1024),
+        "pallas_fpack2_t1024": make_fpack(2, row_tile=1024),
+        "pallas_fpack7_t512": make_fpack(7, row_tile=512),
+        "pallas_fpack4_t2048": make_fpack(4, row_tile=2048),
+        "xla_base_t8192": make_xla_base(row_tile=8192),
+        "xla_flatdot_t1024": make_xla_flatdot(row_tile=1024),
+        "xla_flatdot_t4096": make_xla_flatdot(row_tile=4096),
+        "xla_hilo_t8192": make_xla_hilo(row_tile=8192),
+        "xla_fbatch_t2048": make_xla_fbatch(row_tile=2048),
+    }
+    which = sys.argv[1].split(",") if len(sys.argv) > 1 else list(cases)
+
+    for key in which:
+        fn = cases[key]
+        t0 = time.perf_counter()
+        try:
+            out = fn(bins, pay)
+            out_h = np.asarray(out)
+        except Exception as e:  # noqa: BLE001 - probe must survive Mosaic rejects
+            print(f"{key:24s} FAILED: {type(e).__name__}: {str(e)[:200]}", flush=True)
+            continue
+        dt_c = time.perf_counter() - t0
+        err0 = np.abs(out_h[:, :, 0] - ref[:, :, 0]).max()
+        err1 = np.abs(out_h[:, :, NC - 1] - ref[:, :, 1]).max()
+        ok = "OK " if max(err0, err1) < 0.35 else f"BAD err=({err0:.3g},{err1:.3g})"
+        print(f"{key:24s} compile+check {dt_c:5.0f}s  {ok}", flush=True)
+        if ok != "OK ":
+            continue
+
+        @jax.jit
+        def loop(fn=fn):
+            def body(i, acc):
+                p = pay * (1.0 + i.astype(jnp.float32) * 1e-9)
+                return acc + fn(bins, p).ravel()[0]
+            return jax.lax.fori_loop(0, K_LOOP, body, jnp.float32(0))
+
+        t0 = time.perf_counter()
+        o = loop(); np.asarray(o).ravel()[:1]
+        dt_c2 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(5):
+            o = loop()
+        np.asarray(o).ravel()[:1]
+        total = (time.perf_counter() - t0) / 5 * 1e3
+        print(f"{key:24s} per-pass ~{(total - FLOOR_MS)/K_LOOP:6.2f} ms "
+              f"(loop-compile {dt_c2:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,14 @@ def _parse_params(parameters: str) -> dict:
                 break
             except ValueError:
                 continue
+        if isinstance(v, str):
+            # bool-likes must not stay truthy strings ('header=false' would
+            # otherwise drop the first data row); mirror Config._coerce
+            low = v.lower()
+            if low in ("true", "+", "yes"):
+                v = True
+            elif low in ("false", "-", "no"):
+                v = False
         out[k] = v
     return out
 
@@ -189,6 +197,10 @@ def booster_add_valid(bst: Booster, valid_set) -> bool:
 
 
 def booster_update(bst: Booster) -> int:
+    # the reference's LGBM_BoosterUpdateOneIter reports is_finished per call;
+    # flip the fused path from its deferred (every-32) check to the
+    # one-iteration-late async probe
+    bst._gbdt._report_finish_every_iter = True
     return 1 if bst.update() else 0
 
 
@@ -231,11 +243,14 @@ def booster_get_eval_into(bst: Booster, data_idx: int, out_addr: int) -> int:
     LGBM_BoosterGetEval)."""
     res = bst.eval_train() if data_idx == 0 else bst.eval_valid()
     if data_idx > 0:
-        # filter to the requested valid set (eval_valid returns all)
-        names = sorted({r[0] for r in res})
-        if data_idx - 1 < len(names):
-            want = names[data_idx - 1]
-            res = [r for r in res if r[0] == want]
+        # filter to the requested valid set (eval_valid returns all); the
+        # reference indexes valid sets by REGISTRATION order, and sorting
+        # would misorder >=10 auto-named sets ('valid_10' < 'valid_2')
+        names = list(getattr(bst._gbdt, "valid_names", []))
+        if data_idx - 1 >= len(names):
+            return 0  # out-of-range index must not spill all sets' metrics
+        want = names[data_idx - 1]
+        res = [r for r in res if r[0] == want]
     vals = np.asarray([r[2] for r in res], np.float64)
     dest = _wrap(out_addr, (len(vals),))
     dest[:] = vals

@@ -314,7 +314,6 @@ class GBDT:
     def reset_split_params(self) -> None:
         """Refresh jit-static split hyperparams after a config mutation
         (reference: GBDT::ResetConfig via reset_parameter callbacks)."""
-        old = getattr(self, "_split_params", None)
         self._split_params = SplitParams(
             lambda_l1=self.cfg.lambda_l1,
             lambda_l2=self.cfg.lambda_l2,
@@ -333,13 +332,14 @@ class GBDT:
             cegb_tradeoff=self.cfg.cegb_tradeoff,
             cegb_penalty_split=self.cfg.cegb_penalty_split,
         )
-        # the fused step bakes SplitParams (and sigmoid) as traced constants —
-        # but learning_rate is a runtime argument, so the common
-        # reset_parameter(learning_rate=...) schedule must NOT retrace every
-        # iteration; invalidate only when a baked constant really changed
+        # the fused step bakes SplitParams plus several other config fields
+        # as traced constants — but learning_rate is a runtime argument, so
+        # the common reset_parameter(learning_rate=...) schedule must NOT
+        # retrace every iteration; invalidate only when a baked constant
+        # really changed (reference: GBDT::ResetConfig propagates num_leaves
+        # etc. to the tree learner)
         if self._fused_step is not None and (
-            old != self._split_params
-            or getattr(self, "_fused_sigmoid", None) != self.cfg.sigmoid
+            getattr(self, "_fused_key", None) != self._fused_bake_key()
         ):
             self._fused_step = None
 
@@ -472,6 +472,8 @@ class GBDT:
     _last_mask = None
     _nobag_cache = None
     _fused_step = None
+    _report_finish_every_iter = False
+    _finish_probe = None
 
     def _fused_eligible(self, grad) -> bool:
         """The common hot path — single-class fast grower with a built-in
@@ -549,10 +551,28 @@ class GBDT:
         )
         return self._forced_cache
 
+    def _fused_bake_key(self):
+        """Every config field the fused trace bakes as a constant.  Must stay
+        in sync with _get_fused_step/grow_kwargs: a field listed here forces
+        a retrace on reset_parameter; a missing field is silently frozen."""
+        ts = self.train_set
+        return (
+            self._split_params,
+            self.cfg.sigmoid,
+            self.cfg.num_leaves,
+            self.cfg.max_depth,
+            self.cfg.hist_precision,
+            self._leaf_tile(ts) if ts is not None else None,
+            self._is_goss,
+            self.cfg.top_rate,
+            self.cfg.other_rate,
+            self.cfg.forcedsplits_filename,
+        )
+
     def _get_fused_step(self):
         if self._fused_step is not None:
             return self._fused_step
-        self._fused_sigmoid = self.cfg.sigmoid  # baked into the trace below
+        self._fused_key = self._fused_bake_key()  # baked into the trace below
         ts = self.train_set
         obj = self.objective
         label, weight = self._label, self._weight
@@ -676,7 +696,28 @@ class GBDT:
                         self._valid_scores[vi] = self._valid_scores[vi].at[:, c].add(vals)
             self.iter_ += 1
             self._pred_cache = None
+            if self._report_finish_every_iter:
+                # C API path: the reference reports is_finished immediately.
+                # Reading THIS iteration's num_leaves would sync the tunnel
+                # (~23 ms) and stall the async pipeline, so probe the
+                # PREVIOUS iteration's trees — by now their step has retired,
+                # making the read ~free; is_finished lags one iteration.
+                prev = self._finish_probe
+                self._finish_probe = (
+                    self.iter_,
+                    tuple(a.num_leaves for a in arrays_all),
+                )
+                for x in self._finish_probe[1]:
+                    getattr(x, "copy_to_host_async", lambda: None)()
+                # only trust a probe from the immediately preceding iteration
+                # (rollback / reset / interleaved unfused iterations stale it)
+                if prev is not None and prev[0] == self.iter_ - 1:
+                    return all(int(np.asarray(x)) <= 1 for x in prev[1])
+                return False
             if (self.iter_ % 32) == 0:
+                # library path: syncing every iteration is too expensive (see
+                # above); a finished model only accretes constant trees, so a
+                # deferred check is safe — it is documented in engine.train
                 return all(bool(a.num_leaves <= 1) for a in arrays_all)
             return False
         if grad is None:

@@ -81,6 +81,10 @@ class GrowState(NamedTuple):
     cegb_used: jnp.ndarray  # (F,) bool — features already split on in this tree
     used_features: jnp.ndarray  # (L, F) bool or () — path features (interaction constraints)
     tree: TreeArrays
+    forced_active: jnp.ndarray = True  # () bool — forced prefix still applying
+    # (reference: ForceSplits stops at the FIRST invalid forced split; the
+    # precomputed schedule's leaf ids assume every prior entry applied, so a
+    # rejected entry must disable all later ones, not just itself)
 
 
 def _empty_best(num_leaves: int, num_bins: int) -> BestSplit:
@@ -349,6 +353,7 @@ def grow_tree(
             else jnp.zeros((), bool)
         ),
         tree=tree0,
+        forced_active=jnp.asarray(True),
     )
 
     def _forced_candidate(state: GrowState, i):
@@ -557,13 +562,19 @@ def grow_tree(
             cegb_used=cegb_used,
             used_features=used_features,
             tree=tree,
+            forced_active=state.forced_active,
         )
 
     def body(i, state: GrowState) -> GrowState:
         can_split = jnp.max(state.best.gain) > KMIN_SCORE / 2
         if n_forced > 0:
             f_leaf, s_f, f_valid = _forced_candidate(state, i)
-            use_forced = (i < n_forced) & f_valid
+            in_sched = i < n_forced
+            use_forced = in_sched & f_valid & state.forced_active
+            # first invalid in-schedule entry permanently disables the rest
+            state = state._replace(
+                forced_active=state.forced_active & (~in_sched | f_valid)
+            )
             can_split = can_split | use_forced
             return jax.lax.cond(
                 can_split,

@@ -129,3 +129,61 @@ def test_ranking_variable_query_lengths_row0_gradient():
         assert np.all(np.isfinite(g)) and np.all(np.isfinite(h))
         # row 0 belongs to a non-degenerate query: its hessian must be > 0
         assert h[0] > 0, (cls.__name__, h[:5])
+
+
+def test_reset_parameter_num_leaves_applies_to_fused_path():
+    """Advisor r2 (medium): the fused step bakes num_leaves as a trace
+    constant; reset_parameter({'num_leaves': ...}) must invalidate it
+    (reference: GBDT::ResetConfig propagates to the tree learner)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(3000, 10)
+    y = (X @ rng.randn(10) > 0).astype(np.float64)
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+         "fused_training": True, "min_data_in_leaf": 5},
+        d, num_boost_round=3, keep_training_booster=True)
+    bst.reset_parameter({"num_leaves": 4})
+    for _ in range(3):
+        bst.update()
+    info = bst.dump_model()["tree_info"]
+    assert any(t["num_leaves"] > 4 for t in info[:3])
+    assert all(t["num_leaves"] <= 4 for t in info[3:])
+
+
+def test_capi_parse_params_bool_strings():
+    """Advisor r2 (low): 'header=false' must not evaluate truthy."""
+    from lightgbm_tpu.capi_helpers import _parse_params
+
+    p = _parse_params("header=false two_round=true verbosity=-1 label_column=name:y")
+    assert p["header"] is False
+    assert p["two_round"] is True
+    assert p["verbosity"] == -1
+    assert p["label_column"] == "name:y"
+
+
+def test_capi_get_eval_uses_registration_order():
+    """Advisor r2 (low): data_idx must index valid sets by registration
+    order, not lexicographic name order (reference: LGBM_BoosterGetEval)."""
+    from lightgbm_tpu.capi_helpers import booster_get_eval_into
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(600, 5)
+    y = rng.randn(600)
+    d = lgb.Dataset(X, label=y)
+    valids, names = [], []
+    # 11 valid sets: lexicographic order of auto names != registration order
+    for i in range(11):
+        Xi = rng.randn(50, 5) + i  # shifted -> distinct l2
+        valids.append(lgb.Dataset(Xi, label=rng.randn(50) + i, reference=d))
+        names.append(f"valid_{i}")
+    bst = lgb.train({"objective": "regression", "num_leaves": 4,
+                     "verbosity": -1, "metric": "l2"},
+                    d, num_boost_round=2, valid_sets=valids,
+                    valid_names=names, keep_training_booster=True)
+    expected = {name: val for name, _m, val, _b in bst.eval_valid()}
+    out = np.zeros(4, np.float64)
+    for idx, name in enumerate(names, start=1):
+        n = booster_get_eval_into(bst, idx, out.ctypes.data)
+        assert n >= 1
+        assert out[0] == pytest.approx(expected[name])

@@ -61,3 +61,41 @@ def test_invalid_forced_split_skipped():
     # split is invalid and normal growth takes over (reference skips it)
     root = _train({"feature": 2, "threshold": 1e9})
     assert root["split_feature"] == 0  # the gain-driven choice
+
+
+def test_invalid_forced_split_disables_rest():
+    """The first invalid forced entry must disable ALL remaining entries
+    (reference: ForceSplits stops applying the prefix at the first invalid
+    split) — the precomputed schedule's leaf ids assume every prior entry
+    applied, so a later entry would latch onto the wrong leaf."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(3)
+    n = 2000
+    X = rng.randn(n, 4)
+    y = X[:, 0]  # linear signal: every leaf keeps its gain on feature 0
+    forced = {"feature": 2, "threshold": 1e9,  # invalid: one side empty
+              "right": {"feature": 3, "threshold": 0.0}}
+    with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+        json.dump(forced, f)
+        path = f.name
+    try:
+        d = lgb.Dataset(X, label=y)
+        bst = lgb.train(
+            {"objective": "regression", "num_leaves": 3, "verbosity": -1,
+             "tree_growth_mode": "strict", "forcedsplits_filename": path},
+            d, num_boost_round=1)
+        root = bst.dump_model()["tree_info"][0]["tree_structure"]
+
+        def features(nd):
+            if "split_feature" not in nd:
+                return []
+            return ([nd["split_feature"]] + features(nd["left_child"])
+                    + features(nd["right_child"]))
+
+        # without the cascade, entry 1 (feature 3) was force-applied to the
+        # leaf created by the gain-driven root split
+        assert 3 not in features(root)
+        assert root["split_feature"] == 0
+    finally:
+        os.unlink(path)
