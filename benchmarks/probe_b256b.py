@@ -1,0 +1,165 @@
+"""Probe round 2: feature-packed Pallas histogram via lane-CONCATENATED
+one-hots (pltpu.repeat from k to k*B columns was rejected by Mosaic in
+probe_b256.py; concat of (T, B) blocks at B=256 is lane-aligned).
+
+Per group of K features: K compares (cheap per the round-2 invariances)
+feeding ONE (T, NC)x(T, K*B) dot — if the per-dot operand-staging theory
+holds, pass cost drops ~K-fold from the 7.7 ms baseline.
+
+Also: NC=128 padded-payload control (staging theory predicts ~unchanged
+cost vs NC=48), and int8 payload variant for the quantized path.
+"""
+
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+K_LOOP = 20
+FLOOR_MS = 23.4
+N, F, B = 999424, 28, 256
+
+
+def make_cpack(kpack, *, nc=48, row_tile=1024, dtype=jnp.bfloat16,
+               int8=False):
+    G = (F + kpack - 1) // kpack
+    FP = G * kpack  # features padded to a multiple of kpack
+
+    def kernel(bins_ref, pay_ref, out_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        pay = pay_ref[...]
+        if not int8:
+            pay = pay.astype(dtype)
+        T = pay.shape[0]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (T, B), 1)
+        bins_i32 = bins_ref[...].astype(jnp.int32)
+        odt = jnp.int8 if int8 else dtype
+        for g in range(G):
+            ohs = [
+                (bins_i32[:, g * kpack + j][:, None] == iota).astype(odt)
+                for j in range(kpack)
+            ]
+            oh = jnp.concatenate(ohs, axis=-1)  # (T, kpack*B)
+            acc_ref[g] += jax.lax.dot_general(
+                pay, oh, (((0,), (0,)), ((), ())),
+                preferred_element_type=acc_ref.dtype)  # (NC, kpack*B)
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _():
+            out_ref[...] = acc_ref[...]
+
+    @jax.jit
+    def run(bins, pay):
+        n = bins.shape[0]
+        if FP != F:
+            bins = jnp.pad(bins, ((0, 0), (0, FP - F)), constant_values=B - 1)
+        acc_dt = jnp.int32 if int8 else jnp.float32
+        out = pl.pallas_call(
+            kernel,
+            grid=(n // row_tile,),
+            in_specs=[
+                pl.BlockSpec((row_tile, FP), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((row_tile, nc), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((G, nc, kpack * B), lambda i: (0, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((G, nc, kpack * B), acc_dt),
+            scratch_shapes=[pltpu.VMEM((G, nc, kpack * B), acc_dt)],
+            cost_estimate=pl.CostEstimate(
+                flops=2 * n * FP * B * nc,
+                bytes_accessed=n * FP * 2 + n * nc * 4,
+                transcendentals=0,
+            ),
+        )(bins, pay)
+        # (G, NC, kpack*B) with c = f_local*B + b -> (F, B, NC)
+        out = out.reshape(G, nc, kpack, B)
+        return jnp.transpose(out, (0, 2, 3, 1)).reshape(FP, B, nc)[:F]
+
+    return run
+
+
+def main():
+    rng = np.random.RandomState(0)
+    bins_np = rng.randint(0, B, size=(N, F)).astype(np.int16)
+    pay_np = (rng.randn(N, 48) * 0.1).astype(np.float32)
+
+    bins = jnp.asarray(bins_np)
+    pay48 = jnp.asarray(pay_np)
+    pay128 = jnp.asarray(np.pad(pay_np, ((0, 0), (0, 80))))
+    pay_i8 = jnp.asarray(
+        np.clip(np.round(pay_np / 0.02), -127, 127).astype(np.int8))
+
+    ref = np.zeros((F, B, 2), np.float64)
+    for f in range(F):
+        ref[f, :, 0] = np.bincount(bins_np[:, f], weights=pay_np[:, 0], minlength=B)
+        ref[f, :, 1] = np.bincount(bins_np[:, f], weights=pay_np[:, 47], minlength=B)
+    ref_i8 = np.zeros((F, B), np.int64)
+    i8c0 = np.asarray(pay_i8[:, 0], np.int64)
+    for f in range(F):
+        ref_i8[f] = np.bincount(bins_np[:, f], weights=i8c0, minlength=B)
+
+    cases = {
+        "cpack2_t1024": (make_cpack(2), pay48, ref, 48),
+        "cpack4_t1024": (make_cpack(4), pay48, ref, 48),
+        "cpack4_t2048": (make_cpack(4, row_tile=2048), pay48, ref, 48),
+        "cpack7_t1024": (make_cpack(7), pay48, ref, 48),
+        "cpack14_t512": (make_cpack(14, row_tile=512), pay48, ref, 48),
+        "cpack4_nc128": (make_cpack(4, nc=128), pay128, ref, 128),
+        "cpack1_nc128": (make_cpack(1, nc=128), pay128, ref, 128),
+        "cpack4_int8": (make_cpack(4, int8=True), pay_i8, ref_i8, 48),
+    }
+    which = sys.argv[1].split(",") if len(sys.argv) > 1 else list(cases)
+
+    for key in which:
+        fn, pay, rr, nc = cases[key]
+        t0 = time.perf_counter()
+        try:
+            out = fn(bins, pay)
+            out_h = np.asarray(out)
+        except Exception as e:  # noqa: BLE001
+            print(f"{key:24s} FAILED: {type(e).__name__}: {str(e)[:160]}", flush=True)
+            continue
+        dt_c = time.perf_counter() - t0
+        if key == "cpack4_int8":
+            ok = "OK " if np.abs(out_h[:, :, 0].astype(np.int64) - rr).max() == 0 else "BAD"
+        else:
+            err0 = np.abs(out_h[:, :, 0] - rr[:, :, 0]).max()
+            err1 = np.abs(out_h[:, :, 47] - rr[:, :, 1]).max()
+            ok = "OK " if max(err0, err1) < 0.35 else f"BAD err=({err0:.3g},{err1:.3g})"
+        print(f"{key:24s} compile+check {dt_c:5.0f}s  {ok}", flush=True)
+        if not ok.startswith("OK"):
+            continue
+
+        @jax.jit
+        def loop(fn=fn, pay=pay):
+            def body(i, acc):
+                if pay.dtype == jnp.int8:
+                    p = pay + (i % 2).astype(jnp.int8)
+                else:
+                    p = pay * (1.0 + i.astype(jnp.float32) * 1e-9)
+                return acc + fn(bins, p).ravel()[0].astype(jnp.float32)
+            return jax.lax.fori_loop(0, K_LOOP, body, jnp.float32(0))
+
+        t0 = time.perf_counter()
+        o = loop(); np.asarray(o).ravel()[:1]
+        dt_c2 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(5):
+            o = loop()
+        np.asarray(o).ravel()[:1]
+        total = (time.perf_counter() - t0) / 5 * 1e3
+        print(f"{key:24s} per-pass ~{(total - FLOOR_MS)/K_LOOP:6.2f} ms "
+              f"(loop-compile {dt_c2:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

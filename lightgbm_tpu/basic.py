@@ -764,11 +764,12 @@ class Booster:
 
     # -- serialization ----------------------------------------------------
     def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0,
-                        importance_type: str = "split") -> str:
+                        importance_type: str = None) -> str:
+        # None defers to saved_feature_importance_type (reference: config)
         return self._gbdt.save_model_to_string(num_iteration, start_iteration, importance_type)
 
     def save_model(self, filename, num_iteration: int = -1, start_iteration: int = 0,
-                   importance_type: str = "split") -> "Booster":
+                   importance_type: str = None) -> "Booster":
         Path(filename).write_text(self.model_to_string(num_iteration, start_iteration, importance_type))
         return self
 

@@ -452,6 +452,40 @@ class Config:
     def num_tree_per_iteration(self) -> int:
         return self.num_class if self.objective in ("multiclass", "multiclassova") else 1
 
+    # params that exist for CPU/GPU-implementation reasons and have no TPU
+    # analogue (reference: every accepted param has semantics in
+    # src/io/config_auto.cpp; here the honest equivalent is an explicit
+    # warning whenever a non-default value would otherwise be silently
+    # ignored — see docs/Parameters.md)
+    _NA_PARAMS = {
+        "force_col_wise": "histogram layout is chosen by the measured "
+        "per-max_bin device strategy, not col/row-wise threading",
+        "force_row_wise": "histogram layout is chosen by the measured "
+        "per-max_bin device strategy, not col/row-wise threading",
+        "histogram_pool_size": "per-leaf histograms live in device HBM; "
+        "there is no host LRU histogram pool",
+        "gpu_platform_id": "device selection is owned by JAX/XLA "
+        "(JAX_PLATFORMS, jax.devices())",
+        "gpu_device_id": "device selection is owned by JAX/XLA",
+        "gpu_use_dp": "histogram accumulation precision is controlled by "
+        "hist_precision (bf16x2/f32 lanes)",
+        "num_gpu": "multi-device scale-out uses jax.sharding meshes via "
+        "tree_learner=data|feature|voting",
+        "precise_float_parser": "parsing always uses full float64 "
+        "precision (numpy)",
+        "parser_config_file": "custom parser plugins are not supported",
+    }
+
+    def warn_na_params(self) -> None:
+        """Warn for every accepted-but-N/A param set to a non-default value
+        so nothing is silently ignored."""
+        from .utils.log import log_warning
+
+        defaults = type(self)()
+        for name, reason in self._NA_PARAMS.items():
+            if getattr(self, name) != getattr(defaults, name):
+                log_warning(f"{name} has no effect on this backend: {reason}")
+
 
 def _coerce(value: Any, current: Any, anno: Any) -> Any:
     """Coerce `value` to the type of the dataclass default (LightGBM accepts

@@ -176,6 +176,59 @@ class GBDT:
         )
         cat_mask = np.asarray(self.binner.categorical_mask)
         self._allowed_features = jnp.ones(cat_mask.shape, dtype=bool)
+        # feature_pre_filter (reference: DatasetLoader — ignore features that
+        # can never produce a split satisfying min_data_in_leaf, whatever the
+        # threshold or missing direction).  Exact per-feature check on bin
+        # counts; numerical features only (categorical splits are subsets).
+        if (
+            self.cfg.feature_pre_filter
+            and self.cfg.min_data_in_leaf > 1
+            and jax.process_count() <= 1
+            # multi-controller: ranks may hold different row shards, so
+            # local counts could derive DIVERGENT feature masks and break
+            # the identical-SPMD-program invariant; the reference filters
+            # from globally-synced sample counts — until counts are psum'd
+            # here, skip the (purely optimizing) filter in that mode
+        ):
+            bins_h = np.asarray(train_set.bins)
+            nbpf_h = np.asarray(train_set.binner.num_bins_per_feature)
+            mbpf_h = np.asarray(train_set.binner.missing_bin_per_feature)
+            md = int(self.cfg.min_data_in_leaf)
+            n_rows_h, n_feat_h = bins_h.shape
+            bmax = int(nbpf_h.max()) if n_feat_h else 1
+            allowed = np.ones(n_feat_h, dtype=bool)
+            # one flattened bincount per feature block (not F python loops);
+            # block size bounds the (N, blk) int64 temp to ~128MB
+            blk = max(1, 2**24 // max(n_rows_h, 1))
+            for j0 in range(0, n_feat_h, blk):
+                j1 = min(j0 + blk, n_feat_h)
+                nb = j1 - j0
+                flat = bins_h[:, j0:j1].astype(np.int64)
+                flat += np.arange(nb, dtype=np.int64)[None, :] * bmax
+                counts = np.bincount(flat.ravel(), minlength=nb * bmax).reshape(nb, bmax)
+                for dj in range(nb):
+                    j = j0 + dj
+                    if cat_mask[j] or nbpf_h[j] <= 1:
+                        continue
+                    cm = counts[dj].copy()
+                    m = int(cm[mbpf_h[j]]) if mbpf_h[j] >= 0 else 0
+                    if mbpf_h[j] >= 0:
+                        cm[mbpf_h[j]] = 0
+                    p = np.cumsum(cm[: int(nbpf_h[j])])[:-1]  # left counts
+                    if p.size == 0:
+                        continue
+                    q = (n_rows_h - m) - p
+                    lo, hi = np.minimum(p, q), np.maximum(p, q)
+                    # the missing mass may join the smaller side
+                    if not np.any((hi >= md) & (lo + m >= md)):
+                        allowed[j] = False
+            if not allowed.all():
+                from ..utils.log import log_info
+                log_info(
+                    f"feature_pre_filter: {int((~allowed).sum())} feature(s) "
+                    f"cannot satisfy min_data_in_leaf={md} and were excluded"
+                )
+                self._allowed_features = jnp.asarray(allowed)
         # pass None when no categorical features so the all-numerical jit
         # graph skips the categorical candidate evaluation entirely
         self._categorical_mask = jnp.asarray(cat_mask) if cat_mask.any() else None
@@ -187,6 +240,14 @@ class GBDT:
             self._monotone = jnp.asarray(np.asarray(mc, np.int32))
         else:
             self._monotone = None
+        # per-feature split-gain multipliers (reference: config feature_contri
+        # — gain[i] = max(0, contri[i]) * gain[i] in FindBestThreshold)
+        fc = list(self.cfg.feature_contri or [])
+        if fc and any(float(c) != 1.0 for c in fc):
+            fc = (fc + [1.0] * f)[:f]
+            self._feature_contri = jnp.asarray(np.asarray(fc, np.float32))
+        else:
+            self._feature_contri = None
         # interaction constraints (reference: config interaction_constraints
         # parsed into index sets; col_sampler.hpp filters per-leaf)
         sets = _parse_interaction_constraints(
@@ -231,6 +292,10 @@ class GBDT:
             self._cegb_coupled = None
             self._cegb_used_global = None
         from ..utils.log import log_warning
+        self.cfg.warn_na_params()
+        if self.cfg.bagging_by_query and getattr(train_set, "query_boundaries", None) is None:
+            log_warning("bagging_by_query is set but the dataset has no "
+                        "query groups; falling back to row-wise bagging")
         if self.cfg.forcedsplits_filename and self._use_fast:
             log_warning(
                 "forcedsplits_filename is honored by the strict grower only; "
@@ -398,6 +463,17 @@ class GBDT:
             # re-bag only every bagging_freq iterations (reference: bagging.hpp)
             return self._last_mask
         rng = np.random.RandomState(cfg.bagging_seed + self.iter_)
+        qb = getattr(self.train_set, "query_boundaries", None)
+        if cfg.bagging_by_query and qb is not None:
+            # reference: bagging.hpp bagging_by_query — whole queries are
+            # sampled so ranking pairs never straddle the in-bag boundary
+            qb = np.asarray(qb)
+            nq = len(qb) - 1
+            qmask = rng.rand(nq) < cfg.bagging_fraction
+            mask = np.repeat(qmask, np.diff(qb))
+            out = (jnp.asarray(mask), jnp.ones((n,), jnp.float32))
+            self._last_mask = out
+            return out
         if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
             lbl = np.asarray(self.train_set.label)
             mask = np.zeros(n, dtype=bool)
@@ -474,6 +550,23 @@ class GBDT:
     _fused_step = None
     _report_finish_every_iter = False
     _finish_probe = None
+
+    @staticmethod
+    def _localize_tree(arrays, leaf_id_pad):
+        """Multi-controller runs: bring the (replicated) tree and the
+        (row-sharded) leaf ids back to process-local arrays so the host-side
+        boosting state — scores, gradients, metrics — stays local, exactly
+        like the reference keeps per-rank state local while only the tree
+        learner communicates (reference: DataParallelTreeLearner)."""
+        if jax.process_count() <= 1:
+            return arrays, leaf_id_pad
+        from jax.experimental import multihost_utils
+
+        arrays = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), arrays)
+        leaf_id_pad = jnp.asarray(
+            multihost_utils.process_allgather(leaf_id_pad, tiled=True)
+        )
+        return arrays, leaf_id_pad
 
     def _fused_eligible(self, grad) -> bool:
         """The common hot path — single-class fast grower with a built-in
@@ -579,6 +672,7 @@ class GBDT:
         bins = ts.bins_device
         nbpf, mbpf = ts.num_bins_pf_device, ts.missing_bin_pf_device
         cat_mask, mono = self._categorical_mask, self._monotone
+        contri = self._feature_contri
         inter = self._interaction_sets
         efb_tabs = ts.efb_device_tables() if getattr(ts, "efb", None) is not None else None
         bins_t = ts.bins_device_t() if self._on_tpu else None
@@ -636,6 +730,7 @@ class GBDT:
                     efb_tabs[1] if efb_tabs else None,
                     efb_tabs[2] if efb_tabs else None,
                     bins_t,
+                    contri,
                     **grow_kwargs,
                 )
                 row_delta = (arrays.leaf_value * shrinkage)[leaf_id]
@@ -757,11 +852,13 @@ class GBDT:
                     self._monotone,
                     self._interaction_sets,
                     node_rng,
+                    self._feature_contri,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
                     params=self._split_params,
                 )
+                arrays, leaf_id = self._localize_tree(arrays, leaf_id)
             elif self._dp is not None and self._use_fast_dp:
                 from ..parallel.data_parallel import grow_tree_fast_data_parallel
 
@@ -781,6 +878,7 @@ class GBDT:
                     (jax.random.PRNGKey(self.cfg.seed * 1000003 + self.iter_ * 31 + c)
                      if quant else None),
                     cegb_pen,
+                    self._feature_contri,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -793,6 +891,7 @@ class GBDT:
                     quant_renew=bool(self.cfg.quant_train_renew_leaf),
                     track_path=self._linear,
                 )
+                arrays, leaf_id_pad = self._localize_tree(arrays, leaf_id_pad)
                 leaf_id = leaf_id_pad[: ts.num_data()]
             elif self._dp is not None:
                 from ..parallel.data_parallel import grow_tree_data_parallel
@@ -809,6 +908,7 @@ class GBDT:
                     self._monotone,
                     self._interaction_sets,
                     node_rng,
+                    self._feature_contri,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -816,6 +916,7 @@ class GBDT:
                     parallel_mode=("voting" if self.cfg.tree_learner == "voting" else "data"),
                     top_k=self.cfg.top_k,
                 )
+                arrays, leaf_id_pad = self._localize_tree(arrays, leaf_id_pad)
                 leaf_id = leaf_id_pad[: ts.num_data()]
             elif self._use_fast:
                 from ..ops.treegrow_fast import grow_tree_fast
@@ -842,6 +943,7 @@ class GBDT:
                     efb_tabs[1] if efb_tabs else None,
                     efb_tabs[2] if efb_tabs else None,
                     ts.bins_device_t() if self._on_tpu else None,
+                    self._feature_contri,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -880,6 +982,7 @@ class GBDT:
                     fs[0] if fs else None,
                     fs[1] if fs else None,
                     fs[2] if fs else None,
+                    self._feature_contri,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -1352,6 +1455,9 @@ class GBDT:
             return f"{o} num_class:{self.cfg.num_class}"
         if o == "lambdarank":
             return "lambdarank"
+        if o == "regression" and self.cfg.reg_sqrt:
+            # reference: RegressionL2loss::ToString emits "regression sqrt"
+            return "regression sqrt"
         return o
 
     def _trees_for_export(self, start: int, num_iteration: int) -> List[Tree]:
@@ -1383,7 +1489,14 @@ class GBDT:
         return trees
 
     def save_model_to_string(self, num_iteration: int = -1, start_iteration: int = 0,
-                             importance_type: str = "split") -> str:
+                             importance_type: str = None) -> str:
+        if importance_type is None:
+            # reference: config saved_feature_importance_type selects the
+            # importance written into the model file (0=split, 1=gain)
+            importance_type = (
+                "gain" if int(self.cfg.saved_feature_importance_type) == 1
+                else "split"
+            )
         k = self.num_tree_per_iteration
         trees = self._trees_for_export(start_iteration, num_iteration)
         feature_names = self.feature_names or [f"Column_{i}" for i in range(self.train_set.num_feature())]
@@ -1446,6 +1559,8 @@ class GBDT:
             if ":" in tok:
                 pk, pv = tok.split(":", 1)
                 params[pk] = pv
+            elif tok == "sqrt":  # reference: "regression sqrt"
+                params["reg_sqrt"] = True
         if int(kv.get("num_class", 1)) > 1:
             params["num_class"] = int(kv["num_class"])
         cfg = Config.from_dict(params)

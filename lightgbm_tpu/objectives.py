@@ -69,19 +69,38 @@ class Objective:
 
 
 class RegressionL2(Objective):
-    """reference: RegressionL2loss in regression_objective.hpp."""
+    """reference: RegressionL2loss in regression_objective.hpp.
+
+    reg_sqrt (plain L2 only, as in the reference): the model is fit to
+    sign(y)*sqrt(|y|) and predictions are squared back in ConvertOutput —
+    metrics see original-scale outputs through GBDT._converted."""
 
     name = "regression"
     is_constant_hessian = True
 
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.sqrt = bool(cfg.reg_sqrt) and type(self) is RegressionL2
+
+    def _t(self, label):
+        if self.sqrt:
+            return jnp.sign(label) * jnp.sqrt(jnp.abs(label))
+        return label
+
     def get_gradients(self, score, label, weight):
         w = self._w(weight, label)
-        return (score - label) * w, w
+        return (score - self._t(label)) * w, w
 
     def boost_from_score(self, label, weight):
+        label = self._t(jnp.asarray(label))
         if weight is None:
             return float(jnp.mean(label))
         return float(jnp.sum(label * weight) / jnp.sum(weight))
+
+    def convert_output(self, score):
+        if self.sqrt:
+            return jnp.sign(score) * score * score
+        return score
 
 
 class RegressionL1(Objective):

@@ -157,6 +157,7 @@ def gain_plane(
     depth: jnp.ndarray | None = None,  # scalar — leaf depth (monotone_penalty)
     parent_output: jnp.ndarray | None = None,  # scalar — this leaf's output (path_smooth)
     cegb_feature_penalty: jnp.ndarray | None = None,  # (F,) pre-scaled coupled penalty
+    feature_contri: jnp.ndarray | None = None,  # (F,) split-gain multipliers
 ):
     """Evaluate every (feature, threshold, missing-direction) candidate and
     return `(gain (F, B), ctx)` — the full candidate-gain plane plus the
@@ -370,14 +371,29 @@ def gain_plane(
         factor = monotone_split_gain_penalty(depth, params.monotone_penalty)
         is_mono = (monotone_constraints != 0)[:, None]
         gain = jnp.where(live & is_mono, gain * factor, gain)
+    # ordering mirrors the reference: the min_gain gate sees RAW gains
+    # (FindBestThresholdSequentially's min_gain_shift), then the chosen
+    # gain is scaled by feature_contri (output->gain *= penalty) and the
+    # CEGB delta is subtracted (SerialTreeLearner after FindBestThreshold);
+    # an adjusted gain must stay positive to produce a split
+    gate = live & (gain > params.min_gain_to_split)
+    gain = jnp.where(gate, gain, KMIN_SCORE)
+    has_adjust = False
+    if feature_contri is not None:
+        # reference: config feature_contri — gain[i] = max(0, contri[i]) * gain[i]
+        contri = jnp.maximum(feature_contri.astype(jnp.float32), 0.0)
+        gain = jnp.where(gate, gain * contri[:, None], gain)
+        has_adjust = True
     if params.cegb_penalty_split > 0 or cegb_feature_penalty is not None:
         pen = jnp.zeros((f,), jnp.float32)
         if params.cegb_penalty_split > 0:
             pen = pen + params.cegb_tradeoff * params.cegb_penalty_split * parent_count
         if cegb_feature_penalty is not None:
             pen = pen + cegb_feature_penalty
-        gain = jnp.where(live, gain - pen[:, None], gain)
-    gain = jnp.where(live & (gain > params.min_gain_to_split), gain, KMIN_SCORE)
+        gain = jnp.where(gate, gain - pen[:, None], gain)
+        has_adjust = True
+    if has_adjust:
+        gain = jnp.where(gate & (gain > 0), gain, KMIN_SCORE)
 
     ctx = dict(
         use_left=use_left,
@@ -487,6 +503,7 @@ def find_best_split(
     depth: jnp.ndarray | None = None,
     parent_output: jnp.ndarray | None = None,
     cegb_feature_penalty: jnp.ndarray | None = None,
+    feature_contri: jnp.ndarray | None = None,
 ) -> BestSplit:
     """gain_plane + select_from_plane (reference: FindBestThreshold)."""
     gain, ctx = gain_plane(
@@ -501,5 +518,6 @@ def find_best_split(
         depth=depth,
         parent_output=parent_output,
         cegb_feature_penalty=cegb_feature_penalty,
+        feature_contri=feature_contri,
     )
     return select_from_plane(gain, ctx)

@@ -142,6 +142,7 @@ def grow_tree(
     forced_leaf: jnp.ndarray = None,  # (K,) i32 — forced-split schedule
     forced_feature: jnp.ndarray = None,  # (K,) i32   (reference: ForceSplits
     forced_bin: jnp.ndarray = None,  # (K,) i32        from forcedsplits JSON)
+    feature_contri: jnp.ndarray = None,  # (F,) split-gain multipliers
     *,
     num_leaves: int,
     num_bins: int,
@@ -209,6 +210,7 @@ def grow_tree(
             depth=depth.astype(jnp.float32) if hasattr(depth, 'astype') else jnp.float32(depth),
             parent_output=parent_out,
             cegb_feature_penalty=cegb_pen,
+            feature_contri=feature_contri,
         )
         if mode == "voting":
             # PV-Tree (reference: voting_parallel_tree_learner.cpp): each
@@ -244,6 +246,8 @@ def grow_tree(
             kw_sub["monotone_constraints"] = sub(kw_sub.get("monotone_constraints"))
             if kw_sub.get("cegb_feature_penalty") is not None:
                 kw_sub["cegb_feature_penalty"] = kw_sub["cegb_feature_penalty"][el_idx]
+            if kw_sub.get("feature_contri") is not None:
+                kw_sub["feature_contri"] = kw_sub["feature_contri"][el_idx]
             s = find_best_split(
                 sub_hist, sum_g, sum_h, count,
                 num_bins_per_feature[el_idx], missing_bin_per_feature[el_idx],
@@ -374,6 +378,7 @@ def grow_tree(
             out_lo=state.leaf_out_lo[fl], out_hi=state.leaf_out_hi[fl],
             rng_key=None, depth=state.leaf_depth[fl].astype(jnp.float32),
             parent_output=state.leaf_out[fl], cegb_feature_penalty=None,
+            feature_contri=feature_contri,
         )
         cell = (
             (jnp.arange(f, dtype=jnp.int32)[:, None] == ff)

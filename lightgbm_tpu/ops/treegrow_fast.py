@@ -104,7 +104,7 @@ def _batched_best(
     num_bins_pf, missing_bin_pf, params,
     feature_mask, categorical_mask, monotone, interaction_sets,
     out_lo, out_hi, used, node_ids, rng_key,
-    depth=None, parent_out=None, cegb_pen=None,
+    depth=None, parent_out=None, cegb_pen=None, feature_contri=None,
 ):
     """find_best_split vmapped over leaves."""
     if depth is None:
@@ -124,7 +124,7 @@ def _batched_best(
             feature_mask=fmask, categorical_mask=categorical_mask,
             monotone_constraints=monotone, out_lo=lo, out_hi=hi, rng_key=key,
             depth=dep.astype(jnp.float32), parent_output=pout,
-            cegb_feature_penalty=cegb_pen,
+            cegb_feature_penalty=cegb_pen, feature_contri=feature_contri,
         )
 
     in_axes = (0, 0, 0, 0, 0, 0, 0 if used is not None else None, 0, 0, 0)
@@ -163,6 +163,7 @@ def grow_tree_fast(
     bins_t: jnp.ndarray = None,  # (F, N) feature-major copy: partition's
     # per-feature column reads become contiguous row slices (measured:
     # 8 dynamic column slices of (N, F) cost ~1.1 ms/round on v5e)
+    feature_contri: jnp.ndarray = None,  # (F,) split-gain multipliers
     *,
     num_leaves: int,
     num_bins: int,
@@ -333,6 +334,7 @@ def grow_tree_fast(
                 depth=jnp.asarray([0.0], jnp.float32),
                 parent_out=jnp.asarray([leaf_out0]),
                 cegb_pen=cegb_pen0,
+                feature_contri=feature_contri,
             ),
         ),
     )
@@ -599,6 +601,7 @@ def grow_tree_fast(
             node_ids[fr_idx], rng_key,
             depth=state.leaf_depth[fr_idx], parent_out=state.leaf_out[fr_idx],
             cegb_pen=cegb_pen,
+            feature_contri=feature_contri,
         )
         scatter_pos = jnp.where(fr_ok, fr_idx, 2 * L)  # drop padding slots
 

@@ -134,6 +134,7 @@ def grow_tree_data_parallel(
     interaction_sets: Optional[jnp.ndarray] = None,
     rng_key: Optional[jnp.ndarray] = None,  # replicated — identical per-node
     # sampling on every shard keeps the SPMD trees in lockstep
+    feature_contri: Optional[jnp.ndarray] = None,  # (F,) replicated
     *,
     num_leaves: int,
     num_bins: int,
@@ -153,6 +154,7 @@ def grow_tree_data_parallel(
         "monotone_constraints": monotone_constraints,
         "interaction_sets": interaction_sets,
         "rng_key": rng_key,
+        "feature_contri": feature_contri,
     }
     kw = dict(
         num_leaves=num_leaves, num_bins=num_bins, max_depth=max_depth,
@@ -176,6 +178,7 @@ def grow_tree_fast_data_parallel(
     rng_key: Optional[jnp.ndarray] = None,
     quant_key: Optional[jnp.ndarray] = None,
     cegb_feature_penalty: Optional[jnp.ndarray] = None,
+    feature_contri: Optional[jnp.ndarray] = None,  # (F,) replicated
     *,
     num_leaves: int,
     num_bins: int,
@@ -203,6 +206,7 @@ def grow_tree_fast_data_parallel(
         "rng_key": rng_key,
         "quant_key": quant_key,
         "cegb_feature_penalty": cegb_feature_penalty,
+        "feature_contri": feature_contri,
     }
     kw = dict(
         num_leaves=num_leaves, num_bins=num_bins, max_depth=max_depth,

@@ -89,6 +89,7 @@ def grow_tree_feature_parallel(
     monotone_constraints: Optional[jnp.ndarray] = None,
     interaction_sets: Optional[jnp.ndarray] = None,
     rng_key: Optional[jnp.ndarray] = None,
+    feature_contri: Optional[jnp.ndarray] = None,  # (F,) host array
     *,
     num_leaves: int,
     num_bins: int,
@@ -118,6 +119,10 @@ def grow_tree_feature_parallel(
         opt["interaction_sets"] = sharded.pad_sets(np.asarray(interaction_sets, bool))
     if rng_key is not None:
         opt["rng_key"] = rng_key
+    if feature_contri is not None:
+        opt["feature_contri"] = sharded.pad_features(
+            np.asarray(feature_contri, np.float32), fill=0.0
+        )
     names = list(opt.keys())
     vals = tuple(opt[k] for k in names)
     spec_of = {
@@ -125,6 +130,7 @@ def grow_tree_feature_parallel(
         "monotone_constraints": P(DATA_AXIS),
         "interaction_sets": P(None, DATA_AXIS),
         "rng_key": P(),
+        "feature_contri": P(DATA_AXIS),
     }
 
     def wrapped(bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_, *extras):

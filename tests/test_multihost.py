@@ -79,3 +79,96 @@ def test_two_process_loopback_psum(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
         assert f"RANK{rank}_OK" in out
+
+
+_TRAIN_WORKER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel.distributed import init_distributed
+
+cfg = Config.from_dict({{
+    "num_machines": 2,
+    "machines": "127.0.0.1:{port},127.0.0.1:{port2}",
+    "local_listen_port": {port},
+    "time_out": 2,
+}})
+assert init_distributed(cfg)
+
+import jax
+import numpy as np
+import lightgbm_tpu as lgb
+
+assert jax.process_count() == 2
+rank = jax.process_index()
+
+rng = np.random.RandomState(11)
+X = rng.randn(4000, 6)
+y = (X @ rng.randn(6) + 0.3 * rng.randn(4000) > 0).astype(float)
+params = {{"objective": "binary", "num_leaves": 8, "verbosity": -1,
+          "tree_learner": "data", "min_data_in_leaf": 5}}
+ds = lgb.Dataset(X, label=y)
+bst = lgb.train(params, ds, 3)
+s_dist = bst.model_to_string()
+with open({out!r} + f".rank{{rank}}", "w") as fh:
+    fh.write(s_dist)
+if rank == 0:
+    # reference: tests/distributed/_test_distributed.py — the distributed
+    # model must equal the single-machine model.  Structure must match
+    # EXACTLY; leaf values may differ at f32-psum-ordering level (the same
+    # tolerance tests/test_distributed.py uses single-process).
+    ds2 = lgb.Dataset(X, label=y)
+    bst2 = lgb.train(dict(params, tree_learner="serial"), ds2, 3)
+    s_serial = bst2.model_to_string()
+
+    def parts(s, key):
+        return [ln for ln in s.splitlines() if ln.startswith(key + "=")]
+
+    for key in ("split_feature", "threshold", "decision_type", "num_leaves"):
+        assert parts(s_dist, key) == parts(s_serial, key), key
+    lv_d = [float(v) for ln in parts(s_dist, "leaf_value")
+            for v in ln.split("=")[1].split()]
+    lv_s = [float(v) for ln in parts(s_serial, "leaf_value")
+            for v in ln.split("=")[1].split()]
+    np.testing.assert_allclose(lv_d, lv_s, rtol=2e-3, atol=2e-3)
+print(f"RANK{{rank}}_TRAIN_OK")
+"""
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_MULTIHOST") == "1", reason="opt-out")
+def test_two_process_training_equality(tmp_path):
+    """End-to-end cross-process training: 2 processes, rows sharded over a
+    4-device global mesh (tree_learner=data), and the resulting model must be
+    byte-identical to single-process serial training (reference:
+    tests/distributed/_test_distributed.py)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port, port2 = 29781, 29782
+    out = str(tmp_path / "model")
+    procs = []
+    for rank in range(2):
+        script = _TRAIN_WORKER.format(repo=repo, port=port, port2=port2, out=out)
+        env = dict(os.environ)
+        env["LIGHTGBM_TPU_RANK"] = str(rank)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env.pop("PYTEST_CURRENT_TEST", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", script],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+        )
+    outs = []
+    for p in procs:
+        o, _ = p.communicate(timeout=300)
+        outs.append(o.decode())
+    for rank, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{o[-4000:]}"
+        assert f"RANK{rank}_TRAIN_OK" in o
+    with open(out + ".rank0") as fh:
+        m0 = fh.read()
+    with open(out + ".rank1") as fh:
+        m1 = fh.read()
+    assert m0 == m1  # both processes hold the identical model

@@ -200,3 +200,122 @@ def test_ingestion_pyarrow_table():
                               "num_leaves": 7}, train_set=ds)
     bst.update()
     assert np.isfinite(bst.predict(X[:, :3])).all()
+
+
+# ---- round-3: formerly-dead params now implemented (VERDICT r2 item 4) ----
+
+def test_reg_sqrt_trains_in_sqrt_space():
+    rng = np.random.RandomState(5)
+    X = rng.randn(3000, 6)
+    z = X @ rng.randn(6) + 0.1 * rng.randn(3000)
+    y = np.sign(z) * z * z * 100.0  # large-range label
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "reg_sqrt": True,
+                     "num_leaves": 31, "verbosity": -1}, ds, 40)
+    raw = bst.predict(X, raw_score=True)
+    pred = bst.predict(X)
+    # ConvertOutput: sign(raw) * raw^2
+    np.testing.assert_allclose(pred, np.sign(raw) * raw * raw, rtol=1e-6)
+    # the raw model lives in sqrt-label space
+    t = np.sign(y) * np.sqrt(np.abs(y))
+    assert np.corrcoef(raw, t)[0, 1] > 0.95
+    # and beats a plain-L2 model on sqrt-scale error for this label shape
+    assert np.mean((pred - y) ** 2) < np.var(y)
+    # save/load must preserve the sqrt transform (reference writes
+    # "regression sqrt" into the model header)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst2.predict(X[:100]), pred[:100], rtol=1e-6)
+
+
+def test_bagging_by_query_keeps_queries_whole():
+    rng = np.random.RandomState(6)
+    n, q = 3000, 100
+    X = rng.randn(n, 5)
+    y = rng.randint(0, 3, n).astype(float)
+    group = np.full(q, n // q)
+    ds = lgb.Dataset(X, label=y, group=group)
+    bst = lgb.Booster(params={"objective": "lambdarank", "verbosity": -1,
+                              "bagging_by_query": True,
+                              "bagging_fraction": 0.5, "bagging_freq": 1},
+                      train_set=ds)
+    bst.update()
+    mask = np.asarray(bst._gbdt._bagging_mask()[0])
+    mq = mask.reshape(q, n // q)
+    assert np.all(mq.all(axis=1) | (~mq).any(axis=1))
+    # every query is fully in or fully out
+    assert np.all((mq.sum(axis=1) == 0) | (mq.sum(axis=1) == n // q))
+    # and the fraction is respected roughly
+    assert 0.3 < mask.mean() < 0.7
+
+
+@pytest.mark.parametrize("mode", ["strict", "rounds"])
+def test_feature_contri_zero_disables_feature(mode):
+    rng = np.random.RandomState(7)
+    X = rng.randn(2000, 4)
+    y = X[:, 0] * 2.0 + 0.01 * rng.randn(2000)  # all signal in feature 0
+    contri = [0.0, 1.0, 1.0, 1.0]
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "feature_contri": contri,
+                     "tree_growth_mode": mode, "num_leaves": 8,
+                     "verbosity": -1}, ds, 3)
+    assert bst.feature_importance("split")[0] == 0
+    ds2 = lgb.Dataset(X, label=y)
+    bst2 = lgb.train({"objective": "regression", "tree_growth_mode": mode,
+                      "num_leaves": 8, "verbosity": -1}, ds2, 3)
+    assert bst2.feature_importance("split")[0] > 0
+
+
+def test_feature_pre_filter_excludes_unsplittable():
+    rng = np.random.RandomState(8)
+    n = 2000
+    X = rng.randn(n, 3)
+    X[:, 1] = 0.0
+    X[:5, 1] = 1.0  # only 5 rows differ: unsplittable at min_data_in_leaf=50
+    y = X[:, 0] + X[:, 1]
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params={"objective": "regression", "verbosity": -1,
+                              "min_data_in_leaf": 50,
+                              "feature_pre_filter": True}, train_set=ds)
+    allowed = np.asarray(bst._gbdt._allowed_features)
+    assert not allowed[1] and allowed[0] and allowed[2]
+    ds2 = lgb.Dataset(X, label=y)
+    bst2 = lgb.Booster(params={"objective": "regression", "verbosity": -1,
+                               "min_data_in_leaf": 50,
+                               "feature_pre_filter": False}, train_set=ds2)
+    assert np.asarray(bst2._gbdt._allowed_features).all()
+
+
+def test_saved_feature_importance_type_gain():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    p = {"objective": "regression", "verbosity": -1, "num_leaves": 8}
+    bst = lgb.train(dict(p, saved_feature_importance_type=1), ds, 3,
+                    keep_training_booster=True)
+    s_gain = bst._gbdt.save_model_to_string()
+    s_split = bst._gbdt.save_model_to_string(importance_type="split")
+    assert s_gain != s_split
+    gain = bst.feature_importance("gain")
+    top = int(np.argmax(gain))
+    name = f"Column_{top}"
+    line = [ln for ln in s_gain.splitlines() if ln.startswith(name + "=")][0]
+    assert abs(float(line.split("=")[1]) - gain[top]) / max(gain[top], 1) < 1e-4
+
+
+def test_na_params_warn():
+    logs = []
+    lgb.register_logger(type("L", (), {
+        "info": staticmethod(lambda m: logs.append(("i", m))),
+        "warning": staticmethod(lambda m: logs.append(("w", m))),
+    })())
+    try:
+        X, y = _data(n=500)
+        ds = lgb.Dataset(X, label=y)
+        lgb.train({"objective": "regression", "verbosity": 2,
+                   "force_col_wise": True, "num_gpu": 4,
+                   "histogram_pool_size": 128.0}, ds, 1)
+    finally:
+        lgb.register_logger(None)
+    warned = " ".join(m for lv, m in logs if lv == "w")
+    assert "force_col_wise" in warned
+    assert "num_gpu" in warned
+    assert "histogram_pool_size" in warned
