@@ -296,11 +296,19 @@ class GBDT:
         if self.cfg.bagging_by_query and getattr(train_set, "query_boundaries", None) is None:
             log_warning("bagging_by_query is set but the dataset has no "
                         "query groups; falling back to row-wise bagging")
-        if self.cfg.forcedsplits_filename and self._use_fast:
+        if (
+            self.cfg.forcedsplits_filename
+            and self.cfg.tree_learner != "serial"
+            and jax.device_count() > 1
+        ):
+            # the distributed wrappers (parallel/{data,feature}_parallel.py)
+            # do not thread the forced schedule; warn instead of silently
+            # dropping it (single-device runs fall back to the serial
+            # growers, which DO apply it in both growth modes)
             log_warning(
-                "forcedsplits_filename is honored by the strict grower only; "
-                "the rounds grower (tree_growth_mode=rounds, the TPU default) "
-                "IGNORES it — set tree_growth_mode=strict to force splits."
+                "forcedsplits_filename is not applied by the distributed "
+                "tree learners (tree_learner=data/feature/voting on a "
+                "multi-device mesh); use tree_learner=serial to force splits."
             )
         if any(p != 0 for p in (self.cfg.cegb_penalty_feature_lazy or [])):
             log_warning(
@@ -678,6 +686,7 @@ class GBDT:
         bins_t = ts.bins_device_t() if self._on_tpu else None
         from ..ops.treegrow_fast import grow_tree_fast
 
+        fs = self._forced_schedule()
         grow_kwargs = dict(
             num_leaves=self.cfg.num_leaves,
             num_bins=ts.max_num_bins,
@@ -686,6 +695,7 @@ class GBDT:
             leaf_tile=self._leaf_tile(ts),
             hist_precision=self.cfg.hist_precision,
             use_pallas=self._on_tpu,
+            n_forced=(fs[3] if fs else 0),
         )
 
         use_goss = self._is_goss
@@ -731,6 +741,9 @@ class GBDT:
                     efb_tabs[2] if efb_tabs else None,
                     bins_t,
                     contri,
+                    fs[0] if fs else None,
+                    fs[1] if fs else None,
+                    fs[2] if fs else None,
                     **grow_kwargs,
                 )
                 row_delta = (arrays.leaf_value * shrinkage)[leaf_id]
@@ -923,6 +936,7 @@ class GBDT:
 
                 quant = self.cfg.use_quantized_grad
                 efb_tabs = ts.efb_device_tables() if getattr(ts, "efb", None) is not None else None
+                fs = self._forced_schedule()
                 arrays, leaf_id = grow_tree_fast(
                     ts.bins_device,
                     gc,
@@ -944,6 +958,10 @@ class GBDT:
                     efb_tabs[2] if efb_tabs else None,
                     ts.bins_device_t() if self._on_tpu else None,
                     self._feature_contri,
+                    fs[0] if fs else None,
+                    fs[1] if fs else None,
+                    fs[2] if fs else None,
+                    n_forced=(fs[3] if fs else 0),
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,

@@ -506,6 +506,51 @@ def find_best_split(
     feature_contri: jnp.ndarray | None = None,
 ) -> BestSplit:
     """gain_plane + select_from_plane (reference: FindBestThreshold)."""
+    return _plane_then_select(
+        hist, parent_sum_g, parent_sum_h, parent_count,
+        num_bins_per_feature, missing_bin_per_feature, params,
+        feature_mask, categorical_mask, monotone_constraints, out_lo, out_hi,
+        rng_key, depth, parent_output, cegb_feature_penalty, feature_contri,
+        cell=None,
+    )
+
+
+def forced_split_candidate(
+    hist: jnp.ndarray,  # (F, B, 3) — the target leaf's histograms
+    parent_sum_g, parent_sum_h, parent_count,
+    num_bins_per_feature, missing_bin_per_feature,
+    params: SplitParams,
+    forced_feature, forced_bin,  # scalars — the scheduled cell
+    categorical_mask=None, monotone_constraints=None,
+    out_lo=None, out_hi=None, depth=None, parent_output=None,
+    feature_contri=None,
+) -> BestSplit:
+    """Materialize a forced split (reference: SerialTreeLearner::ForceSplits
+    — the scheduled (feature, bin) cell is evaluated through the standard
+    gain machinery so min_data/min_hess/monotone gates still apply).  Shared
+    by the strict and rounds growers; validity = `gain > KMIN_SCORE / 2` on
+    the returned split, checked by the caller along with leaf/depth gates."""
+    f, b, _ = hist.shape
+    cell = (
+        (jnp.arange(f, dtype=jnp.int32)[:, None] == forced_feature)
+        & (jnp.arange(b, dtype=jnp.int32)[None, :] == forced_bin)
+    )
+    return _plane_then_select(
+        hist, parent_sum_g, parent_sum_h, parent_count,
+        num_bins_per_feature, missing_bin_per_feature, params,
+        None, categorical_mask, monotone_constraints, out_lo, out_hi,
+        None, depth, parent_output, None, feature_contri,
+        cell=cell,
+    )
+
+
+def _plane_then_select(
+    hist, parent_sum_g, parent_sum_h, parent_count,
+    num_bins_per_feature, missing_bin_per_feature, params,
+    feature_mask, categorical_mask, monotone_constraints, out_lo, out_hi,
+    rng_key, depth, parent_output, cegb_feature_penalty, feature_contri,
+    cell,
+) -> BestSplit:
     gain, ctx = gain_plane(
         hist, parent_sum_g, parent_sum_h, parent_count,
         num_bins_per_feature, missing_bin_per_feature, params,
@@ -520,4 +565,6 @@ def find_best_split(
         cegb_feature_penalty=cegb_feature_penalty,
         feature_contri=feature_contri,
     )
+    if cell is not None:
+        gain = jnp.where(cell, gain, KMIN_SCORE)
     return select_from_plane(gain, ctx)

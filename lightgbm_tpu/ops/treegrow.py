@@ -31,8 +31,9 @@ import jax.numpy as jnp
 
 from .histogram import histogram
 from .split import (
-    BestSplit, SplitParams, find_best_split, gain_plane, select_from_plane,
-    leaf_output, leaf_output_smoothed, KMIN_SCORE,
+    BestSplit, SplitParams, find_best_split, forced_split_candidate,
+    gain_plane, select_from_plane, leaf_output, leaf_output_smoothed,
+    KMIN_SCORE,
 )
 
 
@@ -367,24 +368,17 @@ def grow_tree(
         Returns (leaf, BestSplit, valid)."""
         fi = jnp.minimum(i, n_forced - 1)
         fl = jnp.clip(forced_leaf[fi], 0, L - 1)
-        ff = forced_feature[fi]
-        fb = forced_bin[fi]
-        plane, ctx = gain_plane(
+        s_f = forced_split_candidate(
             state.hist[fl], state.leaf_sum_g[fl], state.leaf_sum_h[fl],
             state.leaf_count[fl], num_bins_per_feature, missing_bin_per_feature,
-            params,
-            feature_mask=None, categorical_mask=categorical_mask,
+            params, forced_feature[fi], forced_bin[fi],
+            categorical_mask=categorical_mask,
             monotone_constraints=monotone_constraints,
             out_lo=state.leaf_out_lo[fl], out_hi=state.leaf_out_hi[fl],
-            rng_key=None, depth=state.leaf_depth[fl].astype(jnp.float32),
-            parent_output=state.leaf_out[fl], cegb_feature_penalty=None,
+            depth=state.leaf_depth[fl].astype(jnp.float32),
+            parent_output=state.leaf_out[fl],
             feature_contri=feature_contri,
         )
-        cell = (
-            (jnp.arange(f, dtype=jnp.int32)[:, None] == ff)
-            & (jnp.arange(num_bins, dtype=jnp.int32)[None, :] == fb)
-        )
-        s_f = select_from_plane(jnp.where(cell, plane, KMIN_SCORE), ctx)
         # valid = the forced leaf exists and the cell is a legal split
         valid = (forced_leaf[fi] < state.num_leaves_cur) & (s_f.gain > KMIN_SCORE / 2)
         if max_depth > 0:

@@ -40,7 +40,8 @@ import jax.numpy as jnp
 from .hist_pallas import histogram_pallas_multi, histogram_pallas_multi_quantized
 from .histogram import histogram, histogram_onehot_multi
 from .split import (
-    BestSplit, SplitParams, find_best_split, leaf_output, leaf_output_smoothed,
+    BestSplit, SplitParams, find_best_split, forced_split_candidate,
+    gain_plane, select_from_plane, leaf_output, leaf_output_smoothed,
     KMIN_SCORE,
 )
 from .treegrow import TreeArrays, _empty_best, _set_best
@@ -139,7 +140,7 @@ def _batched_best(
     static_argnames=(
         "num_leaves", "num_bins", "max_depth", "params", "axis_name",
         "leaf_tile", "hist_precision", "use_pallas", "quantize_bins",
-        "stochastic_rounding", "quant_renew", "track_path",
+        "stochastic_rounding", "quant_renew", "track_path", "n_forced",
     ),
 )
 def grow_tree_fast(
@@ -164,6 +165,9 @@ def grow_tree_fast(
     # per-feature column reads become contiguous row slices (measured:
     # 8 dynamic column slices of (N, F) cost ~1.1 ms/round on v5e)
     feature_contri: jnp.ndarray = None,  # (F,) split-gain multipliers
+    forced_leaf: jnp.ndarray = None,  # (K,) i32 — forced-split schedule
+    forced_feature: jnp.ndarray = None,  # (K,) i32   (reference: ForceSplits
+    forced_bin: jnp.ndarray = None,  # (K,) i32        from forcedsplits JSON)
     *,
     num_leaves: int,
     num_bins: int,
@@ -177,6 +181,7 @@ def grow_tree_fast(
     stochastic_rounding: bool = True,
     quant_renew: bool = False,
     track_path: bool = False,
+    n_forced: int = 0,
 ) -> tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree in rounds; returns (tree, final leaf_id per row).
 
@@ -364,25 +369,34 @@ def grow_tree_fast(
 
     eps = KMIN_SCORE / 2
 
-    def round_body(state: FastState) -> FastState:
+    def round_body(state: FastState, forced=None) -> FastState:
         # ---------- phase 1: accept splits for this round ----------
-        gains = state.best.gain  # (L,) KMIN for unevaluated/exhausted
-        can = gains > eps
-        if max_depth > 0:
-            can = can & (state.leaf_depth < max_depth)
-        budget = L - state.num_leaves_cur  # how many new leaves fit
-        # best-gain-first admission within budget, but at most leaf_tile
-        # splits per round (one multi-hist pass)
-        order_rank = jnp.argsort(jnp.argsort(jnp.where(can, -gains, jnp.inf)))
-        accept = can & (order_rank < jnp.minimum(budget, leaf_tile))
+        if forced is None:
+            gains = state.best.gain  # (L,) KMIN for unevaluated/exhausted
+            can = gains > eps
+            if max_depth > 0:
+                can = can & (state.leaf_depth < max_depth)
+            budget = L - state.num_leaves_cur  # how many new leaves fit
+            # best-gain-first admission within budget, but at most leaf_tile
+            # splits per round (one multi-hist pass)
+            order_rank = jnp.argsort(jnp.argsort(jnp.where(can, -gains, jnp.inf)))
+            accept = can & (order_rank < jnp.minimum(budget, leaf_tile))
+            s = state.best  # vectorized split info (L,)
+        else:
+            # forced round (reference: ForceSplits): admit EXACTLY the
+            # scheduled split so right-child numbering (split s -> leaf s+1)
+            # matches the precomputed schedule; state.best is preserved for
+            # the free-growth rounds that follow
+            f_leaf, s_f, f_valid = forced
+            accept = (jnp.arange(L, dtype=jnp.int32) == f_leaf) & f_valid
+            order_rank = jnp.where(accept, 0, L)
+            s = jax.tree.map(lambda b, v: b.at[f_leaf].set(v), state.best, s_f)
         k_acc = jnp.sum(accept.astype(jnp.int32))
 
         # per accepted leaf: new node slot + right-child leaf id, ordered by rank
         acc_rank = jnp.where(accept, order_rank, L)  # (L,)
         node_of = state.num_leaves_cur - 1 + acc_rank  # node slot (valid where accept)
         right_of = state.num_leaves_cur + acc_rank  # right-child leaf id
-
-        s = state.best  # vectorized split info (L,)
 
         # ---------- row partition: all accepted splits at once ----------
         # Loop over the <= leaf_tile accepted slots with dynamic-slice COLUMN
@@ -624,6 +638,47 @@ def grow_tree_fast(
         return jax.lax.cond(
             state.progress, hist_and_eval, lambda st: st, state
         )
+
+    if n_forced > 0:
+        # forced prefix (reference: SerialTreeLearner::ForceSplits): one
+        # single-split round per schedule entry, BEFORE gain-driven growth.
+        # The candidate is evaluated through the standard gain plane masked
+        # to the scheduled (feature, bin) cell, so min_data/min_hess/monotone
+        # gates apply; the first invalid entry disables the rest (the
+        # schedule's leaf ids assume every prior entry applied).
+        def forced_candidate(state: FastState, i: int):
+            fl = jnp.clip(forced_leaf[i], 0, L - 1)
+            s_f = forced_split_candidate(
+                state.hist[fl], state.leaf_sum_g[fl], state.leaf_sum_h[fl],
+                state.leaf_count[fl], num_bins_per_feature,
+                missing_bin_per_feature, params,
+                forced_feature[i], forced_bin[i],
+                categorical_mask=categorical_mask,
+                monotone_constraints=monotone_constraints,
+                out_lo=state.leaf_out_lo[fl], out_hi=state.leaf_out_hi[fl],
+                depth=state.leaf_depth[fl].astype(jnp.float32),
+                parent_output=state.leaf_out[fl],
+                feature_contri=feature_contri,
+            )
+            valid = (
+                (forced_leaf[i] < state.num_leaves_cur)
+                & (state.num_leaves_cur < L)
+                & (s_f.gain > KMIN_SCORE / 2)
+            )
+            if max_depth > 0:
+                valid = valid & (state.leaf_depth[fl] < max_depth)
+            return fl, s_f, valid
+
+        forced_ok = jnp.asarray(True)
+        for i in range(n_forced):
+            fl, s_f, valid = forced_candidate(state, i)
+            valid = valid & forced_ok
+            forced_ok = valid
+            state = round_body(state, forced=(fl, s_f, valid))
+            state = jax.lax.cond(state.progress, hist_and_eval,
+                                 lambda st: st, state)
+        # a rejected forced entry leaves progress=False; free growth still runs
+        state = state._replace(progress=jnp.asarray(True))
 
     state = jax.lax.while_loop(cond, body, state)
 

@@ -11,7 +11,7 @@ import pytest
 import lightgbm_tpu as lgb
 
 
-def _train(forced, n=2000, num_leaves=8, extra=None):
+def _train(forced, n=2000, num_leaves=8, extra=None, mode="strict"):
     rng = np.random.RandomState(0)
     X = rng.randn(n, 4)
     # signal on feature 0 so free growth would NEVER pick feature 2 first
@@ -21,7 +21,7 @@ def _train(forced, n=2000, num_leaves=8, extra=None):
         path = f.name
     try:
         params = {"objective": "regression", "num_leaves": num_leaves,
-                  "verbosity": -1, "tree_growth_mode": "strict",
+                  "verbosity": -1, "tree_growth_mode": mode,
                   "forcedsplits_filename": path}
         params.update(extra or {})
         d = lgb.Dataset(X, label=y)
@@ -31,19 +31,21 @@ def _train(forced, n=2000, num_leaves=8, extra=None):
         os.unlink(path)
 
 
-def test_forced_root_split():
-    root = _train({"feature": 2, "threshold": 0.5})
+@pytest.mark.parametrize("mode", ["strict", "rounds"])
+def test_forced_root_split(mode):
+    root = _train({"feature": 2, "threshold": 0.5}, mode=mode)
     assert root["split_feature"] == 2
     assert root["threshold"] == pytest.approx(0.5, abs=0.2)  # bin upper bound
 
 
-def test_forced_nested_splits():
+@pytest.mark.parametrize("mode", ["strict", "rounds"])
+def test_forced_nested_splits(mode):
     forced = {
         "feature": 2, "threshold": 0.0,
         "left": {"feature": 3, "threshold": -0.5},
         "right": {"feature": 1, "threshold": 0.75},
     }
-    root = _train(forced)
+    root = _train(forced, mode=mode)
     assert root["split_feature"] == 2
     assert root["left_child"]["split_feature"] == 3
     assert root["right_child"]["split_feature"] == 1
@@ -56,14 +58,16 @@ def test_forced_nested_splits():
     assert 0 in features(root)
 
 
-def test_invalid_forced_split_skipped():
+@pytest.mark.parametrize("mode", ["strict", "rounds"])
+def test_invalid_forced_split_skipped(mode):
     # threshold far outside the data range: one side empty -> the forced
     # split is invalid and normal growth takes over (reference skips it)
-    root = _train({"feature": 2, "threshold": 1e9})
+    root = _train({"feature": 2, "threshold": 1e9}, mode=mode)
     assert root["split_feature"] == 0  # the gain-driven choice
 
 
-def test_invalid_forced_split_disables_rest():
+@pytest.mark.parametrize("mode", ["strict", "rounds"])
+def test_invalid_forced_split_disables_rest(mode):
     """The first invalid forced entry must disable ALL remaining entries
     (reference: ForceSplits stops applying the prefix at the first invalid
     split) — the precomputed schedule's leaf ids assume every prior entry
@@ -83,7 +87,7 @@ def test_invalid_forced_split_disables_rest():
         d = lgb.Dataset(X, label=y)
         bst = lgb.train(
             {"objective": "regression", "num_leaves": 3, "verbosity": -1,
-             "tree_growth_mode": "strict", "forcedsplits_filename": path},
+             "tree_growth_mode": mode, "forcedsplits_filename": path},
             d, num_boost_round=1)
         root = bst.dump_model()["tree_info"][0]["tree_structure"]
 
