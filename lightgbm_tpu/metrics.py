@@ -85,6 +85,19 @@ class Metric:
     def eval(self, pred, label, weight, query_boundaries=None) -> List[Tuple[str, float, bool]]:
         raise NotImplementedError
 
+    # device evaluation protocol (reference: src/metric/cuda/*): metrics
+    # returning True from supports_device are evaluated INSIDE one jit per
+    # eval set (gbdt.eval_at) — only a scalar crosses to the host, never the
+    # (N,) score vector.  `device_eval` returns the pre-`transform` value.
+    def supports_device(self, num_class: int) -> bool:
+        return False
+
+    def device_eval(self, pred, label, weight):
+        raise NotImplementedError
+
+    def transform(self, v: float) -> float:
+        return v
+
 
 def _wmean(vals, weight):
     if weight is None:
@@ -93,7 +106,12 @@ def _wmean(vals, weight):
 
 
 class _Pointwise(Metric):
-    def point(self, pred, label):
+    """Pointwise metrics share one elementwise `point` function written
+    against an array namespace (numpy on host, jax.numpy on device) so the
+    device evaluator (reference: src/metric/cuda/cuda_pointwise_metric.cu)
+    and the host path cannot diverge."""
+
+    def point(self, pred, label, xp=np):
         raise NotImplementedError
 
     def transform(self, v: float) -> float:
@@ -103,11 +121,24 @@ class _Pointwise(Metric):
         v = self.transform(_wmean(self.point(np.asarray(pred), np.asarray(label)), weight))
         return [(self.name, v, self.is_higher_better)]
 
+    def supports_device(self, num_class: int) -> bool:
+        return num_class == 1
+
+    def device_eval(self, pred, label, weight):
+        """Weighted mean of `point` as a traced scalar; `transform` is
+        applied host-side to the fetched value."""
+        import jax.numpy as jnp
+
+        v = self.point(pred, label, xp=jnp)
+        if weight is None:
+            return jnp.sum(v) / v.shape[0]
+        return jnp.sum(v * weight) / jnp.sum(weight)
+
 
 class L2Metric(_Pointwise):
     name = "l2"
 
-    def point(self, p, y):
+    def point(self, p, y, xp=np):
         return (p - y) ** 2
 
 
@@ -121,95 +152,122 @@ class RMSEMetric(L2Metric):
 class L1Metric(_Pointwise):
     name = "l1"
 
-    def point(self, p, y):
-        return np.abs(p - y)
+    def point(self, p, y, xp=np):
+        return xp.abs(p - y)
 
 
 class QuantileMetric(_Pointwise):
     name = "quantile"
 
-    def point(self, p, y):
+    def point(self, p, y, xp=np):
         a = self.cfg.alpha
         d = y - p
-        return np.where(d >= 0, a * d, (a - 1.0) * d)
+        return xp.where(d >= 0, a * d, (a - 1.0) * d)
 
 
 class HuberMetric(_Pointwise):
     name = "huber"
 
-    def point(self, p, y):
+    def point(self, p, y, xp=np):
         a = self.cfg.alpha
-        d = np.abs(p - y)
-        return np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
+        d = xp.abs(p - y)
+        return xp.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
 
 
 class FairMetric(_Pointwise):
     name = "fair"
 
-    def point(self, p, y):
+    def point(self, p, y, xp=np):
         c = self.cfg.fair_c
-        x = np.abs(p - y)
-        return c * x - c * c * np.log1p(x / c)
+        x = xp.abs(p - y)
+        return c * x - c * c * xp.log1p(x / c)
 
 
 class PoissonMetric(_Pointwise):
     name = "poisson"
 
-    def point(self, p, y):
+    def point(self, p, y, xp=np):
         eps = 1e-10
-        lp = np.log(np.maximum(p, eps))
+        lp = xp.log(xp.maximum(p, eps))
         return p - y * lp
 
 
 class GammaMetric(_Pointwise):
     name = "gamma"
 
-    def point(self, p, y):
+    def point(self, p, y, xp=np):
         eps = 1e-10
-        x = np.maximum(p, eps)
-        return y / x + np.log(x)
+        x = xp.maximum(p, eps)
+        return y / x + xp.log(x)
 
 
 class GammaDevianceMetric(_Pointwise):
     name = "gamma_deviance"
 
-    def point(self, p, y):
+    def point(self, p, y, xp=np):
         eps = 1e-10
-        r = y / np.maximum(p, eps)
-        return 2.0 * (np.log(np.maximum(1.0 / np.maximum(r, eps), eps)) + r - 1.0)
+        r = y / xp.maximum(p, eps)
+        return 2.0 * (xp.log(xp.maximum(1.0 / xp.maximum(r, eps), eps)) + r - 1.0)
 
 
 class TweedieMetric(_Pointwise):
     name = "tweedie"
 
-    def point(self, p, y):
+    def point(self, p, y, xp=np):
         rho = self.cfg.tweedie_variance_power
         eps = 1e-10
-        x = np.maximum(p, eps)
-        return -y * np.power(x, 1 - rho) / (1 - rho) + np.power(x, 2 - rho) / (2 - rho)
+        x = xp.maximum(p, eps)
+        return -y * xp.power(x, 1 - rho) / (1 - rho) + xp.power(x, 2 - rho) / (2 - rho)
 
 
 class MAPEMetric(_Pointwise):
     name = "mape"
 
-    def point(self, p, y):
-        return np.abs(p - y) / np.maximum(1.0, np.abs(y))
+    def point(self, p, y, xp=np):
+        return xp.abs(p - y) / xp.maximum(1.0, xp.abs(y))
 
 
 class BinaryLoglossMetric(_Pointwise):
     name = "binary_logloss"
 
-    def point(self, p, y):
-        p = np.clip(p, EPS, 1 - EPS)
-        yy = (y > 0).astype(np.float64)
-        return -(yy * np.log(p) + (1 - yy) * np.log(1 - p))
+    def point(self, p, y, xp=np):
+        p = xp.clip(p, EPS, 1 - EPS)
+        yy = (y > 0).astype(p.dtype)
+        return -(yy * xp.log(p) + (1 - yy) * xp.log(1 - p))
 
 
 class BinaryErrorMetric(_Pointwise):
     name = "binary_error"
 
-    def point(self, p, y):
-        return ((p > 0.5) != (y > 0)).astype(np.float64)
+    def point(self, p, y, xp=np):
+        return ((p > 0.5) != (y > 0)).astype(p.dtype)
+
+
+def _auc_device(scores, labels, weights):
+    """jnp mirror of _auc: tie-grouped weighted rank statistic using
+    fixed-shape segment sums (group count bounded by N)."""
+    import jax.numpy as jnp
+
+    n = scores.shape[0]
+    order = jnp.argsort(scores, stable=True)
+    s = scores[order]
+    y = labels[order]
+    w = jnp.ones_like(s) if weights is None else weights[order].astype(s.dtype)
+    pos_w = jnp.where(y > 0, w, 0.0)
+    neg_w = w - pos_w
+    new_grp = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    gid = jnp.cumsum(new_grp.astype(jnp.int32)) - 1  # (N,) 0-based group ids
+    grp_neg = jnp.zeros((n,), s.dtype).at[gid].add(neg_w)
+    grp_pos = jnp.zeros((n,), s.dtype).at[gid].add(pos_w)
+    cum_neg_before = jnp.concatenate(
+        [jnp.zeros((1,), s.dtype), jnp.cumsum(grp_neg)[:-1]]
+    )
+    tot_pos, tot_neg = jnp.sum(pos_w), jnp.sum(neg_w)
+    auc = jnp.sum(grp_pos * (cum_neg_before + 0.5 * grp_neg))
+    return jnp.where(
+        (tot_pos == 0) | (tot_neg == 0), 1.0,
+        auc / jnp.maximum(tot_pos * tot_neg, 1e-30),
+    )
 
 
 class AUCMetric(Metric):
@@ -219,13 +277,19 @@ class AUCMetric(Metric):
     def eval(self, pred, label, weight, query_boundaries=None):
         return [(self.name, _auc(np.asarray(pred), np.asarray(label), weight), True)]
 
+    def supports_device(self, num_class: int) -> bool:
+        return num_class == 1
+
+    def device_eval(self, pred, label, weight):
+        return _auc_device(pred, label, weight)
+
 
 class CrossEntropyMetric(_Pointwise):
     name = "cross_entropy"
 
-    def point(self, p, y):
-        p = np.clip(p, EPS, 1 - EPS)
-        return -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    def point(self, p, y, xp=np):
+        p = xp.clip(p, EPS, 1 - EPS)
+        return -(y * xp.log(p) + (1 - y) * xp.log(1 - p))
 
 
 class XentLambdaMetric(Metric):
@@ -298,6 +362,19 @@ class MultiLoglossMetric(Metric):
         probs = np.clip(p[np.arange(len(y)), y], EPS, None)
         return [(self.name, _wmean(-np.log(probs), weight), False)]
 
+    def supports_device(self, num_class: int) -> bool:
+        return num_class > 1
+
+    def device_eval(self, pred, label, weight):
+        import jax.numpy as jnp
+
+        y = label.astype(jnp.int32)
+        probs = jnp.take_along_axis(pred, y[:, None], axis=1)[:, 0]
+        v = -jnp.log(jnp.clip(probs, EPS, None))
+        if weight is None:
+            return jnp.sum(v) / v.shape[0]
+        return jnp.sum(v * weight) / jnp.sum(weight)
+
 
 class MultiErrorMetric(Metric):
     name = "multi_error"
@@ -312,6 +389,24 @@ class MultiErrorMetric(Metric):
             topk = np.argsort(-p, axis=1)[:, :k]
             err = 1.0 - (topk == y[:, None]).any(axis=1).astype(np.float64)
         return [(self.name, _wmean(err, weight), False)]
+
+    def supports_device(self, num_class: int) -> bool:
+        return num_class > 1
+
+    def device_eval(self, pred, label, weight):
+        import jax
+        import jax.numpy as jnp
+
+        y = label.astype(jnp.int32)
+        k = self.cfg.multi_error_top_k
+        if k <= 1:
+            err = (jnp.argmax(pred, axis=1) != y).astype(jnp.float32)
+        else:
+            _, topk = jax.lax.top_k(pred, min(k, pred.shape[1]))
+            err = 1.0 - jnp.any(topk == y[:, None], axis=1).astype(jnp.float32)
+        if weight is None:
+            return jnp.sum(err) / err.shape[0]
+        return jnp.sum(err * weight) / jnp.sum(weight)
 
 
 class NDCGMetric(Metric):

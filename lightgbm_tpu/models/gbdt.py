@@ -108,6 +108,8 @@ class GBDT:
         self._fused_step = None
         self._nobag_cache = None
         self._forced_cache = None
+        self._eval_jit_cache = None
+        self._finish_probe = None
         if self.cfg.num_machines > 1:
             # multi-host bring-up (reference: Network::Init from machine
             # list).  MUST run before the first JAX computation — so before
@@ -695,7 +697,9 @@ class GBDT:
             leaf_tile=self._leaf_tile(ts),
             hist_precision=self.cfg.hist_precision,
             use_pallas=self._on_tpu,
-            n_forced=(fs[3] if fs else 0),
+            # entries past num_leaves-1 can never apply; clamping avoids
+            # unrolling dead traced rounds
+            n_forced=(min(fs[3], self.cfg.num_leaves - 1) if fs else 0),
         )
 
         use_goss = self._is_goss
@@ -961,7 +965,7 @@ class GBDT:
                     fs[0] if fs else None,
                     fs[1] if fs else None,
                     fs[2] if fs else None,
-                    n_forced=(fs[3] if fs else 0),
+                    n_forced=(min(fs[3], self.cfg.num_leaves - 1) if fs else 0),
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
@@ -1181,6 +1185,41 @@ class GBDT:
         raw sums during training)."""
         return score
 
+    _eval_jit_cache = None
+
+    def _device_evaluator(self, data_idx: int, ds, dev_metrics):
+        """One jit per eval set covering every device-capable metric
+        (reference: the CUDA build's device metric reductions,
+        src/metric/cuda/cuda_pointwise_metric.cu).  convert_output runs
+        in-trace; only len(dev_metrics) scalars cross to the host."""
+        if self._eval_jit_cache is None:
+            self._eval_jit_cache = {}
+        key = (data_idx, tuple(type(m) for m in dev_metrics), ds.weight is None)
+        hit = self._eval_jit_cache.get(key)
+        if hit is not None:
+            return hit
+        obj = self.objective
+        if data_idx == 0 and self._label is not None:
+            # the training labels/weights already live on device
+            label_dev, weight_dev = self._label, self._weight
+        else:
+            label_dev = jnp.asarray(np.asarray(ds.label))
+            weight_dev = None if ds.weight is None else jnp.asarray(
+                np.asarray(ds.weight), jnp.float32
+            )
+
+        @jax.jit
+        def run(margin, label, weight):
+            pred = obj.convert_output(margin) if obj is not None else margin
+            return jnp.stack([
+                jnp.asarray(m.device_eval(pred, label, weight), jnp.float32)
+                for m in dev_metrics
+            ])
+
+        entry = (run, label_dev, weight_dev)
+        self._eval_jit_cache[key] = entry
+        return entry
+
     def eval_at(self, data_idx: int) -> List[Tuple[str, str, float, bool]]:
         """data_idx 0 = training, 1.. = valid sets (reference: GBDT::GetEvalAt).
         Returns (dataset_name, metric_name, value, is_higher_better)."""
@@ -1190,12 +1229,33 @@ class GBDT:
             ds = self.valid_sets[data_idx - 1]
             score = self._valid_scores[data_idx - 1]
             name = self.valid_names[data_idx - 1]
-        pred = self._converted(self._eval_margin(score))
-        label = np.asarray(ds.label)
-        weight = None if ds.weight is None else np.asarray(ds.weight)
+        k = self.num_tree_per_iteration
+        dev_metrics = [
+            m for m in self.metrics
+            if self.objective is not None and m.supports_device(k)
+        ]
+        host_metrics = [m for m in self.metrics if m not in dev_metrics]
+        out_by_metric = {}
+        if dev_metrics:
+            run, label_dev, weight_dev = self._device_evaluator(
+                data_idx, ds, dev_metrics
+            )
+            vals = np.asarray(run(self._eval_margin(score), label_dev, weight_dev))
+            for m, v in zip(dev_metrics, vals):
+                out_by_metric[id(m)] = [
+                    (m.name, m.transform(float(v)), m.is_higher_better)
+                ]
+        if host_metrics:
+            pred = self._converted(self._eval_margin(score))
+            label = np.asarray(ds.label)
+            weight = None if ds.weight is None else np.asarray(ds.weight)
+            for m in host_metrics:
+                out_by_metric[id(m)] = m.eval(
+                    pred, label, weight, ds.query_boundaries
+                )
         out = []
-        for m in self.metrics:
-            for mn, v, hib in m.eval(pred, label, weight, ds.query_boundaries):
+        for m in self.metrics:  # preserve configured metric order
+            for mn, v, hib in out_by_metric[id(m)]:
                 out.append((name, mn, v, hib))
         return out
 

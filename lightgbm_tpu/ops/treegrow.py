@@ -32,8 +32,7 @@ import jax.numpy as jnp
 from .histogram import histogram
 from .split import (
     BestSplit, SplitParams, find_best_split, forced_split_candidate,
-    gain_plane, select_from_plane, leaf_output, leaf_output_smoothed,
-    KMIN_SCORE,
+    gain_plane, leaf_output, leaf_output_smoothed, KMIN_SCORE,
 )
 
 

@@ -41,8 +41,7 @@ from .hist_pallas import histogram_pallas_multi, histogram_pallas_multi_quantize
 from .histogram import histogram, histogram_onehot_multi
 from .split import (
     BestSplit, SplitParams, find_best_split, forced_split_candidate,
-    gain_plane, select_from_plane, leaf_output, leaf_output_smoothed,
-    KMIN_SCORE,
+    leaf_output, leaf_output_smoothed, KMIN_SCORE,
 )
 from .treegrow import TreeArrays, _empty_best, _set_best
 
