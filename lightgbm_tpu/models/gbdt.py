@@ -318,13 +318,23 @@ class GBDT:
                 "charge state); coupled + split penalties are. The lazy "
                 "penalty is IGNORED."
             )
-        if self._monotone is not None and self.cfg.monotone_constraints_method in (
-            "intermediate", "advanced"
-        ):
-            log_warning(
-                f"monotone_constraints_method={self.cfg.monotone_constraints_method!r} "
-                "is not implemented; falling back to 'basic'."
-            )
+        if self._monotone is not None:
+            mmethod = self.cfg.monotone_constraints_method
+            if mmethod == "advanced":
+                log_warning(
+                    "monotone_constraints_method='advanced' is not "
+                    "implemented; using 'intermediate'."
+                )
+            if mmethod in ("intermediate", "advanced") and (
+                self._use_fast
+                or (self.cfg.tree_learner != "serial" and jax.device_count() > 1)
+            ):
+                log_warning(
+                    "monotone intermediate bounds are implemented on the "
+                    "serial strict grower (tree_growth_mode=strict); this "
+                    "configuration falls back to 'basic' — still monotone, "
+                    "more conservative splits."
+                )
         self._linear = bool(self.cfg.linear_tree) and self.cfg.tree_learner == "serial"
         if self.cfg.linear_tree and not self._linear:
             log_warning(
@@ -1012,6 +1022,12 @@ class GBDT:
                     hist_strategy="auto",
                     track_path=self._linear,
                     n_forced=(fs[3] if fs else 0),
+                    monotone_method=(
+                        "intermediate"
+                        if self.cfg.monotone_constraints_method
+                        in ("intermediate", "advanced")
+                        else "basic"
+                    ),
                 )
             linear_fit = None
             if self._linear and arrays.path_features is not None:

@@ -85,6 +85,9 @@ class GrowState(NamedTuple):
     # (reference: ForceSplits stops at the FIRST invalid forced split; the
     # precomputed schedule's leaf ids assume every prior entry applied, so a
     # rejected entry must disable all later ones, not just itself)
+    anc: jnp.ndarray = False  # (L, L-1) bool ancestor masks, or () placeholder
+    aside: jnp.ndarray = False  # (L, L-1) bool — leaf on the RIGHT side of m
+    # (maintained only for monotone_method="intermediate")
 
 
 def _empty_best(num_leaves: int, num_bins: int) -> BestSplit:
@@ -110,6 +113,41 @@ def _set_best(best: BestSplit, i: jnp.ndarray, s: BestSplit) -> BestSplit:
     return BestSplit(*[arr.at[i].set(v) for arr, v in zip(best, s)])
 
 
+def _intermediate_bounds(anc, aside, tree, monotone_constraints, leaf_out,
+                         n_live, L):
+    """Monotone 'intermediate' bounds (reference: monotone_constraints.hpp ->
+    IntermediateLeafConstraints): instead of compounding midpoint fences
+    (basic), each leaf is bounded by the ACTUAL output extremes of the
+    opposite subtree at every monotone ancestor — sound under sequential
+    splits because a new leaf respects all existing opposite-side leaves and
+    future opposite-side leaves respect it in turn.
+
+    anc/aside: (L, L-1) ancestor masks (aside = leaf on the right side).
+    Returns (lo, hi) of shape (L,)."""
+    live = (jnp.arange(L, dtype=jnp.int32) < n_live)[:, None]  # (L, 1)
+    left_m = anc & ~aside & live  # (L, M) leaf ℓ lives in m's left subtree
+    right_m = anc & aside & live
+    o = leaf_out[:, None]
+    ninf, pinf = -jnp.inf, jnp.inf
+    l_max = jnp.max(jnp.where(left_m, o, ninf), axis=0)  # (M,)
+    l_min = jnp.min(jnp.where(left_m, o, pinf), axis=0)
+    r_max = jnp.max(jnp.where(right_m, o, ninf), axis=0)
+    r_min = jnp.min(jnp.where(right_m, o, pinf), axis=0)
+    d = jnp.where(tree.is_cat, 0, monotone_constraints[tree.split_feature])  # (M,)
+    # d=+1 (non-decreasing): right-side leaves >= max(left outputs),
+    #                        left-side leaves <= min(right outputs)
+    # d=-1 mirrored
+    lo_c = jnp.maximum(
+        jnp.where(right_m & (d > 0)[None, :], l_max[None, :], ninf),
+        jnp.where(left_m & (d < 0)[None, :], r_max[None, :], ninf),
+    )
+    hi_c = jnp.minimum(
+        jnp.where(left_m & (d > 0)[None, :], r_min[None, :], pinf),
+        jnp.where(right_m & (d < 0)[None, :], l_min[None, :], pinf),
+    )
+    return jnp.max(lo_c, axis=1), jnp.min(hi_c, axis=1)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -123,6 +161,7 @@ def _set_best(best: BestSplit, i: jnp.ndarray, s: BestSplit) -> BestSplit:
         "top_k",
         "track_path",
         "n_forced",
+        "monotone_method",
     ),
 )
 def grow_tree(
@@ -154,6 +193,7 @@ def grow_tree(
     top_k: int = 20,  # voting mode: per-shard feature votes (reference: top_k)
     track_path: bool = False,  # maintain per-leaf path features (linear trees)
     n_forced: int = 0,
+    monotone_method: str = "basic",  # basic | intermediate (serial mode only)
 ) -> tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -167,6 +207,11 @@ def grow_tree(
     hess = hess.astype(jnp.float32) * sample_weight
     L = num_leaves
     mode = parallel_mode if axis_name is not None else "serial"
+    use_intermediate = (
+        monotone_method == "intermediate"
+        and monotone_constraints is not None
+        and mode == "serial"
+    )
 
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -358,6 +403,10 @@ def grow_tree(
         ),
         tree=tree0,
         forced_active=jnp.asarray(True),
+        anc=(jnp.zeros((L, L - 1), bool) if use_intermediate
+             else jnp.zeros((), bool)),
+        aside=(jnp.zeros((L, L - 1), bool) if use_intermediate
+               else jnp.zeros((), bool)),
     )
 
     def _forced_candidate(state: GrowState, i):
@@ -514,6 +563,22 @@ def grow_tree(
         leaf_out_hi = state.leaf_out_hi.at[best_leaf].set(l_hi).at[new_leaf].set(r_hi)
         leaf_out = state.leaf_out.at[best_leaf].set(out_l_c).at[new_leaf].set(out_r_c)
 
+        if use_intermediate:
+            # maintain ancestor masks and recompute EVERY leaf's bounds from
+            # the opposite-subtree output extremes (reference:
+            # IntermediateLeafConstraints — looser than compounded midpoints)
+            anc_child = state.anc[best_leaf].at[node].set(True)
+            aside_l = state.aside[best_leaf]
+            aside_r = aside_l.at[node].set(True)
+            anc = state.anc.at[best_leaf].set(anc_child).at[new_leaf].set(anc_child)
+            aside = state.aside.at[best_leaf].set(aside_l).at[new_leaf].set(aside_r)
+            leaf_out_lo, leaf_out_hi = _intermediate_bounds(
+                anc, aside, tree, monotone_constraints, leaf_out,
+                state.num_leaves_cur + 1, L,
+            )
+        else:
+            anc, aside = state.anc, state.aside
+
         if interaction_sets is not None or track_path:
             if mode == "feature":
                 ax = jax.lax.axis_index(axis_name)
@@ -535,13 +600,36 @@ def grow_tree(
             used_child = None
 
         # --- best splits for the two fresh leaves ---
-        bl = best_for(hist_left, s.left_sum_g, s.left_sum_h, s.left_count, depth_child,
-                      out_lo=l_lo, out_hi=l_hi, used=used_child, node_id=2 * node + 1,
-                      parent_out=out_l_c, cegb_used=cegb_used)
-        br = best_for(hist_right, s.right_sum_g, s.right_sum_h, s.right_count, depth_child,
-                      out_lo=r_lo, out_hi=r_hi, used=used_child, node_id=2 * node + 2,
-                      parent_out=out_r_c, cegb_used=cegb_used)
-        best = _set_best(_set_best(state.best, best_leaf, bl), new_leaf, br)
+        if use_intermediate:
+            # bounds of OTHER leaves may have moved (their opposite subtree
+            # changed), so their cached best splits are stale — re-evaluate
+            # every live leaf (reference: IntermediateLeafConstraints'
+            # leaves_to_update recompute set; here the vectorized plane makes
+            # recompute-all the simpler exact equivalent)
+            node_ids_all = jnp.clip(leaf_parent, 0, None) * 2 + leaf_side + 1
+            used_all = used_features if interaction_sets is not None else None
+
+            def one(hist_l, g, h, c, dep, lo, hi, nid, pout, u):
+                return best_for(hist_l, g, h, c, dep, out_lo=lo, out_hi=hi,
+                                used=u, node_id=nid, parent_out=pout,
+                                cegb_used=cegb_used)
+
+            in_axes = (0, 0, 0, 0, 0, 0, 0, 0, 0,
+                       0 if used_all is not None else None)
+            bb = jax.vmap(one, in_axes=in_axes)(
+                hist, leaf_sum_g, leaf_sum_h, leaf_count, leaf_depth,
+                leaf_out_lo, leaf_out_hi, node_ids_all, leaf_out, used_all,
+            )
+            live_l = jnp.arange(L, dtype=jnp.int32) < (state.num_leaves_cur + 1)
+            best = bb._replace(gain=jnp.where(live_l, bb.gain, KMIN_SCORE))
+        else:
+            bl = best_for(hist_left, s.left_sum_g, s.left_sum_h, s.left_count, depth_child,
+                          out_lo=l_lo, out_hi=l_hi, used=used_child, node_id=2 * node + 1,
+                          parent_out=out_l_c, cegb_used=cegb_used)
+            br = best_for(hist_right, s.right_sum_g, s.right_sum_h, s.right_count, depth_child,
+                          out_lo=r_lo, out_hi=r_hi, used=used_child, node_id=2 * node + 2,
+                          parent_out=out_r_c, cegb_used=cegb_used)
+            best = _set_best(_set_best(state.best, best_leaf, bl), new_leaf, br)
 
         return GrowState(
             leaf_id=leaf_id,
@@ -561,6 +649,8 @@ def grow_tree(
             used_features=used_features,
             tree=tree,
             forced_active=state.forced_active,
+            anc=anc,
+            aside=aside,
         )
 
     def body(i, state: GrowState) -> GrowState:
@@ -586,8 +676,13 @@ def grow_tree(
 
     # finalize leaf values (reference: leaf outputs are computed during growth;
     # equivalent here since sums are exact)
-    if params.path_smooth > 0:
-        leaf_value = state.leaf_out  # smoothed (and monotone-clipped) at creation
+    if params.path_smooth > 0 or use_intermediate:
+        # smoothed / monotone-clipped AT CREATION.  With intermediate bounds
+        # this is required for correctness, not just convenience: bounds keep
+        # evolving after a leaf is created, and re-clipping raw outputs to the
+        # FINAL bounds can cross a monotone split (creation-time clips always
+        # satisfy the pairwise invariant).
+        leaf_value = state.leaf_out
     else:
         leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
         if monotone_constraints is not None:

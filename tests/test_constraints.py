@@ -149,3 +149,52 @@ def test_feature_fraction_bynode():
     )
     r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
     assert r2 > 0.6, r2
+
+
+def test_monotone_intermediate_monotone_and_looser_than_basic():
+    """VERDICT r2 item 9: intermediate bounds use opposite-subtree output
+    extremes instead of compounded midpoints (reference:
+    IntermediateLeafConstraints).  Fixture where basic over-constrains: the
+    left region's high plateau (8) exceeds basic's midpoint fence (~7) but
+    not the right subtree's minimum (10)."""
+    rng = np.random.RandomState(0)
+    n = 4000
+    x0, x1 = rng.randn(n), rng.randn(n)
+    y = np.where(x0 > 0, 10.0, np.where(x1 > 0, 8.0, 0.0)) + 0.01 * rng.randn(n)
+    X = np.c_[x0, x1]
+
+    def fit(method):
+        ds = lgb.Dataset(X, label=y)
+        return lgb.train(
+            {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+             "learning_rate": 1.0, "tree_growth_mode": "strict",
+             "monotone_constraints": [1, 0],
+             "monotone_constraints_method": method},
+            ds, 1)
+
+    basic, inter = fit("basic"), fit("intermediate")
+
+    # property: predictions non-decreasing in the constrained feature
+    xs = np.linspace(-3, 3, 201)
+    for bst in (basic, inter):
+        for x1v in (-1.5, 0.0, 1.5):
+            grid = np.c_[xs, np.full_like(xs, x1v)]
+            p = bst.predict(grid)
+            assert np.all(np.diff(p) >= -1e-6)
+
+    # intermediate must fit the fixture strictly better than basic
+    mse_b = float(np.mean((basic.predict(X) - y) ** 2))
+    mse_i = float(np.mean((inter.predict(X) - y) ** 2))
+    assert mse_i < mse_b * 0.8, (mse_i, mse_b)
+    # and its total split gain (summed over ALL nodes) is at least basic's
+    def total_gain(nd):
+        if "split_feature" not in nd:
+            return 0.0
+        return (nd.get("split_gain", 0.0)
+                + total_gain(nd["left_child"]) + total_gain(nd["right_child"]))
+
+    gain_b = sum(total_gain(t["tree_structure"])
+                 for t in basic.dump_model()["tree_info"])
+    gain_i = sum(total_gain(t["tree_structure"])
+                 for t in inter.dump_model()["tree_info"])
+    assert gain_i > gain_b
