@@ -30,6 +30,10 @@ class LightGBMError(Exception):
     """reference: LightGBMError in python-package/lightgbm/basic.py."""
 
 
+def _is_scipy_sparse(data) -> bool:
+    return hasattr(data, "tocsr") and hasattr(data, "toarray")
+
+
 def _to_2d_float(data) -> np.ndarray:
     """Accepts numpy arrays, pandas DataFrames (incl. category dtypes),
     scipy CSR/CSC matrices, Sequence objects, and lists thereof (reference:
@@ -59,7 +63,7 @@ def _to_2d_float(data) -> np.ndarray:
         return _to_2d_float(data)
     if hasattr(data, "values"):  # pandas series
         data = data.values
-    if hasattr(data, "tocsr") and hasattr(data, "toarray"):  # scipy sparse
+    if _is_scipy_sparse(data):
         data = data.toarray()
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
@@ -148,11 +152,26 @@ class Dataset:
             return self
         ref = reference if reference is not None else self.reference
         cfg = Config.from_dict(self.params)
-        raw = _to_2d_float(self.data)
+        # sparse inputs are binned straight from CSC (reference:
+        # src/io/sparse_bin.hpp — stored nonzeros + implicit zeros); only the
+        # compact binned matrix is materialized, never dense raw floats
+        sparse_csc = None
+        if _is_scipy_sparse(self.data) and cfg.is_enable_sparse:
+            if cfg.linear_tree:
+                raise LightGBMError(
+                    "linear_tree requires dense raw feature values; pass "
+                    "is_enable_sparse=False to densify explicitly"
+                )
+            sparse_csc = self.data.tocsc()
+            raw = None
+            num_feature = sparse_csc.shape[1]
+        else:
+            raw = _to_2d_float(self.data)
+            num_feature = raw.shape[1]
         self.feature_names = (
             list(self.feature_name)
             if isinstance(self.feature_name, (list, tuple))
-            else _feature_names_of(self.data, raw.shape[1])
+            else _feature_names_of(self.data, num_feature)
         )
         cats: Sequence[int] = ()
         if isinstance(self.categorical_feature, (list, tuple)):
@@ -177,8 +196,7 @@ class Dataset:
                         int(e["feature"]): [float(v) for v in e["bin_upper_bound"]]
                         for e in _json.load(fh)
                     }
-            self.binner = DatasetBinner.fit(
-                raw,
+            fit_kwargs = dict(
                 max_bin=cfg.max_bin,
                 min_data_in_bin=cfg.min_data_in_bin,
                 sample_cnt=cfg.bin_construct_sample_cnt,
@@ -189,7 +207,15 @@ class Dataset:
                 seed=cfg.data_random_seed,
                 forced_bins=forced_bins,
             )
-        self.bins = self.binner.transform(raw)
+            if sparse_csc is not None:
+                self.binner = DatasetBinner.fit_sparse(sparse_csc, **fit_kwargs)
+            else:
+                self.binner = DatasetBinner.fit(raw, **fit_kwargs)
+        self.bins = (
+            self.binner.transform_sparse(sparse_csc)
+            if sparse_csc is not None
+            else self.binner.transform(raw)
+        )
         # int16 on device: half the HBM of int32 at Epsilon scale (max_bin
         # caps at 65535 by far); compute casts per tile
         self.bins_device = jnp.asarray(self.bins, jnp.int16)
@@ -211,17 +237,36 @@ class Dataset:
         elif cfg.enable_bundle:
             from .io.efb import find_bundles
 
+            # bundle capacity uses the FULL max_bin budget, not the widest
+            # individual feature — one-hot blocks (2-bin features) must be
+            # able to pack ~max_bin features per bundle (reference:
+            # FeatureGroup bin counts exceed member features'); the histogram
+            # width is raised to the bundle capacity below
+            bundle_cap = max(self.max_num_bins, int(cfg.max_bin) + 1)
             self.efb = find_bundles(
                 self.bins,
                 self.binner.num_bins_per_feature,
-                self.max_num_bins,
+                bundle_cap,
                 categorical_mask=np.asarray(self.binner.categorical_mask),
                 seed=cfg.data_random_seed,
             )
-        self._num_data, self._num_feature = raw.shape
+            if self.efb is not None and self.efb.is_useful:
+                # histogram width follows the widest achieved column (the
+                # gather-table stride), not the packing capacity
+                self.max_num_bins = max(
+                    self.max_num_bins, int(self.efb.gather_idx.shape[1])
+                )
+        self._num_data, self._num_feature = (
+            sparse_csc.shape if sparse_csc is not None else raw.shape
+        )
         if cfg.linear_tree or (ref is not None and getattr(ref, "raw_device", None) is not None):
             # linear trees need raw feature values at fit/score time
             # (reference: linear_tree_learner.cpp keeps a raw-data view)
+            if sparse_csc is not None:
+                raise LightGBMError(
+                    "linear_tree requires dense raw feature values; pass "
+                    "is_enable_sparse=False to densify explicitly"
+                )
             self.raw_device = jnp.asarray(raw.astype(np.float32))
         if self.free_raw_data:
             self.data = None
@@ -717,6 +762,23 @@ class Booster:
     ) -> np.ndarray:
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if _is_scipy_sparse(data):
+            # bounded-memory sparse prediction: densify per row chunk only
+            # (reference: the CSR predict path never materializes the full
+            # dense matrix either).  Chunk rows from a byte budget so wide
+            # matrices stay bounded too.
+            chunk = max(256, int(512e6 // (max(data.shape[1], 1) * 8)))
+            if data.shape[0] > chunk:
+                csr = data.tocsr()
+                outs = []
+                for lo in range(0, csr.shape[0], chunk):
+                    outs.append(self.predict(
+                        csr[lo:lo + chunk], start_iteration=start_iteration,
+                        num_iteration=num_iteration, raw_score=raw_score,
+                        pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                        **kwargs,
+                    ))
+                return np.concatenate(outs, axis=0)
         X = _to_2d_float(data)
         n_feat = self.num_feature()
         if n_feat and X.shape[1] != n_feat and not kwargs.get("predict_disable_shape_check", False):

@@ -154,9 +154,15 @@ def find_bin(
     is_categorical: bool = False,
     min_data_per_group: int = 100,
     forced_bounds: Sequence[float] = (),
+    num_implicit_zeros: int = 0,
 ) -> BinMapper:
     """Construct a BinMapper from (a sample of) one feature's values
-    (reference: BinMapper::FindBin in src/io/bin.cpp)."""
+    (reference: BinMapper::FindBin in src/io/bin.cpp).
+
+    num_implicit_zeros: count of exact-0.0 values NOT present in `values` —
+    the sparse-ingestion path passes only a column's stored (nonzero) entries
+    plus this count, mirroring the reference's FindBin(total_sample_cnt >
+    len(values)) contract for SparseBin construction."""
     values = np.asarray(values, dtype=np.float64).ravel()
     nan_mask = np.isnan(values)
     has_nan = bool(nan_mask.any())
@@ -164,6 +170,14 @@ def find_bin(
     if is_categorical:
         clean = values[~nan_mask].astype(np.int64)
         cats, counts = np.unique(clean, return_counts=True)
+        if num_implicit_zeros > 0:
+            zi = np.searchsorted(cats, 0)
+            if zi < len(cats) and cats[zi] == 0:
+                counts = counts.copy()
+                counts[zi] += num_implicit_zeros
+            else:
+                cats = np.insert(cats, zi, 0)
+                counts = np.insert(counts, zi, num_implicit_zeros)
         order = np.argsort(-counts, kind="stable")
         cats, counts = cats[order], counts[order]
         # cap category count at max_bin (rare cats fold to the most frequent bin 0)
@@ -179,19 +193,29 @@ def find_bin(
         )
 
     if zero_as_missing and use_missing:
-        # zeros (and NaN) both become the missing value stream
+        # zeros (and NaN) both become the missing value stream — implicit
+        # (sparse-stored) zeros join it too
         zero_mask = np.abs(values) <= _KZERO_THRESHOLD
         nan_mask = nan_mask | zero_mask
-        has_nan = bool(nan_mask.any())
+        has_nan = bool(nan_mask.any()) or num_implicit_zeros > 0
         missing_type = MISSING_ZERO if has_nan else MISSING_NONE
+        num_implicit_zeros = 0
     else:
         missing_type = MISSING_NAN if (use_missing and has_nan) else MISSING_NONE
 
     clean = values[~nan_mask]
-    if len(clean) == 0:
+    if len(clean) == 0 and num_implicit_zeros == 0:
         return BinMapper(upper_bounds=np.asarray([np.inf]), missing_type=missing_type)
 
     sorted_vals, counts = np.unique(clean, return_counts=True)
+    if num_implicit_zeros > 0:
+        zi = np.searchsorted(sorted_vals, 0.0)
+        if zi < len(sorted_vals) and sorted_vals[zi] == 0.0:
+            counts = counts.copy()
+            counts[zi] += num_implicit_zeros
+        else:
+            sorted_vals = np.insert(sorted_vals, zi, 0.0)
+            counts = np.insert(counts, zi, num_implicit_zeros)
     n_avail = max_bin - (1 if missing_type != MISSING_NONE else 0)
     n_avail = max(n_avail, 1)
     if len(forced_bounds):
@@ -203,7 +227,7 @@ def find_bin(
         forced = forced[: n_avail - 1]
         rest = max(n_avail - len(forced), 1)
         greedy = _greedy_equal_count_bounds(
-            sorted_vals, counts, rest, min_data_in_bin, total_cnt=len(clean)
+            sorted_vals, counts, rest, min_data_in_bin, total_cnt=int(counts.sum())
         )
         bounds = np.unique(np.concatenate([forced, greedy]))
         if len(bounds) > n_avail:
@@ -214,7 +238,7 @@ def find_bin(
             bounds = np.append(bounds, np.inf)
     else:
         bounds = _greedy_equal_count_bounds(
-            sorted_vals, counts, n_avail, min_data_in_bin, total_cnt=len(clean)
+            sorted_vals, counts, n_avail, min_data_in_bin, total_cnt=int(counts.sum())
         )
     mapper = BinMapper(
         upper_bounds=bounds,
@@ -305,4 +329,67 @@ class DatasetBinner:
         out = np.empty((n, f), dtype=dtype)
         for j, m in enumerate(self.mappers):
             out[:, j] = m.transform(data[:, j]).astype(dtype)
+        return out
+
+    @classmethod
+    def fit_sparse(
+        cls,
+        csc,  # scipy.sparse CSC matrix
+        max_bin: int = 255,
+        min_data_in_bin: int = 3,
+        sample_cnt: int = 200000,
+        use_missing: bool = True,
+        zero_as_missing: bool = False,
+        categorical_features: Sequence[int] = (),
+        max_bin_by_feature: Sequence[int] = (),
+        seed: int = 1,
+        forced_bins: Optional[dict] = None,
+    ) -> "DatasetBinner":
+        """Fit bin mappers from a CSC matrix WITHOUT densifying (reference:
+        DatasetLoader::ConstructBinMappersFromSampleData over SparseBin
+        columns — stored nonzeros plus an implicit-zero count per feature)."""
+        n, f = csc.shape
+        if n > sample_cnt:
+            rng = np.random.RandomState(seed)
+            idx = np.sort(rng.choice(n, size=sample_cnt, replace=False))
+            csc = csc[idx]
+            n = sample_cnt
+        cats = set(int(c) for c in categorical_features)
+        forced_bins = forced_bins or {}
+        indptr, data = csc.indptr, csc.data
+        mappers = []
+        for j in range(f):
+            vals = np.asarray(data[indptr[j]:indptr[j + 1]], np.float64)
+            mb = int(max_bin_by_feature[j]) if len(max_bin_by_feature) == f else max_bin
+            mappers.append(
+                find_bin(
+                    vals,
+                    max_bin=mb,
+                    min_data_in_bin=min_data_in_bin,
+                    use_missing=use_missing,
+                    zero_as_missing=zero_as_missing,
+                    is_categorical=j in cats,
+                    forced_bounds=forced_bins.get(j, ()),
+                    num_implicit_zeros=int(n - len(vals)),
+                )
+            )
+        return cls(mappers=mappers)
+
+    def transform_sparse(self, csc) -> np.ndarray:
+        """CSC matrix -> dense BINNED (N, F) uint8/int32 — the raw float
+        matrix is never materialized (the binned matrix is 8x smaller than
+        a float64 densify and is the layout training uses anyway)."""
+        n, f = csc.shape
+        assert f == self.num_features, (f, self.num_features)
+        dtype = np.uint8 if self.max_num_bins <= 256 else np.int32
+        out = np.empty((n, f), dtype=dtype)
+        indptr, indices, data = csc.indptr, csc.indices, csc.data
+        for j, m in enumerate(self.mappers):
+            zero_bin = int(m.transform(np.zeros(1))[0])
+            out[:, j] = zero_bin
+            lo, hi = indptr[j], indptr[j + 1]
+            if hi > lo:
+                out[indices[lo:hi], j] = m.transform(
+                    np.asarray(data[lo:hi], np.float64)
+                ).astype(dtype)
         return out

@@ -298,3 +298,97 @@ def predict_into(bst: Booster, data_addr: int, nrow: int, ncol: int,
     dest = _wrap(out_addr, (out.size,))
     dest[:] = out
     return int(out.size)
+
+
+# ---- CSR surface (reference: LGBM_DatasetCreateFromCSR /
+#      LGBM_BoosterPredictForCSR in src/c_api.cpp) ----
+
+def _wrap_csr(indptr_addr: int, indptr_type: int, indices_addr: int,
+              data_addr: int, data_type: int, nindptr: int, nelem: int,
+              num_col: int):
+    import scipy.sparse as sp
+
+    indptr = np.array(_wrap_typed(indptr_addr, (nindptr,), indptr_type))
+    indices = np.array(_wrap_typed(indices_addr, (nelem,), 2))  # int32
+    data = np.array(_wrap_typed(data_addr, (nelem,), data_type))
+    return sp.csr_matrix((data, indices, indptr),
+                         shape=(nindptr - 1, num_col))
+
+
+def dataset_from_csr(indptr_addr: int, indptr_type: int, indices_addr: int,
+                     data_addr: int, data_type: int, nindptr: int,
+                     nelem: int, num_col: int, parameters: str,
+                     reference) -> Dataset:
+    x = _wrap_csr(indptr_addr, indptr_type, indices_addr, data_addr,
+                  data_type, nindptr, nelem, num_col)
+    return Dataset(x, params=_parse_params(parameters),
+                   reference=reference if isinstance(reference, Dataset) else None,
+                   free_raw_data=False)
+
+
+def predict_csr_into(bst: Booster, indptr_addr: int, indptr_type: int,
+                     indices_addr: int, data_addr: int, data_type: int,
+                     nindptr: int, nelem: int, num_col: int,
+                     predict_type: int, out_addr: int) -> int:
+    x = _wrap_csr(indptr_addr, indptr_type, indices_addr, data_addr,
+                  data_type, nindptr, nelem, num_col)
+    return _predict_any_into(bst, x, predict_type, out_addr)
+
+
+def _predict_any_into(bst: Booster, x, predict_type: int, out_addr: int,
+                      **kw) -> int:
+    if predict_type == _PREDICT_LEAF_INDEX:
+        out = bst.predict(x, pred_leaf=True, **kw).astype(np.float64)
+    elif predict_type == _PREDICT_CONTRIB:
+        out = bst.predict(x, pred_contrib=True, **kw)
+    elif predict_type == _PREDICT_RAW_SCORE:
+        out = bst.predict(x, raw_score=True, **kw)
+    else:
+        out = bst.predict(x, **kw)
+    out = np.ascontiguousarray(out, np.float64).ravel()
+    dest = _wrap(out_addr, (out.size,))
+    dest[:] = out
+    return int(out.size)
+
+
+# ---- single-row fast predict (reference: SingleRowPredictor +
+#      LGBM_BoosterPredictForMatSingleRowFast / FastConfigHandle) ----
+
+class _FastConfig:
+    """Opaque FastConfig handle: booster + frozen predict settings
+    (reference: FastConfig in src/c_api.cpp — caches everything so the
+    per-call path only reads one row and writes one result)."""
+
+    def __init__(self, bst: Booster, predict_type: int, data_type: int,
+                 ncol: int, parameters: str = ""):
+        self.bst = bst
+        self.predict_type = predict_type
+        self.data_type = data_type
+        self.ncol = ncol
+        p = _parse_params(parameters)
+        self.num_iteration = int(p.pop("num_iteration", -1))
+        self.start_iteration = int(p.pop("start_iteration", 0))
+        self.kwargs = p  # e.g. predict_disable_shape_check
+
+
+def predict_single_row_fast_init(bst: Booster, predict_type: int,
+                                 data_type: int, ncol: int,
+                                 parameters: str = "") -> _FastConfig:
+    return _FastConfig(bst, predict_type, data_type, ncol, parameters)
+
+
+def predict_single_row_fast(cfg: _FastConfig, data_addr: int,
+                            out_addr: int) -> int:
+    x = np.array(_wrap_typed(data_addr, (1, cfg.ncol), cfg.data_type),
+                 np.float64)
+    return _predict_any_into(cfg.bst, x, cfg.predict_type, out_addr,
+                             num_iteration=cfg.num_iteration,
+                             start_iteration=cfg.start_iteration,
+                             **cfg.kwargs)
+
+
+def predict_single_row_into(bst: Booster, data_addr: int, ncol: int,
+                            data_type: int, predict_type: int,
+                            out_addr: int) -> int:
+    x = np.array(_wrap_typed(data_addr, (1, ncol), data_type), np.float64)
+    return _predict_any_into(bst, x, predict_type, out_addr)

@@ -147,7 +147,18 @@ def find_bundles(
     singles = [[j] for j in range(f) if j not in in_multi]
     bundles = multi + singles
     fb = len(bundles)
-    B = max_total_bins
+    # gather/table stride = the widest ACTUAL column (bundle or single
+    # feature), not the packing capacity — capacity may be the full max_bin
+    # budget while e.g. one-hot bundles pack far narrower, and this stride
+    # becomes the dataset's histogram width
+    B = max(
+        max(
+            (1 + sum(int(num_bins_pf[j]) - 1 for j in m)) if len(m) > 1
+            else int(num_bins_pf[m[0]])
+            for m in bundles
+        ),
+        1,
+    )
 
     bundled_num_bins = np.zeros(fb, np.int32)
     gather_idx = np.full((f, B), fb * B, np.int64)  # default -> zero pad slot

@@ -469,6 +469,102 @@ int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
   return 0;
 }
 
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  GilGuard gil;
+  PyObject* ref = reference != nullptr ? static_cast<PyObject*>(reference)
+                                       : Py_None;
+  PyObject* r = call_helper(
+      "dataset_from_csr", "(KiKKiLLLsO)",
+      reinterpret_cast<unsigned long long>(indptr), indptr_type,
+      reinterpret_cast<unsigned long long>(indices),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col), parameters, ref);
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem, int64_t num_col,
+                              int predict_type, int64_t* out_len,
+                              double* out_result) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_csr_into", "(OKiKKiLLLiK)", static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(indptr), indptr_type,
+      reinterpret_cast<unsigned long long>(indices),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col), predict_type,
+      reinterpret_cast<unsigned long long>(out_result));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle, const void* data,
+                                       int data_type, int32_t ncol,
+                                       int is_row_major, int predict_type,
+                                       int64_t* out_len, double* out_result) {
+  (void)is_row_major;  /* one row: both layouts identical */
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_single_row_into", "(OKiiiK)", static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(data), static_cast<int>(ncol),
+      data_type, predict_type,
+      reinterpret_cast<unsigned long long>(out_result));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForMatSingleRowFastInit(BoosterHandle handle,
+                                               int predict_type,
+                                               int data_type, int32_t ncol,
+                                               const char* parameters,
+                                               FastConfigHandle* out) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_single_row_fast_init", "(Oiiis)",
+      static_cast<PyObject*>(handle), predict_type, data_type,
+      static_cast<int>(ncol), parameters == nullptr ? "" : parameters);
+  if (r == nullptr) return -1;
+  *out = static_cast<FastConfigHandle>(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForMatSingleRowFast(FastConfigHandle fast_config,
+                                           const void* data, int64_t* out_len,
+                                           double* out_result) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_single_row_fast", "(OKK)",
+      static_cast<PyObject*>(fast_config),
+      reinterpret_cast<unsigned long long>(data),
+      reinterpret_cast<unsigned long long>(out_result));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_FastConfigFree(FastConfigHandle fast_config) {
+  if (fast_config == nullptr) return 0;
+  GilGuard gil;
+  Py_DECREF(static_cast<PyObject*>(fast_config));
+  return 0;
+}
+
 int LGBM_BoosterPredictForMat(BoosterHandle handle, const double* data,
                               int32_t nrow, int32_t ncol,
                               int32_t is_row_major, int32_t predict_type,

@@ -156,6 +156,62 @@ int LGBM_BoosterSaveModel(BoosterHandle handle,
                           int feature_importance_type,
                           const char* filename);
 
+/* ---- CSR ingestion & prediction (reference: LGBM_DatasetCreateFromCSR,
+ * LGBM_BoosterPredictForCSR).  indptr_type / data_type use the
+ * C_API_DTYPE codes (0=f32 1=f64 2=i32 3=i64); indices are int32. */
+int LGBM_DatasetCreateFromCSR(const void* indptr,
+                              int indptr_type,
+                              const int32_t* indices,
+                              const void* data,
+                              int data_type,
+                              int64_t nindptr,
+                              int64_t nelem,
+                              int64_t num_col,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+
+int LGBM_BoosterPredictForCSR(BoosterHandle handle,
+                              const void* indptr,
+                              int indptr_type,
+                              const int32_t* indices,
+                              const void* data,
+                              int data_type,
+                              int64_t nindptr,
+                              int64_t nelem,
+                              int64_t num_col,
+                              int predict_type,
+                              int64_t* out_len,
+                              double* out_result);
+
+/* ---- single-row predict, plain and Fast (reference: SingleRowPredictor,
+ * FastConfigHandle — the Fast variants freeze predict settings into an
+ * opaque handle so the per-call path is minimal). */
+typedef void* FastConfigHandle;
+
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
+                                       const void* data,
+                                       int data_type,
+                                       int32_t ncol,
+                                       int is_row_major,
+                                       int predict_type,
+                                       int64_t* out_len,
+                                       double* out_result);
+
+int LGBM_BoosterPredictForMatSingleRowFastInit(BoosterHandle handle,
+                                               int predict_type,
+                                               int data_type,
+                                               int32_t ncol,
+                                               const char* parameters,
+                                               FastConfigHandle* out);
+
+int LGBM_BoosterPredictForMatSingleRowFast(FastConfigHandle fast_config,
+                                           const void* data,
+                                           int64_t* out_len,
+                                           double* out_result);
+
+int LGBM_FastConfigFree(FastConfigHandle fast_config);
+
 /* data: row-major (nrow x ncol) float64 matrix. out_result must hold
  * nrow (normal/raw), nrow*num_class (multiclass), or nrow*num_trees
  * (leaf index) doubles; *out_len receives the count written. */

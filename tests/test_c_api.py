@@ -287,3 +287,87 @@ def test_c_api_dump_model_json():
     assert model["num_class"] == 1 and len(model["tree_info"]) == 1
     lib.LGBM_BoosterFree(bst)
     lib.LGBM_DatasetFree(ds)
+
+
+def test_c_api_csr_and_single_row_fast(tmp_path):
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(1)
+    Xd = rng.randn(600, 6)
+    Xd[rng.rand(600, 6) < 0.6] = 0.0
+    X = sp.csr_matrix(Xd)
+    y = ((Xd @ rng.randn(6)) > 0).astype(np.float64)
+
+    lib = ctypes.CDLL(_build())
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    indptr = np.asarray(X.indptr, np.int32)
+    indices = np.asarray(X.indices, np.int32)
+    data = np.asarray(X.data, np.float64)
+
+    # dataset from CSR -> train -> predictions must match the dense path
+    dsh = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromCSR(
+        indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(6), b"max_bin=63", None, ctypes.byref(dsh))
+    assert rc == 0, lib.LGBM_GetLastError()
+    yv = y.astype(np.float32)
+    rc = lib.LGBM_DatasetSetField(dsh, b"label",
+                                  yv.ctypes.data_as(ctypes.c_void_p),
+                                  ctypes.c_int(len(yv)), 0)
+    assert rc == 0, lib.LGBM_GetLastError()
+    bh = ctypes.c_void_p()
+    rc = lib.LGBM_BoosterCreate(dsh, b"objective=binary num_leaves=7 verbosity=-1",
+                                ctypes.byref(bh))
+    assert rc == 0, lib.LGBM_GetLastError()
+    fin = ctypes.c_int()
+    for _ in range(5):
+        assert lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(fin)) == 0
+
+    # reference model trained through the Python API on the dense matrix
+    ds_py = lgb.Dataset(Xd, label=y, params={"max_bin": 63})
+    bst_py = lgb.Booster(params={"objective": "binary", "num_leaves": 7,
+                                 "verbosity": -1}, train_set=ds_py)
+    for _ in range(5):
+        bst_py.update()
+    expect = bst_py.predict(Xd)
+
+    # CSR batch predict
+    out = np.zeros(600, np.float64)
+    out_len = ctypes.c_int64()
+    rc = lib.LGBM_BoosterPredictForCSR(
+        bh, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(6), 0, ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert out_len.value == 600
+    np.testing.assert_allclose(out, expect, rtol=1e-6, atol=1e-9)
+
+    # single-row plain + Fast must match batch predictions
+    one = np.zeros(1, np.float64)
+    row = np.ascontiguousarray(Xd[17], np.float64)
+    rc = lib.LGBM_BoosterPredictForMatSingleRow(
+        bh, row.ctypes.data_as(ctypes.c_void_p), 1, 6, 1, 0,
+        ctypes.byref(out_len), one.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert one[0] == pytest.approx(expect[17], rel=1e-6)
+
+    fch = ctypes.c_void_p()
+    rc = lib.LGBM_BoosterPredictForMatSingleRowFastInit(
+        bh, 0, 1, 6, b"", ctypes.byref(fch))
+    assert rc == 0, lib.LGBM_GetLastError()
+    for i in (3, 99, 400):
+        row = np.ascontiguousarray(Xd[i], np.float64)
+        rc = lib.LGBM_BoosterPredictForMatSingleRowFast(
+            fch, row.ctypes.data_as(ctypes.c_void_p), ctypes.byref(out_len),
+            one.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        assert rc == 0, lib.LGBM_GetLastError()
+        assert one[0] == pytest.approx(expect[i], rel=1e-6)
+    assert lib.LGBM_FastConfigFree(fch) == 0
+    assert lib.LGBM_BoosterFree(bh) == 0
+    assert lib.LGBM_DatasetFree(dsh) == 0

@@ -1,0 +1,84 @@
+"""Sparse ingestion without densify (VERDICT r2 item 7): scipy input is
+binned straight from CSC (reference: src/io/sparse_bin.hpp — stored
+nonzeros + implicit zero counts); dense raw floats are never materialized."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.binning import DatasetBinner
+
+sp = pytest.importorskip("scipy.sparse")
+
+
+def _rand_sparse(n, f, nnz_per_row, seed=0):
+    rng = np.random.RandomState(seed)
+    rows = np.repeat(np.arange(n), nnz_per_row)
+    cols = rng.randint(0, f, size=nnz_per_row * n)
+    vals = rng.rand(nnz_per_row * n) + 0.5
+    X = sp.csr_matrix((vals, (rows, cols)), shape=(n, f))
+    X.sum_duplicates()
+    return X
+
+
+def test_sparse_binner_matches_dense():
+    X = _rand_sparse(5000, 64, 3)
+    dense = X.toarray()
+    b_d = DatasetBinner.fit(dense, max_bin=63)
+    b_s = DatasetBinner.fit_sparse(X.tocsc(), max_bin=63)
+    for md, ms in zip(b_d.mappers, b_s.mappers):
+        np.testing.assert_array_equal(md.upper_bounds, ms.upper_bounds)
+        assert md.missing_type == ms.missing_type
+    np.testing.assert_array_equal(
+        b_d.transform(dense), b_s.transform_sparse(X.tocsc())
+    )
+
+
+def test_sparse_train_no_densify_matches_dense_train():
+    n, f = 60_000, 512
+    X = _rand_sparse(n, f, 2, seed=1)
+    y = np.asarray(X[:, :8].sum(axis=1)).ravel() + 0.05 * np.random.RandomState(2).randn(n)
+
+    dense_bst = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X.toarray(), label=y), 5)
+
+    # forbid ANY densification of the training matrix
+    def boom(*a, **k):
+        raise AssertionError("sparse input was densified")
+
+    X.toarray = boom
+    X.todense = boom
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=y), 5)
+    assert bst.model_to_string() == dense_bst.model_to_string()
+
+    # chunked sparse predict (no full densify) matches dense predict
+    Xp = _rand_sparse(70_000, f, 2, seed=3)  # > one 65536 chunk
+    p_sparse = bst.predict(Xp)
+    p_dense = bst.predict(Xp.toarray())
+    np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-6)
+
+
+def test_sparse_onehot_efb_bundles_and_memory():
+    """One-hot-style blocks bundle via EFB so the device matrix is narrow."""
+    rng = np.random.RandomState(4)
+    n, blocks, block_w = 50_000, 8, 64  # 512 one-hot columns
+    cols = np.concatenate([
+        b * block_w + rng.randint(0, block_w, n) for b in range(blocks)
+    ])
+    rows = np.tile(np.arange(n), blocks)
+    X = sp.csr_matrix((np.ones(blocks * n), (rows, cols)),
+                      shape=(n, blocks * block_w))
+    beta = rng.randn(blocks * block_w)
+    y = np.asarray(X @ beta).ravel() + 0.1 * rng.randn(n)
+    X.toarray = X.todense = lambda *a, **k: (_ for _ in ()).throw(AssertionError)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbosity": -1}, ds, 10, keep_training_booster=True)
+    ts = bst._gbdt.train_set
+    assert ts.efb is not None and ts.efb.num_bundled < 64  # 512 -> few bundles
+    assert ts.bins.dtype == np.uint8  # compact binned storage, no floats
+    pred = bst.predict(_rand_sparse(1000, blocks * block_w, 2, seed=5))
+    assert np.isfinite(pred).all()
