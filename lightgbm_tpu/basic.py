@@ -157,11 +157,7 @@ class Dataset:
         # compact binned matrix is materialized, never dense raw floats
         sparse_csc = None
         if _is_scipy_sparse(self.data) and cfg.is_enable_sparse:
-            if cfg.linear_tree:
-                raise LightGBMError(
-                    "linear_tree requires dense raw feature values; pass "
-                    "is_enable_sparse=False to densify explicitly"
-                )
+            # (linear_tree + sparse raises below, before any raw upload)
             sparse_csc = self.data.tocsc()
             raw = None
             num_feature = sparse_csc.shape[1]
@@ -767,7 +763,7 @@ class Booster:
             # (reference: the CSR predict path never materializes the full
             # dense matrix either).  Chunk rows from a byte budget so wide
             # matrices stay bounded too.
-            chunk = max(256, int(512e6 // (max(data.shape[1], 1) * 8)))
+            chunk = max(1, int(512e6 // (max(data.shape[1], 1) * 8)))
             if data.shape[0] > chunk:
                 csr = data.tocsr()
                 outs = []

@@ -286,18 +286,7 @@ def predict_into(bst: Booster, data_addr: int, nrow: int, ncol: int,
         x = _wrap(data_addr, (nrow, ncol))
     else:
         x = _wrap(data_addr, (ncol, nrow)).T
-    if predict_type == _PREDICT_LEAF_INDEX:
-        out = bst.predict(x, pred_leaf=True).astype(np.float64)
-    elif predict_type == _PREDICT_CONTRIB:
-        out = bst.predict(x, pred_contrib=True)
-    elif predict_type == _PREDICT_RAW_SCORE:
-        out = bst.predict(x, raw_score=True)
-    else:
-        out = bst.predict(x)
-    out = np.ascontiguousarray(out, np.float64).ravel()
-    dest = _wrap(out_addr, (out.size,))
-    dest[:] = out
-    return int(out.size)
+    return _predict_any_into(bst, x, predict_type, out_addr)
 
 
 # ---- CSR surface (reference: LGBM_DatasetCreateFromCSR /

@@ -522,7 +522,9 @@ def create_metrics(cfg: Config) -> List[Metric]:
         names = [default] if default else []
     out = []
     for name in names:
-        if name in ("none", "null", "na", ""):
+        # reference: "None"/"na"/"null"/"custom" disable metrics (the alias
+        # list in docs/Parameters.rst is case-sensitive only in docs)
+        if str(name).lower() in ("none", "null", "na", "custom", ""):
             continue
         if name not in _METRICS:
             raise ValueError(f"Unknown metric: {name}")

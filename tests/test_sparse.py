@@ -54,8 +54,10 @@ def test_sparse_train_no_densify_matches_dense_train():
         lgb.Dataset(X, label=y), 5)
     assert bst.model_to_string() == dense_bst.model_to_string()
 
-    # chunked sparse predict (no full densify) matches dense predict
-    Xp = _rand_sparse(70_000, f, 2, seed=3)  # > one 65536 chunk
+    # chunked sparse predict (no full densify) matches dense predict; with
+    # f=512 the 512MB byte budget gives 125k-row chunks, so 130k rows
+    # exercises the multi-chunk recursion
+    Xp = _rand_sparse(130_000, f, 2, seed=3)
     p_sparse = bst.predict(Xp)
     p_dense = bst.predict(Xp.toarray())
     np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-6)
