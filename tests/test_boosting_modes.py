@@ -18,6 +18,8 @@ def shifted_regression():
     y = 100.0 + X @ rng.randn(8) + 0.1 * rng.randn(2000)
     return X, y
 
+pytestmark = pytest.mark.slow
+
 
 def test_valid_scores_not_double_counting_init(shifted_regression):
     X, y = shifted_regression

@@ -15,6 +15,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_REPO, "src", "capi", "lightgbm_tpu_c_api.cpp")
 _SO = os.path.join(_REPO, "src", "capi", "_lightgbm_tpu_c_api.so")
 
+pytestmark = pytest.mark.slow
+
 
 def _build():
     if os.path.exists(_SO) and os.path.getmtime(_SO) > os.path.getmtime(_SRC):

@@ -11,6 +11,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow
+
 
 def _make_cat_regression(n=4000, n_cat=12, seed=0):
     rng = np.random.RandomState(seed)

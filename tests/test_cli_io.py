@@ -38,6 +38,8 @@ def csv_files(tmp_path_factory):
     return dict(tmp=tmp, train=str(train_p), valid=str(valid_p),
                 X=X, y=y)
 
+pytestmark = pytest.mark.slow
+
 
 def test_native_loader_builds():
     lib = get_lib()

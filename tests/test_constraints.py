@@ -10,6 +10,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow
+
 
 def _is_monotone(bst, f_idx, sign, n_grid=50, n_probe=20, seed=0):
     """Check predictions are monotone in feature f_idx pointwise on a grid."""

@@ -27,6 +27,8 @@ def synth():
     y = ((X @ w + rng.randn(n)) > 0).astype(np.float64)
     return X, y
 
+pytestmark = pytest.mark.slow
+
 
 def test_eight_devices_available():
     assert jax.device_count() >= 8

@@ -1,10 +1,13 @@
 """EFB tests (reference: DatasetLoader::FindGroups/FastFeatureBundling;
 VERDICT round-1 item 5)."""
 
+import pytest
 import numpy as np
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.io.efb import find_bundles
+
+pytestmark = pytest.mark.slow
 
 
 def _onehot_data(n=6000, groups=40, seed=0):

@@ -10,6 +10,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow
+
 
 def _train(forced, n=2000, num_leaves=8, extra=None, mode="strict"):
     rng = np.random.RandomState(0)

@@ -7,6 +7,8 @@ import pytest
 import lightgbm_tpu as lgb
 from lightgbm_tpu.ops.histogram import histogram_onehot_multi, histogram_scatter
 
+pytestmark = pytest.mark.slow
+
 
 def _fit(params, n=400, rounds=3, rank=False):
     rng = np.random.RandomState(0)

@@ -5,6 +5,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow
+
 
 def _piecewise_linear(n=5000, seed=0):
     rng = np.random.RandomState(seed)

@@ -51,6 +51,8 @@ print(f"RANK{{rank}}_OK", val)
 """
 
 
+pytestmark = pytest.mark.slow
+
 @pytest.mark.skipif(os.environ.get("SKIP_MULTIHOST") == "1", reason="opt-out")
 def test_two_process_loopback_psum(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

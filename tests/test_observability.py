@@ -1,6 +1,7 @@
 """Tracing/profiling harness + NaN-sanitizer analog (SURVEY §6.1/§6.2:
 the reference's TIMETAG timers and its sanitizer CI jobs)."""
 
+import pytest
 import glob
 import os
 
@@ -8,6 +9,8 @@ import numpy as np
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.utils.profiling import device_trace, log_timings, timed_section
+
+pytestmark = pytest.mark.slow
 
 
 def _tiny_train(extra=None):

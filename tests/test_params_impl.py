@@ -9,6 +9,8 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.slow
+
 
 def _data(n=4000, f=8, seed=0):
     rng = np.random.RandomState(seed)

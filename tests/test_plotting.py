@@ -23,6 +23,8 @@ def model():
     )
     return bst
 
+pytestmark = pytest.mark.slow
+
 
 def test_plot_importance(model):
     ax = lgb.plot_importance(model)

@@ -2,9 +2,12 @@
 init_model must reproduce uninterrupted training (the reference's recovery
 story is exactly snapshot_freq + task=train input_model=...)."""
 
+import pytest
 import numpy as np
 
 import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.slow
 
 
 def test_snapshot_resume_matches_uninterrupted(tmp_path):

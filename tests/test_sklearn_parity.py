@@ -16,6 +16,8 @@ import lightgbm_tpu as lgb
 
 sk = pytest.importorskip("sklearn.ensemble")
 
+pytestmark = pytest.mark.slow
+
 
 def _int_data(n=3000, f=6, vals=12, seed=0):
     rng = np.random.RandomState(seed)

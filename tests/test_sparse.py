@@ -10,6 +10,8 @@ from lightgbm_tpu.binning import DatasetBinner
 
 sp = pytest.importorskip("scipy.sparse")
 
+pytestmark = pytest.mark.slow
+
 
 def _rand_sparse(n, f, nnz_per_row, seed=0):
     rng = np.random.RandomState(seed)
