@@ -13,6 +13,7 @@ live on the TPU as jax arrays owned by the model objects.
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -152,11 +153,78 @@ class Dataset:
             return self
         ref = reference if reference is not None else self.reference
         cfg = Config.from_dict(self.params)
+        pre_binner = pre_bins = None
+        if isinstance(self.data, (str, os.PathLike)):
+            # file-path datasets (reference: Dataset accepts a path;
+            # DatasetLoader::LoadFromFile).  two_round streams the file
+            # twice — sample+count, then bin per chunk — and never holds
+            # the raw float matrix (reference: two_round=true semantics).
+            path = os.fspath(self.data)
+            from .io.parser import load_data_file, load_data_file_two_round
+
+            col_kw = dict(
+                header=bool(cfg.header),
+                label_column=cfg.label_column,
+                weight_column=cfg.weight_column,
+                group_column=cfg.group_column,
+                ignore_column=cfg.ignore_column,
+            )
+            if cfg.two_round:
+                if ref is not None:
+                    ref.construct()
+                    factory = lambda sample, names: ref.binner  # noqa: E731
+                else:
+                    def factory(sample, names, _cfg=cfg):
+                        cats_f = []
+                        if isinstance(self.categorical_feature, (list, tuple)):
+                            cats_f = [
+                                names.index(c) if isinstance(c, str) else int(c)
+                                for c in self.categorical_feature
+                            ]
+                        forced = None
+                        if _cfg.forcedbins_filename:
+                            with open(_cfg.forcedbins_filename) as fh:
+                                forced = {
+                                    int(e["feature"]):
+                                        [float(v) for v in e["bin_upper_bound"]]
+                                    for e in json.load(fh)
+                                }
+                        return DatasetBinner.fit(
+                            sample, max_bin=_cfg.max_bin,
+                            min_data_in_bin=_cfg.min_data_in_bin,
+                            sample_cnt=len(sample),
+                            use_missing=_cfg.use_missing,
+                            zero_as_missing=_cfg.zero_as_missing,
+                            categorical_features=cats_f,
+                            max_bin_by_feature=_cfg.max_bin_by_feature,
+                            seed=_cfg.data_random_seed,
+                            forced_bins=forced,
+                        )
+                loaded = load_data_file_two_round(
+                    path, factory,
+                    sample_cnt=cfg.bin_construct_sample_cnt,
+                    seed=cfg.data_random_seed, **col_kw,
+                )
+                pre_binner, pre_bins = loaded["binner"], loaded["bins"]
+            else:
+                loaded = load_data_file(path, **col_kw)
+                self.data = loaded["data"]
+            if self.label is None and loaded.get("label") is not None:
+                self.label = np.asarray(loaded["label"], np.float64).ravel()
+            if self.weight is None and loaded.get("weight") is not None:
+                self.weight = np.asarray(loaded["weight"], np.float64).ravel()
+            if self.group is None and loaded.get("group") is not None:
+                self.group = np.asarray(loaded["group"], np.int64).ravel()
+            if self.feature_name == "auto":
+                self.feature_name = list(loaded["feature_names"])
         # sparse inputs are binned straight from CSC (reference:
         # src/io/sparse_bin.hpp — stored nonzeros + implicit zeros); only the
         # compact binned matrix is materialized, never dense raw floats
         sparse_csc = None
-        if _is_scipy_sparse(self.data) and cfg.is_enable_sparse:
+        if pre_bins is not None:
+            raw = None
+            num_feature = pre_bins.shape[1]
+        elif _is_scipy_sparse(self.data) and cfg.is_enable_sparse:
             # (linear_tree + sparse raises below, before any raw upload)
             sparse_csc = self.data.tocsc()
             raw = None
@@ -175,7 +243,9 @@ class Dataset:
                 self.feature_names.index(c) if isinstance(c, str) else int(c)
                 for c in self.categorical_feature
             ]
-        if ref is not None:
+        if pre_binner is not None:
+            self.binner = pre_binner
+        elif ref is not None:
             ref.construct()
             # bin alignment with the reference dataset (reference= semantics)
             self.binner = ref.binner
@@ -207,11 +277,12 @@ class Dataset:
                 self.binner = DatasetBinner.fit_sparse(sparse_csc, **fit_kwargs)
             else:
                 self.binner = DatasetBinner.fit(raw, **fit_kwargs)
-        self.bins = (
-            self.binner.transform_sparse(sparse_csc)
-            if sparse_csc is not None
-            else self.binner.transform(raw)
-        )
+        if pre_bins is not None:
+            self.bins = pre_bins
+        elif sparse_csc is not None:
+            self.bins = self.binner.transform_sparse(sparse_csc)
+        else:
+            self.bins = self.binner.transform(raw)
         # int16 on device: half the HBM of int32 at Epsilon scale (max_bin
         # caps at 65535 by far); compute casts per tile
         self.bins_device = jnp.asarray(self.bins, jnp.int16)
@@ -253,15 +324,16 @@ class Dataset:
                     self.max_num_bins, int(self.efb.gather_idx.shape[1])
                 )
         self._num_data, self._num_feature = (
-            sparse_csc.shape if sparse_csc is not None else raw.shape
+            self.bins.shape if raw is None else raw.shape
         )
         if cfg.linear_tree or (ref is not None and getattr(ref, "raw_device", None) is not None):
             # linear trees need raw feature values at fit/score time
             # (reference: linear_tree_learner.cpp keeps a raw-data view)
-            if sparse_csc is not None:
+            if raw is None:
                 raise LightGBMError(
                     "linear_tree requires dense raw feature values; pass "
-                    "is_enable_sparse=False to densify explicitly"
+                    "is_enable_sparse=False (sparse input) or disable "
+                    "two_round (file streaming) to materialize them"
                 )
             self.raw_device = jnp.asarray(raw.astype(np.float32))
         if self.free_raw_data:

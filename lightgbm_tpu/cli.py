@@ -59,6 +59,10 @@ def parse_argv(argv: List[str]) -> Dict[str, str]:
 
 
 def _load_dataset(cfg: Config, path: str, params: Dict, reference=None) -> Dataset:
+    if cfg.two_round:
+        # streaming two-pass load (reference: two_round=true): the Dataset
+        # takes the path and bins per chunk without a raw float matrix
+        return Dataset(path, params=params, reference=reference)
     loaded = load_data_file(
         path,
         header=cfg.header,

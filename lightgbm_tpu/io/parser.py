@@ -82,6 +82,61 @@ def _resolve_column(spec: str, header_names: Optional[List[str]]) -> int:
     return int(spec)
 
 
+
+def _file_column_spec(path: str, fmt: str, header: bool, label_column: str,
+                      weight_column: str, group_column: str,
+                      ignore_column: str):
+    """Shared header/format sniffing + column-index resolution for BOTH the
+    eager and the two-round loaders (one implementation so the two modes
+    cannot drift)."""
+    with open(path, "r") as fh:
+        first = fh.readline()
+    fmt_detected = fmt if fmt != "auto" else _detect_format(first)
+    header_names: Optional[List[str]] = None
+    if header and fmt_detected != "libsvm":
+        delim = "\t" if fmt_detected == "tsv" else ","
+        header_names = [t.strip() for t in first.rstrip("\n\r").split(delim)]
+    if fmt_detected == "libsvm":
+        return fmt_detected, None, -1, -1, -1, []
+    label_idx = _resolve_column(label_column, header_names) if label_column else 0
+    weight_idx = _resolve_column(weight_column, header_names) if weight_column else -1
+    group_idx = _resolve_column(group_column, header_names) if group_column else -1
+    ignore_idxs = [
+        _resolve_column(t, header_names) for t in (ignore_column or "").split(",") if t
+    ]
+    return fmt_detected, header_names, label_idx, weight_idx, group_idx, ignore_idxs
+
+
+def _split_columns(cols: np.ndarray, label_idx: int, weight_idx: int,
+                   group_idx: int, ignore_idxs: List[int]):
+    """Split a parsed all-columns chunk into (features, label, weight, group)
+    with the same out-of-range tolerance in both loaders."""
+    ncol = cols.shape[1]
+    label = (cols[:, label_idx].copy() if 0 <= label_idx < ncol
+             else np.zeros(len(cols)))
+    weight = cols[:, weight_idx].copy() if 0 <= weight_idx < ncol else None
+    group = cols[:, group_idx].copy() if 0 <= group_idx < ncol else None
+    drop = {label_idx, *ignore_idxs}
+    if 0 <= weight_idx < ncol:
+        drop.add(weight_idx)
+    if 0 <= group_idx < ncol:
+        drop.add(group_idx)
+    keep = [j for j in range(ncol) if j not in drop]
+    return cols[:, keep], label, weight, group, keep
+
+
+def _group_ids_to_sizes(gcol: np.ndarray) -> np.ndarray:
+    """Query-id column -> group sizes, preserving file order of query ids
+    (reference: Metadata group column semantics)."""
+    ids, idx = np.unique(gcol, return_index=True)
+    _, counts = np.unique(gcol, return_counts=True)
+    order = np.argsort(idx)
+    sizes = np.zeros(len(ids), np.int64)
+    for rank, o in enumerate(order):
+        sizes[rank] = counts[o]
+    return sizes
+
+
 def load_data_file(
     path: str,
     header: bool = False,
@@ -97,25 +152,10 @@ def load_data_file(
     Side files `<path>.weight` and `<path>.query` are honored like the
     reference (Metadata::LoadWeights/LoadQueryBoundaries).
     """
-    with open(path, "r") as fh:
-        first = fh.readline()
-    fmt_detected = fmt if fmt != "auto" else _detect_format(first)
-
-    header_names: Optional[List[str]] = None
-    if header and fmt_detected != "libsvm":
-        delim = "\t" if fmt_detected == "tsv" else ","
-        header_names = [t.strip() for t in first.rstrip("\n\r").split(delim)]
-
-    label_idx = 0
-    if label_column:
-        label_idx = _resolve_column(label_column, header_names)
-    weight_idx = _resolve_column(weight_column, header_names) if weight_column else -1
-    group_idx = _resolve_column(group_column, header_names) if group_column else -1
-    ignore_idxs: List[int] = []
-    if ignore_column:
-        ignore_idxs = [
-            _resolve_column(t, header_names) for t in ignore_column.split(",") if t
-        ]
+    fmt_detected, header_names, label_idx, weight_idx, group_idx, ignore_idxs = (
+        _file_column_spec(path, fmt, header, label_column, weight_column,
+                          group_column, ignore_column)
+    )
 
     if fmt_detected == "libsvm":
         native = parse_file_native(path, "libsvm", False, 0)
@@ -138,17 +178,9 @@ def load_data_file(
             if header:
                 text = text.split("\n", 1)[1] if "\n" in text else ""
             cols, _, _ = parse_text(text, fmt_detected)
-        ncol = cols.shape[1]
-        label = cols[:, label_idx].copy() if 0 <= label_idx < ncol else np.zeros(len(cols))
-        weight = cols[:, weight_idx].copy() if 0 <= weight_idx < ncol else None
-        group = cols[:, group_idx].copy() if 0 <= group_idx < ncol else None
-        drop = {label_idx, *ignore_idxs}
-        if weight_idx >= 0:
-            drop.add(weight_idx)
-        if group_idx >= 0:
-            drop.add(group_idx)
-        keep = [j for j in range(ncol) if j not in drop]
-        data = cols[:, keep]
+        data, label, weight, group, keep = _split_columns(
+            cols, label_idx, weight_idx, group_idx, ignore_idxs
+        )
         if header_names:
             names = [header_names[j] for j in keep]
         else:
@@ -161,15 +193,147 @@ def load_data_file(
     if os.path.exists(path + ".query"):
         query = np.loadtxt(path + ".query", dtype=np.int64).reshape(-1)
     elif group is not None:
-        # group column holds a query id per row -> convert to group sizes
-        _, counts = np.unique(group, return_counts=True)
-        # preserve file order of query ids
-        ids, idx = np.unique(group, return_index=True)
-        order = np.argsort(idx)
-        sizes = np.zeros(len(ids), np.int64)
-        for rank, o in enumerate(order):
-            sizes[rank] = counts[o]
-        query = sizes
+        query = _group_ids_to_sizes(group)
 
     return dict(data=data, label=label, weight=weight, group=query,
                 feature_names=names)
+
+
+def _iter_chunks(path: str, fmt: str, header: bool, chunk_rows: int):
+    """Yield parsed (columns, first_col) chunks of a CSV/TSV/LibSVM file
+    without ever holding the whole file (reference: TextReader's chunked
+    reads + PipelineReader).  LibSVM chunks are as wide as their own widest
+    feature index; the caller reconciles widths."""
+    buf: List[str] = []
+    with open(path, "r") as fh:
+        if header and fmt != "libsvm":
+            fh.readline()
+        for line in fh:
+            if not line.strip() or line.startswith("#"):
+                continue
+            buf.append(line)
+            if len(buf) >= chunk_rows:
+                yield parse_text("".join(buf), fmt)[0:2]
+                buf = []
+    if buf:
+        yield parse_text("".join(buf), fmt)[0:2]
+
+
+def load_data_file_two_round(
+    path: str,
+    binner_factory,
+    header: bool = False,
+    label_column: str = "",
+    weight_column: str = "",
+    group_column: str = "",
+    ignore_column: str = "",
+    fmt: str = "auto",
+    sample_cnt: int = 200000,
+    chunk_rows: int = 200000,
+    seed: int = 1,
+):
+    """Two-pass streaming load (reference: DatasetLoader::LoadFromFile with
+    two_round=true — the file is read twice and the raw float matrix is
+    NEVER materialized): pass 1 reservoir-samples rows and counts them;
+    `binner_factory(sample, feature_names)` fits (or supplies) bin mappers;
+    pass 2 streams chunks through the binner into a preallocated compact bin
+    matrix.  Column semantics are shared with load_data_file via
+    _file_column_spec/_split_columns.
+
+    Returns dict(binner, bins, label, weight, group, feature_names).
+    """
+    fmt_detected, header_names, label_idx, weight_idx, group_idx, ignore_idxs = (
+        _file_column_spec(path, fmt, header, label_column, weight_column,
+                          group_column, ignore_column)
+    )
+    rng = np.random.RandomState(seed)
+
+    def split_chunk(cols, lab):
+        if fmt_detected == "libsvm":
+            return cols, lab, None, None
+        return _split_columns(cols, label_idx, weight_idx, group_idx,
+                              ignore_idxs)[:4]
+
+    # ---- pass 1: row count + reservoir sample (Vitter's algorithm R) ----
+    sample = None
+    n_seen = 0
+    n_feat = 0
+    for cols, lab in _iter_chunks(path, fmt_detected, header, chunk_rows):
+        feats = split_chunk(cols, lab)[0]
+        n_feat = max(n_feat, feats.shape[1])
+        if feats.shape[1] < n_feat:  # libsvm ragged width
+            feats = np.pad(feats, ((0, 0), (0, n_feat - feats.shape[1])))
+        if sample is None:
+            sample = np.empty((0, n_feat), np.float64)
+        elif sample.shape[1] < n_feat:
+            sample = np.pad(sample, ((0, 0), (0, n_feat - sample.shape[1])))
+        need = sample_cnt - len(sample)
+        if need > 0:
+            sample = np.concatenate([sample, feats[:need].copy()], axis=0)
+            rest = feats[need:]
+            base = n_seen + min(need, feats.shape[0])
+        else:
+            rest = feats
+            base = n_seen
+        if len(rest):
+            # vectorized reservoir step: row i replaces slot js[i] when
+            # js[i] < sample_cnt, with js[i] uniform on [0, base + i]
+            js = (rng.random(len(rest))
+                  * (base + np.arange(len(rest)) + 1)).astype(np.int64)
+            hit = js < sample_cnt
+            sample[js[hit]] = rest[hit]
+        n_seen += feats.shape[0]
+
+    if sample is None or n_seen == 0:
+        raise ValueError(f"empty data file: {path}")
+
+    if header_names:
+        drop = {label_idx, weight_idx, group_idx, *ignore_idxs}
+        names = [header_names[j] for j in range(len(header_names)) if j not in drop]
+    else:
+        names = [f"Column_{i}" for i in range(n_feat)]
+
+    binner = binner_factory(sample, names)
+    del sample
+    if binner.num_features > n_feat:
+        # a reference binner may be wider than this file (e.g. a LibSVM
+        # valid set missing the rarest feature indices): pad to its width
+        n_feat = binner.num_features
+
+    # ---- pass 2: stream chunks through the binner into the bin matrix ----
+    dtype = np.uint8 if binner.max_num_bins <= 256 else np.int32
+    bins = np.empty((n_seen, n_feat), dtype=dtype)
+    labels = np.empty(n_seen, np.float64)
+    weights = [] if (fmt_detected != "libsvm" and weight_idx >= 0) else None
+    groups = [] if (fmt_detected != "libsvm" and group_idx >= 0) else None
+    lo = 0
+    for cols, lab in _iter_chunks(path, fmt_detected, header, chunk_rows):
+        feats, label, weight, group = split_chunk(cols, lab)
+        if fmt_detected == "libsvm":
+            label = lab
+        if feats.shape[1] < n_feat:
+            feats = np.pad(feats, ((0, 0), (0, n_feat - feats.shape[1])))
+        hi = lo + feats.shape[0]
+        bins[lo:hi] = binner.transform(feats).astype(dtype)
+        labels[lo:hi] = label
+        if weights is not None:
+            # _split_columns already copies, so no chunk view is retained
+            weights.append(weight if weight is not None
+                           else np.ones(feats.shape[0]))
+        if groups is not None:
+            groups.append(group if group is not None
+                          else np.zeros(feats.shape[0]))
+        lo = hi
+
+    weight_arr = np.concatenate(weights) if weights else None
+    if weight_arr is None and os.path.exists(path + ".weight"):
+        weight_arr = np.loadtxt(path + ".weight", dtype=np.float64).reshape(-1)
+    # side-file precedence matches load_data_file: .query wins over a column
+    group_arr = None
+    if os.path.exists(path + ".query"):
+        group_arr = np.loadtxt(path + ".query", dtype=np.int64).reshape(-1)
+    elif groups:
+        group_arr = _group_ids_to_sizes(np.concatenate(groups))
+
+    return dict(binner=binner, bins=bins, label=labels, weight=weight_arr,
+                group=group_arr, feature_names=names)

@@ -1,0 +1,92 @@
+"""two_round streaming ingestion (VERDICT r2 item "out-of-core"): the file
+is read twice — sample+count, then chunked binning — and the raw float
+matrix is never materialized (reference: DatasetLoader::LoadFromFile with
+two_round=true)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.slow
+
+
+def _write_csv(path, n=20000, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = ((X @ rng.randn(f)) > 0).astype(np.float64)
+    arr = np.c_[y, X]
+    np.savetxt(path, arr, delimiter=",", fmt="%.6f")
+    return X, y
+
+
+def test_two_round_matches_in_memory(tmp_path, monkeypatch):
+    p = str(tmp_path / "train.csv")
+    _write_csv(p)
+    # compare against the PARSED file values (the csv text truncates floats)
+    arr = np.loadtxt(p, delimiter=",")
+    X, y = arr[:, 1:], arr[:, 0]
+
+    bst_mem = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 5)
+
+    # the eager full-file loader must NOT be used in two_round mode
+    import lightgbm_tpu.io.parser as parser
+    monkeypatch.setattr(parser, "load_data_file",
+                        lambda *a, **k: (_ for _ in ()).throw(AssertionError(
+                            "two_round used the eager loader")))
+    ds = lgb.Dataset(p, params={"two_round": True})
+    bst_stream = lgb.train({"objective": "binary", "num_leaves": 15,
+                            "verbosity": -1, "two_round": True}, ds, 5)
+    # n < bin_construct_sample_cnt: both paths bin from ALL rows -> the
+    # models must be identical
+    assert bst_stream.model_to_string() == bst_mem.model_to_string()
+
+
+def test_two_round_chunked_paths(tmp_path):
+    """Multiple chunks + reservoir sampling path (sample_cnt < n)."""
+    p = str(tmp_path / "train.csv")
+    X, y = _write_csv(p, n=30000, f=5, seed=1)
+    ds = lgb.Dataset(p, params={"two_round": True,
+                                "bin_construct_sample_cnt": 5000})
+    import lightgbm_tpu.io.parser as parser
+    orig = parser._iter_chunks
+    calls = []
+
+    def spy(path, fmt, header, chunk_rows):
+        calls.append(1)
+        return orig(path, fmt, header, 4096)  # force many chunks
+
+    parser._iter_chunks = spy
+    try:
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "two_round": True}, ds, 5)
+    finally:
+        parser._iter_chunks = orig
+    assert len(calls) == 2  # exactly two passes over the file
+    pred = bst.predict(X)
+    auc = _auc(pred, y)
+    assert auc > 0.8
+
+
+def _auc(s, y):
+    order = np.argsort(s)
+    r = np.empty(len(s)); r[order] = np.arange(len(s))
+    pos = y > 0
+    return (r[pos].mean() - (pos.sum() - 1) / 2) / max((~pos).sum(), 1)
+
+
+def test_two_round_file_dataset_plain_load(tmp_path):
+    """A path Dataset WITHOUT two_round uses the eager loader (parity with
+    the reference's Dataset('file') support)."""
+    p = str(tmp_path / "train.csv")
+    X, y = _write_csv(p, n=5000, f=4, seed=2)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(p), 3)
+    arr = np.loadtxt(p, delimiter=",")
+    Xp, yp = arr[:, 1:], arr[:, 0]
+    bst_mem = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(Xp, label=yp), 3)
+    # file datasets name features by FILE column (CLI convention), so
+    # compare the models through their predictions
+    np.testing.assert_allclose(bst.predict(Xp), bst_mem.predict(Xp), rtol=1e-7)
