@@ -170,6 +170,15 @@ class Dataset:
                 ignore_column=cfg.ignore_column,
             )
             if cfg.two_round:
+                import jax as _jax
+
+                if cfg.pre_partition and _jax.process_count() > 1:
+                    raise LightGBMError(
+                        "two_round + pre_partition is not supported yet: "
+                        "per-rank streamed binning cannot sync bin "
+                        "boundaries; load shards in memory (pre_partition "
+                        "syncs the binning sample) or disable two_round"
+                    )
                 if ref is not None:
                     ref.construct()
                     factory = lambda sample, names: ref.binner  # noqa: E731
@@ -203,7 +212,8 @@ class Dataset:
                 loaded = load_data_file_two_round(
                     path, factory,
                     sample_cnt=cfg.bin_construct_sample_cnt,
-                    seed=cfg.data_random_seed, **col_kw,
+                    seed=cfg.data_random_seed,
+                    sample_needed=(ref is None), **col_kw,
                 )
                 pre_binner, pre_bins = loaded["binner"], loaded["bins"]
             else:
@@ -273,7 +283,44 @@ class Dataset:
                 seed=cfg.data_random_seed,
                 forced_bins=forced_bins,
             )
-            if sparse_csc is not None:
+            import jax as _jax
+
+            if (
+                cfg.pre_partition
+                and _jax.process_count() > 1
+                and raw is not None
+            ):
+                # pre-partitioned multi-controller load: every rank holds a
+                # different row shard, so bin boundaries must come from the
+                # GLOBAL sample (reference: DatasetLoader's distributed bin
+                # sync via Network::Allgather of BinMappers).  Gather equal
+                # per-rank samples and fit identical mappers everywhere.
+                from jax.experimental import multihost_utils
+
+                per = max(
+                    min(cfg.bin_construct_sample_cnt // _jax.process_count(),
+                        raw.shape[0]),
+                    1,
+                )
+                rng_s = np.random.RandomState(cfg.data_random_seed)
+                idx = (rng_s.choice(raw.shape[0], per, replace=False)
+                       if raw.shape[0] > per else np.arange(raw.shape[0]))
+                # gather float64 BIT-EXACTLY as int32 pairs (x64 is disabled
+                # in jax, and f32 rounding would shift bin boundaries vs the
+                # serial path)
+                local64 = np.ascontiguousarray(raw[idx], np.float64)
+                bits = local64.view(np.int32).reshape(local64.shape[0], -1)
+                gathered = np.ascontiguousarray(np.asarray(
+                    multihost_utils.process_allgather(
+                        jnp.asarray(bits), tiled=True
+                    )
+                ))
+                sample_g = gathered.view(np.float64).reshape(
+                    -1, local64.shape[1]
+                )
+                fit_kwargs["sample_cnt"] = len(sample_g)
+                self.binner = DatasetBinner.fit(sample_g, **fit_kwargs)
+            elif sparse_csc is not None:
                 self.binner = DatasetBinner.fit_sparse(sparse_csc, **fit_kwargs)
             else:
                 self.binner = DatasetBinner.fit(raw, **fit_kwargs)

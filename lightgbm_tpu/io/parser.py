@@ -231,6 +231,7 @@ def load_data_file_two_round(
     sample_cnt: int = 200000,
     chunk_rows: int = 200000,
     seed: int = 1,
+    sample_needed: bool = True,
 ):
     """Two-pass streaming load (reference: DatasetLoader::LoadFromFile with
     two_round=true — the file is read twice and the raw float matrix is
@@ -255,26 +256,32 @@ def load_data_file_two_round(
                               ignore_idxs)[:4]
 
     # ---- pass 1: row count + reservoir sample (Vitter's algorithm R) ----
+    # (sample_needed=False — a pre-supplied reference binner — only counts
+    # rows and reconciles the width; no sample is built)
     sample = None
     n_seen = 0
     n_feat = 0
     for cols, lab in _iter_chunks(path, fmt_detected, header, chunk_rows):
         feats = split_chunk(cols, lab)[0]
         n_feat = max(n_feat, feats.shape[1])
+        n_seen += feats.shape[0]
+        if not sample_needed:
+            continue
         if feats.shape[1] < n_feat:  # libsvm ragged width
             feats = np.pad(feats, ((0, 0), (0, n_feat - feats.shape[1])))
         if sample is None:
             sample = np.empty((0, n_feat), np.float64)
         elif sample.shape[1] < n_feat:
             sample = np.pad(sample, ((0, 0), (0, n_feat - sample.shape[1])))
+        seen_before = n_seen - feats.shape[0]
         need = sample_cnt - len(sample)
         if need > 0:
             sample = np.concatenate([sample, feats[:need].copy()], axis=0)
             rest = feats[need:]
-            base = n_seen + min(need, feats.shape[0])
+            base = seen_before + min(need, feats.shape[0])
         else:
             rest = feats
-            base = n_seen
+            base = seen_before
         if len(rest):
             # vectorized reservoir step: row i replaces slot js[i] when
             # js[i] < sample_cnt, with js[i] uniform on [0, base + i]
@@ -282,9 +289,8 @@ def load_data_file_two_round(
                   * (base + np.arange(len(rest)) + 1)).astype(np.int64)
             hit = js < sample_cnt
             sample[js[hit]] = rest[hit]
-        n_seen += feats.shape[0]
 
-    if sample is None or n_seen == 0:
+    if n_seen == 0:
         raise ValueError(f"empty data file: {path}")
 
     if header_names:

@@ -136,15 +136,35 @@ class GBDT:
             # needed regardless of boost_from_average
             self.objective.prepare(np.asarray(train_set.label), train_set.weight)
         if self.objective is not None and self.cfg.boost_from_average and not self.models:
+            # pre-partition multi-controller runs compute the init score from
+            # the GLOBAL label distribution (reference: BoostFromScore syncs
+            # via Network::GlobalSyncUpBySum); equal shard sizes required
+            init_label, init_weight = self._label, self._weight
+            if (
+                self.cfg.pre_partition
+                and jax.process_count() > 1
+                and self.cfg.tree_learner in ("data", "voting")
+            ):
+                from jax.experimental import multihost_utils
+
+                init_label = jnp.asarray(
+                    multihost_utils.process_allgather(self._label, tiled=True)
+                )
+                if self._weight is not None:
+                    init_weight = jnp.asarray(
+                        multihost_utils.process_allgather(self._weight, tiled=True)
+                    )
             if k == 1:
-                self.init_scores = [self.objective.boost_from_score(self._label, self._weight)]
+                self.init_scores = [self.objective.boost_from_score(init_label, init_weight)]
                 init += np.float32(self.init_scores[0])
             else:
                 # per-class init (reference: multiclass BoostFromScore per tree id)
                 self.init_scores = []
+                lbl_all = np.asarray(init_label)
+                w_all = None if init_weight is None else np.asarray(init_weight)
                 for c in range(k):
-                    lbl = (np.asarray(train_set.label) == c).astype(np.float32)
-                    p = float(lbl.mean() if self._weight is None else np.average(lbl, weights=np.asarray(self._weight)))
+                    lbl = (lbl_all == c).astype(np.float32)
+                    p = float(lbl.mean() if w_all is None else np.average(lbl, weights=w_all))
                     p = min(max(p, 1e-15), 1 - 1e-15)
                     self.init_scores.append(float(np.log(p / (1 - p))))
                 init += np.asarray(self.init_scores, dtype=np.float32)[None, :]
@@ -389,11 +409,15 @@ class GBDT:
                 else:
                     from ..parallel.data_parallel import ShardedData
 
+                    self._pre_partition = (
+                        self.cfg.pre_partition and jax.process_count() > 1
+                    )
                     self._dp = ShardedData(
                         mesh,
                         np.asarray(train_set.bins),
                         np.asarray(train_set.binner.num_bins_per_feature),
                         np.asarray(train_set.binner.missing_bin_per_feature),
+                        process_local=self._pre_partition,
                     )
 
     def reset_split_params(self) -> None:
@@ -559,11 +583,18 @@ class GBDT:
             if use_efb and getattr(ts, "efb", None) is not None
             else ts.num_feature()
         )
-        f_pad = max((f_eff + 127) // 128 * 128, 1) if f_eff > 128 else f_eff
-        budget = 64_000_000  # bytes; measured Mosaic ceiling ~100MB, with margin
+        # wide data runs one pallas_call per 128-feature chunk
+        # (ops/hist_pallas.py), so the VMEM accumulator — the binding
+        # constraint — is (min(F,128), lanes, B) f32 regardless of total F;
+        # lanes beyond ~64 also measurably slow the dot (probe_b256b/c), so
+        # the wide-data cap is 10 leaves x 6ch = 60 lanes
+        fb = min(f_eff if f_eff > 0 else 1, 128)
+        fb_pad = max((fb + 7) // 8 * 8, 8)
+        budget = 8_000_000  # bytes of VMEM accumulator headroom
         bpad = (max(ts.max_num_bins, 8) + 7) // 8 * 8  # kernel pads B to 8
-        per_leaf = f_pad * bpad * 4 * 6  # ncl=6 f32 lanes
-        return max(1, min(8, budget // max(per_leaf, 1), self.cfg.num_leaves))
+        per_leaf = fb_pad * bpad * 4 * 6  # ncl=6 f32 lanes
+        cap = 8 if f_eff <= 128 else 10  # narrow: measured optimum is 8
+        return max(1, min(cap, budget // max(per_leaf, 1), self.cfg.num_leaves))
 
     _last_mask = None
     _nobag_cache = None
@@ -571,8 +602,9 @@ class GBDT:
     _report_finish_every_iter = False
     _finish_probe = None
 
-    @staticmethod
-    def _localize_tree(arrays, leaf_id_pad):
+    _pre_partition = False
+
+    def _localize_tree(self, arrays, leaf_id_pad):
         """Multi-controller runs: bring the (replicated) tree and the
         (row-sharded) leaf ids back to process-local arrays so the host-side
         boosting state — scores, gradients, metrics — stays local, exactly
@@ -580,12 +612,17 @@ class GBDT:
         learner communicates (reference: DataParallelTreeLearner)."""
         if jax.process_count() <= 1:
             return arrays, leaf_id_pad
-        from jax.experimental import multihost_utils
-
         arrays = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), arrays)
-        leaf_id_pad = jnp.asarray(
-            multihost_utils.process_allgather(leaf_id_pad, tiled=True)
-        )
+        if self._pre_partition and self._dp is not None:
+            # each rank keeps only ITS rows' leaf ids (pre_partition: no
+            # rank ever holds the full row space)
+            leaf_id_pad = jnp.asarray(self._dp.local_rows(leaf_id_pad))
+        else:
+            from jax.experimental import multihost_utils
+
+            leaf_id_pad = jnp.asarray(
+                multihost_utils.process_allgather(leaf_id_pad, tiled=True)
+            )
         return arrays, leaf_id_pad
 
     def _fused_eligible(self, grad) -> bool:

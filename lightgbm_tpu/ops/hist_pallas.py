@@ -57,7 +57,7 @@ _FEAT_BLOCK = 128  # feature-block width for wide datasets (Epsilon-class);
 # width, which covers every narrow dataset)
 
 
-def _direct_kernel(bins_ref, pay_ref, out_ref, acc_ref, *, FB, B, NC, dtype):
+def _direct_kernel(bins_ref, pay_ref, out_ref, *, FB, B, NC, dtype):
     """Grid (feature_blocks, row_tiles); row tiles iterate fastest, so the
     accumulator lives across the row sweep of one feature block.
 
@@ -71,9 +71,12 @@ def _direct_kernel(bins_ref, pay_ref, out_ref, acc_ref, *, FB, B, NC, dtype):
     number of passes, do not shrink B or NC."""
     i = pl.program_id(1)
 
+    # the revisited output block IS the accumulator (a separate VMEM
+    # scratch would double the scoped footprint and OOM at 60 lanes x 256
+    # bins x 128 features — measured 17.04M vs the 16M cap)
     @pl.when(i == 0)
     def _():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+        out_ref[...] = jnp.zeros_like(out_ref)
 
     pay = pay_ref[...].astype(dtype)  # (T, NC)
     T = pay.shape[0]
@@ -84,13 +87,9 @@ def _direct_kernel(bins_ref, pay_ref, out_ref, acc_ref, *, FB, B, NC, dtype):
         oh = (binf == iota_b).astype(dtype)  # (T, B)
         h = jax.lax.dot_general(
             pay, oh, (((0,), (0,)), ((), ())),
-            preferred_element_type=acc_ref.dtype,
+            preferred_element_type=out_ref.dtype,
         )  # (NC, B)
-        acc_ref[f] += h
-
-    @pl.when(i == pl.num_programs(1) - 1)
-    def _():
-        out_ref[...] = acc_ref[...]
+        out_ref[f] += h
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile", "matmul_dtype"))
@@ -107,22 +106,32 @@ def _hist_pallas_raw(
     B = _round_up(max(num_bins, 8), 8)
     acc_dtype = jnp.int32 if payload.dtype == jnp.int8 else jnp.float32
 
-    FB = f if f <= _FEAT_BLOCK else _FEAT_BLOCK
     if f > _FEAT_BLOCK:
-        # wide data: the accumulator + revisited output block dominate
-        # scoped VMEM (16MB hard cap, and while_loop bodies get less slack
-        # than standalone kernels — measured 512KB over at T=1024); halve
-        # the row tile to stay inside
-        row_tile = min(row_tile, 512)
-    f_pad = _round_up(f, FB)
-    n_pad = _round_up(n, row_tile)
-    if n_pad != n or f_pad != f:
-        bins = jnp.pad(bins, ((0, n_pad - n), (0, f_pad - f)))
-    if n_pad != n:
-        payload = jnp.pad(payload, ((0, n_pad - n), (0, 0)))
-    grid = (f_pad // FB, n_pad // row_tile)
+        # wide data (Epsilon-class): one pallas_call PER 128-feature chunk,
+        # unrolled in-trace.  Each call's output/accumulator is (128, NC, B)
+        # — small enough that neither the Mosaic ~100MB output ceiling nor
+        # scoped VMEM caps the payload lanes, so the leaf tile no longer
+        # shrinks with total F (round 2 clamped row_tile to 512 and leaf
+        # tile to ~5 at 2000x255; in-trace per-op launches are free, unlike
+        # tunnel dispatches)
+        outs = [
+            _hist_pallas_raw(
+                bins[:, j0:j0 + _FEAT_BLOCK], payload,
+                num_bins=num_bins, row_tile=row_tile,
+                matmul_dtype=matmul_dtype,
+            )
+            for j0 in range(0, f, _FEAT_BLOCK)
+        ]
+        return jnp.concatenate(outs, axis=0)
 
-    out_dims = (f_pad, nc, B)
+    FB = f  # narrow data: one feature block (wide F recursed above)
+    n_pad = _round_up(n, row_tile)
+    if n_pad != n:
+        bins = jnp.pad(bins, ((0, n_pad - n), (0, 0)))
+        payload = jnp.pad(payload, ((0, n_pad - n), (0, 0)))
+    grid = (1, n_pad // row_tile)
+
+    out_dims = (f, nc, B)
     out = pl.pallas_call(
         functools.partial(_direct_kernel, FB=FB, B=B, NC=nc, dtype=matmul_dtype),
         grid=grid,
@@ -132,14 +141,13 @@ def _hist_pallas_raw(
         ],
         out_specs=pl.BlockSpec((FB, nc, B), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(out_dims, acc_dtype),
-        scratch_shapes=[pltpu.VMEM((FB, nc, B), acc_dtype)],
         cost_estimate=pl.CostEstimate(
             flops=2 * n_pad * f_pad * B * nc,
             bytes_accessed=n_pad * f_pad * bins.dtype.itemsize + n_pad * nc * 4,
             transcendentals=0,
         ),
     )(bins, payload)
-    return out[:f] if f_pad != f else out
+    return out
 
 
 def _split_bf16x2(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -43,33 +43,67 @@ class ShardedData:
     contribute to histograms)."""
 
     def __init__(self, mesh: Mesh, bins: np.ndarray, num_bins_pf: np.ndarray,
-                 missing_bin_pf: np.ndarray):
+                 missing_bin_pf: np.ndarray, *, process_local: bool = False):
+        """process_local=True (reference: pre_partition): `bins` holds only
+        THIS process's rows; the global array is assembled from per-process
+        shards (each process pads its share to a per-device multiple), so no
+        rank ever materializes the full dataset."""
         self.mesh = mesh
         n, f = bins.shape
         self.n_devices = mesh.devices.size
-        pad = (-n) % self.n_devices
-        self.num_data = n
-        self.padded = n + pad
-        if pad:
-            bins = np.concatenate([bins, np.zeros((pad, f), bins.dtype)], axis=0)
-        row_valid = np.zeros(self.padded, bool)
-        row_valid[:n] = True
+        self.process_local = process_local and jax.process_count() > 1
         self.row_sharding = NamedSharding(mesh, P(DATA_AXIS))
         self.rep_sharding = NamedSharding(mesh, P())
-        self.bins = jax.device_put(bins, self.row_sharding)
-        self.row_valid = jax.device_put(row_valid, self.row_sharding)
+        if self.process_local:
+            local_dev = self.n_devices // jax.process_count()
+            pad = (-n) % max(local_dev, 1)
+            self.num_data = n  # LOCAL rows (Dataset holds the local shard)
+            self.local_padded = n + pad
+            self.padded = self.local_padded * jax.process_count()
+        else:
+            pad = (-n) % self.n_devices
+            self.num_data = n
+            self.padded = n + pad
+            self.local_padded = self.padded
+        if pad:
+            bins = np.concatenate([bins, np.zeros((pad, f), bins.dtype)], axis=0)
+        row_valid = np.zeros(self.local_padded, bool)
+        row_valid[:n] = True
+        self.bins = self._put_rows(bins)
+        self.row_valid = self._put_rows(row_valid)
         self.num_bins_pf = jax.device_put(num_bins_pf, self.rep_sharding)
         self.missing_bin_pf = jax.device_put(missing_bin_pf, self.rep_sharding)
 
+    def _put_rows(self, arr: np.ndarray) -> jnp.ndarray:
+        if self.process_local:
+            return jax.make_array_from_process_local_data(
+                self.row_sharding, np.asarray(arr)
+            )
+        return jax.device_put(arr, self.row_sharding)
+
     def pad_rows(self, arr: np.ndarray, fill=0.0) -> jnp.ndarray:
-        pad = self.padded - self.num_data
+        pad = self.local_padded - self.num_data
         if pad:
             arr = np.concatenate([np.asarray(arr), np.full((pad,) + np.shape(arr)[1:], fill, np.asarray(arr).dtype)])
-        return jax.device_put(arr, self.row_sharding)
+        return self._put_rows(arr)
+
+    def local_rows(self, global_arr) -> np.ndarray:
+        """Extract THIS process's rows of a row-sharded global array
+        (ordered by each shard's global offset), trimmed of padding."""
+        shards = sorted(global_arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        out = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+        return out[: self.num_data]
 
     def pad_rows_device(self, arr, dtype, fill=0.0) -> jnp.ndarray:
         """Pad + reshard WITHOUT a host round-trip (the async rounds-grower
         path: grad/hess/masks are already device arrays)."""
+        if self.process_local:
+            # device_put with a global sharding would treat every rank's
+            # [local, zeros] as the same global array and feed rank 1+ the
+            # zero padding; go through the per-process assembly path (one
+            # host hop — correctness over pipelining in multi-controller)
+            return self.pad_rows(np.asarray(jnp.asarray(arr, dtype)), fill)
         arr = jnp.asarray(arr, dtype)
         pad = self.padded - self.num_data
         if pad:

@@ -1,0 +1,149 @@
+"""Multi-process training launcher — the Dask-orchestration analogue.
+
+Reference: python-package/lightgbm/dask.py (~1,700 LoC): align partitions to
+workers, find open ports, build the `machines` list, inject
+num_machines/local_listen_port/tree_learner, run plain `lightgbm.train` on
+every worker with network params, return the rank-0 model.
+
+TPU-native redesign: workers are local processes wired through
+`jax.distributed` (parallel/distributed.py maps the reference's machine-list
+handshake onto the coordinator bring-up).  Each worker receives ONLY its row
+shard (`pre_partition` semantics: bin boundaries sync from the global
+sample, the global device array is assembled from process-local shards, and
+no rank ever materializes the full dataset).  Every rank ends up with the
+identical model; the launcher returns rank 0's.
+
+This launcher is the single-host (loopback) form; on a real multi-host pod
+run one worker per host with the same `machines` list — the worker body is
+ordinary `lightgbm_tpu.train`, exactly like the reference's `_train_part`.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Dict, Optional
+
+import numpy as np
+
+_WORKER_SRC = r"""
+import os, sys
+sys.path.insert(0, os.environ["LGBM_TPU_REPO"])
+import numpy as np
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel.distributed import init_distributed
+
+shard = np.load(os.environ["LGBM_TPU_SHARD"], allow_pickle=True)
+net = {k: shard[k].item() for k in ("num_machines", "machines",
+                                    "local_listen_port", "time_out")}
+assert init_distributed(Config.from_dict(net))
+
+import lightgbm_tpu as lgb
+
+params = dict(np.load(os.environ["LGBM_TPU_PARAMS"], allow_pickle=True)[
+    "params"].item())
+params.update(net)
+params["pre_partition"] = True
+params.setdefault("tree_learner", "data")
+ds = lgb.Dataset(
+    shard["X"],
+    label=shard["y"],
+    weight=(shard["w"] if shard["w"].size > 0 else None),
+)
+bst = lgb.train(params, ds, int(os.environ["LGBM_TPU_ROUNDS"]))
+out = os.environ["LGBM_TPU_MODEL_OUT"]
+bst.save_model(out + f".rank{os.environ['LIGHTGBM_TPU_RANK']}")
+print("LAUNCHER_RANK_OK", os.environ["LIGHTGBM_TPU_RANK"], flush=True)
+"""
+
+
+def _free_ports(k: int) -> list:
+    """reference: dask.py _find_n_open_ports."""
+    socks, ports = [], []
+    for _ in range(k):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def train_distributed(
+    params: Dict,
+    X: np.ndarray,
+    y: np.ndarray,
+    num_boost_round: int = 100,
+    *,
+    num_machines: int = 2,
+    weight: Optional[np.ndarray] = None,
+    devices_per_machine: int = 1,
+    timeout_s: int = 600,
+    env_extra: Optional[Dict[str, str]] = None,
+):
+    """Shard rows over `num_machines` local worker processes, train with
+    tree_learner=data under pre_partition, and return rank 0's model as a
+    Booster.  Rows are padded to equal shard sizes with weight-0 rows when
+    the split is uneven (equal shards are a pre_partition requirement)."""
+    import lightgbm_tpu as lgb
+
+    n = X.shape[0]
+    per = -(-n // num_machines)
+    pad = per * num_machines - n
+    if pad:
+        X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], X.dtype)])
+        y = np.concatenate([y, np.zeros(pad, np.asarray(y).dtype)])
+        weight = np.concatenate([
+            np.ones(n) if weight is None else np.asarray(weight, np.float64),
+            np.zeros(pad),
+        ])
+    ports = _free_ports(num_machines)
+    machines = ",".join(f"127.0.0.1:{p}" for p in ports)
+
+    tmp = tempfile.mkdtemp(prefix="lgbm_tpu_launch_")
+    params_path = os.path.join(tmp, "params.npz")
+    np.savez(params_path, params=np.asarray(dict(params), dtype=object))
+    model_out = os.path.join(tmp, "model.txt")
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    procs = []
+    for rank in range(num_machines):
+        lo, hi = rank * per, (rank + 1) * per
+        shard_path = os.path.join(tmp, f"shard{rank}.npz")
+        np.savez(
+            shard_path,
+            X=X[lo:hi], y=np.asarray(y)[lo:hi],
+            w=(np.asarray(weight, np.float64)[lo:hi]
+               if weight is not None else np.asarray(())),
+            num_machines=num_machines, machines=machines,
+            local_listen_port=ports[rank], time_out=2,
+        )
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["LIGHTGBM_TPU_RANK"] = str(rank)
+        env["LGBM_TPU_REPO"] = repo
+        env["LGBM_TPU_SHARD"] = shard_path
+        env["LGBM_TPU_PARAMS"] = params_path
+        env["LGBM_TPU_ROUNDS"] = str(num_boost_round)
+        env["LGBM_TPU_MODEL_OUT"] = model_out
+        env.pop("PYTEST_CURRENT_TEST", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER_SRC], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=timeout_s)
+        outs.append(out.decode())
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"launcher worker rank {rank} failed:\n{out[-4000:]}")
+    return lgb.Booster(model_file=model_out + ".rank0"), [
+        model_out + f".rank{r}" for r in range(num_machines)
+    ]

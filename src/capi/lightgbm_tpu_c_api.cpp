@@ -14,16 +14,16 @@
 #include <cstdarg>
 #include <cstdio>
 #include <cstring>
-#include <mutex>
 #include <string>
 
 namespace {
 
-std::mutex g_err_mutex;
-std::string g_last_error = "ok";
+// per-thread, like the reference (c_api.cpp LGBM_GetLastError returns the
+// CALLING thread's last error; a shared buffer would let one thread's
+// failure overwrite another's success message)
+thread_local std::string g_last_error = "ok";
 
 void set_last_error(const std::string& msg) {
-  std::lock_guard<std::mutex> lk(g_err_mutex);
   g_last_error = msg;
 }
 
@@ -147,7 +147,6 @@ int str_to_buffer(PyObject* s, int64_t buffer_len, int64_t* out_len,
 extern "C" {
 
 const char* LGBM_GetLastError(void) {
-  std::lock_guard<std::mutex> lk(g_err_mutex);
   return g_last_error.c_str();
 }
 

