@@ -119,6 +119,9 @@ def dataset_from_file(filename: str, parameters: str, reference) -> Dataset:
 
 def dataset_set_field(ds, field_name: str, data_addr: int,
                       num_element: int, dtype_code: int) -> bool:
+    if num_element == 0 or data_addr == 0:
+        ds.set_field(field_name, None)  # reference: zero-length clears
+        return True
     arr = np.array(_wrap_typed(data_addr, (num_element,), dtype_code))
     ds.set_field(field_name, arr)  # Dataset and StreamingDataset both accept
     return True
@@ -158,10 +161,12 @@ class StreamingDataset:
 
     def dataset(self) -> Dataset:
         if self._ds is None:
-            if self.pushed < self.num_total:
+            if self.pushed < self.num_total and not getattr(self, "_finished", False):
                 raise ValueError(
                     f"only {self.pushed}/{self.num_total} rows pushed")
+            names = list(getattr(self.reference, "feature_names", []) or [])
             self._ds = Dataset(self.buf, reference=self.reference,
+                              feature_name=names or "auto",
                               free_raw_data=False)
             for k, v in self.fields.items():
                 self._ds.set_field(k, v)
@@ -186,7 +191,10 @@ def dataset_push_rows(ds: StreamingDataset, data_addr: int, dtype_code: int,
 # -- booster training surface (reference: LGBM_Booster*) ------------------
 
 def booster_create(train_set, parameters: str) -> Booster:
-    return Booster(params=_parse_params(parameters), train_set=_as_dataset(train_set))
+    params = _parse_params(parameters)
+    if _NETWORK_PARAMS:  # LGBM_NetworkInit state is global, like the reference
+        params = dict(_NETWORK_PARAMS, **params)
+    return Booster(params=params, train_set=_as_dataset(train_set))
 
 
 def booster_add_valid(bst: Booster, valid_set) -> bool:
@@ -381,3 +389,706 @@ def predict_single_row_into(bst: Booster, data_addr: int, ncol: int,
                             out_addr: int) -> int:
     x = np.array(_wrap_typed(data_addr, (1, ncol), data_type), np.float64)
     return _predict_any_into(bst, x, predict_type, out_addr)
+
+
+# ---- CSC surface (reference: LGBM_DatasetCreateFromCSC /
+#      LGBM_BoosterPredictForCSC in src/c_api.cpp) ----
+
+def _wrap_csc(colptr_addr: int, colptr_type: int, indices_addr: int,
+              data_addr: int, data_type: int, ncolptr: int, nelem: int,
+              num_row: int):
+    import scipy.sparse as sp
+
+    colptr = np.array(_wrap_typed(colptr_addr, (ncolptr,), colptr_type))
+    indices = np.array(_wrap_typed(indices_addr, (nelem,), 2))  # int32
+    data = np.array(_wrap_typed(data_addr, (nelem,), data_type))
+    return sp.csc_matrix((data, indices, colptr),
+                         shape=(num_row, ncolptr - 1))
+
+
+def dataset_from_csc(colptr_addr: int, colptr_type: int, indices_addr: int,
+                     data_addr: int, data_type: int, ncolptr: int,
+                     nelem: int, num_row: int, parameters: str,
+                     reference) -> Dataset:
+    x = _wrap_csc(colptr_addr, colptr_type, indices_addr, data_addr,
+                  data_type, ncolptr, nelem, num_row)
+    return Dataset(x, params=_parse_params(parameters),
+                   reference=reference if isinstance(reference, Dataset) else None,
+                   free_raw_data=False)
+
+
+def predict_csc_into(bst: Booster, colptr_addr: int, colptr_type: int,
+                     indices_addr: int, data_addr: int, data_type: int,
+                     ncolptr: int, nelem: int, num_row: int,
+                     predict_type: int, out_addr: int) -> int:
+    x = _wrap_csc(colptr_addr, colptr_type, indices_addr, data_addr,
+                  data_type, ncolptr, nelem, num_row)
+    return _predict_any_into(bst, x, predict_type, out_addr)
+
+
+# ---- multi-block matrices (reference: LGBM_DatasetCreateFromMats /
+#      LGBM_BoosterPredictForMats) ----
+
+def _wrap_mats(nmat: int, data_ptrs_addr: int, dtype_code: int,
+               nrow_addr: int, ncol: int, is_row_major: int) -> np.ndarray:
+    ptrs = np.array(_wrap_typed(data_ptrs_addr, (nmat,), 3))  # void** as i64
+    nrows = np.array(_wrap_typed(nrow_addr, (nmat,), 2))
+    blocks = []
+    for p, nr in zip(ptrs, nrows):
+        if is_row_major:
+            b = _wrap_typed(int(p), (int(nr), ncol), dtype_code)
+        else:
+            b = _wrap_typed(int(p), (ncol, int(nr)), dtype_code).T
+        blocks.append(np.array(b, np.float64))
+    return np.vstack(blocks)
+
+
+def dataset_from_mats(nmat: int, data_ptrs_addr: int, dtype_code: int,
+                      nrow_addr: int, ncol: int, is_row_major: int,
+                      parameters: str, reference) -> Dataset:
+    x = _wrap_mats(nmat, data_ptrs_addr, dtype_code, nrow_addr, ncol,
+                   is_row_major)
+    return Dataset(x, params=_parse_params(parameters),
+                   reference=reference if isinstance(reference, Dataset) else None,
+                   free_raw_data=False)
+
+
+def predict_mats_into(bst: Booster, nmat: int, data_ptrs_addr: int,
+                      dtype_code: int, nrow_addr: int, ncol: int,
+                      predict_type: int, out_addr: int) -> int:
+    x = _wrap_mats(nmat, data_ptrs_addr, dtype_code, nrow_addr, ncol, 1)
+    return _predict_any_into(bst, x, predict_type, out_addr)
+
+
+# ---- sampled-column schema construction (reference:
+#      LGBM_DatasetCreateFromSampledColumn → DatasetLoader::
+#      ConstructFromSampleData: bin mappers come from the per-column value
+#      sample; rows stream in afterwards via PushRows) ----
+
+def dataset_from_sampled_column(sample_ptrs_addr: int, indices_ptrs_addr: int,
+                                ncol: int, num_per_col_addr: int,
+                                num_sample_row: int, num_local_row: int,
+                                parameters: str) -> "StreamingDataset":
+    col_ptrs = np.array(_wrap_typed(sample_ptrs_addr, (ncol,), 3))
+    idx_ptrs = np.array(_wrap_typed(indices_ptrs_addr, (ncol,), 3))
+    counts = np.array(_wrap_typed(num_per_col_addr, (ncol,), 2))
+    sample = np.zeros((num_sample_row, ncol), np.float64)
+    for c in range(ncol):
+        k = int(counts[c])
+        if k == 0:
+            continue
+        vals = np.array(_wrap_typed(int(col_ptrs[c]), (k,), 1))
+        rows = np.array(_wrap_typed(int(idx_ptrs[c]), (k,), 2))
+        sample[rows, c] = vals
+    schema = Dataset(sample, params=_parse_params(parameters),
+                     free_raw_data=False)
+    schema.construct()
+    return StreamingDataset(schema, num_local_row)
+
+
+# ---- dataset field / name / persistence surface ------------------------
+
+# reference: LGBM_DatasetGetField returns a pointer into dataset-owned
+# memory typed per field (label/weight float32, init_score float64,
+# group int32 boundaries).
+_FIELD_OUT_TYPES = {"label": 0, "weight": 0, "init_score": 1,
+                    "group": 2, "query": 2, "position": 2}
+
+
+def dataset_get_field(ds, field_name: str):
+    """Returns (addr, num_element, dtype_code); the array stays alive on the
+    dataset (reference hands out internal pointers the same way)."""
+    ds = _as_dataset(ds)
+    val = ds.get_field(field_name)
+    code = _FIELD_OUT_TYPES.get(field_name)
+    if code is None:
+        raise ValueError(f"Unknown field: {field_name}")
+    if val is None:
+        return (0, 0, code)
+    if field_name in ("group", "query"):
+        # sizes -> cumulative boundaries, as the reference returns
+        val = ds.query_boundaries
+    arr = np.ascontiguousarray(val, _DTYPES[code])
+    if not hasattr(ds, "_capi_field_cache"):
+        ds._capi_field_cache = {}
+    ds._capi_field_cache[field_name] = arr
+    return (int(arr.ctypes.data), int(arr.size), code)
+
+
+def dataset_set_feature_names(ds, names) -> bool:
+    _as_dataset(ds).set_feature_name(list(names))
+    return True
+
+
+def dataset_feature_names(ds):
+    return list(_as_dataset(ds).get_feature_name())
+
+
+def dataset_save_binary(ds, filename: str) -> bool:
+    _as_dataset(ds).save_binary(filename)
+    return True
+
+
+def dataset_dump_text(ds, filename: str) -> bool:
+    """reference: LGBM_DatasetDumpText — human-readable dataset dump."""
+    ds = _as_dataset(ds)
+    ds.construct()
+    with open(filename, "w") as f:
+        f.write("\t".join(ds.get_feature_name()) + "\n")
+        data = ds.get_data()
+        if data is not None:
+            arr = np.asarray(data if not hasattr(data, "toarray") else data.toarray())
+            for row in arr:
+                f.write("\t".join(repr(float(v)) for v in row) + "\n")
+        else:  # raw freed: dump binned values (still row-per-line)
+            for row in np.asarray(ds.bins):
+                f.write("\t".join(str(int(v)) for v in row) + "\n")
+    return True
+
+
+def dataset_get_subset(ds, indices_addr: int, num_indices: int,
+                       parameters: str) -> Dataset:
+    idx = np.array(_wrap_typed(indices_addr, (num_indices,), 2))
+    return _as_dataset(ds).subset(idx, params=_parse_params(parameters))
+
+
+def dataset_add_features_from(target, source) -> bool:
+    _as_dataset(target).add_features_from(_as_dataset(source))
+    return True
+
+
+# params that change the binned representation; changing them between a
+# reference dataset and a dependent one is the conflict the reference's
+# LGBM_DatasetUpdateParamChecking exists to catch
+_DATASET_PARAMS = (
+    "max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
+    "zero_as_missing", "use_missing", "enable_bundle", "max_bin_by_feature",
+    "categorical_feature", "feature_pre_filter", "two_round", "header",
+    "label_column", "weight_column", "group_column", "ignore_column",
+    "precise_float_parser", "forcedbins_filename", "linear_tree",
+)
+
+
+def dataset_update_param_checking(old_parameters: str,
+                                  new_parameters: str) -> bool:
+    from .config import Config
+
+    old = _parse_params(old_parameters)
+    new = _parse_params(new_parameters)
+    # compare EFFECTIVE values: a new param restating the default the old
+    # config already had is not a conflict (reference builds Configs from
+    # both strings and diffs them)
+    cfg_old = Config.from_dict(old)
+    cfg_new = Config.from_dict(dict(old, **new))
+
+    def effective(cfg, key):
+        return getattr(cfg, key, cfg.extra.get(key))
+
+    for k in _DATASET_PARAMS:
+        if effective(cfg_old, k) != effective(cfg_new, k):
+            raise ValueError(
+                f"Cannot change {k} after constructed Dataset handle")
+    return True
+
+
+def dataset_push_rows_by_csr(ds: "StreamingDataset", indptr_addr: int,
+                             indptr_type: int, indices_addr: int,
+                             data_addr: int, data_type: int, nindptr: int,
+                             nelem: int, num_col: int, start_row: int) -> bool:
+    x = _wrap_csr(indptr_addr, indptr_type, indices_addr, data_addr,
+                  data_type, nindptr, nelem, num_col)
+    ds.push(np.asarray(x.todense(), np.float64), start_row)
+    return True
+
+
+# ---- streaming metadata (reference: LGBM_DatasetInitStreaming /
+#      LGBM_DatasetPushRows*WithMetadata / LGBM_DatasetMarkFinished) ----
+
+def dataset_init_streaming(ds: "StreamingDataset", has_weights: int,
+                           has_init_scores: int, has_queries: int,
+                           nclasses: int) -> bool:
+    n = ds.num_total
+    ds.fields["label"] = np.zeros(n, np.float64)
+    if has_weights:
+        ds.fields["weight"] = np.zeros(n, np.float64)
+    if has_init_scores:
+        ds.fields["init_score"] = np.zeros((n, max(nclasses, 1)) if nclasses > 1
+                                           else n, np.float64)
+    if has_queries:
+        ds._stream_qids = np.zeros(n, np.int64)
+    ds._manual_finish = True
+    return True
+
+
+def dataset_push_rows_with_metadata(ds: "StreamingDataset", data_addr: int,
+                                    dtype_code: int, nrow: int, ncol: int,
+                                    start_row: int, label_addr: int,
+                                    weight_addr: int, init_score_addr: int,
+                                    query_addr: int) -> bool:
+    rows = np.array(_wrap_typed(data_addr, (nrow, ncol), dtype_code),
+                    np.float64)
+    ds.push(rows, start_row)
+    sl = slice(start_row, start_row + nrow)
+    if label_addr:
+        ds.fields.setdefault("label", np.zeros(ds.num_total, np.float64))[sl] = \
+            np.array(_wrap_typed(label_addr, (nrow,), 0))
+    if weight_addr:
+        ds.fields.setdefault("weight", np.zeros(ds.num_total, np.float64))[sl] = \
+            np.array(_wrap_typed(weight_addr, (nrow,), 0))
+    if init_score_addr:
+        _push_init_scores(ds, init_score_addr, nrow, sl)
+    if query_addr:
+        if not hasattr(ds, "_stream_qids"):
+            ds._stream_qids = np.zeros(ds.num_total, np.int64)
+        ds._stream_qids[sl] = np.array(_wrap_typed(query_addr, (nrow,), 2))
+    return True
+
+
+def _push_init_scores(ds, init_score_addr, nrow, sl):
+    """Multiclass pushes nrow*k doubles class-major (reference:
+    Metadata::InsertInitScores layout)."""
+    buf = ds.fields.setdefault("init_score", np.zeros(ds.num_total, np.float64))
+    if buf.ndim == 2:
+        k = buf.shape[1]
+        vals = np.array(_wrap_typed(init_score_addr, (k, nrow), 1))
+        buf[sl] = vals.T
+    else:
+        buf[sl] = np.array(_wrap_typed(init_score_addr, (nrow,), 1))
+
+
+def dataset_push_rows_by_csr_with_metadata(ds: "StreamingDataset",
+                                           indptr_addr: int, indptr_type: int,
+                                           indices_addr: int, data_addr: int,
+                                           data_type: int, nindptr: int,
+                                           nelem: int, num_col: int,
+                                           start_row: int, label_addr: int,
+                                           weight_addr: int,
+                                           init_score_addr: int,
+                                           query_addr: int) -> bool:
+    x = _wrap_csr(indptr_addr, indptr_type, indices_addr, data_addr,
+                  data_type, nindptr, nelem, num_col)
+    nrow = x.shape[0]
+    ds.push(np.asarray(x.todense(), np.float64), start_row)
+    sl = slice(start_row, start_row + nrow)
+    if label_addr:
+        ds.fields.setdefault("label", np.zeros(ds.num_total, np.float64))[sl] = \
+            np.array(_wrap_typed(label_addr, (nrow,), 0))
+    if weight_addr:
+        ds.fields.setdefault("weight", np.zeros(ds.num_total, np.float64))[sl] = \
+            np.array(_wrap_typed(weight_addr, (nrow,), 0))
+    if init_score_addr:
+        _push_init_scores(ds, init_score_addr, nrow, sl)
+    if query_addr:
+        if not hasattr(ds, "_stream_qids"):
+            ds._stream_qids = np.zeros(ds.num_total, np.int64)
+        ds._stream_qids[sl] = np.array(_wrap_typed(query_addr, (nrow,), 2))
+    return True
+
+
+def dataset_mark_finished(ds: "StreamingDataset") -> bool:
+    if hasattr(ds, "_stream_qids"):
+        qid = ds._stream_qids
+        change = np.nonzero(np.diff(qid) != 0)[0] + 1
+        bounds = np.concatenate([[0], change, [len(qid)]])
+        ds.fields["group"] = np.diff(bounds).astype(np.int64)
+    ds._finished = True
+    ds.dataset()
+    return True
+
+
+def dataset_set_wait_for_manual_finish(ds: "StreamingDataset",
+                                       wait: int) -> bool:
+    ds._manual_finish = bool(wait)
+    return True
+
+
+# ---- serialized reference + ByteBuffer (reference:
+#      LGBM_DatasetSerializeReferenceToBinary /
+#      LGBM_DatasetCreateFromSerializedReference / LGBM_ByteBuffer*) ----
+
+def dataset_serialize_reference(ds) -> bytes:
+    """Schema-only serialization: bin mappers + names, enough for a remote
+    worker to construct a bin-aligned streaming dataset."""
+    import pickle
+
+    ds = _as_dataset(ds)
+    ds.construct()
+    payload = {
+        "mappers": ds.binner.mappers,
+        "feature_names": list(ds.get_feature_name()),
+        "params": {k: v for k, v in (ds.params or {}).items()
+                   if isinstance(v, (int, float, str, bool))},
+    }
+    return pickle.dumps(payload)
+
+
+def dataset_from_serialized_reference(buf_addr: int, buf_size: int,
+                                      num_row: int,
+                                      parameters: str) -> "StreamingDataset":
+    import pickle
+
+    from .binning import DatasetBinner
+
+    raw = bytes((ctypes.c_uint8 * buf_size).from_address(buf_addr))
+    payload = pickle.loads(raw)
+    schema = Dataset.__new__(Dataset)
+    # minimal constructed schema carrier: mappers + names (StreamingDataset
+    # only reads binner/feature metadata from its reference)
+    n_feat = len(payload["mappers"])
+    schema.__dict__.update({
+        "binner": DatasetBinner(mappers=list(payload["mappers"])),
+        "feature_names": payload["feature_names"],
+        "params": dict(payload["params"], **_parse_params(parameters)),
+        "label": None, "weight": None, "group": None, "init_score": None,
+        "position": None, "data": None, "efb": None, "_efb_device": None,
+        "_constructed": True, "_num_feature": n_feat,
+        "_num_data": 0,
+    })
+    schema.bins = np.zeros((0, n_feat), np.int16)
+    return StreamingDataset(schema, num_row)
+
+
+# ---- booster model-surgery surface -------------------------------------
+
+def booster_merge(bst: Booster, other: Booster) -> bool:
+    """reference: LGBM_BoosterMerge — append other's trees.  Deep-copied:
+    later leaf mutations on either booster must not corrupt the other."""
+    import copy
+
+    bst._gbdt.models.extend(copy.deepcopy(t) for t in other._gbdt.models)
+    return True
+
+
+def booster_refit_leaf_preds(bst: Booster, leaf_addr: int, nrow: int,
+                             ncol: int) -> bool:
+    """reference: LGBM_BoosterRefit(leaf_preds) — renew leaf values of each
+    tree from the attached training data, rows assigned per the caller's
+    leaf-index matrix."""
+    from .objectives import create_objective
+
+    leaf = np.array(_wrap_typed(leaf_addr, (nrow, ncol), 2))
+    gbdt = bst._gbdt
+    ds = bst._train_set
+    if ds is None:
+        raise ValueError("Refit requires the training dataset to be attached")
+    label = np.asarray(_as_dataset(ds).label, np.float64)
+    cfg = gbdt.cfg
+    obj = create_objective(cfg)
+    k = gbdt.num_tree_per_iteration
+    decay = float(cfg.refit_decay_rate)
+    import jax.numpy as _jnp
+
+    score = np.zeros((nrow, k), np.float64) if k > 1 else np.zeros(nrow, np.float64)
+    for t_i, tree in enumerate(gbdt.models):
+        if t_i >= ncol:
+            break
+        c = t_i % k
+        if c == 0:  # gradients refresh once per boosting iteration
+            g, h = obj.get_gradients(_jnp.asarray(score, _jnp.float32),
+                                     _jnp.asarray(label, _jnp.float32), None)
+            g, h = np.asarray(g, np.float64), np.asarray(h, np.float64)
+            if g.ndim == 1 and k > 1:
+                g, h = g.reshape(k, nrow).T, h.reshape(k, nrow).T
+        gc = g[:, c] if g.ndim > 1 else g
+        hc = h[:, c] if h.ndim > 1 else h
+        li = leaf[:, t_i]
+        sum_g = np.bincount(li, weights=gc, minlength=tree.num_leaves)
+        sum_h = np.bincount(li, weights=hc, minlength=tree.num_leaves)
+        new_vals = -sum_g / (sum_h + cfg.lambda_l2 + 1e-15) * tree.shrinkage
+        tree.leaf_value = decay * tree.leaf_value + (1.0 - decay) * np.where(
+            sum_h > 0, new_vals, tree.leaf_value)
+        pred = tree.leaf_value[li]
+        if k > 1:
+            score[:, c] += pred
+        else:
+            score += pred
+    return True
+
+
+def booster_get_leaf_value(bst: Booster, tree_idx: int, leaf_idx: int) -> float:
+    return bst.get_leaf_output(tree_idx, leaf_idx)
+
+
+def booster_set_leaf_value(bst: Booster, tree_idx: int, leaf_idx: int,
+                           value: float) -> bool:
+    bst.set_leaf_output(tree_idx, leaf_idx, value)
+    return True
+
+
+def booster_get_linear(bst: Booster) -> int:
+    return 1 if getattr(bst._gbdt.cfg, "linear_tree", False) else 0
+
+
+def booster_num_model_per_iteration(bst: Booster) -> int:
+    return int(bst.num_model_per_iteration())
+
+
+def booster_lower_bound(bst: Booster) -> float:
+    return float(bst.lower_bound())
+
+
+def booster_upper_bound(bst: Booster) -> float:
+    return float(bst.upper_bound())
+
+
+def booster_eval_names(bst: Booster):
+    """Metric names without evaluating (reference: GetEvalNames is static
+    metadata; hosts call it every iteration)."""
+    names = []
+    for m in bst._gbdt.metrics:
+        if m.name in ("ndcg", "map"):
+            names.extend(f"{m.name}@{k}" for k in m.cfg.eval_at)
+        else:
+            names.append(m.name)
+    return names
+
+
+def booster_feature_names(bst: Booster):
+    return list(bst.feature_name())
+
+
+def booster_loaded_param(bst: Booster) -> str:
+    import json
+
+    cfg = bst._gbdt.cfg
+    return json.dumps({k: v for k, v in cfg.to_dict().items()
+                       if isinstance(v, (int, float, str, bool))},
+                      default=str)
+
+
+def booster_validate_feature_names(bst: Booster, names) -> bool:
+    model_names = list(bst.feature_name())
+    names = list(names)
+    if len(names) != len(model_names) or any(
+            a != b for a, b in zip(names, model_names)):
+        raise ValueError(
+            "Expected feature names %r, got %r" % (model_names, names))
+    return True
+
+
+def booster_shuffle_models(bst: Booster, start_iter: int,
+                           end_iter: int) -> bool:
+    bst.shuffle_models(start_iter, end_iter)
+    return True
+
+
+def booster_get_num_predict(bst: Booster, data_idx: int) -> int:
+    gbdt = bst._gbdt
+    score = gbdt._score if data_idx == 0 else gbdt._valid_scores[data_idx - 1]
+    return int(np.prod(score.shape))
+
+
+def booster_get_predict_into(bst: Booster, data_idx: int,
+                             out_addr: int) -> int:
+    """reference: LGBM_BoosterGetPredict — current raw scores of the
+    train (0) or (i-1)-th valid dataset."""
+    gbdt = bst._gbdt
+    score = gbdt._score if data_idx == 0 else gbdt._valid_scores[data_idx - 1]
+    out = np.ascontiguousarray(np.asarray(score), np.float64).ravel()
+    dest = _wrap(out_addr, (out.size,))
+    dest[:] = out
+    return int(out.size)
+
+
+def booster_calc_num_predict(bst: Booster, num_row: int, predict_type: int,
+                             start_iteration: int, num_iteration: int) -> int:
+    gbdt = bst._gbdt
+    k = gbdt.num_tree_per_iteration
+    total_iters = len(gbdt.models) // max(k, 1)
+    if num_iteration <= 0:
+        num_iteration = total_iters - start_iteration
+    num_iteration = max(0, min(num_iteration, total_iters - start_iteration))
+    if predict_type == _PREDICT_LEAF_INDEX:
+        return num_row * num_iteration * k
+    if predict_type == _PREDICT_CONTRIB:
+        return num_row * k * (bst.num_feature() + 1)
+    return num_row * k
+
+
+def predict_for_file(bst: Booster, data_filename: str, data_has_header: int,
+                     predict_type: int, start_iteration: int,
+                     num_iteration: int, parameter: str,
+                     result_filename: str) -> bool:
+    """reference: LGBM_BoosterPredictForFile via Predictor — batch predict a
+    data file to a result file, one row per line."""
+    from .io.parser import load_data_file
+
+    p = _parse_params(parameter)
+    loaded = load_data_file(data_filename, header=bool(data_has_header),
+                            label_column=str(p.get("label_column", "")))
+    kw = dict(num_iteration=num_iteration if num_iteration > 0 else -1,
+              start_iteration=start_iteration)
+    if predict_type == _PREDICT_LEAF_INDEX:
+        out = bst.predict(loaded["data"], pred_leaf=True, **kw)
+    elif predict_type == _PREDICT_CONTRIB:
+        out = bst.predict(loaded["data"], pred_contrib=True, **kw)
+    elif predict_type == _PREDICT_RAW_SCORE:
+        out = bst.predict(loaded["data"], raw_score=True, **kw)
+    else:
+        out = bst.predict(loaded["data"], **kw)
+    out = np.atleast_2d(np.asarray(out, np.float64))
+    if out.shape[0] == 1 and len(loaded["data"]) != 1:
+        out = out.T
+    with open(result_filename, "w") as f:
+        for row in out:
+            f.write("\t".join(repr(float(v)) for v in np.atleast_1d(row)) + "\n")
+    return True
+
+
+def predict_csr_single_row_into(bst: Booster, indptr_addr: int,
+                                indptr_type: int, indices_addr: int,
+                                data_addr: int, data_type: int, nindptr: int,
+                                nelem: int, num_col: int, predict_type: int,
+                                out_addr: int) -> int:
+    x = _wrap_csr(indptr_addr, indptr_type, indices_addr, data_addr,
+                  data_type, nindptr, nelem, num_col)
+    return _predict_any_into(bst, x, predict_type, out_addr)
+
+
+def predict_csr_single_row_fast_init(bst: Booster, predict_type: int,
+                                     data_type: int, num_col: int,
+                                     parameters: str = "") -> _FastConfig:
+    return _FastConfig(bst, predict_type, data_type, num_col, parameters)
+
+
+def predict_csr_single_row_fast(cfg: _FastConfig, indptr_addr: int,
+                                indptr_type: int, indices_addr: int,
+                                data_addr: int, nindptr: int, nelem: int,
+                                out_addr: int) -> int:
+    x = _wrap_csr(indptr_addr, indptr_type, indices_addr, data_addr,
+                  cfg.data_type, nindptr, nelem, cfg.ncol)
+    return _predict_any_into(cfg.bst, x, cfg.predict_type, out_addr,
+                             num_iteration=cfg.num_iteration,
+                             start_iteration=cfg.start_iteration,
+                             **cfg.kwargs)
+
+
+# ---- network surface (reference: LGBM_NetworkInit / Free /
+#      InitWithFunctions).  On TPU the collective transport is XLA over
+#      ICI/DCN; these entries configure the machine-list bring-up that
+#      parallel/distributed.py maps onto jax.distributed. ----
+
+_NETWORK_PARAMS: dict = {}
+
+
+def network_init(machines: str, local_listen_port: int, listen_time_out: int,
+                 num_machines: int) -> bool:
+    _NETWORK_PARAMS.clear()
+    if num_machines > 1:
+        _NETWORK_PARAMS.update({
+            "machines": machines,
+            "local_listen_port": int(local_listen_port),
+            "time_out": int(listen_time_out),
+            "num_machines": int(num_machines),
+        })
+        from .config import Config
+        from .parallel.distributed import init_distributed
+
+        cfg = Config.from_dict(dict(_NETWORK_PARAMS))
+        init_distributed(cfg)
+    return True
+
+
+def network_free() -> bool:
+    _NETWORK_PARAMS.clear()
+    return True
+
+
+def network_init_with_functions(num_machines: int, rank: int) -> bool:
+    """reference: LGBM_NetworkInitWithFunctions lets the host (SynapseML)
+    supply reduce-scatter/allgather function pointers.  XLA owns the
+    collective transport here, so the pointers are not callable into the
+    compiled path; we accept the topology (ranks still drive pre_partition
+    semantics) and warn.  docs/BINDINGS.md records the deviation."""
+    from .utils.log import log_warning
+
+    _NETWORK_PARAMS.clear()
+    if num_machines > 1:
+        _NETWORK_PARAMS.update({"num_machines": int(num_machines),
+                                "rank": int(rank)})
+        log_warning(
+            "LGBM_NetworkInitWithFunctions: external collective functions are "
+            "replaced by XLA collectives on TPU; topology (num_machines=%d, "
+            "rank=%d) recorded" % (num_machines, rank))
+    return True
+
+
+def network_params() -> dict:
+    """Booster creation merges these (reference: Network state is global)."""
+    return dict(_NETWORK_PARAMS)
+
+
+# ---- global configuration surface --------------------------------------
+
+def dump_param_aliases() -> str:
+    """reference: LGBM_DumpParamAliases — JSON of parameter -> aliases."""
+    import json
+
+    from .config import _ALIASES
+
+    table: dict = {}
+    for alias, canonical in _ALIASES.items():
+        table.setdefault(canonical, []).append(alias)
+    return json.dumps(table, sort_keys=True)
+
+
+_MAX_THREADS = [0]  # 0/-1 = OMP default in the reference; advisory here
+
+
+def get_max_threads() -> int:
+    return _MAX_THREADS[0] if _MAX_THREADS[0] > 0 else -1
+
+
+def set_max_threads(n: int) -> bool:
+    """Host-side parallelism cap (reference: LGBM_SetMaxThreads).  Device
+    compute is XLA-scheduled; this caps host binning/parsing threads."""
+    _MAX_THREADS[0] = int(n)
+    return True
+
+
+_LOG_CALLBACK = [None]
+
+
+def register_log_callback(fn_addr: int) -> bool:
+    """reference: LGBM_RegisterLogCallback(void (*)(const char*))."""
+    from .utils import log as _log
+
+    cb = ctypes.CFUNCTYPE(None, ctypes.c_char_p)(fn_addr)
+    _LOG_CALLBACK[0] = cb  # keep alive
+
+    class _CRedirect:
+        def info(self, msg):
+            cb(str(msg).encode())
+
+        warning = info
+
+    _log.register_logger(_CRedirect())
+    return True
+
+
+def get_sample_count(num_total_row: int, parameters: str) -> int:
+    p = _parse_params(parameters)
+    from .config import Config
+
+    cfg = Config.from_dict(p)
+    return int(min(cfg.bin_construct_sample_cnt, num_total_row))
+
+
+def sample_indices_into(num_total_row: int, parameters: str,
+                        out_addr: int) -> int:
+    """reference: LGBM_SampleIndices — deterministic row sample for
+    sampled-column dataset construction (int32 out)."""
+    cnt = get_sample_count(num_total_row, parameters)
+    p = _parse_params(parameters)
+    from .config import Config
+
+    cfg = Config.from_dict(p)
+    rng = np.random.RandomState(cfg.data_random_seed)
+    if cnt >= num_total_row:
+        idx = np.arange(num_total_row, dtype=np.int32)
+    else:
+        idx = np.sort(rng.choice(num_total_row, size=cnt,
+                                 replace=False)).astype(np.int32)
+    dest = (ctypes.c_int32 * len(idx)).from_address(out_addr)
+    dest[:] = idx.tolist()
+    return len(idx)

@@ -125,6 +125,53 @@ PyObject* call_helper(const char* method, const char* fmt, ...) {
   return r;
 }
 
+// Fill a char** with a Python list of str using the reference's
+// (len buffers of buffer_len) + size-then-fill contract.
+int strlist_to_buffers(PyObject* list, int len, int* out_len,
+                       size_t buffer_len, size_t* out_buffer_len,
+                       char** out_strs) {
+  if (!PyList_Check(list)) {
+    set_last_error("expected list of names");
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(list);
+  *out_len = static_cast<int>(n);
+  size_t need = 1;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    Py_ssize_t sz = 0;
+    const char* c = PyUnicode_AsUTF8AndSize(PyList_GetItem(list, i), &sz);
+    if (c == nullptr) {
+      set_error_from_python();
+      return -1;
+    }
+    if (static_cast<size_t>(sz) + 1 > need) need = static_cast<size_t>(sz) + 1;
+    if (out_strs != nullptr && i < len && buffer_len > 0) {
+      size_t ncopy = static_cast<size_t>(sz) + 1 <= buffer_len
+                         ? static_cast<size_t>(sz) + 1
+                         : buffer_len;
+      std::memcpy(out_strs[i], c, ncopy);
+      out_strs[i][ncopy - 1] = '\0';
+    }
+  }
+  *out_buffer_len = need;
+  return 0;
+}
+
+// Build a Python list[str] from a char** (for SetFeatureNames etc.).
+PyObject* buffers_to_strlist(const char** strs, int n) {
+  PyObject* list = PyList_New(n);
+  if (list == nullptr) return nullptr;
+  for (int i = 0; i < n; ++i) {
+    PyObject* s = PyUnicode_FromString(strs[i]);
+    if (s == nullptr) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SetItem(list, i, s);  // steals
+  }
+  return list;
+}
+
 // Copy a Python str into a caller buffer with the reference's
 // size-then-fill contract.
 int str_to_buffer(PyObject* s, int64_t buffer_len, int64_t* out_len,
@@ -586,6 +633,736 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const double* data,
     return -1;
   }
   *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- CSC ---- */
+
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  GilGuard gil;
+  PyObject* ref = reference != nullptr ? static_cast<PyObject*>(reference)
+                                       : Py_None;
+  PyObject* r = call_helper(
+      "dataset_from_csc", "(KiKKiLLLsO)",
+      reinterpret_cast<unsigned long long>(col_ptr), col_ptr_type,
+      reinterpret_cast<unsigned long long>(indices),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<long long>(ncol_ptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_row), parameters, ref);
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem, int64_t num_row,
+                              int predict_type, int64_t* out_len,
+                              double* out_result) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_csc_into", "(OKiKKiLLLiK)", static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(col_ptr), col_ptr_type,
+      reinterpret_cast<unsigned long long>(indices),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<long long>(ncol_ptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_row), predict_type,
+      reinterpret_cast<unsigned long long>(out_result));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- multi-block matrices ---- */
+
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data, int data_type,
+                               int32_t* nrow, int32_t ncol, int is_row_major,
+                               const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out) {
+  GilGuard gil;
+  PyObject* ref = reference != nullptr ? static_cast<PyObject*>(reference)
+                                       : Py_None;
+  PyObject* r = call_helper(
+      "dataset_from_mats", "(iKiKiisO)", static_cast<int>(nmat),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      reinterpret_cast<unsigned long long>(nrow), static_cast<int>(ncol),
+      is_row_major, parameters, ref);
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
+                               int data_type, int32_t nmat, int32_t* nrow,
+                               int32_t ncol, int predict_type,
+                               int64_t* out_len, double* out_result) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_mats_into", "(OiKiKiiK)", static_cast<PyObject*>(handle),
+      static_cast<int>(nmat), reinterpret_cast<unsigned long long>(data),
+      data_type, reinterpret_cast<unsigned long long>(nrow),
+      static_cast<int>(ncol), predict_type,
+      reinterpret_cast<unsigned long long>(out_result));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- sampled-column construction ---- */
+
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices, int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_local_row,
+                                        int64_t num_dist_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out) {
+  (void)num_dist_total_row; /* distributed total used only for logging */
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "dataset_from_sampled_column", "(KKiKiis)",
+      reinterpret_cast<unsigned long long>(sample_data),
+      reinterpret_cast<unsigned long long>(sample_indices),
+      static_cast<int>(ncol),
+      reinterpret_cast<unsigned long long>(num_per_col),
+      static_cast<int>(num_sample_row), static_cast<int>(num_local_row),
+      parameters);
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+/* ---- dataset field / names / persistence ---- */
+
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr, int* out_type) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_get_field", "(Os)",
+                            static_cast<PyObject*>(handle), field_name);
+  if (r == nullptr) return -1;
+  unsigned long long addr = 0;
+  int n = 0, code = 0;
+  if (!PyArg_ParseTuple(r, "Kii", &addr, &n, &code)) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_ptr = reinterpret_cast<const void*>(addr);
+  *out_len = n;
+  *out_type = code;
+  return 0;
+}
+
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names) {
+  GilGuard gil;
+  PyObject* list = buffers_to_strlist(feature_names, num_feature_names);
+  if (list == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = call_helper("dataset_set_feature_names", "(OO)",
+                            static_cast<PyObject*>(handle), list);
+  Py_DECREF(list);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, const int len,
+                                int* out_len, const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_feature_names", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  int rc = strlist_to_buffers(r, len, out_len, buffer_len, out_buffer_len,
+                              out_strs);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_save_binary", "(Os)",
+                            static_cast<PyObject*>(handle), filename);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_dump_text", "(Os)",
+                            static_cast<PyObject*>(handle), filename);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "dataset_get_subset", "(OKis)", static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(used_row_indices),
+      static_cast<int>(num_used_row_indices), parameters);
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+int LGBM_DatasetAddFeaturesFrom(DatasetHandle target, DatasetHandle source) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_add_features_from", "(OO)",
+                            static_cast<PyObject*>(target),
+                            static_cast<PyObject*>(source));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                    const char* new_parameters) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_update_param_checking", "(ss)",
+                            old_parameters, new_parameters);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type, int64_t nindptr,
+                              int64_t nelem, int64_t num_col,
+                              int32_t start_row) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "dataset_push_rows_by_csr", "(OKiKKiLLLi)",
+      static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(indptr), indptr_type,
+      reinterpret_cast<unsigned long long>(indices),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col), static_cast<int>(start_row));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- streaming with metadata ---- */
+
+int LGBM_DatasetInitStreaming(DatasetHandle handle, int32_t has_weights,
+                              int32_t has_init_scores, int32_t has_queries,
+                              int32_t nclasses, int32_t nthreads,
+                              int32_t omp_max_threads) {
+  (void)nthreads;
+  (void)omp_max_threads; /* host threading is numpy's job here */
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "dataset_init_streaming", "(Oiiii)", static_cast<PyObject*>(handle),
+      static_cast<int>(has_weights), static_cast<int>(has_init_scores),
+      static_cast<int>(has_queries), static_cast<int>(nclasses));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetPushRowsWithMetadata(DatasetHandle handle, const void* data,
+                                     int data_type, int32_t nrow, int32_t ncol,
+                                     int32_t start_row, const float* label,
+                                     const float* weight,
+                                     const double* init_score,
+                                     const int32_t* query, int32_t tid) {
+  (void)tid;
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "dataset_push_rows_with_metadata", "(OKiiiiKKKK)",
+      static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<int>(nrow), static_cast<int>(ncol),
+      static_cast<int>(start_row),
+      reinterpret_cast<unsigned long long>(label),
+      reinterpret_cast<unsigned long long>(weight),
+      reinterpret_cast<unsigned long long>(init_score),
+      reinterpret_cast<unsigned long long>(query));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetPushRowsByCSRWithMetadata(
+    DatasetHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col, int32_t start_row, const float* label,
+    const float* weight, const double* init_score, const int32_t* query,
+    int32_t tid) {
+  (void)tid;
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "dataset_push_rows_by_csr_with_metadata", "(OKiKKiLLLiKKKK)",
+      static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(indptr), indptr_type,
+      reinterpret_cast<unsigned long long>(indices),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col), static_cast<int>(start_row),
+      reinterpret_cast<unsigned long long>(label),
+      reinterpret_cast<unsigned long long>(weight),
+      reinterpret_cast<unsigned long long>(init_score),
+      reinterpret_cast<unsigned long long>(query));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetMarkFinished(DatasetHandle handle) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_mark_finished", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetSetWaitForManualFinish(DatasetHandle handle, int wait) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_set_wait_for_manual_finish", "(Oi)",
+                            static_cast<PyObject*>(handle), wait);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- serialized reference + ByteBuffer ---- */
+
+int LGBM_DatasetSerializeReferenceToBinary(DatasetHandle handle,
+                                           ByteBufferHandle* out,
+                                           int32_t* out_len) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_serialize_reference", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<ByteBufferHandle>(r); /* Python bytes object */
+  *out_len = static_cast<int32_t>(PyBytes_Size(r));
+  return 0;
+}
+
+int LGBM_ByteBufferGetAt(ByteBufferHandle handle, int32_t index,
+                         uint8_t* out_val) {
+  GilGuard gil;
+  PyObject* bytes = static_cast<PyObject*>(handle);
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &n) != 0 || index < 0 ||
+      index >= n) {
+    PyErr_Clear();
+    set_last_error("ByteBuffer index out of range");
+    return -1;
+  }
+  *out_val = static_cast<uint8_t>(buf[index]);
+  return 0;
+}
+
+int LGBM_ByteBufferFree(ByteBufferHandle handle) {
+  if (handle == nullptr) return 0;
+  GilGuard gil;
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+int LGBM_DatasetCreateFromSerializedReference(const void* ref_buffer,
+                                              int32_t ref_buffer_size,
+                                              int64_t num_row,
+                                              int32_t num_classes,
+                                              const char* parameters,
+                                              DatasetHandle* out) {
+  (void)num_classes; /* class count rides in parameters */
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "dataset_from_serialized_reference", "(KiLs)",
+      reinterpret_cast<unsigned long long>(ref_buffer),
+      static_cast<int>(ref_buffer_size), static_cast<long long>(num_row),
+      parameters);
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+/* ---- booster model surgery & introspection ---- */
+
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_merge", "(OO)",
+                            static_cast<PyObject*>(handle),
+                            static_cast<PyObject*>(other_handle));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "booster_refit_leaf_preds", "(OKii)", static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(leaf_preds),
+      static_cast<int>(nrow), static_cast<int>(ncol));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx, int leaf_idx,
+                             double* out_val) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_get_leaf_value", "(Oii)",
+                            static_cast<PyObject*>(handle), tree_idx,
+                            leaf_idx);
+  if (r == nullptr) return -1;
+  *out_val = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx, int leaf_idx,
+                             double val) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_set_leaf_value", "(Oiid)",
+                            static_cast<PyObject*>(handle), tree_idx, leaf_idx,
+                            val);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetLinear(BoosterHandle handle, int* out) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_get_linear", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                     int* out_tree_per_iteration) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_num_model_per_iteration", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  *out_tree_per_iteration = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetLowerBoundValue(BoosterHandle handle,
+                                   double* out_results) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_lower_bound", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  out_results[0] = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetUpperBoundValue(BoosterHandle handle,
+                                   double* out_results) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_upper_bound", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  out_results[0] = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, const int len, int* out_len,
+                             const size_t buffer_len, size_t* out_buffer_len,
+                             char** out_strs) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_eval_names", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  int rc = strlist_to_buffers(r, len, out_len, buffer_len, out_buffer_len,
+                              out_strs);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, const int len,
+                                int* out_len, const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_feature_names", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  int rc = strlist_to_buffers(r, len, out_len, buffer_len, out_buffer_len,
+                              out_strs);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterGetLoadedParam(BoosterHandle handle, int64_t buffer_len,
+                               int64_t* out_len, char* out_str) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_loaded_param", "(O)",
+                            static_cast<PyObject*>(handle));
+  if (r == nullptr) return -1;
+  int rc = str_to_buffer(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterValidateFeatureNames(BoosterHandle handle,
+                                     const char** data_names,
+                                     int data_num_features) {
+  GilGuard gil;
+  PyObject* list = buffers_to_strlist(data_names, data_num_features);
+  if (list == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = call_helper("booster_validate_feature_names", "(OO)",
+                            static_cast<PyObject*>(handle), list);
+  Py_DECREF(list);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_shuffle_models", "(Oii)",
+                            static_cast<PyObject*>(handle), start_iter,
+                            end_iter);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_get_num_predict", "(Oi)",
+                            static_cast<PyObject*>(handle), data_idx);
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "booster_get_predict_into", "(OiK)", static_cast<PyObject*>(handle),
+      data_idx, reinterpret_cast<unsigned long long>(out_result));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int start_iteration,
+                               int num_iteration, int64_t* out_len) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_calc_num_predict", "(Oiiii)",
+                            static_cast<PyObject*>(handle), num_row,
+                            predict_type, start_iteration, num_iteration);
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle, const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int start_iteration, int num_iteration,
+                               const char* parameter,
+                               const char* result_filename) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_for_file", "(Osiiiiss)", static_cast<PyObject*>(handle),
+      data_filename, data_has_header, predict_type, start_iteration,
+      num_iteration, parameter == nullptr ? "" : parameter, result_filename);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
+                                       const void* indptr, int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col, int predict_type,
+                                       int64_t* out_len, double* out_result) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_csr_single_row_into", "(OKiKKiLLLiK)",
+      static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(indptr), indptr_type,
+      reinterpret_cast<unsigned long long>(indices),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col), predict_type,
+      reinterpret_cast<unsigned long long>(out_result));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSRSingleRowFastInit(BoosterHandle handle,
+                                               int predict_type, int data_type,
+                                               int64_t num_col,
+                                               const char* parameters,
+                                               FastConfigHandle* out) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_csr_single_row_fast_init", "(Oiiis)",
+      static_cast<PyObject*>(handle), predict_type, data_type,
+      static_cast<int>(num_col), parameters == nullptr ? "" : parameters);
+  if (r == nullptr) return -1;
+  *out = static_cast<FastConfigHandle>(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSRSingleRowFast(FastConfigHandle fast_config,
+                                           const void* indptr,
+                                           int indptr_type,
+                                           const int32_t* indices,
+                                           const void* data, int64_t nindptr,
+                                           int64_t nelem, int64_t* out_len,
+                                           double* out_result) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_csr_single_row_fast", "(OKiKKLLK)",
+      static_cast<PyObject*>(fast_config),
+      reinterpret_cast<unsigned long long>(indptr), indptr_type,
+      reinterpret_cast<unsigned long long>(indices),
+      reinterpret_cast<unsigned long long>(data),
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      reinterpret_cast<unsigned long long>(out_result));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- network ---- */
+
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines) {
+  GilGuard gil;
+  PyObject* r = call_helper("network_init", "(siii)",
+                            machines == nullptr ? "" : machines,
+                            local_listen_port, listen_time_out, num_machines);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_NetworkFree(void) {
+  GilGuard gil;
+  PyObject* r = call_helper("network_free", "()");
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                  void* reduce_scatter_ext_fun,
+                                  void* allgather_ext_fun) {
+  (void)reduce_scatter_ext_fun;
+  (void)allgather_ext_fun; /* XLA owns the transport; see header note */
+  GilGuard gil;
+  PyObject* r = call_helper("network_init_with_functions", "(ii)",
+                            num_machines, rank);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- global configuration ---- */
+
+int LGBM_DumpParamAliases(int64_t buffer_len, int64_t* out_len,
+                          char* out_str) {
+  GilGuard gil;
+  PyObject* r = call_helper("dump_param_aliases", "()");
+  if (r == nullptr) return -1;
+  int rc = str_to_buffer(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_GetMaxThreads(int* out) {
+  GilGuard gil;
+  PyObject* r = call_helper("get_max_threads", "()");
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_SetMaxThreads(int num_threads) {
+  GilGuard gil;
+  PyObject* r = call_helper("set_max_threads", "(i)", num_threads);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_RegisterLogCallback(void (*callback)(const char*)) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "register_log_callback", "(K)",
+      reinterpret_cast<unsigned long long>(callback));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_GetSampleCount(int32_t num_total_row, const char* parameters,
+                        int* out) {
+  GilGuard gil;
+  PyObject* r = call_helper("get_sample_count", "(is)",
+                            static_cast<int>(num_total_row), parameters);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_SampleIndices(int32_t num_total_row, const char* parameters,
+                       void* out, int32_t* out_len) {
+  GilGuard gil;
+  PyObject* r = call_helper("sample_indices_into", "(isK)",
+                            static_cast<int>(num_total_row), parameters,
+                            reinterpret_cast<unsigned long long>(out));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int32_t>(PyLong_AsLong(r));
   Py_DECREF(r);
   return 0;
 }

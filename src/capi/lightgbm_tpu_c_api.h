@@ -11,6 +11,7 @@
 #ifndef LIGHTGBM_TPU_C_API_H_
 #define LIGHTGBM_TPU_C_API_H_
 
+#include <stddef.h>
 #include <stdint.h>
 
 #ifdef __cplusplus
@@ -223,6 +224,338 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle,
                               int32_t predict_type,
                               int64_t* out_len,
                               double* out_result);
+
+/* ---- CSC ingestion & prediction (reference: LGBM_DatasetCreateFromCSC,
+ * LGBM_BoosterPredictForCSC).  col_ptr has ncol_ptr entries; indices are
+ * int32 row ids; num_row is the dense row count. */
+int LGBM_DatasetCreateFromCSC(const void* col_ptr,
+                              int col_ptr_type,
+                              const int32_t* indices,
+                              const void* data,
+                              int data_type,
+                              int64_t ncol_ptr,
+                              int64_t nelem,
+                              int64_t num_row,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+
+int LGBM_BoosterPredictForCSC(BoosterHandle handle,
+                              const void* col_ptr,
+                              int col_ptr_type,
+                              const int32_t* indices,
+                              const void* data,
+                              int data_type,
+                              int64_t ncol_ptr,
+                              int64_t nelem,
+                              int64_t num_row,
+                              int predict_type,
+                              int64_t* out_len,
+                              double* out_result);
+
+/* ---- multi-block matrices (reference: LGBM_DatasetCreateFromMats,
+ * LGBM_BoosterPredictForMats).  data: nmat pointers; nrow: rows per mat. */
+int LGBM_DatasetCreateFromMats(int32_t nmat,
+                               const void** data,
+                               int data_type,
+                               int32_t* nrow,
+                               int32_t ncol,
+                               int is_row_major,
+                               const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
+
+int LGBM_BoosterPredictForMats(BoosterHandle handle,
+                               const void** data,
+                               int data_type,
+                               int32_t nmat,
+                               int32_t* nrow,
+                               int32_t ncol,
+                               int predict_type,
+                               int64_t* out_len,
+                               double* out_result);
+
+/* ---- sampled-column schema construction (reference:
+ * LGBM_DatasetCreateFromSampledColumn → ConstructBinMappersFromSampleData;
+ * bin mappers come from the per-column sample, rows arrive via PushRows). */
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices,
+                                        int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_local_row,
+                                        int64_t num_dist_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out);
+
+/* ---- dataset field/name/persistence (reference: LGBM_DatasetGetField,
+ * Set/GetFeatureNames, SaveBinary, DumpText, GetSubset, AddFeaturesFrom,
+ * UpdateParamChecking). */
+
+/* *out_ptr points into dataset-owned memory (valid until the dataset is
+ * freed); *out_type is a C_API_DTYPE code. */
+int LGBM_DatasetGetField(DatasetHandle handle,
+                         const char* field_name,
+                         int* out_len,
+                         const void** out_ptr,
+                         int* out_type);
+
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names);
+
+/* len buffers of buffer_len bytes each; *out_len = #names,
+ * *out_buffer_len = max name length incl. NUL (size-then-fill). */
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle,
+                                const int len,
+                                int* out_len,
+                                const size_t buffer_len,
+                                size_t* out_buffer_len,
+                                char** out_strs);
+
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
+
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename);
+
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters,
+                          DatasetHandle* out);
+
+int LGBM_DatasetAddFeaturesFrom(DatasetHandle target, DatasetHandle source);
+
+int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                    const char* new_parameters);
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle handle,
+                              const void* indptr,
+                              int indptr_type,
+                              const int32_t* indices,
+                              const void* data,
+                              int data_type,
+                              int64_t nindptr,
+                              int64_t nelem,
+                              int64_t num_col,
+                              int32_t start_row);
+
+/* ---- streaming with metadata (reference: LGBM_DatasetInitStreaming,
+ * LGBM_DatasetPushRowsWithMetadata, LGBM_DatasetMarkFinished,
+ * LGBM_DatasetSetWaitForManualFinish). */
+int LGBM_DatasetInitStreaming(DatasetHandle handle,
+                              int32_t has_weights,
+                              int32_t has_init_scores,
+                              int32_t has_queries,
+                              int32_t nclasses,
+                              int32_t nthreads,
+                              int32_t omp_max_threads);
+
+int LGBM_DatasetPushRowsWithMetadata(DatasetHandle handle,
+                                     const void* data,
+                                     int data_type,
+                                     int32_t nrow,
+                                     int32_t ncol,
+                                     int32_t start_row,
+                                     const float* label,
+                                     const float* weight,
+                                     const double* init_score,
+                                     const int32_t* query,
+                                     int32_t tid);
+
+int LGBM_DatasetPushRowsByCSRWithMetadata(DatasetHandle handle,
+                                          const void* indptr,
+                                          int indptr_type,
+                                          const int32_t* indices,
+                                          const void* data,
+                                          int data_type,
+                                          int64_t nindptr,
+                                          int64_t nelem,
+                                          int64_t num_col,
+                                          int32_t start_row,
+                                          const float* label,
+                                          const float* weight,
+                                          const double* init_score,
+                                          const int32_t* query,
+                                          int32_t tid);
+
+int LGBM_DatasetMarkFinished(DatasetHandle handle);
+
+int LGBM_DatasetSetWaitForManualFinish(DatasetHandle handle, int wait);
+
+/* ---- serialized dataset reference + ByteBuffer (reference:
+ * LGBM_DatasetSerializeReferenceToBinary,
+ * LGBM_DatasetCreateFromSerializedReference, LGBM_ByteBuffer*). */
+typedef void* ByteBufferHandle;
+
+int LGBM_DatasetSerializeReferenceToBinary(DatasetHandle handle,
+                                           ByteBufferHandle* out,
+                                           int32_t* out_len);
+
+int LGBM_ByteBufferGetAt(ByteBufferHandle handle, int32_t index,
+                         uint8_t* out_val);
+
+int LGBM_ByteBufferFree(ByteBufferHandle handle);
+
+int LGBM_DatasetCreateFromSerializedReference(const void* ref_buffer,
+                                              int32_t ref_buffer_size,
+                                              int64_t num_row,
+                                              int32_t num_classes,
+                                              const char* parameters,
+                                              DatasetHandle* out);
+
+/* ---- booster model surgery & introspection ---- */
+
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle);
+
+/* leaf_preds: (nrow x num_trees) int32 leaf assignments on the attached
+ * training data (reference: GBDT::RefitTree). */
+int LGBM_BoosterRefit(BoosterHandle handle,
+                      const int32_t* leaf_preds,
+                      int32_t nrow,
+                      int32_t ncol);
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle,
+                             int tree_idx,
+                             int leaf_idx,
+                             double* out_val);
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle,
+                             int tree_idx,
+                             int leaf_idx,
+                             double val);
+
+int LGBM_BoosterGetLinear(BoosterHandle handle, int* out);
+
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                     int* out_tree_per_iteration);
+
+/* out_results: double[num_class]. */
+int LGBM_BoosterGetLowerBoundValue(BoosterHandle handle, double* out_results);
+
+int LGBM_BoosterGetUpperBoundValue(BoosterHandle handle, double* out_results);
+
+int LGBM_BoosterGetEvalNames(BoosterHandle handle,
+                             const int len,
+                             int* out_len,
+                             const size_t buffer_len,
+                             size_t* out_buffer_len,
+                             char** out_strs);
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle,
+                                const int len,
+                                int* out_len,
+                                const size_t buffer_len,
+                                size_t* out_buffer_len,
+                                char** out_strs);
+
+int LGBM_BoosterGetLoadedParam(BoosterHandle handle,
+                               int64_t buffer_len,
+                               int64_t* out_len,
+                               char* out_str);
+
+int LGBM_BoosterValidateFeatureNames(BoosterHandle handle,
+                                     const char** data_names,
+                                     int data_num_features);
+
+int LGBM_BoosterShuffleModels(BoosterHandle handle,
+                              int start_iter,
+                              int end_iter);
+
+/* Raw scores of the train (data_idx 0) or (i-1)-th valid dataset. */
+int LGBM_BoosterGetNumPredict(BoosterHandle handle,
+                              int data_idx,
+                              int64_t* out_len);
+
+int LGBM_BoosterGetPredict(BoosterHandle handle,
+                           int data_idx,
+                           int64_t* out_len,
+                           double* out_result);
+
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle,
+                               int num_row,
+                               int predict_type,
+                               int start_iteration,
+                               int num_iteration,
+                               int64_t* out_len);
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header,
+                               int predict_type,
+                               int start_iteration,
+                               int num_iteration,
+                               const char* parameter,
+                               const char* result_filename);
+
+int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
+                                       const void* indptr,
+                                       int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data,
+                                       int data_type,
+                                       int64_t nindptr,
+                                       int64_t nelem,
+                                       int64_t num_col,
+                                       int predict_type,
+                                       int64_t* out_len,
+                                       double* out_result);
+
+int LGBM_BoosterPredictForCSRSingleRowFastInit(BoosterHandle handle,
+                                               int predict_type,
+                                               int data_type,
+                                               int64_t num_col,
+                                               const char* parameters,
+                                               FastConfigHandle* out);
+
+int LGBM_BoosterPredictForCSRSingleRowFast(FastConfigHandle fast_config,
+                                           const void* indptr,
+                                           int indptr_type,
+                                           const int32_t* indices,
+                                           const void* data,
+                                           int64_t nindptr,
+                                           int64_t nelem,
+                                           int64_t* out_len,
+                                           double* out_result);
+
+/* ---- network bring-up (reference: LGBM_NetworkInit over socket/MPI
+ * linkers; here the machine list drives jax.distributed + XLA collectives
+ * — see docs/DISTRIBUTED.md). ---- */
+int LGBM_NetworkInit(const char* machines,
+                     int local_listen_port,
+                     int listen_time_out,
+                     int num_machines);
+
+int LGBM_NetworkFree(void);
+
+/* External collective fn pointers are not callable from the XLA-compiled
+ * path; topology is honored, transport is XLA's (docs/BINDINGS.md). */
+int LGBM_NetworkInitWithFunctions(int num_machines,
+                                  int rank,
+                                  void* reduce_scatter_ext_fun,
+                                  void* allgather_ext_fun);
+
+/* ---- global configuration (reference: LGBM_DumpParamAliases,
+ * LGBM_Get/SetMaxThreads, LGBM_RegisterLogCallback, LGBM_GetSampleCount,
+ * LGBM_SampleIndices). ---- */
+int LGBM_DumpParamAliases(int64_t buffer_len,
+                          int64_t* out_len,
+                          char* out_str);
+
+int LGBM_GetMaxThreads(int* out);
+
+int LGBM_SetMaxThreads(int num_threads);
+
+int LGBM_RegisterLogCallback(void (*callback)(const char*));
+
+int LGBM_GetSampleCount(int32_t num_total_row,
+                        const char* parameters,
+                        int* out);
+
+/* out: int32 buffer of at least GetSampleCount entries. */
+int LGBM_SampleIndices(int32_t num_total_row,
+                       const char* parameters,
+                       void* out,
+                       int32_t* out_len);
 
 #ifdef __cplusplus
 }

@@ -1,0 +1,574 @@
+"""Extended C API surface tests (reference: include/LightGBM/c_api.h) —
+CSC/Mats/sampled-column ingestion, field/name introspection, streaming with
+metadata, serialized references + ByteBuffer, model surgery (merge/refit/
+leaf get-set/shuffle), score introspection, file predict, and the global
+configuration entries."""
+
+import ctypes
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import lightgbm_tpu as lgb
+
+from test_c_api import _build
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = ctypes.CDLL(_build())
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(rc, lib):
+    assert rc == 0, lib.LGBM_GetLastError()
+
+
+def _dense_handle(lib, X, y, params=b"max_bin=63"):
+    h = ctypes.c_void_p()
+    Xc = np.ascontiguousarray(X, np.float64)
+    _check(lib.LGBM_DatasetCreateFromMat(
+        Xc.ctypes.data_as(ctypes.c_void_p), 1, Xc.shape[0], Xc.shape[1], 1,
+        params, None, ctypes.byref(h)), lib)
+    yc = np.ascontiguousarray(y, np.float32)
+    _check(lib.LGBM_DatasetSetField(
+        h, b"label", yc.ctypes.data_as(ctypes.c_void_p), len(yc), 0), lib)
+    return h
+
+
+def _train(lib, ds_handle, iters=3, params=b"objective=binary num_leaves=7 verbosity=-1"):
+    bh = ctypes.c_void_p()
+    _check(lib.LGBM_BoosterCreate(ds_handle, params, ctypes.byref(bh)), lib)
+    fin = ctypes.c_int()
+    for _ in range(iters):
+        _check(lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(fin)), lib)
+    return bh
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(7)
+    X = rng.randn(400, 5)
+    y = ((X @ rng.randn(5)) > 0).astype(np.float64)
+    return X, y
+
+
+def test_csc_dataset_and_predict(lib, data):
+    X, y = data
+    csc = sp.csc_matrix(X)
+    h = ctypes.c_void_p()
+    _check(lib.LGBM_DatasetCreateFromCSC(
+        csc.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p), 2,
+        csc.indices.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+        csc.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(csc.indptr)), ctypes.c_int64(csc.nnz),
+        ctypes.c_int64(X.shape[0]), b"max_bin=63", None,
+        ctypes.byref(h)), lib)
+    yc = y.astype(np.float32)
+    _check(lib.LGBM_DatasetSetField(
+        h, b"label", yc.ctypes.data_as(ctypes.c_void_p), len(yc), 0), lib)
+    bh = _train(lib, h)
+
+    # CSC-trained model == dense-trained model
+    dh = _dense_handle(lib, X, y)
+    bh2 = _train(lib, dh)
+    s1 = _model_string(lib, bh)
+    s2 = _model_string(lib, bh2)
+    assert s1 == s2
+
+    # PredictForCSC == PredictForMat
+    out = np.zeros(X.shape[0])
+    n_out = ctypes.c_int64()
+    _check(lib.LGBM_BoosterPredictForCSC(
+        bh, csc.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p), 2,
+        csc.indices.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+        csc.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(csc.indptr)), ctypes.c_int64(csc.nnz),
+        ctypes.c_int64(X.shape[0]), 0, ctypes.byref(n_out),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
+    ref = np.zeros(X.shape[0])
+    Xc = np.ascontiguousarray(X, np.float64)
+    _check(lib.LGBM_BoosterPredictForMat(
+        bh, Xc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), X.shape[0],
+        X.shape[1], 1, 0, ctypes.byref(n_out),
+        ref.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
+    np.testing.assert_allclose(out, ref, rtol=1e-12)
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_BoosterFree(bh2)
+    lib.LGBM_DatasetFree(h)
+    lib.LGBM_DatasetFree(dh)
+
+
+def _model_string(lib, bh):
+    n = ctypes.c_int64()
+    _check(lib.LGBM_BoosterSaveModelToString(
+        bh, 0, -1, 0, 0, ctypes.byref(n), None), lib)
+    buf = ctypes.create_string_buffer(n.value)
+    _check(lib.LGBM_BoosterSaveModelToString(
+        bh, 0, -1, 0, n.value, ctypes.byref(n), buf), lib)
+    return buf.value
+
+
+def test_mats_dataset_and_predict(lib, data):
+    X, y = data
+    halves = [np.ascontiguousarray(X[:200], np.float64),
+              np.ascontiguousarray(X[200:], np.float64)]
+    ptrs = (ctypes.c_void_p * 2)(*[h.ctypes.data for h in halves])
+    nrows = (ctypes.c_int32 * 2)(200, 200)
+    h = ctypes.c_void_p()
+    _check(lib.LGBM_DatasetCreateFromMats(
+        2, ptrs, 1, nrows, X.shape[1], 1, b"max_bin=63", None,
+        ctypes.byref(h)), lib)
+    yc = y.astype(np.float32)
+    _check(lib.LGBM_DatasetSetField(
+        h, b"label", yc.ctypes.data_as(ctypes.c_void_p), len(yc), 0), lib)
+    bh = _train(lib, h)
+    assert _model_string(lib, bh) == _model_string(
+        lib, _train(lib, _dense_handle(lib, X, y)))
+
+    out = np.zeros(X.shape[0])
+    n_out = ctypes.c_int64()
+    _check(lib.LGBM_BoosterPredictForMats(
+        bh, ptrs, 1, 2, nrows, X.shape[1], 0, ctypes.byref(n_out),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
+    assert n_out.value == X.shape[0]
+    assert np.isfinite(out).all()
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_DatasetFree(h)
+
+
+def test_get_field_and_names(lib, data):
+    X, y = data
+    h = _dense_handle(lib, X, y)
+    w = np.linspace(0.5, 1.5, len(y)).astype(np.float32)
+    _check(lib.LGBM_DatasetSetField(
+        h, b"weight", w.ctypes.data_as(ctypes.c_void_p), len(w), 0), lib)
+
+    out_len = ctypes.c_int()
+    out_ptr = ctypes.c_void_p()
+    out_type = ctypes.c_int()
+    _check(lib.LGBM_DatasetGetField(
+        h, b"weight", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)), lib)
+    assert out_type.value == 0 and out_len.value == len(w)
+    got = np.frombuffer(
+        (ctypes.c_float * out_len.value).from_address(out_ptr.value),
+        np.float32)
+    np.testing.assert_allclose(got, w, rtol=1e-6)
+
+    # group sizes in -> cumulative boundaries out (reference convention)
+    g = np.asarray([100, 150, 150], np.int32)
+    _check(lib.LGBM_DatasetSetField(
+        h, b"group", g.ctypes.data_as(ctypes.c_void_p), len(g), 2), lib)
+    _check(lib.LGBM_DatasetGetField(
+        h, b"group", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)), lib)
+    assert out_type.value == 2
+    bounds = np.frombuffer(
+        (ctypes.c_int32 * out_len.value).from_address(out_ptr.value),
+        np.int32)
+    np.testing.assert_array_equal(bounds, [0, 100, 250, 400])
+
+    names = [b"alpha", b"beta", b"gamma", b"delta", b"eps"]
+    arr = (ctypes.c_char_p * 5)(*names)
+    _check(lib.LGBM_DatasetSetFeatureNames(h, arr, 5), lib)
+    bufs = [ctypes.create_string_buffer(64) for _ in range(5)]
+    out_strs = (ctypes.c_char_p * 5)(*[ctypes.addressof(b) for b in bufs])
+    n_names = ctypes.c_int()
+    need = ctypes.c_size_t()
+    _check(lib.LGBM_DatasetGetFeatureNames(
+        h, 5, ctypes.byref(n_names), 64, ctypes.byref(need),
+        ctypes.cast(out_strs, ctypes.POINTER(ctypes.c_char_p))), lib)
+    assert n_names.value == 5
+    assert [b.value for b in bufs] == names
+    assert need.value == len(b"gamma") + 1
+
+    # clear group (zero-length clears, like the reference) so the binary
+    # objective trains; booster-side names flow from the dataset
+    _check(lib.LGBM_DatasetSetField(h, b"group", None, 0, 2), lib)
+    bh = _train(lib, h)
+    _check(lib.LGBM_BoosterGetFeatureNames(
+        bh, 5, ctypes.byref(n_names), 64, ctypes.byref(need),
+        ctypes.cast(out_strs, ctypes.POINTER(ctypes.c_char_p))), lib)
+    assert [b.value for b in bufs] == names
+
+    # validate-feature-names: match ok, mismatch errors
+    _check(lib.LGBM_BoosterValidateFeatureNames(bh, arr, 5), lib)
+    bad = (ctypes.c_char_p * 5)(b"a", b"b", b"c", b"d", b"e")
+    assert lib.LGBM_BoosterValidateFeatureNames(bh, bad, 5) == -1
+    assert b"Expected feature names" in lib.LGBM_GetLastError()
+
+    n_eval = ctypes.c_int()
+    _check(lib.LGBM_BoosterGetEvalNames(
+        bh, 5, ctypes.byref(n_eval), 64, ctypes.byref(need),
+        ctypes.cast(out_strs, ctypes.POINTER(ctypes.c_char_p))), lib)
+    assert n_eval.value >= 1
+    assert bufs[0].value == b"binary_logloss"
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_DatasetFree(h)
+
+
+def test_save_binary_dump_text_subset(lib, data, tmp_path):
+    X, y = data
+    h = _dense_handle(lib, X, y)
+    binpath = str(tmp_path / "d.npz").encode()
+    _check(lib.LGBM_DatasetSaveBinary(h, binpath), lib)
+    assert os.path.getsize(binpath) > 0
+
+    txtpath = str(tmp_path / "d.txt").encode()
+    _check(lib.LGBM_DatasetDumpText(h, txtpath), lib)
+    lines = open(txtpath).read().splitlines()
+    assert len(lines) == 1 + X.shape[0]
+
+    idx = np.arange(0, 400, 2, dtype=np.int32)
+    sh = ctypes.c_void_p()
+    _check(lib.LGBM_DatasetGetSubset(
+        h, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(idx), b"",
+        ctypes.byref(sh)), lib)
+    n = ctypes.c_int32()
+    _check(lib.LGBM_DatasetGetNumData(sh, ctypes.byref(n)), lib)
+    assert n.value == 200
+    lib.LGBM_DatasetFree(sh)
+    lib.LGBM_DatasetFree(h)
+
+
+def test_add_features_and_param_checking(lib, data):
+    X, y = data
+    h1 = _dense_handle(lib, X[:, :3], y)
+    h2 = _dense_handle(lib, X[:, 3:], y)
+    _check(lib.LGBM_DatasetAddFeaturesFrom(h1, h2), lib)
+    nf = ctypes.c_int32()
+    _check(lib.LGBM_DatasetGetNumFeature(h1, ctypes.byref(nf)), lib)
+    assert nf.value == 5
+    lib.LGBM_DatasetFree(h1)
+    lib.LGBM_DatasetFree(h2)
+
+    _check(lib.LGBM_DatasetUpdateParamChecking(
+        b"max_bin=63 verbosity=-1", b"max_bin=63 learning_rate=0.2"), lib)
+    assert lib.LGBM_DatasetUpdateParamChecking(
+        b"max_bin=63", b"max_bin=255") == -1
+    assert b"max_bin" in lib.LGBM_GetLastError()
+
+
+def test_push_rows_by_csr_streaming(lib, data):
+    X, y = data
+    ref = _dense_handle(lib, X, y)
+    sh = ctypes.c_void_p()
+    _check(lib.LGBM_DatasetCreateByReference(ref, len(y), ctypes.byref(sh)), lib)
+    csr = sp.csr_matrix(X)
+    for lo in range(0, 400, 100):
+        blk = csr[lo:lo + 100]
+        _check(lib.LGBM_DatasetPushRowsByCSR(
+            sh, blk.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p), 2,
+            blk.indices.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+            blk.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int64(len(blk.indptr)), ctypes.c_int64(blk.nnz),
+            ctypes.c_int64(X.shape[1]), lo), lib)
+    yc = y.astype(np.float32)
+    _check(lib.LGBM_DatasetSetField(
+        sh, b"label", yc.ctypes.data_as(ctypes.c_void_p), len(yc), 0), lib)
+    bh = _train(lib, sh)
+    bh_ref = _train(lib, ref)
+    assert _model_string(lib, bh) == _model_string(lib, bh_ref)
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_BoosterFree(bh_ref)
+    lib.LGBM_DatasetFree(sh)
+    lib.LGBM_DatasetFree(ref)
+
+
+def test_sampled_column_schema(lib, data):
+    X, y = data
+    n, f = X.shape
+    # full-sample: schema from the sample == schema from the data
+    cols = [np.ascontiguousarray(X[:, c], np.float64) for c in range(f)]
+    idxs = [np.arange(n, dtype=np.int32) for _ in range(f)]
+    col_ptrs = (ctypes.POINTER(ctypes.c_double) * f)(
+        *[c.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) for c in cols])
+    idx_ptrs = (ctypes.POINTER(ctypes.c_int) * f)(
+        *[i.ctypes.data_as(ctypes.POINTER(ctypes.c_int)) for i in idxs])
+    counts = (ctypes.c_int * f)(*([n] * f))
+    h = ctypes.c_void_p()
+    _check(lib.LGBM_DatasetCreateFromSampledColumn(
+        col_ptrs, idx_ptrs, f, counts, n, n, ctypes.c_int64(n),
+        b"max_bin=63", ctypes.byref(h)), lib)
+    Xc = np.ascontiguousarray(X, np.float64)
+    _check(lib.LGBM_DatasetPushRows(
+        h, Xc.ctypes.data_as(ctypes.c_void_p), 1, n, f, 0), lib)
+    yc = y.astype(np.float32)
+    _check(lib.LGBM_DatasetSetField(
+        h, b"label", yc.ctypes.data_as(ctypes.c_void_p), len(yc), 0), lib)
+    bh = _train(lib, h)
+    bh_ref = _train(lib, _dense_handle(lib, X, y))
+    assert _model_string(lib, bh) == _model_string(lib, bh_ref)
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_BoosterFree(bh_ref)
+    lib.LGBM_DatasetFree(h)
+
+
+def test_streaming_with_metadata(lib, data):
+    X, y = data
+    ref = _dense_handle(lib, X, y)
+    sh = ctypes.c_void_p()
+    _check(lib.LGBM_DatasetCreateByReference(ref, len(y), ctypes.byref(sh)), lib)
+    _check(lib.LGBM_DatasetInitStreaming(sh, 1, 0, 1, 1, 1, 1), lib)
+    _check(lib.LGBM_DatasetSetWaitForManualFinish(sh, 1), lib)
+    qid = np.repeat(np.arange(8), 50).astype(np.int32)
+    for lo in range(0, 400, 100):
+        blk = np.ascontiguousarray(X[lo:lo + 100], np.float64)
+        lab = y[lo:lo + 100].astype(np.float32)
+        w = np.full(100, 2.0, np.float32)
+        q = qid[lo:lo + 100]
+        _check(lib.LGBM_DatasetPushRowsWithMetadata(
+            sh, blk.ctypes.data_as(ctypes.c_void_p), 1, 100, X.shape[1], lo,
+            lab.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            None, q.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), 0), lib)
+    _check(lib.LGBM_DatasetMarkFinished(sh), lib)
+    bh = _train(lib, sh, params=b"objective=lambdarank num_leaves=7 verbosity=-1")
+    it = ctypes.c_int()
+    _check(lib.LGBM_BoosterGetCurrentIteration(bh, ctypes.byref(it)), lib)
+    assert it.value == 3
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_DatasetFree(sh)
+    lib.LGBM_DatasetFree(ref)
+
+
+def test_serialized_reference_bytebuffer(lib, data):
+    X, y = data
+    ref = _dense_handle(lib, X, y)
+    buf_h = ctypes.c_void_p()
+    buf_len = ctypes.c_int32()
+    _check(lib.LGBM_DatasetSerializeReferenceToBinary(
+        ref, ctypes.byref(buf_h), ctypes.byref(buf_len)), lib)
+    assert buf_len.value > 0
+    raw = bytearray(buf_len.value)
+    v = ctypes.c_uint8()
+    for i in range(buf_len.value):
+        _check(lib.LGBM_ByteBufferGetAt(buf_h, i, ctypes.byref(v)), lib)
+        raw[i] = v.value
+    assert lib.LGBM_ByteBufferGetAt(buf_h, buf_len.value, ctypes.byref(v)) == -1
+
+    carr = (ctypes.c_uint8 * len(raw)).from_buffer(raw)
+    h2 = ctypes.c_void_p()
+    _check(lib.LGBM_DatasetCreateFromSerializedReference(
+        carr, len(raw), ctypes.c_int64(len(y)), 1, b"", ctypes.byref(h2)), lib)
+    Xc = np.ascontiguousarray(X, np.float64)
+    _check(lib.LGBM_DatasetPushRows(
+        h2, Xc.ctypes.data_as(ctypes.c_void_p), 1, len(y), X.shape[1], 0), lib)
+    yc = y.astype(np.float32)
+    _check(lib.LGBM_DatasetSetField(
+        h2, b"label", yc.ctypes.data_as(ctypes.c_void_p), len(yc), 0), lib)
+    bh = _train(lib, h2)
+    bh_ref = _train(lib, ref)
+    # schema round-tripped through bytes -> identical bins -> identical model
+    assert _model_string(lib, bh) == _model_string(lib, bh_ref)
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_BoosterFree(bh_ref)
+    lib.LGBM_ByteBufferFree(buf_h)
+    lib.LGBM_DatasetFree(h2)
+    lib.LGBM_DatasetFree(ref)
+
+
+def test_model_surgery(lib, data):
+    X, y = data
+    h = _dense_handle(lib, X, y)
+    bh = _train(lib, h, iters=2)
+    bh2 = _train(lib, h, iters=3)
+
+    n_models = ctypes.c_int()
+    _check(lib.LGBM_BoosterMerge(bh, bh2), lib)
+    _check(lib.LGBM_BoosterNumberOfTotalModel(bh, ctypes.byref(n_models)), lib)
+    assert n_models.value == 5
+
+    k = ctypes.c_int()
+    _check(lib.LGBM_BoosterNumModelPerIteration(bh, ctypes.byref(k)), lib)
+    assert k.value == 1
+
+    lin = ctypes.c_int()
+    _check(lib.LGBM_BoosterGetLinear(bh, ctypes.byref(lin)), lib)
+    assert lin.value == 0
+
+    lo = ctypes.c_double()
+    hi = ctypes.c_double()
+    _check(lib.LGBM_BoosterGetLowerBoundValue(bh, ctypes.byref(lo)), lib)
+    _check(lib.LGBM_BoosterGetUpperBoundValue(bh, ctypes.byref(hi)), lib)
+    assert lo.value < hi.value
+
+    val = ctypes.c_double()
+    _check(lib.LGBM_BoosterGetLeafValue(bh, 0, 1, ctypes.byref(val)), lib)
+    _check(lib.LGBM_BoosterSetLeafValue(
+        bh, 0, 1, ctypes.c_double(val.value + 0.25)), lib)
+    _check(lib.LGBM_BoosterGetLeafValue(bh, 0, 1, ctypes.byref(val2 := ctypes.c_double())), lib)
+    assert abs(val2.value - (val.value + 0.25)) < 1e-12
+
+    _check(lib.LGBM_BoosterShuffleModels(bh, 0, -1), lib)
+
+    n64 = ctypes.c_int64()
+    _check(lib.LGBM_BoosterCalcNumPredict(bh, 10, 0, 0, -1, ctypes.byref(n64)), lib)
+    assert n64.value == 10
+    _check(lib.LGBM_BoosterCalcNumPredict(bh, 10, 2, 0, -1, ctypes.byref(n64)), lib)
+    assert n64.value == 50  # leaf-index: rows x 5 trees
+    _check(lib.LGBM_BoosterCalcNumPredict(bh, 10, 3, 0, -1, ctypes.byref(n64)), lib)
+    assert n64.value == 60  # contrib: rows x (features+1)
+
+    # loaded params round-trip as JSON
+    n = ctypes.c_int64()
+    _check(lib.LGBM_BoosterGetLoadedParam(bh, ctypes.c_int64(0), ctypes.byref(n), None), lib)
+    pbuf = ctypes.create_string_buffer(n.value)
+    _check(lib.LGBM_BoosterGetLoadedParam(bh, ctypes.c_int64(n.value), ctypes.byref(n), pbuf), lib)
+    import json
+
+    params = json.loads(pbuf.value)
+    assert params["num_leaves"] == 7
+
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_BoosterFree(bh2)
+    lib.LGBM_DatasetFree(h)
+
+
+def test_refit_and_get_predict(lib, data):
+    X, y = data
+    h = _dense_handle(lib, X, y)
+    bh = _train(lib, h, iters=3)
+
+    n64 = ctypes.c_int64()
+    _check(lib.LGBM_BoosterGetNumPredict(bh, 0, ctypes.byref(n64)), lib)
+    assert n64.value == len(y)
+    scores = np.zeros(len(y))
+    _check(lib.LGBM_BoosterGetPredict(
+        bh, 0, ctypes.byref(n64),
+        scores.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
+    assert np.isfinite(scores).all() and scores.std() > 0
+
+    # refit with the model's own leaf assignments shrinks leaf values toward
+    # the training optimum but keeps them finite/valid
+    pred_before = _predict_dense(lib, bh, X)
+    nt = ctypes.c_int()
+    _check(lib.LGBM_BoosterNumberOfTotalModel(bh, ctypes.byref(nt)), lib)
+    leaf = np.zeros((len(y), nt.value), np.int32)
+    out = np.zeros(len(y) * nt.value)
+    _check(lib.LGBM_BoosterPredictForMat(
+        bh, np.ascontiguousarray(X).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)), X.shape[0], X.shape[1], 1, 2,
+        ctypes.byref(n64), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))),
+        lib)
+    leaf[:] = out.reshape(len(y), nt.value).astype(np.int32)
+    _check(lib.LGBM_BoosterRefit(
+        bh, leaf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(y),
+        nt.value), lib)
+    pred_after = _predict_dense(lib, bh, X)
+    assert np.isfinite(pred_after).all()
+    assert not np.allclose(pred_before, pred_after)
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_DatasetFree(h)
+
+
+def _predict_dense(lib, bh, X):
+    out = np.zeros(X.shape[0])
+    n = ctypes.c_int64()
+    _check(lib.LGBM_BoosterPredictForMat(
+        bh, np.ascontiguousarray(X).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)), X.shape[0], X.shape[1], 1, 0,
+        ctypes.byref(n), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))),
+        lib)
+    return out
+
+
+def test_predict_for_file(lib, data, tmp_path):
+    X, y = data
+    h = _dense_handle(lib, X, y)
+    bh = _train(lib, h)
+    datafile = tmp_path / "rows.csv"
+    np.savetxt(datafile, np.column_stack([y, X]), delimiter=",")
+    result = tmp_path / "preds.txt"
+    _check(lib.LGBM_BoosterPredictForFile(
+        bh, str(datafile).encode(), 0, 0, 0, -1, b"", str(result).encode()),
+        lib)
+    got = np.loadtxt(result)
+    np.testing.assert_allclose(got, _predict_dense(lib, bh, X), rtol=1e-9)
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_DatasetFree(h)
+
+
+def test_csr_single_row_and_fast(lib, data):
+    X, y = data
+    h = _dense_handle(lib, X, y)
+    bh = _train(lib, h)
+    expect = _predict_dense(lib, bh, X[:1])
+
+    row = sp.csr_matrix(X[:1])
+    out = np.zeros(1)
+    n = ctypes.c_int64()
+    _check(lib.LGBM_BoosterPredictForCSRSingleRow(
+        bh, row.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p), 2,
+        row.indices.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+        row.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(row.indptr)), ctypes.c_int64(row.nnz),
+        ctypes.c_int64(X.shape[1]), 0, ctypes.byref(n),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
+    np.testing.assert_allclose(out, expect, rtol=1e-12)
+
+    fc = ctypes.c_void_p()
+    _check(lib.LGBM_BoosterPredictForCSRSingleRowFastInit(
+        bh, 0, 1, ctypes.c_int64(X.shape[1]), b"", ctypes.byref(fc)), lib)
+    out2 = np.zeros(1)
+    _check(lib.LGBM_BoosterPredictForCSRSingleRowFast(
+        fc, row.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p), 2,
+        row.indices.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+        row.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(len(row.indptr)), ctypes.c_int64(row.nnz),
+        ctypes.byref(n),
+        out2.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
+    np.testing.assert_allclose(out2, expect, rtol=1e-12)
+    lib.LGBM_FastConfigFree(fc)
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_DatasetFree(h)
+
+
+def test_global_config_entries(lib):
+    # DumpParamAliases: valid JSON mapping canonical -> aliases
+    n = ctypes.c_int64()
+    _check(lib.LGBM_DumpParamAliases(ctypes.c_int64(0), ctypes.byref(n), None), lib)
+    buf = ctypes.create_string_buffer(n.value)
+    _check(lib.LGBM_DumpParamAliases(ctypes.c_int64(n.value), ctypes.byref(n), buf), lib)
+    import json
+
+    aliases = json.loads(buf.value)
+    assert "num_threads" in aliases and "nthread" in aliases["num_threads"]
+
+    nt = ctypes.c_int()
+    _check(lib.LGBM_GetMaxThreads(ctypes.byref(nt)), lib)
+    assert nt.value == -1
+    _check(lib.LGBM_SetMaxThreads(4), lib)
+    _check(lib.LGBM_GetMaxThreads(ctypes.byref(nt)), lib)
+    assert nt.value == 4
+    _check(lib.LGBM_SetMaxThreads(-1), lib)
+
+    cnt = ctypes.c_int()
+    _check(lib.LGBM_GetSampleCount(1000, b"bin_construct_sample_cnt=200", ctypes.byref(cnt)), lib)
+    assert cnt.value == 200
+    idx = np.zeros(200, np.int32)
+    got = ctypes.c_int32()
+    _check(lib.LGBM_SampleIndices(
+        1000, b"bin_construct_sample_cnt=200",
+        idx.ctypes.data_as(ctypes.c_void_p), ctypes.byref(got)), lib)
+    assert got.value == 200
+    assert (np.diff(idx) > 0).all() and idx.max() < 1000
+
+    # log callback receives warning lines
+    seen = []
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p)
+    cb = CB(lambda msg: seen.append(msg))
+    _check(lib.LGBM_RegisterLogCallback(cb), lib)
+
+    # network: single machine is a no-op bring-up; WithFunctions warns
+    _check(lib.LGBM_NetworkInit(b"127.0.0.1:12400", 12400, 120, 1), lib)
+    _check(lib.LGBM_NetworkFree(), lib)
+    _check(lib.LGBM_NetworkInitWithFunctions(2, 0, None, None), lib)
+    assert any(b"XLA collectives" in m for m in seen)
+    _check(lib.LGBM_NetworkFree(), lib)
