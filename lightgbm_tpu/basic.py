@@ -59,9 +59,8 @@ def _to_2d_float(data) -> np.ndarray:
                 cols.append(col.to_numpy(dtype=np.float64, na_value=np.nan))
         arr = np.stack(cols, axis=1)
         return arr
-    if hasattr(data, "to_pandas") and hasattr(data, "schema"):  # pyarrow Table
-        data = data.to_pandas()
-        return _to_2d_float(data)
+    if hasattr(data, "schema") and hasattr(data, "column"):  # pyarrow
+        return _arrow_to_2d(data)
     if hasattr(data, "values"):  # pandas series
         data = data.values
     if _is_scipy_sparse(data):
@@ -70,6 +69,43 @@ def _to_2d_float(data) -> np.ndarray:
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
     return arr
+
+
+def _arrow_to_2d(data) -> np.ndarray:
+    """pyarrow Table/RecordBatch -> float64 matrix, column-at-a-time with no
+    pandas hop (reference: include/LightGBM/arrow.h chunked-array iterators).
+    Null-free numeric chunks convert zero-copy via the buffer protocol;
+    chunks with nulls cast to float64 with NaN; dictionary columns use their
+    integer codes (pandas-categorical semantics)."""
+    import pyarrow as pa
+
+    def chunk_values(chunk) -> np.ndarray:
+        t = chunk.type
+        if isinstance(t, pa.DictionaryType):
+            idx = chunk.indices  # nulls live in the indices
+            return idx.cast(pa.float64()).to_numpy(zero_copy_only=False)
+        if pa.types.is_boolean(t):
+            return chunk.cast(pa.float64()).to_numpy(zero_copy_only=False)
+        if chunk.null_count == 0:
+            return np.asarray(chunk, dtype=np.float64)
+        return chunk.cast(pa.float64()).to_numpy(zero_copy_only=False)
+
+    cols = []
+    for i in range(data.num_columns):
+        col = data.column(i)
+        if (isinstance(col.type, pa.DictionaryType)
+                and getattr(col, "num_chunks", 1) > 1):
+            # per-chunk dictionaries may order categories differently; codes
+            # are only comparable after unification
+            col = col.unify_dictionaries()
+        chunks = col.chunks if hasattr(col, "chunks") else [col]
+        if len(chunks) == 1:
+            cols.append(chunk_values(chunks[0]))
+        elif not chunks:
+            cols.append(np.zeros(0, np.float64))
+        else:
+            cols.append(np.concatenate([chunk_values(c) for c in chunks]))
+    return np.stack(cols, axis=1) if cols else np.zeros((data.num_rows, 0))
 
 
 class Sequence_:
@@ -102,6 +138,8 @@ def _from_sequences(seqs) -> np.ndarray:
 
 
 def _feature_names_of(data, num_features: int) -> List[str]:
+    if hasattr(data, "schema") and hasattr(data, "column"):  # pyarrow:
+        return [str(n) for n in data.schema.names]  # .columns is the arrays
     if hasattr(data, "columns"):
         return [str(c) for c in data.columns]
     return [f"Column_{i}" for i in range(num_features)]

@@ -963,6 +963,82 @@ def predict_csr_single_row_fast(cfg: _FastConfig, indptr_addr: int,
                              **cfg.kwargs)
 
 
+# ---- Arrow C-data-interface surface (reference:
+#      LGBM_DatasetCreateFromArrow / LGBM_DatasetSetFieldFromArrow /
+#      LGBM_BoosterPredictForArrow over include/LightGBM/arrow.h).
+#      Chunks arrive as a contiguous array of struct ArrowArray (the C data
+#      interface fixed 80-byte layout); pyarrow imports them zero-copy and
+#      takes ownership (release is called per the spec). ----
+
+_ARROW_ARRAY_STRUCT_SIZE = 80  # 5 int64 + 5 pointers, fixed by the spec
+
+
+def _release_arrow_arrays(chunks_addr: int, start: int, n_chunks: int) -> None:
+    """Call the C-data-interface release callback on chunks [start, n_chunks)
+    that were never imported (the contract transfers ownership to us even on
+    failure).  release fn lives at struct offset 64; NULL means already
+    released."""
+    fn_type = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+    for i in range(start, n_chunks):
+        base = chunks_addr + i * _ARROW_ARRAY_STRUCT_SIZE
+        fn_addr = ctypes.c_void_p.from_address(base + 64).value
+        if fn_addr:
+            fn_type(fn_addr)(base)
+
+
+def _import_arrow_table(n_chunks: int, chunks_addr: int, schema_addr: int):
+    import pyarrow as pa
+
+    schema = pa.Schema._import_from_c(schema_addr)
+    struct_type = pa.struct(list(schema))
+    batches = []
+    for i in range(n_chunks):
+        try:
+            arr = pa.Array._import_from_c(
+                chunks_addr + i * _ARROW_ARRAY_STRUCT_SIZE, struct_type)
+            batches.append(pa.RecordBatch.from_struct_array(arr))
+        except Exception:
+            _release_arrow_arrays(chunks_addr, i, n_chunks)
+            raise
+    return pa.Table.from_batches(batches, schema=schema)
+
+
+def dataset_from_arrow(n_chunks: int, chunks_addr: int, schema_addr: int,
+                       parameters: str, reference) -> Dataset:
+    table = _import_arrow_table(n_chunks, chunks_addr, schema_addr)
+    return Dataset(table, params=_parse_params(parameters),
+                   reference=reference if isinstance(reference, Dataset) else None,
+                   free_raw_data=False)
+
+
+def dataset_set_field_from_arrow(ds, field_name: str, n_chunks: int,
+                                 chunks_addr: int, schema_addr: int) -> bool:
+    import pyarrow as pa
+
+    dtype = pa.DataType._import_from_c(schema_addr)
+    if n_chunks == 0:
+        ds.set_field(field_name, None)  # zero-length clears, like SetField
+        return True
+    parts = []
+    for i in range(n_chunks):
+        try:
+            parts.append(pa.Array._import_from_c(
+                chunks_addr + i * _ARROW_ARRAY_STRUCT_SIZE, dtype))
+        except Exception:
+            _release_arrow_arrays(chunks_addr, i, n_chunks)
+            raise
+    vals = np.concatenate([p.to_numpy(zero_copy_only=False) for p in parts])
+    ds.set_field(field_name, vals)
+    return True
+
+
+def predict_arrow_into(bst: Booster, n_chunks: int, chunks_addr: int,
+                       schema_addr: int, predict_type: int,
+                       out_addr: int) -> int:
+    table = _import_arrow_table(n_chunks, chunks_addr, schema_addr)
+    return _predict_any_into(bst, table, predict_type, out_addr)
+
+
 # ---- network surface (reference: LGBM_NetworkInit / Free /
 #      InitWithFunctions).  On TPU the collective transport is XLA over
 #      ICI/DCN; these entries configure the machine-list bring-up that

@@ -1271,6 +1271,60 @@ int LGBM_BoosterPredictForCSRSingleRowFast(FastConfigHandle fast_config,
   return 0;
 }
 
+/* ---- Arrow C-data-interface ---- */
+
+int LGBM_DatasetCreateFromArrow(int64_t n_chunks,
+                                const struct ArrowArray* chunks,
+                                const struct ArrowSchema* schema,
+                                const char* parameters,
+                                const DatasetHandle reference,
+                                DatasetHandle* out) {
+  GilGuard gil;
+  PyObject* ref = reference != nullptr ? static_cast<PyObject*>(reference)
+                                       : Py_None;
+  PyObject* r = call_helper(
+      "dataset_from_arrow", "(LKKsO)", static_cast<long long>(n_chunks),
+      reinterpret_cast<unsigned long long>(chunks),
+      reinterpret_cast<unsigned long long>(schema), parameters, ref);
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+int LGBM_DatasetSetFieldFromArrow(DatasetHandle handle, const char* field_name,
+                                  int64_t n_chunks,
+                                  const struct ArrowArray* chunks,
+                                  const struct ArrowSchema* schema) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "dataset_set_field_from_arrow", "(OsLKK)",
+      static_cast<PyObject*>(handle), field_name,
+      static_cast<long long>(n_chunks),
+      reinterpret_cast<unsigned long long>(chunks),
+      reinterpret_cast<unsigned long long>(schema));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForArrow(BoosterHandle handle, int64_t n_chunks,
+                                const struct ArrowArray* chunks,
+                                const struct ArrowSchema* schema,
+                                int predict_type, int64_t* out_len,
+                                double* out_result) {
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_arrow_into", "(OLKKiK)", static_cast<PyObject*>(handle),
+      static_cast<long long>(n_chunks),
+      reinterpret_cast<unsigned long long>(chunks),
+      reinterpret_cast<unsigned long long>(schema), predict_type,
+      reinterpret_cast<unsigned long long>(out_result));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
 /* ---- network ---- */
 
 int LGBM_NetworkInit(const char* machines, int local_listen_port,

@@ -517,6 +517,36 @@ int LGBM_BoosterPredictForCSRSingleRowFast(FastConfigHandle fast_config,
                                            int64_t* out_len,
                                            double* out_result);
 
+/* ---- Arrow C-data-interface ingestion (reference:
+ * LGBM_DatasetCreateFromArrow / LGBM_DatasetSetFieldFromArrow /
+ * LGBM_BoosterPredictForArrow over include/LightGBM/arrow.h).  chunks is a
+ * contiguous array of n_chunks struct ArrowArray record batches (struct
+ * layout per the Arrow C data interface spec); ownership transfers (release
+ * is called). */
+struct ArrowArray;
+struct ArrowSchema;
+
+int LGBM_DatasetCreateFromArrow(int64_t n_chunks,
+                                const struct ArrowArray* chunks,
+                                const struct ArrowSchema* schema,
+                                const char* parameters,
+                                const DatasetHandle reference,
+                                DatasetHandle* out);
+
+int LGBM_DatasetSetFieldFromArrow(DatasetHandle handle,
+                                  const char* field_name,
+                                  int64_t n_chunks,
+                                  const struct ArrowArray* chunks,
+                                  const struct ArrowSchema* schema);
+
+int LGBM_BoosterPredictForArrow(BoosterHandle handle,
+                                int64_t n_chunks,
+                                const struct ArrowArray* chunks,
+                                const struct ArrowSchema* schema,
+                                int predict_type,
+                                int64_t* out_len,
+                                double* out_result);
+
 /* ---- network bring-up (reference: LGBM_NetworkInit over socket/MPI
  * linkers; here the machine list drives jax.distributed + XLA collectives
  * — see docs/DISTRIBUTED.md). ---- */

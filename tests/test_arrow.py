@@ -1,0 +1,161 @@
+"""Arrow ingestion without the pandas hop (reference:
+include/LightGBM/arrow.h + LGBM_DatasetCreateFromArrow /
+LGBM_DatasetSetFieldFromArrow / LGBM_BoosterPredictForArrow in
+src/c_api.cpp)."""
+
+import ctypes
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+pa = pytest.importorskip("pyarrow")
+
+
+def _data(n=600, f=4, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = ((X @ rng.randn(f)) > 0).astype(np.float64)
+    return X, y
+
+
+def _model(X_or_table, y, **params):
+    ds = lgb.Dataset(X_or_table, label=y)
+    p = dict(objective="binary", num_leaves=7, verbosity=-1, **params)
+    bst = lgb.Booster(params=p, train_set=ds)
+    for _ in range(3):
+        bst.update()
+    return bst
+
+
+def test_table_matches_numpy_no_pandas(monkeypatch):
+    X, y = _data()
+    table = pa.table({f"Column_{i}": X[:, i] for i in range(X.shape[1])})
+    # prove the conversion path does not fall back to pandas
+    monkeypatch.setitem(sys.modules, "pandas", None)
+    bst_arrow = _model(table, y)
+    monkeypatch.undo()
+    bst_np = _model(X, y)
+    assert bst_arrow.model_to_string() == bst_np.model_to_string()
+
+
+def test_nulls_become_missing():
+    X, y = _data()
+    Xn = X.copy()
+    Xn[::7, 1] = np.nan
+    cols = {}
+    for i in range(X.shape[1]):
+        v = Xn[:, i]
+        cols[f"Column_{i}"] = pa.array(
+            [None if np.isnan(x) else x for x in v], type=pa.float64())
+    table = pa.table(cols)
+    assert table.column(1).null_count > 0
+    bst_arrow = _model(table, y, use_missing=True)
+    bst_np = _model(Xn, y, use_missing=True)
+    assert bst_arrow.model_to_string() == bst_np.model_to_string()
+
+
+def test_dictionary_column_uses_codes():
+    X, y = _data()
+    cats = np.array(["a", "b", "c"])[
+        (np.abs(X[:, 0] * 3).astype(int) % 3)]
+    dict_col = pa.array(cats).dictionary_encode()
+    codes = dict_col.indices.to_numpy(zero_copy_only=False).astype(np.float64)
+    table = pa.table({"Column_0": dict_col,
+                      **{f"Column_{i}": X[:, i] for i in range(1, X.shape[1])}})
+    Xc = np.column_stack([codes, X[:, 1:]])
+    bst_arrow = _model(table, y, categorical_feature=[0])
+    bst_np = _model(Xc, y, categorical_feature=[0])
+    assert bst_arrow.model_to_string() == bst_np.model_to_string()
+
+
+def test_multichunk_and_int_columns():
+    X, y = _data()
+    Xi = np.round(X * 10).astype(np.int64)
+    batches = [
+        pa.record_batch({f"Column_{i}": Xi[lo:lo + 200, i]
+                         for i in range(X.shape[1])})
+        for lo in range(0, len(y), 200)
+    ]
+    table = pa.Table.from_batches(batches)
+    assert table.column(0).num_chunks == 3
+    bst_arrow = _model(table, y)
+    bst_np = _model(Xi.astype(np.float64), y)
+    assert bst_arrow.model_to_string() == bst_np.model_to_string()
+
+
+def test_chunked_dictionary_unifies_codes():
+    # per-chunk dictionaries with different category orders must unify
+    # before their codes are used as categorical values
+    c1 = pa.array(["a", "b"]).dictionary_encode()
+    c2 = pa.array(["b", "a"]).dictionary_encode()
+    col = pa.chunked_array([c1, c2])
+    table = pa.table({"Column_0": col})
+    from lightgbm_tpu.basic import _arrow_to_2d
+
+    vals = _arrow_to_2d(table)[:, 0]
+    assert vals[0] == vals[3] and vals[1] == vals[2] and vals[0] != vals[1]
+
+
+@pytest.mark.slow
+def test_c_api_arrow_roundtrip():
+    from test_c_api import _build
+
+    lib = ctypes.CDLL(_build())
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    X, y = _data()
+    batch = pa.record_batch({f"f{i}": X[:, i] for i in range(X.shape[1])})
+
+    # export through the C data interface structs, as a real C caller would
+    c_arr = (ctypes.c_uint8 * 80)()   # struct ArrowArray (spec: 80 bytes)
+    c_schema = (ctypes.c_uint8 * 72)()  # struct ArrowSchema
+    batch._export_to_c(ctypes.addressof(c_arr), ctypes.addressof(c_schema))
+
+    h = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromArrow(
+        ctypes.c_int64(1), ctypes.byref(c_arr), ctypes.byref(c_schema),
+        b"max_bin=63", None, ctypes.byref(h))
+    assert rc == 0, lib.LGBM_GetLastError()
+
+    lab = pa.array(y, type=pa.float64())
+    la = (ctypes.c_uint8 * 80)()
+    ls = (ctypes.c_uint8 * 72)()
+    lab._export_to_c(ctypes.addressof(la), ctypes.addressof(ls))
+    rc = lib.LGBM_DatasetSetFieldFromArrow(
+        h, b"label", ctypes.c_int64(1), ctypes.byref(la), ctypes.byref(ls))
+    assert rc == 0, lib.LGBM_GetLastError()
+
+    bh = ctypes.c_void_p()
+    rc = lib.LGBM_BoosterCreate(
+        h, b"objective=binary num_leaves=7 verbosity=-1", ctypes.byref(bh))
+    assert rc == 0, lib.LGBM_GetLastError()
+    fin = ctypes.c_int()
+    for _ in range(3):
+        assert lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(fin)) == 0
+
+    # PredictForArrow == PredictForMat
+    pa_out = np.zeros(len(y))
+    n = ctypes.c_int64()
+    batch2 = pa.record_batch({f"f{i}": X[:, i] for i in range(X.shape[1])})
+    a2 = (ctypes.c_uint8 * 80)()
+    s2 = (ctypes.c_uint8 * 72)()
+    batch2._export_to_c(ctypes.addressof(a2), ctypes.addressof(s2))
+    rc = lib.LGBM_BoosterPredictForArrow(
+        bh, ctypes.c_int64(1), ctypes.byref(a2), ctypes.byref(s2), 0,
+        ctypes.byref(n),
+        pa_out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+
+    mat_out = np.zeros(len(y))
+    Xc = np.ascontiguousarray(X, np.float64)
+    rc = lib.LGBM_BoosterPredictForMat(
+        bh, Xc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), X.shape[0],
+        X.shape[1], 1, 0, ctypes.byref(n),
+        mat_out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+    np.testing.assert_allclose(pa_out, mat_out, rtol=1e-12)
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_DatasetFree(h)
