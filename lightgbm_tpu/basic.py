@@ -137,6 +137,50 @@ def _from_sequences(seqs) -> np.ndarray:
     return np.concatenate(chunks, axis=0)
 
 
+def _allgather_rows_f64(local: np.ndarray) -> np.ndarray:
+    """Row-concatenate a float64 array across processes BIT-EXACTLY (float64
+    as int32 pairs — x64 is disabled in jax, and f32 rounding would corrupt
+    values like bin boundaries vs the serial path).  Uneven per-rank row
+    counts are handled by padding to the max count and slicing each rank's
+    block back to its true length (reference: Network::Allgather carries
+    per-rank byte counts)."""
+    from jax.experimental import multihost_utils
+
+    a = np.ascontiguousarray(np.asarray(local, np.float64))
+    lead = a.shape[0]
+    counts = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([lead], jnp.int32), tiled=True)).ravel()
+    cmax = int(counts.max()) if len(counts) else lead
+    if lead < cmax:
+        a = np.concatenate(
+            [a, np.zeros((cmax - lead,) + a.shape[1:], np.float64)])
+    bits = a.view(np.int32).reshape(cmax, -1)
+    g = np.ascontiguousarray(np.asarray(
+        multihost_utils.process_allgather(jnp.asarray(bits), tiled=True)))
+    full = g.view(np.float64).reshape((len(counts) * cmax,) + a.shape[1:])
+    if (counts == cmax).all():
+        return full
+    return np.concatenate([
+        full[r * cmax: r * cmax + int(c)] for r, c in enumerate(counts)
+    ])
+
+
+def _sync_binning_sample(local: np.ndarray, target_cnt: int,
+                         seed: int) -> np.ndarray:
+    """Pre-partitioned multi-controller binning sync: every rank holds a
+    different row shard, so bin boundaries must come from the GLOBAL sample
+    (reference: DatasetLoader's distributed bin sync via
+    Network::Allgather of BinMappers)."""
+    import jax as _jax
+
+    nproc = _jax.process_count()
+    per = max(min(target_cnt // nproc, local.shape[0]), 1)
+    rng_s = np.random.RandomState(seed)
+    idx = (rng_s.choice(local.shape[0], per, replace=False)
+           if local.shape[0] > per else np.arange(local.shape[0]))
+    return _allgather_rows_f64(local[idx])
+
+
 def _feature_names_of(data, num_features: int) -> List[str]:
     if hasattr(data, "schema") and hasattr(data, "column"):  # pyarrow:
         return [str(n) for n in data.schema.names]  # .columns is the arrays
@@ -210,13 +254,6 @@ class Dataset:
             if cfg.two_round:
                 import jax as _jax
 
-                if cfg.pre_partition and _jax.process_count() > 1:
-                    raise LightGBMError(
-                        "two_round + pre_partition is not supported yet: "
-                        "per-rank streamed binning cannot sync bin "
-                        "boundaries; load shards in memory (pre_partition "
-                        "syncs the binning sample) or disable two_round"
-                    )
                 if ref is not None:
                     ref.construct()
                     factory = lambda sample, names: ref.binner  # noqa: E731
@@ -247,6 +284,22 @@ class Dataset:
                             seed=_cfg.data_random_seed,
                             forced_bins=forced,
                         )
+                if (ref is None and cfg.pre_partition
+                        and _jax.process_count() > 1):
+                    # per-rank streamed shards: sync the reservoir sample
+                    # across ranks before fitting mappers, so every rank
+                    # bins on identical boundaries (same gather the
+                    # in-memory pre_partition path uses)
+                    inner_factory = factory
+
+                    def factory(sample, names, _cfg=cfg,
+                                _inner=inner_factory):
+                        sample_g = _sync_binning_sample(
+                            np.asarray(sample, np.float64),
+                            _cfg.bin_construct_sample_cnt,
+                            _cfg.data_random_seed)
+                        return _inner(sample_g, names)
+
                 loaded = load_data_file_two_round(
                     path, factory,
                     sample_cnt=cfg.bin_construct_sample_cnt,
@@ -328,34 +381,8 @@ class Dataset:
                 and _jax.process_count() > 1
                 and raw is not None
             ):
-                # pre-partitioned multi-controller load: every rank holds a
-                # different row shard, so bin boundaries must come from the
-                # GLOBAL sample (reference: DatasetLoader's distributed bin
-                # sync via Network::Allgather of BinMappers).  Gather equal
-                # per-rank samples and fit identical mappers everywhere.
-                from jax.experimental import multihost_utils
-
-                per = max(
-                    min(cfg.bin_construct_sample_cnt // _jax.process_count(),
-                        raw.shape[0]),
-                    1,
-                )
-                rng_s = np.random.RandomState(cfg.data_random_seed)
-                idx = (rng_s.choice(raw.shape[0], per, replace=False)
-                       if raw.shape[0] > per else np.arange(raw.shape[0]))
-                # gather float64 BIT-EXACTLY as int32 pairs (x64 is disabled
-                # in jax, and f32 rounding would shift bin boundaries vs the
-                # serial path)
-                local64 = np.ascontiguousarray(raw[idx], np.float64)
-                bits = local64.view(np.int32).reshape(local64.shape[0], -1)
-                gathered = np.ascontiguousarray(np.asarray(
-                    multihost_utils.process_allgather(
-                        jnp.asarray(bits), tiled=True
-                    )
-                ))
-                sample_g = gathered.view(np.float64).reshape(
-                    -1, local64.shape[1]
-                )
+                sample_g = _sync_binning_sample(
+                    raw, cfg.bin_construct_sample_cnt, cfg.data_random_seed)
                 fit_kwargs["sample_cnt"] = len(sample_g)
                 self.binner = DatasetBinner.fit(sample_g, **fit_kwargs)
             elif sparse_csc is not None:

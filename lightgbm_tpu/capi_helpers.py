@@ -989,8 +989,12 @@ def _release_arrow_arrays(chunks_addr: int, start: int, n_chunks: int) -> None:
 def _import_arrow_table(n_chunks: int, chunks_addr: int, schema_addr: int):
     import pyarrow as pa
 
-    schema = pa.Schema._import_from_c(schema_addr)
-    struct_type = pa.struct(list(schema))
+    try:
+        schema = pa.Schema._import_from_c(schema_addr)
+        struct_type = pa.struct(list(schema))
+    except Exception:
+        _release_arrow_arrays(chunks_addr, 0, n_chunks)
+        raise
     batches = []
     for i in range(n_chunks):
         try:
@@ -1015,7 +1019,11 @@ def dataset_set_field_from_arrow(ds, field_name: str, n_chunks: int,
                                  chunks_addr: int, schema_addr: int) -> bool:
     import pyarrow as pa
 
-    dtype = pa.DataType._import_from_c(schema_addr)
+    try:
+        dtype = pa.DataType._import_from_c(schema_addr)
+    except Exception:
+        _release_arrow_arrays(chunks_addr, 0, n_chunks)
+        raise
     if n_chunks == 0:
         ds.set_field(field_name, None)  # zero-length clears, like SetField
         return True

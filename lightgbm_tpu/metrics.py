@@ -98,6 +98,15 @@ class Metric:
     def transform(self, v: float) -> float:
         return v
 
+    # distributed-eval protocol (reference: metrics call
+    # Network::GlobalSyncUpBySum on their local sums): decomposable metrics
+    # return [(name, local_numerator, local_denominator, higher_better)];
+    # global value = transform(sum(num)/sum(den)).  None = not
+    # sum-decomposable (the AUC family) — the caller gathers shard
+    # predictions instead.
+    def eval_sums(self, pred, label, weight, query_boundaries=None):
+        return None
+
 
 def _wmean(vals, weight):
     if weight is None:
@@ -120,6 +129,14 @@ class _Pointwise(Metric):
     def eval(self, pred, label, weight, query_boundaries=None):
         v = self.transform(_wmean(self.point(np.asarray(pred), np.asarray(label)), weight))
         return [(self.name, v, self.is_higher_better)]
+
+    def eval_sums(self, pred, label, weight, query_boundaries=None):
+        v = self.point(np.asarray(pred), np.asarray(label))
+        if weight is None:
+            return [(self.name, float(np.sum(v)), float(v.size),
+                     self.is_higher_better)]
+        return [(self.name, float(np.sum(v * weight)),
+                 float(np.sum(weight)), self.is_higher_better)]
 
     def supports_device(self, num_class: int) -> bool:
         return num_class == 1
@@ -309,6 +326,11 @@ class XentLambdaMetric(Metric):
         loss = (1 - t) * lam - t * np.log(-np.expm1(-np.maximum(lam, 1e-300)))
         return [(self.name, float(np.mean(loss)), False)]
 
+    def eval_sums(self, pred, label, weight, query_boundaries=None):
+        v = self.eval(pred, label, weight)[0][1]
+        n = len(np.asarray(label))
+        return [(self.name, v * n, float(n), False)]
+
 
 class AucMuMetric(Metric):
     """Multiclass AUC-mu (reference: auc_mu in src/metric/multiclass_metric.hpp,
@@ -362,6 +384,15 @@ class MultiLoglossMetric(Metric):
         probs = np.clip(p[np.arange(len(y)), y], EPS, None)
         return [(self.name, _wmean(-np.log(probs), weight), False)]
 
+    def eval_sums(self, pred, label, weight, query_boundaries=None):
+        p = np.asarray(pred)
+        y = np.asarray(label).astype(np.int64)
+        v = -np.log(np.clip(p[np.arange(len(y)), y], EPS, None))
+        if weight is None:
+            return [(self.name, float(np.sum(v)), float(v.size), False)]
+        return [(self.name, float(np.sum(v * weight)),
+                 float(np.sum(weight)), False)]
+
     def supports_device(self, num_class: int) -> bool:
         return num_class > 1
 
@@ -379,16 +410,25 @@ class MultiLoglossMetric(Metric):
 class MultiErrorMetric(Metric):
     name = "multi_error"
 
-    def eval(self, pred, label, weight, query_boundaries=None):
+    def _row_errors(self, pred, label) -> np.ndarray:
         p = np.asarray(pred)
         y = np.asarray(label).astype(np.int64)
         k = self.cfg.multi_error_top_k
         if k <= 1:
-            err = (np.argmax(p, axis=1) != y).astype(np.float64)
-        else:
-            topk = np.argsort(-p, axis=1)[:, :k]
-            err = 1.0 - (topk == y[:, None]).any(axis=1).astype(np.float64)
-        return [(self.name, _wmean(err, weight), False)]
+            return (np.argmax(p, axis=1) != y).astype(np.float64)
+        topk = np.argsort(-p, axis=1)[:, :k]
+        return 1.0 - (topk == y[:, None]).any(axis=1).astype(np.float64)
+
+    def eval(self, pred, label, weight, query_boundaries=None):
+        return [(self.name, _wmean(self._row_errors(pred, label), weight),
+                 False)]
+
+    def eval_sums(self, pred, label, weight, query_boundaries=None):
+        e = self._row_errors(pred, label)
+        if weight is None:
+            return [(self.name, float(np.sum(e)), float(e.size), False)]
+        return [(self.name, float(np.sum(e * weight)),
+                 float(np.sum(weight)), False)]
 
     def supports_device(self, num_class: int) -> bool:
         return num_class > 1
@@ -409,7 +449,18 @@ class MultiErrorMetric(Metric):
         return jnp.sum(err * weight) / jnp.sum(weight)
 
 
-class NDCGMetric(Metric):
+class _MeanPerQuery(Metric):
+    """Ranking metrics averaging a per-query statistic decompose for
+    distributed eval as (sum over local queries, #local queries)."""
+
+    def eval_sums(self, pred, label, weight, query_boundaries=None):
+        nq = float(len(query_boundaries) - 1)
+        return [(nm, v * nq, nq, hib)
+                for nm, v, hib in self.eval(pred, label, weight,
+                                            query_boundaries)]
+
+
+class NDCGMetric(_MeanPerQuery):
     name = "ndcg"
     is_higher_better = True
 
@@ -427,7 +478,7 @@ class NDCGMetric(Metric):
         return out
 
 
-class MAPMetric(Metric):
+class MAPMetric(_MeanPerQuery):
     name = "map"
     is_higher_better = True
 

@@ -1273,15 +1273,65 @@ class GBDT:
         self._eval_jit_cache[key] = entry
         return entry
 
+    def _eval_target(self, data_idx: int):
+        """data_idx -> (dataset, raw score, display name); 0 = train,
+        i>0 = (i-1)-th valid set."""
+        if data_idx == 0:
+            return self.train_set, self._score, self.train_name
+        return (self.valid_sets[data_idx - 1],
+                self._valid_scores[data_idx - 1],
+                self.valid_names[data_idx - 1])
+
+    def _eval_at_synced(self, data_idx: int) -> List[Tuple[str, str, float, bool]]:
+        """Distributed eval under pre_partition: each rank holds only its
+        row shard, so metric values must sync across processes (reference:
+        Metric::Eval + Network::GlobalSyncUpBySum).  Decomposable metrics
+        sum local (numerator, denominator) pairs; the AUC family gathers
+        shard predictions and evaluates globally on every rank."""
+        from ..basic import _allgather_rows_f64 as gather
+
+        ds, score, name = self._eval_target(data_idx)
+        pred = self._converted(self._eval_margin(score))
+        label = np.asarray(ds.label)
+        weight = None if ds.weight is None else np.asarray(ds.weight)
+        qb = ds.query_boundaries
+
+        per_metric = [(m, m.eval_sums(pred, label, weight, qb))
+                      for m in self.metrics]
+        sum_rows = [(num, den) for _, s in per_metric if s is not None
+                    for (_, num, den, _) in s]
+        totals = None
+        if sum_rows:
+            loc = np.ascontiguousarray(np.asarray(sum_rows, np.float64))
+            totals = gather(loc.reshape(1, -1)).reshape(
+                -1, len(sum_rows), 2).sum(axis=0)
+        gathered = None
+        out: List[Tuple[str, str, float, bool]] = []
+        i = 0
+        for m, s in per_metric:
+            if s is not None:
+                for (mn, _, _, hib) in s:
+                    num_g, den_g = totals[i]
+                    out.append((name, mn,
+                                m.transform(num_g / max(den_g, 1e-300)), hib))
+                    i += 1
+            else:
+                if gathered is None:
+                    gathered = (
+                        gather(pred),
+                        gather(label),
+                        None if weight is None else gather(weight),
+                    )
+                for (mn, v, hib) in m.eval(*gathered, None):
+                    out.append((name, mn, v, hib))
+        return out
+
     def eval_at(self, data_idx: int) -> List[Tuple[str, str, float, bool]]:
         """data_idx 0 = training, 1.. = valid sets (reference: GBDT::GetEvalAt).
         Returns (dataset_name, metric_name, value, is_higher_better)."""
-        if data_idx == 0:
-            ds, score, name = self.train_set, self._score, self.train_name
-        else:
-            ds = self.valid_sets[data_idx - 1]
-            score = self._valid_scores[data_idx - 1]
-            name = self.valid_names[data_idx - 1]
+        if self._pre_partition and jax.process_count() > 1:
+            return self._eval_at_synced(data_idx)
+        ds, score, name = self._eval_target(data_idx)
         k = self.num_tree_per_iteration
         dev_metrics = [
             m for m in self.metrics
