@@ -199,6 +199,59 @@ def load_data_file(
                 feature_names=names)
 
 
+def _prefetch(it, depth: int = 1):
+    """Async double-buffered iteration (reference:
+    include/LightGBM/utils/pipeline_reader.h — PipelineReader overlaps the
+    next block's read+parse with the consumer's work).  depth=1 is true
+    double buffering: one chunk parsing ahead while one is consumed.
+    Worker exceptions re-raise at the consuming site; if the consumer exits
+    early, the worker is unblocked and the source iterator closed so no
+    thread or file handle leaks."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END, _ERR = object(), object()
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for item in it:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 - re-raised at consumer
+            q.put((_ERR, e))
+            return
+        finally:
+            if stop.is_set():
+                it.close()  # unwind the source's `with open(...)`
+        q.put(_END)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                raise item[1]
+            yield item
+    finally:
+        stop.set()
+        while not q.empty():  # unblock a worker waiting in q.put
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+
+
 def _iter_chunks(path: str, fmt: str, header: bool, chunk_rows: int):
     """Yield parsed (columns, first_col) chunks of a CSV/TSV/LibSVM file
     without ever holding the whole file (reference: TextReader's chunked
@@ -261,7 +314,7 @@ def load_data_file_two_round(
     sample = None
     n_seen = 0
     n_feat = 0
-    for cols, lab in _iter_chunks(path, fmt_detected, header, chunk_rows):
+    for cols, lab in _prefetch(_iter_chunks(path, fmt_detected, header, chunk_rows)):
         feats = split_chunk(cols, lab)[0]
         n_feat = max(n_feat, feats.shape[1])
         n_seen += feats.shape[0]
@@ -313,7 +366,7 @@ def load_data_file_two_round(
     weights = [] if (fmt_detected != "libsvm" and weight_idx >= 0) else None
     groups = [] if (fmt_detected != "libsvm" and group_idx >= 0) else None
     lo = 0
-    for cols, lab in _iter_chunks(path, fmt_detected, header, chunk_rows):
+    for cols, lab in _prefetch(_iter_chunks(path, fmt_detected, header, chunk_rows)):
         feats, label, weight, group = split_chunk(cols, lab)
         if fmt_detected == "libsvm":
             label = lab

@@ -756,8 +756,9 @@ class GBDT:
 
         @jax.jit
         def step(score, row_mask, sample_weight, feature_mask, shrinkage,
-                 goss_key, goss_warm):
-            g, h = obj.get_gradients(score, label, weight)
+                 goss_key, goss_warm, obj_state):
+            g, h, new_obj_state = obj.fused_gradients(
+                score, label, weight, obj_state)
             if use_goss:
                 # GOSS in-trace (reference: goss.hpp): the mask depends on
                 # THIS iteration's gradients, so it must live inside the
@@ -804,7 +805,8 @@ class GBDT:
                     new_score = new_score.at[:, c].add(row_delta)
                 arrays_all.append(arrays)
                 leaf_all.append(leaf_id)
-            return tuple(arrays_all), tuple(leaf_all), new_score, g, h
+            return (tuple(arrays_all), tuple(leaf_all), new_score, g, h,
+                    new_obj_state)
 
         self._fused_step = step
         return step
@@ -834,11 +836,12 @@ class GBDT:
             feature_mask = self._feature_mask()
             shrinkage = 1.0 if self.average_output else self.cfg.learning_rate
             step = self._get_fused_step()
-            arrays_all, leaf_all, self._score, g, h = step(
+            arrays_all, leaf_all, self._score, g, h, obj_state = step(
                 self._score, row_mask, sample_weight,
                 jnp.asarray(feature_mask), jnp.float32(shrinkage),
-                goss_key, goss_warm,
+                goss_key, goss_warm, self.objective.fused_state(),
             )
+            self.objective.set_fused_state(obj_state)
             self._cur_grad, self._cur_hess = g, h
             for c, arrays in enumerate(arrays_all):
                 self._pending.append((arrays, shrinkage, None))

@@ -49,6 +49,23 @@ class Objective:
     def is_fusable(self) -> bool:
         return self.fusable
 
+    # fused-state protocol: objectives with per-iteration device state (e.g.
+    # LambdaRank position biases) stay fusable by threading that state
+    # through the fused step as an explicit carry instead of mutating self
+    # in-trace.  fused_state() -> carry (or None); fused_gradients is PURE
+    # and returns (grad, hess, new_carry); set_fused_state writes the carry
+    # back after the step retires.
+    def fused_state(self):
+        return None
+
+    def fused_gradients(self, score: Array, label: Array,
+                        weight: Optional[Array], state):
+        g, h = self.get_gradients(score, label, weight)
+        return g, h, state
+
+    def set_fused_state(self, state) -> None:
+        pass
+
     def __init__(self, cfg: Config):
         self.cfg = cfg
 
@@ -365,8 +382,9 @@ class _RankingObjective(Objective):
     are laid out as a dense (Q, S) block padded to the longest query; masked
     lanes contribute zeros (SURVEY.md §10.3 item 3)."""
 
-    # per-iteration host state (position-bias Newton update, xendcg RNG
-    # iteration counter) — must not be baked into a traced step
+    # per-iteration host state (xendcg's RNG iteration counter) must not be
+    # baked into a traced step; LambdaRank overrides this — its position
+    # biases ride the fused step as an explicit carry (fused_state protocol)
     fusable = False
 
     def set_query(self, query_boundaries: np.ndarray, labels: np.ndarray):
@@ -478,6 +496,9 @@ class LambdarankNDCG(_RankingObjective):
     """
 
     name = "lambdarank"
+    # always fusable: plain lambdas are pure, and position-bias state rides
+    # the fused step as a carry (fused_state protocol below)
+    fusable = True
 
     def __init__(self, cfg: Config):
         super().__init__(cfg)
@@ -525,24 +546,23 @@ class LambdarankNDCG(_RankingObjective):
 
     _pos_pad = None
 
-    def is_fusable(self) -> bool:
-        # pure unless position-bias correction is on (its Newton refit
-        # mutates self.pos_bias every call)
-        return self._pos_pad is None
-
-    def get_gradients(self, score, label, weight):
+    def _gradients_core(self, score, label, pos_bias):
+        """PURE lambda computation: position bias enters as an argument and
+        the refit bias is returned, so this body can trace inside the fused
+        step with the bias as a carry."""
         idx, msk = self._pad_idx, self._pad_mask
         s = score[idx.reshape(-1)].reshape(idx.shape)
         l = label[idx.reshape(-1)].reshape(idx.shape)
-        if self._pos_pad is not None:
+        if pos_bias is not None:
             # scores seen by the lambda computation include the position bias
-            s = s + jnp.where(msk, self.pos_bias[self._pos_pad], 0.0)
+            s = s + jnp.where(msk, pos_bias[self._pos_pad], 0.0)
         gains = jnp.asarray(self.label_gain, dtype=jnp.float32)
         inv_mdcg = jnp.asarray(self.inverse_max_dcg, dtype=jnp.float32)
         g, h = _lambdarank_pairwise(
             s, l, msk, gains, inv_mdcg, self.sigmoid, self.truncation, self.norm
         )
-        if self._pos_pad is not None:
+        new_bias = pos_bias
+        if pos_bias is not None:
             # Newton refit of the biases from this iteration's lambdas
             # (reference: UpdatePositionBiasFactors once per iteration)
             P = self.num_positions
@@ -552,12 +572,33 @@ class LambdarankNDCG(_RankingObjective):
             Gp = jnp.zeros((P,), jnp.float32).at[pp].add(gm)
             Hp = jnp.zeros((P,), jnp.float32).at[pp].add(hm)
             reg = self.pos_reg
-            self.pos_bias = self.pos_bias - (Gp + reg * self.pos_bias) / (Hp + reg + 1e-9)
+            new_bias = pos_bias - (Gp + reg * pos_bias) / (Hp + reg + 1e-9)
         # .add, not .set: pad_idx's padding lanes all alias row 0 and carry
         # masked-out zeros — a duplicate-index .set would zero row 0's grads
         grad = jnp.zeros_like(score).at[idx.reshape(-1)].add(g.reshape(-1))
         hess = jnp.zeros_like(score).at[idx.reshape(-1)].add(h.reshape(-1))
+        return grad, hess, new_bias
+
+    def get_gradients(self, score, label, weight):
+        bias = self.pos_bias if self._pos_pad is not None else None
+        grad, hess, new_bias = self._gradients_core(score, label, bias)
+        if self._pos_pad is not None:
+            self.pos_bias = new_bias
         return grad, hess
+
+    # fused-state protocol: the position biases ride the fused step as a
+    # carry (reference: UpdatePositionBiasFactors runs once per iteration —
+    # here that Newton refit happens in-trace and the carry is written back
+    # when the step retires)
+    def fused_state(self):
+        return self.pos_bias if self._pos_pad is not None else None
+
+    def fused_gradients(self, score, label, weight, state):
+        return self._gradients_core(score, label, state)
+
+    def set_fused_state(self, state) -> None:
+        if state is not None:
+            self.pos_bias = state
 
 
 @functools.partial(jax.jit, static_argnames=("sigmoid", "truncation", "norm"))

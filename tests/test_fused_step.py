@@ -28,16 +28,36 @@ def test_stateful_ranking_objectives_not_fused():
     bst = _fit({"objective": "rank_xendcg", "tree_growth_mode": "rounds"}, rank=True)
     assert not bst._gbdt._fused_eligible(None)
     assert bst.num_trees() == 3
-    # lambdarank WITH position bias mutates pos_bias per call -> not fusable
+
+
+def test_position_bias_lambdarank_fuses_and_matches():
+    # position-bias state rides the fused step as a carry (fused_state
+    # protocol) — the fused run must reproduce the unfused run exactly,
+    # including the learned biases
     rng = np.random.RandomState(0)
     X = rng.randn(400, 4)
     y = rng.randint(0, 3, 400).astype(float)
-    d = lgb.Dataset(X, label=y, group=np.full(20, 20),
-                    position=np.tile(np.arange(20), 20))
-    bst = lgb.train({"objective": "lambdarank", "verbosity": -1,
-                     "lambdarank_position_bias_regularization": 0.1,
-                     "tree_growth_mode": "rounds"}, d, num_boost_round=2)
-    assert not bst._gbdt._fused_eligible(None)
+    params = {"objective": "lambdarank", "verbosity": -1, "num_leaves": 7,
+              "lambdarank_position_bias_regularization": 0.1,
+              "tree_growth_mode": "rounds"}
+    preds, biases = {}, {}
+    for fuse in (True, False):
+        d = lgb.Dataset(X, label=y, group=np.full(20, 20),
+                        position=np.tile(np.arange(20), 20))
+        bst = lgb.Booster(params=params, train_set=d)
+        if fuse:
+            assert bst._gbdt._fused_eligible(None)
+        else:
+            bst._gbdt._fused_eligible = lambda grad: False
+        for _ in range(3):
+            bst.update()
+        preds[fuse] = bst.predict(X)
+        biases[fuse] = np.asarray(bst._gbdt.objective.pos_bias)
+    assert np.abs(biases[True]).max() > 0  # biases actually learned
+    np.testing.assert_allclose(biases[True], biases[False], rtol=1e-5,
+                               atol=1e-7)
+    np.testing.assert_allclose(preds[True], preds[False], rtol=1e-5,
+                               atol=1e-7)
 
 
 def test_plain_lambdarank_fuses_and_matches():
