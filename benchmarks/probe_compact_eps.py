@@ -1,0 +1,41 @@
+"""Round-3 lever-2 probe: row-compaction gather cost at Epsilon shape.
+
+At 1M x 28 the leaf-gather was a measured dead end (909 ms vs ~3 ms
+passes).  At Epsilon shape (400k x 2000, 255 bins) passes cost ~200 ms
+each and the grower runs ~26 admission rounds; if a full-matrix gather
+costs ~1-2 passes, physically regrouping rows by leaf once per round
+could shrink later passes.  Measure the gather + a pass over the
+compacted matrix.
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+N, F = 400_000, 2000
+rng = np.random.RandomState(0)
+bins = jnp.asarray(rng.randint(0, 255, (N, F), np.int16), jnp.int16)
+perm = jnp.asarray(rng.permutation(N))
+
+@jax.jit
+def gather_rows(b, p):
+    return jnp.take(b, p, axis=0)
+
+def timeit(fn, *a, reps=5):
+    out = fn(*a); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*a)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+t_gather = timeit(gather_rows, bins, perm)
+print(f"row-gather (400k x 2000 int16): {t_gather*1e3:.1f} ms")
+
+# and the transposed (feature-major) layout the partition loop uses
+bins_t = jnp.asarray(np.asarray(bins).T)
+@jax.jit
+def gather_cols(bt, p):
+    return jnp.take(bt, p, axis=1)
+t_gather_t = timeit(gather_cols, bins_t, perm)
+print(f"col-gather of (2000 x 400k) int16: {t_gather_t*1e3:.1f} ms")

@@ -332,12 +332,28 @@ class GBDT:
                 "tree learners (tree_learner=data/feature/voting on a "
                 "multi-device mesh); use tree_learner=serial to force splits."
             )
+        # CEGB lazy per-(row, feature) fetch charges (reference:
+        # cost_effective_gradient_boosting.hpp feature_used_in_data): state
+        # is (N, F) across trees, threaded through the strict serial grower
         if any(p != 0 for p in (self.cfg.cegb_penalty_feature_lazy or [])):
-            log_warning(
-                "cegb_penalty_feature_lazy is not implemented (per-row feature "
-                "charge state); coupled + split penalties are. The lazy "
-                "penalty is IGNORED."
-            )
+            lazy = np.zeros(f, np.float32)
+            for i, v in enumerate((self.cfg.cegb_penalty_feature_lazy or [])[:f]):
+                lazy[i] = self.cfg.cegb_tradeoff * float(v)
+            self._cegb_lazy = jnp.asarray(lazy)
+            self._cegb_lazy_used = jnp.zeros((train_set.num_data(), f), bool)
+            if self._use_fast or (
+                self.cfg.tree_learner != "serial" and jax.device_count() > 1
+            ):
+                # single-device non-serial learners fall back to the strict
+                # serial grower, which DOES apply the penalty
+                log_warning(
+                    "cegb_penalty_feature_lazy is applied by the strict "
+                    "serial grower only (tree_growth_mode=strict, single-"
+                    "device); this configuration IGNORES it."
+                )
+        else:
+            self._cegb_lazy = None
+            self._cegb_lazy_used = None
         if self._monotone is not None:
             mmethod = self.cfg.monotone_constraints_method
             if mmethod == "advanced":
@@ -603,6 +619,8 @@ class GBDT:
     _finish_probe = None
 
     _pre_partition = False
+    _cegb_lazy = None
+    _cegb_lazy_used = None
 
     def _localize_tree(self, arrays, leaf_id_pad):
         """Multi-controller runs: bring the (replicated) tree and the
@@ -1037,7 +1055,7 @@ class GBDT:
                 )
             else:
                 fs = self._forced_schedule()
-                arrays, leaf_id = grow_tree(
+                grow_out = grow_tree(
                     ts.bins_device,
                     gc,
                     hc,
@@ -1051,6 +1069,8 @@ class GBDT:
                     self._interaction_sets,
                     node_rng,
                     cegb_pen,
+                    self._cegb_lazy,
+                    self._cegb_lazy_used,
                     fs[0] if fs else None,
                     fs[1] if fs else None,
                     fs[2] if fs else None,
@@ -1069,6 +1089,10 @@ class GBDT:
                         else "basic"
                     ),
                 )
+                if self._cegb_lazy is not None and len(grow_out) == 3:
+                    arrays, leaf_id, self._cegb_lazy_used = grow_out
+                else:
+                    arrays, leaf_id = grow_out
             linear_fit = None
             if self._linear and arrays.path_features is not None:
                 from ..ops.linear import fit_linear_leaves

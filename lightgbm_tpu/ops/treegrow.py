@@ -88,6 +88,10 @@ class GrowState(NamedTuple):
     anc: jnp.ndarray = False  # (L, L-1) bool ancestor masks, or () placeholder
     aside: jnp.ndarray = False  # (L, L-1) bool — leaf on the RIGHT side of m
     # (maintained only for monotone_method="intermediate")
+    lazy_used: jnp.ndarray = False  # (N, F) bool — rows charged per feature
+    lazy_counts: jnp.ndarray = False  # (L, F) f32 — per-leaf uncharged rows
+    # (maintained only for CEGB cegb_penalty_feature_lazy; reference:
+    # CostEfficientGradientBoosting feature_used_in_data bitset)
 
 
 def _empty_best(num_leaves: int, num_bins: int) -> BestSplit:
@@ -178,6 +182,8 @@ def grow_tree(
     interaction_sets: jnp.ndarray = None,  # (S, F) bool — allowed feature sets
     rng_key: jnp.ndarray = None,  # base PRNG key (extra_trees / bynode)
     cegb_feature_penalty: jnp.ndarray = None,  # (F,) pre-scaled coupled penalties
+    cegb_lazy_penalty: jnp.ndarray = None,  # (F,) pre-scaled lazy penalties
+    cegb_lazy_used: jnp.ndarray = None,  # (N, F) bool — rows already charged
     forced_leaf: jnp.ndarray = None,  # (K,) i32 — forced-split schedule
     forced_feature: jnp.ndarray = None,  # (K,) i32   (reference: ForceSplits
     forced_bin: jnp.ndarray = None,  # (K,) i32        from forcedsplits JSON)
@@ -207,6 +213,13 @@ def grow_tree(
     hess = hess.astype(jnp.float32) * sample_weight
     L = num_leaves
     mode = parallel_mode if axis_name is not None else "serial"
+    # CEGB lazy per-(row, feature) fetch charges (reference:
+    # cost_effective_gradient_boosting.hpp — DeltaGain subtracts
+    # penalty_feature_lazy[f] * #uncharged rows in the leaf; rows charge
+    # when a split applies).  Serial-mode only: the (N, F) charge state is
+    # row-global and the distributed wrappers do not thread it.
+    use_lazy = (cegb_lazy_penalty is not None and cegb_lazy_used is not None
+                and mode == "serial")
     use_intermediate = (
         monotone_method == "intermediate"
         and monotone_constraints is not None
@@ -235,7 +248,8 @@ def grow_tree(
         return jnp.any(interaction_sets & ok_s[:, None], axis=0)  # (F,)
 
     def best_for(hist_leaf, sum_g, sum_h, count, depth, out_lo=None, out_hi=None,
-                 used=None, node_id=None, parent_out=None, cegb_used=None):
+                 used=None, node_id=None, parent_out=None, cegb_used=None,
+                 lazy_counts=None):
         fmask = feature_mask
         if interaction_sets is not None and used is not None:
             fmask = fmask & allowed_from_used(used) if fmask is not None else allowed_from_used(used)
@@ -245,6 +259,9 @@ def grow_tree(
         cegb_pen = None
         if cegb_feature_penalty is not None:
             cegb_pen = jnp.where(cegb_used, 0.0, cegb_feature_penalty)
+        if lazy_counts is not None:
+            lz = cegb_lazy_penalty * lazy_counts
+            cegb_pen = lz if cegb_pen is None else cegb_pen + lz
         kw = dict(
             feature_mask=fmask,
             categorical_mask=categorical_mask,
@@ -351,6 +368,10 @@ def grow_tree(
 
     leaf_out0 = leaf_output(g0, h0, params)
     cegb_used0 = jnp.zeros((f,), bool)
+    if use_lazy:
+        lazy_used0 = cegb_lazy_used
+        lazy_counts0 = jnp.einsum(
+            "n,nf->f", mask0, (~lazy_used0).astype(jnp.float32))
 
     tree0 = TreeArrays(
         num_leaves=jnp.asarray(1, jnp.int32),
@@ -383,6 +404,7 @@ def grow_tree(
                 used=(jnp.zeros((f,), bool) if interaction_sets is not None else None),
                 node_id=jnp.asarray(0, jnp.int32),
                 parent_out=leaf_out0, cegb_used=cegb_used0,
+                lazy_counts=(lazy_counts0 if use_lazy else None),
             ),
         ),
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
@@ -407,6 +429,9 @@ def grow_tree(
              else jnp.zeros((), bool)),
         aside=(jnp.zeros((L, L - 1), bool) if use_intermediate
                else jnp.zeros((), bool)),
+        lazy_used=(lazy_used0 if use_lazy else jnp.zeros((), bool)),
+        lazy_counts=(jnp.zeros((L, f), jnp.float32).at[0].set(lazy_counts0)
+                     if use_lazy else jnp.zeros((), bool)),
     )
 
     def _forced_candidate(state: GrowState, i):
@@ -488,6 +513,26 @@ def grow_tree(
             state.cegb_used.at[s.feature].set(True)
             if cegb_feature_penalty is not None else state.cegb_used
         )
+        if use_lazy:
+            # charge the split leaf's in-bag rows for the split feature,
+            # THEN compute the children's uncharged counts (a child split
+            # on the same feature is free)
+            charge = in_leaf & row_mask
+            lazy_used = state.lazy_used.at[:, s.feature].set(
+                state.lazy_used[:, s.feature] | charge)
+            m_l = ((leaf_id == best_leaf) & row_mask).astype(jnp.float32)
+            counts_l = jnp.einsum(
+                "n,nf->f", m_l, (~lazy_used).astype(jnp.float32))
+            # rows partition across leaves, so the parent's stored counts are
+            # still current at split time; after charging s.feature the
+            # children's counts for it are 0, and the right child holds the
+            # remainder — one einsum instead of two
+            parent_counts = state.lazy_counts[best_leaf].at[s.feature].set(0.0)
+            counts_r = jnp.maximum(parent_counts - counts_l, 0.0)
+            lazy_counts = (state.lazy_counts.at[best_leaf].set(counts_l)
+                           .at[new_leaf].set(counts_r))
+        else:
+            lazy_used, lazy_counts = state.lazy_used, state.lazy_counts
         old_parent = state.leaf_parent[best_leaf]
         old_side = state.leaf_side[best_leaf]
         t = state.tree
@@ -609,26 +654,30 @@ def grow_tree(
             node_ids_all = jnp.clip(leaf_parent, 0, None) * 2 + leaf_side + 1
             used_all = used_features if interaction_sets is not None else None
 
-            def one(hist_l, g, h, c, dep, lo, hi, nid, pout, u):
+            def one(hist_l, g, h, c, dep, lo, hi, nid, pout, u, lzc):
                 return best_for(hist_l, g, h, c, dep, out_lo=lo, out_hi=hi,
                                 used=u, node_id=nid, parent_out=pout,
-                                cegb_used=cegb_used)
+                                cegb_used=cegb_used, lazy_counts=lzc)
 
             in_axes = (0, 0, 0, 0, 0, 0, 0, 0, 0,
-                       0 if used_all is not None else None)
+                       0 if used_all is not None else None,
+                       0 if use_lazy else None)
             bb = jax.vmap(one, in_axes=in_axes)(
                 hist, leaf_sum_g, leaf_sum_h, leaf_count, leaf_depth,
                 leaf_out_lo, leaf_out_hi, node_ids_all, leaf_out, used_all,
+                lazy_counts if use_lazy else None,
             )
             live_l = jnp.arange(L, dtype=jnp.int32) < (state.num_leaves_cur + 1)
             best = bb._replace(gain=jnp.where(live_l, bb.gain, KMIN_SCORE))
         else:
             bl = best_for(hist_left, s.left_sum_g, s.left_sum_h, s.left_count, depth_child,
                           out_lo=l_lo, out_hi=l_hi, used=used_child, node_id=2 * node + 1,
-                          parent_out=out_l_c, cegb_used=cegb_used)
+                          parent_out=out_l_c, cegb_used=cegb_used,
+                          lazy_counts=(lazy_counts[best_leaf] if use_lazy else None))
             br = best_for(hist_right, s.right_sum_g, s.right_sum_h, s.right_count, depth_child,
                           out_lo=r_lo, out_hi=r_hi, used=used_child, node_id=2 * node + 2,
-                          parent_out=out_r_c, cegb_used=cegb_used)
+                          parent_out=out_r_c, cegb_used=cegb_used,
+                          lazy_counts=(lazy_counts[new_leaf] if use_lazy else None))
             best = _set_best(_set_best(state.best, best_leaf, bl), new_leaf, br)
 
         return GrowState(
@@ -651,6 +700,8 @@ def grow_tree(
             forced_active=state.forced_active,
             anc=anc,
             aside=aside,
+            lazy_used=lazy_used,
+            lazy_counts=lazy_counts,
         )
 
     def body(i, state: GrowState) -> GrowState:
@@ -697,4 +748,8 @@ def grow_tree(
         leaf_depth=state.leaf_depth,
         path_features=(state.used_features if track_path else None),
     )
+    if use_lazy:
+        # hand the cross-tree charge state back (reference: the
+        # feature_used_in_data bitset persists across trees)
+        return tree, state.leaf_id, state.lazy_used
     return tree, state.leaf_id
