@@ -95,6 +95,10 @@ class Metric:
     def device_eval(self, pred, label, weight):
         raise NotImplementedError
 
+    # rank metrics set True: they are evaluated via device_eval_queries with
+    # per-dataset padded-query constants instead of device_eval
+    needs_queries = False
+
     def transform(self, v: float) -> float:
         return v
 
@@ -449,15 +453,56 @@ class MultiErrorMetric(Metric):
         return jnp.sum(err * weight) / jnp.sum(weight)
 
 
+def pad_queries(query_boundaries: np.ndarray):
+    """Queries as a dense (Q, S) padded block (the TPU formulation of the
+    reference's per-query loops; same layout objectives._RankingObjective
+    uses).  Returns (pad_idx, pad_mask)."""
+    qb = np.asarray(query_boundaries)
+    nq = len(qb) - 1
+    lens = np.diff(qb)
+    smax = int(lens.max()) if nq else 0
+    pad_idx = np.zeros((nq, smax), np.int64)
+    pad_mask = np.zeros((nq, smax), bool)
+    for q in range(nq):
+        lo, hi = qb[q], qb[q + 1]
+        pad_idx[q, : hi - lo] = np.arange(lo, hi)
+        pad_mask[q, : hi - lo] = True
+    return pad_idx, pad_mask
+
+
 class _MeanPerQuery(Metric):
     """Ranking metrics averaging a per-query statistic decompose for
-    distributed eval as (sum over local queries, #local queries)."""
+    distributed eval as (sum over local queries, #local queries).
+
+    Device protocol (reference: the CUDA build's rank metrics,
+    src/metric/cuda/cuda_rank_metric.cu): `device_query_constants`
+    precomputes per-dataset tensors on host (padding, ideal DCGs);
+    `device_eval_queries` is a pure jnp function evaluated inside the
+    per-eval-set jit, returning one value per eval_at k."""
+
+    needs_queries = True
 
     def eval_sums(self, pred, label, weight, query_boundaries=None):
         nq = float(len(query_boundaries) - 1)
         return [(nm, v * nq, nq, hib)
                 for nm, v, hib in self.eval(pred, label, weight,
                                             query_boundaries)]
+
+    def supports_device(self, num_class: int) -> bool:
+        return num_class == 1
+
+    def device_out_names(self):
+        return [f"{self.name}@{k}" for k in self.cfg.eval_at]
+
+    def device_query_constants(self, label: np.ndarray,
+                               query_boundaries: np.ndarray,
+                               shared: dict = None) -> dict:
+        """`shared` (from the evaluator) carries the padded layout computed
+        once per eval set: pad_idx/pad_mask as numpy + device arrays."""
+        raise NotImplementedError
+
+    def device_eval_queries(self, pred, consts: dict):
+        raise NotImplementedError
 
 
 class NDCGMetric(_MeanPerQuery):
@@ -476,6 +521,65 @@ class NDCGMetric(_MeanPerQuery):
             v = ndcg_at_k(np.asarray(pred), np.asarray(label), query_boundaries, k, self.label_gain)
             out.append((f"ndcg@{k}", v, True))
         return out
+
+    def device_query_constants(self, label, query_boundaries, shared=None):
+        import jax.numpy as jnp
+
+        label = np.asarray(label)
+        qb = np.asarray(query_boundaries)
+        if shared is not None:
+            pad_idx, pad_mask = shared["pad_idx_np"], shared["pad_mask_np"]
+            dev_idx, dev_mask = shared["pad_idx"], shared["pad_mask"]
+        else:
+            pad_idx, pad_mask = pad_queries(qb)
+            dev_idx, dev_mask = jnp.asarray(pad_idx), jnp.asarray(pad_mask)
+        nq = len(qb) - 1
+        ks = list(self.cfg.eval_at)
+        inv_ideal = np.zeros((len(ks), nq), np.float64)
+        all_same = np.zeros(nq, bool)
+        for q in range(nq):
+            ql = label[qb[q]: qb[q + 1]]
+            all_same[q] = bool(np.all(ql == ql[0]))
+            ideal = np.sort(ql)[::-1]
+            for i, k in enumerate(ks):
+                m = dcg_at_k(ideal, min(len(ql), k), self.label_gain)
+                inv_ideal[i, q] = 1.0 / m if m > 0 else 0.0
+        return {
+            "pad_idx": dev_idx,
+            "pad_mask": dev_mask,
+            "inv_ideal": jnp.asarray(inv_ideal, jnp.float32),
+            "all_same": jnp.asarray(all_same),
+            "gain_pad": jnp.asarray(  # per-slot gains, masked
+                np.where(
+                    pad_mask,
+                    self.label_gain[np.clip(
+                        label[pad_idx].astype(np.int64), 0,
+                        len(self.label_gain) - 1)],
+                    0.0,
+                ), jnp.float32),
+            "ks": ks,
+        }
+
+    def device_eval_queries(self, pred, consts):
+        import jax.numpy as jnp
+
+        idx, msk = consts["pad_idx"], consts["pad_mask"]
+        s = pred[idx.reshape(-1)].reshape(idx.shape)
+        ms = jnp.where(msk, s, jnp.float32(-1e30))
+        order = jnp.argsort(-ms, axis=1, stable=True)
+        ranks = jnp.argsort(order, axis=1)  # rank of each original slot
+        disc = jnp.where(msk, 1.0 / jnp.log2(ranks.astype(jnp.float32) + 2.0),
+                         0.0)
+        gains = consts["gain_pad"]
+        outs = []
+        for i, k in enumerate(consts["ks"]):
+            dcg = jnp.sum(gains * disc * (ranks < k), axis=1)  # (Q,)
+            # host parity (ndcg_at_k): no-variation or zero-ideal queries
+            # count as 1
+            valid = (consts["inv_ideal"][i] > 0) & ~consts["all_same"]
+            ndcg = jnp.where(valid, dcg * consts["inv_ideal"][i], 1.0)
+            outs.append(jnp.mean(ndcg))
+        return jnp.stack(outs)
 
 
 class MAPMetric(_MeanPerQuery):
@@ -500,6 +604,44 @@ class MAPMetric(_MeanPerQuery):
                 total += float(np.sum(prec * rel[:kk]) / denom)
             out.append((f"map@{k}", total / max(nq, 1), True))
         return out
+
+    def device_query_constants(self, label, query_boundaries, shared=None):
+        import jax.numpy as jnp
+
+        label = np.asarray(label)
+        if shared is not None:
+            pad_idx, pad_mask = shared["pad_idx_np"], shared["pad_mask_np"]
+            dev_idx, dev_mask = shared["pad_idx"], shared["pad_mask"]
+        else:
+            pad_idx, pad_mask = pad_queries(query_boundaries)
+            dev_idx, dev_mask = jnp.asarray(pad_idx), jnp.asarray(pad_mask)
+        rel_pad = np.where(pad_mask, label[pad_idx] > 0, False)
+        return {
+            "pad_idx": dev_idx,
+            "pad_mask": dev_mask,
+            "rel_pad": jnp.asarray(rel_pad),
+            "ks": list(self.cfg.eval_at),
+        }
+
+    def device_eval_queries(self, pred, consts):
+        import jax.numpy as jnp
+
+        idx, msk = consts["pad_idx"], consts["pad_mask"]
+        s = pred[idx.reshape(-1)].reshape(idx.shape)
+        ms = jnp.where(msk, s, jnp.float32(-1e30))
+        order = jnp.argsort(-ms, axis=1, stable=True)
+        srel = jnp.take_along_axis(
+            consts["rel_pad"], order, axis=1).astype(jnp.float32)
+        hits = jnp.cumsum(srel, axis=1)
+        pos = jnp.arange(1, srel.shape[1] + 1, dtype=jnp.float32)[None, :]
+        prec = hits / pos
+        total_rel = jnp.sum(srel, axis=1)
+        outs = []
+        for k in consts["ks"]:
+            contrib = jnp.sum(prec * srel * (pos <= k), axis=1)
+            denom = jnp.maximum(jnp.minimum(total_rel, float(k)), 1.0)
+            outs.append(jnp.mean(contrib / denom))
+        return jnp.stack(outs)
 
 
 _METRICS: Dict[str, Callable[[Config], Metric]] = {

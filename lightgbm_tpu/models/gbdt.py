@@ -1287,14 +1287,39 @@ class GBDT:
             weight_dev = None if ds.weight is None else jnp.asarray(
                 np.asarray(ds.weight), jnp.float32
             )
+        # rank metrics need the eval set's padded query layout + ideal DCGs
+        # (host-precomputed per dataset, device constants in the trace);
+        # the layout is computed once and shared by every rank metric
+        shared = None
+        if any(m.needs_queries for m in dev_metrics):
+            from ..metrics import pad_queries
+
+            pad_idx_np, pad_mask_np = pad_queries(ds.query_boundaries)
+            shared = {
+                "pad_idx_np": pad_idx_np, "pad_mask_np": pad_mask_np,
+                "pad_idx": jnp.asarray(pad_idx_np),
+                "pad_mask": jnp.asarray(pad_mask_np),
+            }
+        qconsts = {
+            id(m): m.device_query_constants(
+                np.asarray(ds.label), ds.query_boundaries, shared)
+            for m in dev_metrics if m.needs_queries
+        }
 
         @jax.jit
         def run(margin, label, weight):
             pred = obj.convert_output(margin) if obj is not None else margin
-            return jnp.stack([
-                jnp.asarray(m.device_eval(pred, label, weight), jnp.float32)
-                for m in dev_metrics
-            ])
+            outs = []
+            for m in dev_metrics:
+                if m.needs_queries:
+                    outs.append(jnp.asarray(
+                        m.device_eval_queries(pred, qconsts[id(m)]),
+                        jnp.float32))
+                else:
+                    outs.append(jnp.asarray(
+                        m.device_eval(pred, label, weight),
+                        jnp.float32).reshape(-1))
+            return jnp.concatenate(outs)
 
         entry = (run, label_dev, weight_dev)
         self._eval_jit_cache[key] = entry
@@ -1363,6 +1388,7 @@ class GBDT:
         dev_metrics = [
             m for m in self.metrics
             if self.objective is not None and m.supports_device(k)
+            and (not m.needs_queries or ds.query_boundaries is not None)
         ]
         host_metrics = [m for m in self.metrics if m not in dev_metrics]
         out_by_metric = {}
@@ -1371,10 +1397,17 @@ class GBDT:
                 data_idx, ds, dev_metrics
             )
             vals = np.asarray(run(self._eval_margin(score), label_dev, weight_dev))
-            for m, v in zip(dev_metrics, vals):
+            off = 0
+            for m in dev_metrics:
+                if m.needs_queries:
+                    names = m.device_out_names()
+                else:
+                    names = [m.name]
                 out_by_metric[id(m)] = [
-                    (m.name, m.transform(float(v)), m.is_higher_better)
+                    (nm, m.transform(float(vals[off + j])), m.is_higher_better)
+                    for j, nm in enumerate(names)
                 ]
+                off += len(names)
         if host_metrics:
             pred = self._converted(self._eval_margin(score))
             label = np.asarray(ds.label)

@@ -388,16 +388,13 @@ class _RankingObjective(Objective):
     fusable = False
 
     def set_query(self, query_boundaries: np.ndarray, labels: np.ndarray):
+        from .metrics import pad_queries
+
         self.query_boundaries = np.asarray(query_boundaries)
         nq = len(self.query_boundaries) - 1
         lens = np.diff(self.query_boundaries)
         self.max_query = int(lens.max()) if nq else 0
-        pad_idx = np.zeros((nq, self.max_query), dtype=np.int64)
-        pad_mask = np.zeros((nq, self.max_query), dtype=bool)
-        for q in range(nq):
-            lo, hi = self.query_boundaries[q], self.query_boundaries[q + 1]
-            pad_idx[q, : hi - lo] = np.arange(lo, hi)
-            pad_mask[q, : hi - lo] = True
+        pad_idx, pad_mask = pad_queries(self.query_boundaries)
         self._pad_idx = jnp.asarray(pad_idx)
         self._pad_mask = jnp.asarray(pad_mask)
 

@@ -91,3 +91,36 @@ def test_eval_device_matches_host_multiclass():
         (hn, hv, hh) = m.eval(pred, np.asarray(ds.label), None)[0]
         assert mn == hn
         assert v == pytest.approx(hv, rel=2e-4, abs=2e-5)
+
+
+def test_rank_metrics_device_match_host():
+    # ndcg@k / map@k evaluate inside the per-eval-set jit (reference: the
+    # CUDA rank metrics); values must match the host per-query loops
+    rng = np.random.RandomState(4)
+    n, docs = 2400, 24
+    X = rng.randn(n, 10)
+    y = np.clip(np.floor(X[:, 0] + rng.randn(n) * 0.5) + 2, 0, 4).astype(float)
+    g = np.full(n // docs, docs)
+    train = lgb.Dataset(X[:1800], label=y[:1800], group=g[: 1800 // docs])
+    valid = lgb.Dataset(X[1800:], label=y[1800:], group=g[: 600 // docs],
+                        reference=train)
+    bst = lgb.train(
+        {"objective": "lambdarank", "verbosity": -1,
+         "metric": ["ndcg", "map"], "eval_at": [1, 3, 5]},
+        train, 8, valid_sets=[valid], keep_training_booster=True)
+    gb = bst._gbdt
+    # the device path must actually engage for both rank metrics
+    ds = gb.valid_sets[0]
+    k = gb.num_tree_per_iteration
+    assert all(m.supports_device(k) and m.needs_queries for m in gb.metrics)
+    res = gb.eval_at(1)
+    names = [r[1] for r in res]
+    assert names == ["ndcg@1", "ndcg@3", "ndcg@5", "map@1", "map@3", "map@5"]
+    pred = gb._converted(gb._eval_margin(gb._valid_scores[0]))
+    label = np.asarray(ds.label)
+    host = []
+    for m in gb.metrics:
+        host.extend(m.eval(pred, label, None, ds.query_boundaries))
+    for (dn, dm, dv, dh), (hn, hv, hh) in zip(res, host):
+        assert dm == hn and dh == hh
+        assert dv == pytest.approx(hv, rel=2e-4, abs=2e-5)
