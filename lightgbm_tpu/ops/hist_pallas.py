@@ -142,8 +142,8 @@ def _hist_pallas_raw(
         out_specs=pl.BlockSpec((FB, nc, B), lambda j, i: (j, 0, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(out_dims, acc_dtype),
         cost_estimate=pl.CostEstimate(
-            flops=2 * n_pad * f_pad * B * nc,
-            bytes_accessed=n_pad * f_pad * bins.dtype.itemsize + n_pad * nc * 4,
+            flops=2 * n_pad * FB * B * nc,
+            bytes_accessed=n_pad * FB * bins.dtype.itemsize + n_pad * nc * 4,
             transcendentals=0,
         ),
     )(bins, payload)

@@ -45,3 +45,29 @@ def test_onehot_multi_leaf_base_offset():
     rel = np.max(np.abs(np.asarray(out[0]) - np.asarray(ref0))) / (
         np.abs(np.asarray(ref0)).max() + 1)
     assert rel < 2e-4
+
+
+def test_pallas_hist_paths_trace_on_cpu():
+    """jax.eval_shape traces the pallas histogram builders without TPU
+    compilation — catches Python-level breakage (e.g. a bad cost_estimate)
+    in the narrow AND the wide (per-128-feature chunked) paths, which only
+    real-TPU runs would otherwise reach."""
+    import jax
+
+    from lightgbm_tpu.ops.hist_pallas import (histogram_pallas,
+                                              histogram_pallas_multi)
+
+    for f in (28, 300):  # narrow; wide enough to take the chunked branch
+        n = 256
+        bins = jnp.zeros((n, f), jnp.int16)
+        g = h = m = jnp.zeros((n,), jnp.float32)
+        out = jax.eval_shape(
+            lambda b, g_, h_, m_: histogram_pallas(b, g_, h_, m_, 63),
+            bins, g, h, m)
+        assert out.shape == (f, 63, 3)
+        lid = jnp.zeros((n,), jnp.int32)
+        out = jax.eval_shape(
+            lambda b, g_, h_, m_, l_: histogram_pallas_multi(
+                b, g_, h_, m_, l_, 0, 4, 63),
+            bins, g, h, m, lid)
+        assert out.shape == (4, f, 63, 3)
