@@ -463,10 +463,11 @@ class GBDT:
         # retrace every iteration; invalidate only when a baked constant
         # really changed (reference: GBDT::ResetConfig propagates num_leaves
         # etc. to the tree learner)
-        if self._fused_step is not None and (
-            getattr(self, "_fused_key", None) != self._fused_bake_key()
-        ):
+        if getattr(self, "_fused_key", None) != self._fused_bake_key():
             self._fused_step = None
+            # a changed baked constant yields a fresh trace, so a previous
+            # compile failure no longer applies — give fused another chance
+            self._fused_disabled = False
 
     def add_valid(self, valid_set, name: str) -> None:
         valid_set.construct(reference=self.train_set)
@@ -621,6 +622,7 @@ class GBDT:
     _pre_partition = False
     _cegb_lazy = None
     _cegb_lazy_used = None
+    _fused_disabled = False
 
     def _localize_tree(self, arrays, leaf_id_pad):
         """Multi-controller runs: bring the (replicated) tree and the
@@ -651,6 +653,7 @@ class GBDT:
         return (
             grad is None
             and self.cfg.fused_training
+            and not self._fused_disabled
             # each class tree inlines into the trace: cap the blowup
             and self.num_tree_per_iteration <= 8
             # very wide/deep shapes compile the combined trace pathologically
@@ -854,11 +857,35 @@ class GBDT:
             feature_mask = self._feature_mask()
             shrinkage = 1.0 if self.average_output else self.cfg.learning_rate
             step = self._get_fused_step()
-            arrays_all, leaf_all, self._score, g, h, obj_state = step(
-                self._score, row_mask, sample_weight,
-                jnp.asarray(feature_mask), jnp.float32(shrinkage),
-                goss_key, goss_warm, self.objective.fused_state(),
-            )
+            try:
+                arrays_all, leaf_all, self._score, g, h, obj_state = step(
+                    self._score, row_mask, sample_weight,
+                    jnp.asarray(feature_mask), jnp.float32(shrinkage),
+                    goss_key, goss_warm, self.objective.fused_state(),
+                )
+            except Exception:  # noqa: BLE001
+                from ..utils.log import log_warning
+
+                try:
+                    # transient transport hiccups are common on the remote
+                    # compile path: retry once before giving up
+                    arrays_all, leaf_all, self._score, g, h, obj_state = step(
+                        self._score, row_mask, sample_weight,
+                        jnp.asarray(feature_mask), jnp.float32(shrinkage),
+                        goss_key, goss_warm, self.objective.fused_state(),
+                    )
+                except Exception as e:  # noqa: BLE001
+                    # nothing is mutated before `step` returns, so fall back
+                    # to the unfused path (re-enabled if reset_parameter
+                    # changes a baked constant and retraces)
+                    log_warning(
+                        "fused training step failed twice "
+                        f"({type(e).__name__}: {str(e)[:200]}); "
+                        "falling back to per-phase dispatches"
+                    )
+                    self._fused_disabled = True
+                    self._fused_step = None
+                    return self.train_one_iter(grad, hess)
             self.objective.set_fused_state(obj_state)
             self._cur_grad, self._cur_hess = g, h
             for c, arrays in enumerate(arrays_all):

@@ -263,6 +263,25 @@ def histogram_pallas_multi(
     return out3
 
 
+def quantized_leaf_payload(grad_q, hess_q, mask, leaf_id, leaf_base,
+                           num_leaves_tile) -> jnp.ndarray:
+    """(N, L_tile*3) int8 payload: leaf-onehot x (grad_q, hess_q, count).
+    Shared by the Pallas kernel and the XLA one-hot einsum so the two
+    quantized strategies cannot desynchronize."""
+    m8 = mask.astype(jnp.int8)
+    base = jnp.stack(
+        [grad_q.astype(jnp.int8) * m8, hess_q.astype(jnp.int8) * m8, m8],
+        axis=-1,
+    )  # (N, 3)
+    lid = leaf_id.astype(jnp.int32) - leaf_base
+    onehot = (
+        lid[:, None] == jnp.arange(num_leaves_tile, dtype=jnp.int32)[None, :]
+    ).astype(jnp.int8)  # (N, L_tile)
+    return (onehot[:, :, None] * base[:, None, :]).reshape(
+        grad_q.shape[0], num_leaves_tile * 3
+    )
+
+
 def histogram_pallas_multi_quantized(
     bins: jnp.ndarray,  # (N, F) int
     grad_q: jnp.ndarray,  # (N,) int8 — discretized gradients
@@ -279,18 +298,9 @@ def histogram_pallas_multi_quantized(
     (L_tile, F, B, 3) int32: exact integer accumulation on the int8 MXU
     (reference: gradient_discretizer.cpp + per-leaf ConstructHistograms).
     Lanes are leaf-onehot x (grad_q, hess_q, count) int8 payload."""
-    m8 = mask.astype(jnp.int8)
-    base = jnp.stack(
-        [grad_q.astype(jnp.int8) * m8, hess_q.astype(jnp.int8) * m8, m8], axis=-1
-    )  # (N, 3)
-    lid = leaf_id.astype(jnp.int32) - leaf_base
-    onehot = (
-        lid[:, None] == jnp.arange(num_leaves_tile, dtype=jnp.int32)[None, :]
-    ).astype(jnp.int8)  # (N, L_tile)
+    pay = quantized_leaf_payload(grad_q, hess_q, mask, leaf_id, leaf_base,
+                                 num_leaves_tile)
     ncl = 3
-    pay = (onehot[:, :, None] * base[:, None, :]).reshape(
-        bins.shape[0], num_leaves_tile * ncl
-    )
     nc_pad = _round_up(num_leaves_tile * ncl, 4)
     if nc_pad != pay.shape[1]:
         pay = jnp.pad(pay, ((0, 0), (0, nc_pad - pay.shape[1])))

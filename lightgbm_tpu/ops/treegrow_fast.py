@@ -38,7 +38,8 @@ import jax
 import jax.numpy as jnp
 
 from .hist_pallas import histogram_pallas_multi, histogram_pallas_multi_quantized
-from .histogram import histogram, histogram_onehot_multi
+from .histogram import (histogram, histogram_onehot_multi,
+                        histogram_onehot_multi_quantized)
 from .split import (
     BestSplit, SplitParams, find_best_split, forced_split_candidate,
     leaf_output, leaf_output_smoothed, KMIN_SCORE,
@@ -257,10 +258,19 @@ def grow_tree_fast(
     def multi_hist(leaf_slot, tile):
         """(N,)-slot -> (tile, F, B, 3) f32: per-slot histograms, one pass."""
         if use_pallas and quantize_bins:
-            hi = histogram_pallas_multi_quantized(
-                hist_bins, gq, hq, row_mask & (leaf_slot >= 0),
-                jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
-            )
+            if num_bins <= 64:
+                # same measured strategy selection as the float path: XLA's
+                # fused one-hot (here int8 x int8 -> int32) wins at narrow
+                # bins; exactness is identical
+                hi = histogram_onehot_multi_quantized(
+                    hist_bins, gq, hq, row_mask & (leaf_slot >= 0),
+                    jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
+                )
+            else:
+                hi = histogram_pallas_multi_quantized(
+                    hist_bins, gq, hq, row_mask & (leaf_slot >= 0),
+                    jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
+                )
             h = unbundle(hi).astype(jnp.float32) * quant_scale
         elif use_pallas and num_bins <= 64:
             # measured strategy selection (ops/histogram.py docstring): at

@@ -171,3 +171,23 @@ def test_onehot_multi_bf16_precision():
     scale = np.abs(np.asarray(ref)).max() + 1
     rel = np.max(np.abs(np.asarray(out[0]) - np.asarray(ref))) / scale
     assert rel < 5e-3  # bf16-rounded payload tolerance
+
+
+def test_fused_failure_falls_back_to_unfused():
+    # a compile/transport failure in the fused step must degrade to the
+    # unfused path, not kill training
+    bst = _fit({"objective": "binary", "tree_growth_mode": "rounds"}, rounds=1)
+    g = bst._gbdt
+    if not g._fused_eligible(None):
+        pytest.skip("fused path not engaged on this backend")
+
+    def boom():
+        def step(*a, **k):
+            raise RuntimeError("synthetic remote-compile failure")
+        return step
+
+    g._get_fused_step = boom
+    assert not g.train_one_iter()  # completes via the unfused path
+    assert g._fused_disabled
+    assert not g._fused_eligible(None)
+    assert bst.num_trees() == 2

@@ -71,3 +71,63 @@ def test_pallas_hist_paths_trace_on_cpu():
                 b, g_, h_, m_, l_, 0, 4, 63),
             bins, g, h, m, lid)
         assert out.shape == (4, f, 63, 3)
+
+
+def test_quantized_onehot_multi_exact_int32():
+    """The XLA int8 one-hot quantized histogram (narrow-bin strategy) must
+    produce EXACT integer sums, matching a numpy reference."""
+    from lightgbm_tpu.ops.histogram import histogram_onehot_multi_quantized
+
+    rng = np.random.RandomState(0)
+    n, f, B, tile = 5000, 6, 63, 4
+    bins = rng.randint(0, B, (n, f)).astype(np.int16)
+    gq = rng.randint(-127, 128, n).astype(np.int8)
+    hq = rng.randint(0, 128, n).astype(np.int8)
+    mask = rng.rand(n) < 0.8
+    leaf = rng.randint(0, tile, n).astype(np.int32)
+    out = np.asarray(histogram_onehot_multi_quantized(
+        jnp.asarray(bins), jnp.asarray(gq), jnp.asarray(hq),
+        jnp.asarray(mask), jnp.asarray(leaf), 0, tile, B))
+    assert out.dtype == np.int32
+    ref = np.zeros((tile, f, B, 3), np.int64)
+    for l in range(tile):
+        m = mask & (leaf == l)
+        for c, v in enumerate((gq.astype(np.int64), hq.astype(np.int64),
+                               np.ones(n, np.int64))):
+            for j in range(f):
+                ref[l, j, :, c] = np.bincount(
+                    bins[m, j], weights=v[m], minlength=B)[:B]
+    np.testing.assert_array_equal(out.astype(np.int64), ref)
+
+
+def test_fast_grower_tpu_branches_trace_on_cpu():
+    """eval_shape the ROUND-BATCHED grower with use_pallas=True through the
+    strategy-selection branches the suite otherwise never reaches off-TPU:
+    float narrow (XLA), float wide (Pallas), quantized narrow (XLA int8),
+    quantized wide (Pallas int8)."""
+    import jax
+
+    from lightgbm_tpu.ops.treegrow_fast import grow_tree_fast
+
+    n, f = 512, 5
+    for num_bins, quant in ((63, 0), (255, 0), (63, 4), (255, 4)):
+        bins = jnp.zeros((n, f), jnp.int16)
+        g = h = sw = jnp.zeros((n,), jnp.float32)
+        rm = jnp.ones((n,), bool)
+        fm = jnp.ones((f,), bool)
+        nbpf = jnp.full((f,), num_bins, jnp.int32)
+        mbpf = jnp.full((f,), -1, jnp.int32)
+
+        def run(bins, g, h, rm, sw, fm, nbpf, mbpf, _nb=num_bins, _q=quant):
+            return grow_tree_fast(
+                bins, g, h, rm, sw, fm, nbpf, mbpf,
+                None, None, None, None,
+                jax.random.PRNGKey(0) if _q else None,
+                None, None, None, None, None, None, None, None, None,
+                num_leaves=7, num_bins=_nb, params=__import__(
+                    "lightgbm_tpu.ops.split", fromlist=["SplitParams"]
+                ).SplitParams(),
+                use_pallas=True, quantize_bins=_q,
+            )
+        arrays, leaf = jax.eval_shape(run, bins, g, h, rm, sw, fm, nbpf, mbpf)
+        assert leaf.shape == (n,)
