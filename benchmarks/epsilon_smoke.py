@@ -1,4 +1,5 @@
 """Epsilon-shape capacity smoke (BASELINE.md config 2; VERDICT item 6):
+EPS_QUANT=1 measures the quantized-training path (doubled leaf tile).
 400k x 2000 dense, 255 leaves, 255 bins must train on ONE chip without OOM.
 Prints iters/sec for a few iterations."""
 
@@ -21,13 +22,17 @@ def main():
     import jax
     import lightgbm_tpu as lgb
 
+    quant = os.environ.get("EPS_QUANT", "0") == "1"
     train = lgb.Dataset(X, label=y)
     del X
-    bst = lgb.Booster(
-        params={"objective": "binary", "num_leaves": 255, "max_bin": 255,
-                "verbosity": -1, "min_data_in_leaf": 20},
-        train_set=train,
-    )
+    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+              "verbosity": -1, "min_data_in_leaf": 20}
+    if quant:
+        # int8 payloads carry 3 channels/leaf -> the wide-shape leaf tile
+        # doubles (10 -> 20) at the same ~60-lane budget
+        params.update(use_quantized_grad=True, num_grad_quant_bins=16)
+    bst = lgb.Booster(params=params, train_set=train)
+    print("leaf_tile:", bst._gbdt._leaf_tile(bst._gbdt.train_set), flush=True)
     bst.update()
     jax.block_until_ready(bst._gbdt._score)
     t0 = time.perf_counter()

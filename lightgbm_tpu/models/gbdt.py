@@ -588,6 +588,7 @@ class GBDT:
         return jnp.asarray(mask) & self._allowed_features
 
     def _leaf_tile(self, ts, use_efb: bool = True) -> int:
+        quant = bool(self.cfg.use_quantized_grad)
         if ts.max_num_bins <= 64 and self._on_tpu:
             # XLA einsum strategy (ops/histogram.py) — no Mosaic VMEM
             # ceiling.  Measured: 8 is best at 31 leaves (pass cost grows
@@ -604,13 +605,19 @@ class GBDT:
         # (ops/hist_pallas.py), so the VMEM accumulator — the binding
         # constraint — is (min(F,128), lanes, B) f32 regardless of total F;
         # lanes beyond ~64 also measurably slow the dot (probe_b256b/c), so
-        # the wide-data cap is 10 leaves x 6ch = 60 lanes
+        # the wide-data budget is ~60 payload lanes: 10 leaves x 6ch float,
+        # or 20 leaves x 3ch quantized (the int path needs no bf16x2 split
+        # — half the lanes per leaf buys half the admission rounds)
+        ncl = 3 if quant else 6
         fb = min(f_eff if f_eff > 0 else 1, 128)
         fb_pad = max((fb + 7) // 8 * 8, 8)
         budget = 8_000_000  # bytes of VMEM accumulator headroom
         bpad = (max(ts.max_num_bins, 8) + 7) // 8 * 8  # kernel pads B to 8
-        per_leaf = fb_pad * bpad * 4 * 6  # ncl=6 f32 lanes
-        cap = 8 if f_eff <= 128 else 10  # narrow: measured optimum is 8
+        per_leaf = fb_pad * bpad * 4 * ncl  # f32/int32 accumulator lanes
+        if f_eff <= 128:
+            cap = 8  # narrow: measured optimum is 8
+        else:
+            cap = 20 if quant else 10  # both = ~60 lanes
         return max(1, min(cap, budget // max(per_leaf, 1), self.cfg.num_leaves))
 
     _last_mask = None
