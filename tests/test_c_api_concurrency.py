@@ -61,14 +61,22 @@ def test_get_last_error_is_thread_local_and_predict_during_update():
                 results.append(out.copy())
 
     def failer():
-        # deliberately broken call: its error must stay on THIS thread
+        # deliberately broken call: its error must stay on THIS thread.
+        # The filename is unique to this thread — the main-thread slot may
+        # legitimately hold a stale error from an earlier test (the
+        # reference's GetLastError also persists until the next error).
+        # Failures report via the shared list: an assert raised inside a
+        # Thread would be swallowed at join().
         bad = ctypes.c_void_p()
         for _ in range(15):
-            rc = lib.LGBM_BoosterCreateFromModelfile(b"/nonexistent/x.txt",
-                                                     ctypes.byref(bad))
-            assert rc != 0
+            rc = lib.LGBM_BoosterCreateFromModelfile(
+                b"/nonexistent/failer_thread_only.txt", ctypes.byref(bad))
+            if rc == 0:
+                errors.append(("failer", "expected failure, got rc=0"))
+                continue
             msg = lib.LGBM_GetLastError().decode()
-            assert "nonexistent" in msg or "No such file" in msg, msg
+            if "failer_thread_only" not in msg:
+                errors.append(("failer", msg))
 
     threads = [threading.Thread(target=trainer),
                threading.Thread(target=predictor),
@@ -82,6 +90,6 @@ def test_get_last_error_is_thread_local_and_predict_during_update():
     assert results and all(np.isfinite(r).all() for r in results)
     # the failer thread's errors never leaked into this thread's slot
     main_msg = lib.LGBM_GetLastError().decode()
-    assert "nonexistent" not in main_msg and "No such file" not in main_msg
+    assert "failer_thread_only" not in main_msg, main_msg
     assert lib.LGBM_BoosterFree(bh) == 0
     assert lib.LGBM_DatasetFree(dsh) == 0

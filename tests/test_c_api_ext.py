@@ -560,7 +560,15 @@ def test_global_config_entries(lib):
     assert got.value == 200
     assert (np.diff(idx) > 0).all() and idx.max() < 1000
 
-    # log callback receives warning lines
+    # log callback receives warning lines (earlier tests may have trained
+    # with verbosity=-1, which sets the process-global level like the
+    # reference's Log::ResetLogLevel — raise it so warnings emit, and
+    # restore afterwards so later tests keep their expected quiet logs)
+    from lightgbm_tpu.utils import log as _log
+
+    prev_verbosity = _log._verbosity
+    set_verbosity = _log.set_verbosity
+    set_verbosity(1)
     seen = []
     CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p)
     cb = CB(lambda msg: seen.append(msg))
@@ -572,3 +580,4 @@ def test_global_config_entries(lib):
     _check(lib.LGBM_NetworkInitWithFunctions(2, 0, None, None), lib)
     assert any(b"XLA collectives" in m for m in seen)
     _check(lib.LGBM_NetworkFree(), lib)
+    set_verbosity(prev_verbosity)
