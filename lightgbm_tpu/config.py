@@ -104,6 +104,14 @@ _ALIASES: Dict[str, str] = {
     "rate_drop": "drop_rate",
     "topk": "top_k",
     "mc": "monotone_constraints",
+    "feature_contrib": "feature_contri",
+    "fc": "feature_contri",
+    "fp": "feature_contri",
+    "feature_penalty": "feature_contri",
+    "fs": "forcedsplits_filename",
+    "forced_splits_filename": "forcedsplits_filename",
+    "forced_splits_file": "forcedsplits_filename",
+    "forced_splits": "forcedsplits_filename",
     "monotone_constraint": "monotone_constraints",
     "monotonic_cst": "monotone_constraints",
     "monotone_constraining_method": "monotone_constraints_method",
