@@ -378,6 +378,37 @@ class AucMuMetric(Metric):
                 wsum += pw
         return [(self.name, total / max(wsum, 1e-30), True)]
 
+    def supports_device(self, num_class: int) -> bool:
+        # class pairs unroll in-trace: k*(k-1)/2 masked device AUCs
+        return 1 < num_class <= 12
+
+    def device_eval(self, pred, label, weight):
+        import jax.numpy as jnp
+
+        k = pred.shape[1]
+        y = label.astype(jnp.int32)
+        w = (jnp.ones(pred.shape[0], jnp.float32) if weight is None
+             else weight.astype(jnp.float32))
+        # host parity: pairs skip by LABEL presence (unweighted), computed
+        # once per class — zero-weight classes still count (their AUC
+        # degenerates to 1.0 in _auc_device exactly like the host's _auc)
+        class_present = [jnp.any(y == i) for i in range(k)]
+        total = jnp.float32(0.0)
+        wsum = jnp.float32(0.0)
+        for i in range(k):
+            for j in range(i + 1, k):
+                # non-pair rows get weight 0 — they sort in but contribute
+                # nothing, the fixed-shape analogue of the host's row subset
+                pm = ((y == i) | (y == j)).astype(jnp.float32) * w
+                lab = (y == i).astype(jnp.float32)
+                a = _auc_device(pred[:, i] - pred[:, j], lab, pm)
+                pw = (2.0 if self.weights is None
+                      else float(self.weights[i, j] + self.weights[j, i]))
+                valid = class_present[i] & class_present[j]
+                total = total + jnp.where(valid, pw * a, 0.0)
+                wsum = wsum + jnp.where(valid, pw, 0.0)
+        return total / jnp.maximum(wsum, 1e-30)
+
 
 class MultiLoglossMetric(Metric):
     name = "multi_logloss"

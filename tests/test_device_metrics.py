@@ -124,3 +124,49 @@ def test_rank_metrics_device_match_host():
     for (dn, dm, dv, dh), (hn, hv, hh) in zip(res, host):
         assert dm == hn and dh == hh
         assert dv == pytest.approx(hv, rel=2e-4, abs=2e-5)
+
+
+def test_auc_mu_device_matches_host():
+    rng = np.random.RandomState(6)
+    n, k = 3000, 4
+    X = rng.randn(n, 8)
+    y = np.argmax(X[:, :k] + 0.8 * rng.randn(n, k), axis=1).astype(float)
+    w = rng.rand(n) + 0.5
+    train = lgb.Dataset(X[:2400], label=y[:2400], weight=w[:2400])
+    valid = lgb.Dataset(X[2400:], label=y[2400:], weight=w[2400:],
+                        reference=train)
+    bst = lgb.train(
+        {"objective": "multiclass", "num_class": k, "verbosity": -1,
+         "metric": ["auc_mu", "multi_logloss"]},
+        train, 8, valid_sets=[valid], keep_training_booster=True)
+    g = bst._gbdt
+    assert all(m.supports_device(k) for m in g.metrics)
+    res = g.eval_at(1)
+    assert [r[1] for r in res] == ["auc_mu", "multi_logloss"]
+    ds = g.valid_sets[0]
+    pred = g._converted(g._eval_margin(g._valid_scores[0]))
+    label = np.asarray(ds.label)
+    weight = np.asarray(ds.weight)
+    for m, (_, mn, v, hib) in zip(g.metrics, res):
+        hn, hv, hh = m.eval(pred, label, weight)[0]
+        assert mn == hn and hib == hh
+        assert v == pytest.approx(hv, rel=3e-4, abs=3e-5)
+
+
+def test_auc_mu_device_matches_host_zero_weight_class():
+    # a class whose rows all carry weight 0 still counts its pairs (host
+    # semantics: skip is by label presence, not by weighted sums)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import AucMuMetric
+
+    rng = np.random.RandomState(8)
+    n, k = 600, 3
+    pred = rng.rand(n, k)
+    y = rng.randint(0, k, n).astype(np.float64)
+    w = rng.rand(n) + 0.1
+    w[y == 1] = 0.0  # class 1 fully zero-weighted
+    m = AucMuMetric(Config.from_dict({"num_class": k}))
+    host = m.eval(pred, y, w)[0][1]
+    dev = float(m.device_eval(jnp.asarray(pred, jnp.float32),
+                              jnp.asarray(y), jnp.asarray(w, jnp.float32)))
+    assert dev == pytest.approx(host, rel=3e-4, abs=3e-5)
