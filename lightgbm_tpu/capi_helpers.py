@@ -12,7 +12,7 @@ import ctypes
 
 import numpy as np
 
-from .basic import Booster, Dataset
+from .basic import Booster, Dataset, LightGBMError
 
 _PREDICT_NORMAL = 0
 _PREDICT_RAW_SCORE = 1
@@ -288,13 +288,17 @@ def booster_feature_importance_into(bst: Booster, importance_type: int,
     return len(imp)
 
 
-def predict_into(bst: Booster, data_addr: int, nrow: int, ncol: int,
-                 is_row_major: int, predict_type: int, out_addr: int) -> int:
+def predict_into(bst: Booster, data_addr: int, data_type: int, nrow: int,
+                 ncol: int, is_row_major: int, predict_type: int,
+                 start_iteration: int, num_iteration: int, parameter: str,
+                 out_addr: int) -> int:
     if is_row_major:
-        x = _wrap(data_addr, (nrow, ncol))
+        x = _wrap_typed(data_addr, (nrow, ncol), data_type)
     else:
-        x = _wrap(data_addr, (ncol, nrow)).T
-    return _predict_any_into(bst, x, predict_type, out_addr)
+        x = _wrap_typed(data_addr, (ncol, nrow), data_type).T
+    return _predict_any_into(bst, x, predict_type, out_addr,
+                             **_predict_kw(start_iteration, num_iteration,
+                                           parameter))
 
 
 # ---- CSR surface (reference: LGBM_DatasetCreateFromCSR /
@@ -326,10 +330,26 @@ def dataset_from_csr(indptr_addr: int, indptr_type: int, indices_addr: int,
 def predict_csr_into(bst: Booster, indptr_addr: int, indptr_type: int,
                      indices_addr: int, data_addr: int, data_type: int,
                      nindptr: int, nelem: int, num_col: int,
-                     predict_type: int, out_addr: int) -> int:
+                     predict_type: int, start_iteration: int,
+                     num_iteration: int, parameter: str,
+                     out_addr: int) -> int:
     x = _wrap_csr(indptr_addr, indptr_type, indices_addr, data_addr,
                   data_type, nindptr, nelem, num_col)
-    return _predict_any_into(bst, x, predict_type, out_addr)
+    return _predict_any_into(bst, x, predict_type, out_addr,
+                             **_predict_kw(start_iteration, num_iteration,
+                                           parameter))
+
+
+def _predict_kw(start_iteration: int = 0, num_iteration: int = -1,
+                parameter: str = "") -> dict:
+    """Predict kwargs from the reference C predict-entry triple
+    (start_iteration, num_iteration, parameter).  The explicit C arguments
+    win over any duplicates inside the parameter string (reference:
+    LGBM_BoosterPredictForMat passes them straight into the Config)."""
+    kw = _parse_params(parameter or "")
+    kw["start_iteration"] = int(start_iteration)
+    kw["num_iteration"] = int(num_iteration)
+    return kw
 
 
 def _predict_any_into(bst: Booster, x, predict_type: int, out_addr: int,
@@ -369,9 +389,14 @@ class _FastConfig:
 
 
 def predict_single_row_fast_init(bst: Booster, predict_type: int,
+                                 start_iteration: int, num_iteration: int,
                                  data_type: int, ncol: int,
                                  parameters: str = "") -> _FastConfig:
-    return _FastConfig(bst, predict_type, data_type, ncol, parameters)
+    cfg = _FastConfig(bst, predict_type, data_type, ncol, parameters)
+    # the explicit C arguments win over duplicates in the parameter string
+    cfg.start_iteration = int(start_iteration)
+    cfg.num_iteration = int(num_iteration)
+    return cfg
 
 
 def predict_single_row_fast(cfg: _FastConfig, data_addr: int,
@@ -386,9 +411,12 @@ def predict_single_row_fast(cfg: _FastConfig, data_addr: int,
 
 def predict_single_row_into(bst: Booster, data_addr: int, ncol: int,
                             data_type: int, predict_type: int,
-                            out_addr: int) -> int:
+                            start_iteration: int, num_iteration: int,
+                            parameter: str, out_addr: int) -> int:
     x = np.array(_wrap_typed(data_addr, (1, ncol), data_type), np.float64)
-    return _predict_any_into(bst, x, predict_type, out_addr)
+    return _predict_any_into(bst, x, predict_type, out_addr,
+                             **_predict_kw(start_iteration, num_iteration,
+                                           parameter))
 
 
 # ---- CSC surface (reference: LGBM_DatasetCreateFromCSC /
@@ -420,10 +448,14 @@ def dataset_from_csc(colptr_addr: int, colptr_type: int, indices_addr: int,
 def predict_csc_into(bst: Booster, colptr_addr: int, colptr_type: int,
                      indices_addr: int, data_addr: int, data_type: int,
                      ncolptr: int, nelem: int, num_row: int,
-                     predict_type: int, out_addr: int) -> int:
+                     predict_type: int, start_iteration: int,
+                     num_iteration: int, parameter: str,
+                     out_addr: int) -> int:
     x = _wrap_csc(colptr_addr, colptr_type, indices_addr, data_addr,
                   data_type, ncolptr, nelem, num_row)
-    return _predict_any_into(bst, x, predict_type, out_addr)
+    return _predict_any_into(bst, x, predict_type, out_addr,
+                             **_predict_kw(start_iteration, num_iteration,
+                                           parameter))
 
 
 # ---- multi-block matrices (reference: LGBM_DatasetCreateFromMats /
@@ -455,9 +487,13 @@ def dataset_from_mats(nmat: int, data_ptrs_addr: int, dtype_code: int,
 
 def predict_mats_into(bst: Booster, nmat: int, data_ptrs_addr: int,
                       dtype_code: int, nrow_addr: int, ncol: int,
-                      predict_type: int, out_addr: int) -> int:
+                      predict_type: int, start_iteration: int,
+                      num_iteration: int, parameter: str,
+                      out_addr: int) -> int:
     x = _wrap_mats(nmat, data_ptrs_addr, dtype_code, nrow_addr, ncol, 1)
-    return _predict_any_into(bst, x, predict_type, out_addr)
+    return _predict_any_into(bst, x, predict_type, out_addr,
+                             **_predict_kw(start_iteration, num_iteration,
+                                           parameter))
 
 
 # ---- sampled-column schema construction (reference:
@@ -706,39 +742,80 @@ def dataset_set_wait_for_manual_finish(ds: "StreamingDataset",
 #      LGBM_DatasetSerializeReferenceToBinary /
 #      LGBM_DatasetCreateFromSerializedReference / LGBM_ByteBuffer*) ----
 
+_SCHEMA_MAGIC = b"LGBMTPU-SCHEMA\x01"  # magic + format version byte
+
+
 def dataset_serialize_reference(ds) -> bytes:
     """Schema-only serialization: bin mappers + names, enough for a remote
-    worker to construct a bin-aligned streaming dataset."""
-    import pickle
+    worker to construct a bin-aligned streaming dataset.
+
+    The buffer crosses process/machine boundaries (SynapseML-style hosts
+    forward it over the network), so it is inert data — a magic/version
+    header, a JSON descriptor and np.savez numeric arrays — never pickled
+    code (the reference's counterpart is a plain binary schema dump)."""
+    import io
+    import json
 
     ds = _as_dataset(ds)
     ds.construct()
-    payload = {
-        "mappers": ds.binner.mappers,
+    mappers = ds.binner.mappers
+    arrays = {
+        "missing_type": np.array([m.missing_type for m in mappers], np.int32),
+        "is_categorical": np.array([m.is_categorical for m in mappers],
+                                   np.bool_),
+        "min_value": np.array([m.min_value for m in mappers], np.float64),
+        "max_value": np.array([m.max_value for m in mappers], np.float64),
+    }
+    for i, m in enumerate(mappers):
+        ub = m.upper_bounds if m.upper_bounds is not None else np.zeros(0)
+        arrays[f"ub{i}"] = np.asarray(ub, np.float64)
+        if m.categories is not None:
+            arrays[f"cat{i}"] = np.asarray(m.categories, np.float64)
+    header = json.dumps({
+        "n_features": len(mappers),
         "feature_names": list(ds.get_feature_name()),
         "params": {k: v for k, v in (ds.params or {}).items()
                    if isinstance(v, (int, float, str, bool))},
-    }
-    return pickle.dumps(payload)
+    }).encode()
+    buf = io.BytesIO()
+    np.savez(buf, header=np.frombuffer(header, np.uint8), **arrays)
+    return _SCHEMA_MAGIC + buf.getvalue()
 
 
 def dataset_from_serialized_reference(buf_addr: int, buf_size: int,
                                       num_row: int,
                                       parameters: str) -> "StreamingDataset":
-    import pickle
+    import io
+    import json
 
-    from .binning import DatasetBinner
+    from .binning import BinMapper, DatasetBinner
 
     raw = bytes((ctypes.c_uint8 * buf_size).from_address(buf_addr))
-    payload = pickle.loads(raw)
+    if not raw.startswith(_SCHEMA_MAGIC):
+        raise ValueError(
+            "serialized reference: bad magic or unsupported schema version")
+    with np.load(io.BytesIO(raw[len(_SCHEMA_MAGIC):]),
+                 allow_pickle=False) as data:
+        header = json.loads(bytes(data["header"]).decode())
+        mappers = []
+        for i in range(int(header["n_features"])):
+            mappers.append(BinMapper(
+                upper_bounds=data[f"ub{i}"],
+                missing_type=int(data["missing_type"][i]),
+                is_categorical=bool(data["is_categorical"][i]),
+                categories=(data[f"cat{i}"] if f"cat{i}" in data.files
+                            else None),
+                min_value=float(data["min_value"][i]),
+                max_value=float(data["max_value"][i]),
+            ))
     schema = Dataset.__new__(Dataset)
     # minimal constructed schema carrier: mappers + names (StreamingDataset
     # only reads binner/feature metadata from its reference)
-    n_feat = len(payload["mappers"])
+    n_feat = len(mappers)
     schema.__dict__.update({
-        "binner": DatasetBinner(mappers=list(payload["mappers"])),
-        "feature_names": payload["feature_names"],
-        "params": dict(payload["params"], **_parse_params(parameters)),
+        "binner": DatasetBinner(mappers=mappers),
+        "feature_names": header["feature_names"],
+        "params": dict(header["params"], **_parse_params(parameters)),
         "label": None, "weight": None, "group": None, "init_score": None,
         "position": None, "data": None, "efb": None, "_efb_device": None,
         "_constructed": True, "_num_feature": n_feat,
@@ -771,21 +848,36 @@ def booster_refit_leaf_preds(bst: Booster, leaf_addr: int, nrow: int,
     ds = bst._train_set
     if ds is None:
         raise ValueError("Refit requires the training dataset to be attached")
-    label = np.asarray(_as_dataset(ds).label, np.float64)
+    dsc = _as_dataset(ds)
+    label = np.asarray(dsc.label, np.float64)
     cfg = gbdt.cfg
     obj = create_objective(cfg)
     k = gbdt.num_tree_per_iteration
     decay = float(cfg.refit_decay_rate)
     import jax.numpy as _jnp
 
+    # start the running score where training did: boost_from_average init
+    # scores plus any dataset init_score (reference: RefitTree recomputes
+    # gradients at the model's current score, not at zero)
     score = np.zeros((nrow, k), np.float64) if k > 1 else np.zeros(nrow, np.float64)
+    if gbdt.init_scores and any(s != 0.0 for s in gbdt.init_scores):
+        if k > 1:
+            score += np.asarray(gbdt.init_scores, np.float64)[None, :]
+        else:
+            score += float(gbdt.init_scores[0])
+    if dsc.init_score is not None:
+        score += np.asarray(dsc.init_score, np.float64).reshape(score.shape)
+    # training weights flow through the objective, so the per-leaf g/h sums
+    # below aggregate weighted gradients exactly as training did
+    w_j = (None if dsc.weight is None
+           else _jnp.asarray(np.asarray(dsc.weight), _jnp.float32))
     for t_i, tree in enumerate(gbdt.models):
         if t_i >= ncol:
             break
         c = t_i % k
         if c == 0:  # gradients refresh once per boosting iteration
             g, h = obj.get_gradients(_jnp.asarray(score, _jnp.float32),
-                                     _jnp.asarray(label, _jnp.float32), None)
+                                     _jnp.asarray(label, _jnp.float32), w_j)
             g, h = np.asarray(g, np.float64), np.asarray(h, np.float64)
             if g.ndim == 1 and k > 1:
                 g, h = g.reshape(k, nrow).T, h.reshape(k, nrow).T
@@ -939,16 +1031,23 @@ def predict_csr_single_row_into(bst: Booster, indptr_addr: int,
                                 indptr_type: int, indices_addr: int,
                                 data_addr: int, data_type: int, nindptr: int,
                                 nelem: int, num_col: int, predict_type: int,
-                                out_addr: int) -> int:
+                                start_iteration: int, num_iteration: int,
+                                parameter: str, out_addr: int) -> int:
     x = _wrap_csr(indptr_addr, indptr_type, indices_addr, data_addr,
                   data_type, nindptr, nelem, num_col)
-    return _predict_any_into(bst, x, predict_type, out_addr)
+    return _predict_any_into(bst, x, predict_type, out_addr,
+                             **_predict_kw(start_iteration, num_iteration,
+                                           parameter))
 
 
 def predict_csr_single_row_fast_init(bst: Booster, predict_type: int,
+                                     start_iteration: int, num_iteration: int,
                                      data_type: int, num_col: int,
                                      parameters: str = "") -> _FastConfig:
-    return _FastConfig(bst, predict_type, data_type, num_col, parameters)
+    cfg = _FastConfig(bst, predict_type, data_type, num_col, parameters)
+    cfg.start_iteration = int(start_iteration)
+    cfg.num_iteration = int(num_iteration)
+    return cfg
 
 
 def predict_csr_single_row_fast(cfg: _FastConfig, indptr_addr: int,
@@ -1042,9 +1141,12 @@ def dataset_set_field_from_arrow(ds, field_name: str, n_chunks: int,
 
 def predict_arrow_into(bst: Booster, n_chunks: int, chunks_addr: int,
                        schema_addr: int, predict_type: int,
-                       out_addr: int) -> int:
+                       start_iteration: int, num_iteration: int,
+                       parameter: str, out_addr: int) -> int:
     table = _import_arrow_table(n_chunks, chunks_addr, schema_addr)
-    return _predict_any_into(bst, table, predict_type, out_addr)
+    return _predict_any_into(bst, table, predict_type, out_addr,
+                             **_predict_kw(start_iteration, num_iteration,
+                                           parameter))
 
 
 # ---- network surface (reference: LGBM_NetworkInit / Free /
@@ -1078,16 +1180,32 @@ def network_free() -> bool:
     return True
 
 
-def network_init_with_functions(num_machines: int, rank: int) -> bool:
+def network_init_with_functions(num_machines: int, rank: int,
+                                has_reduce_scatter: int = 0,
+                                has_allgather: int = 0) -> bool:
     """reference: LGBM_NetworkInitWithFunctions lets the host (SynapseML)
     supply reduce-scatter/allgather function pointers.  XLA owns the
     collective transport here, so the pointers are not callable into the
-    compiled path; we accept the topology (ranks still drive pre_partition
-    semantics) and warn.  docs/BINDINGS.md records the deviation."""
+    compiled path.  A host that relies on its custom transport (e.g. a
+    firewalled environment where only its channel works) would silently get
+    XLA collectives instead — so a multi-machine call with real function
+    pointers is an ERROR unless the host opts in by setting
+    LIGHTGBM_TPU_ACCEPT_XLA_TRANSPORT=1.  Topology (ranks) still drives
+    pre_partition semantics.  docs/BINDINGS.md records the deviation."""
+    import os
+
     from .utils.log import log_warning
 
     _NETWORK_PARAMS.clear()
     if num_machines > 1:
+        if (has_reduce_scatter or has_allgather) and os.environ.get(
+                "LIGHTGBM_TPU_ACCEPT_XLA_TRANSPORT") != "1":
+            raise LightGBMError(
+                "LGBM_NetworkInitWithFunctions: the supplied collective "
+                "function pointers cannot be invoked from the XLA-compiled "
+                "path; collectives would run over XLA's transport instead. "
+                "Set LIGHTGBM_TPU_ACCEPT_XLA_TRANSPORT=1 to accept that "
+                "substitution (docs/BINDINGS.md).")
         _NETWORK_PARAMS.update({"num_machines": int(num_machines),
                                 "rank": int(rank)})
         log_warning(

@@ -540,16 +540,18 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               int indptr_type, const int32_t* indices,
                               const void* data, int data_type,
                               int64_t nindptr, int64_t nelem, int64_t num_col,
-                              int predict_type, int64_t* out_len,
-                              double* out_result) {
+                              int predict_type, int start_iteration,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
   GilGuard gil;
   PyObject* r = call_helper(
-      "predict_csr_into", "(OKiKKiLLLiK)", static_cast<PyObject*>(handle),
+      "predict_csr_into", "(OKiKKiLLLiiisK)", static_cast<PyObject*>(handle),
       reinterpret_cast<unsigned long long>(indptr), indptr_type,
       reinterpret_cast<unsigned long long>(indices),
       reinterpret_cast<unsigned long long>(data), data_type,
       static_cast<long long>(nindptr), static_cast<long long>(nelem),
-      static_cast<long long>(num_col), predict_type,
+      static_cast<long long>(num_col), predict_type, start_iteration,
+      num_iteration, parameter == nullptr ? "" : parameter,
       reinterpret_cast<unsigned long long>(out_result));
   if (r == nullptr) return -1;
   *out_len = PyLong_AsLongLong(r);
@@ -560,13 +562,16 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
 int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle, const void* data,
                                        int data_type, int32_t ncol,
                                        int is_row_major, int predict_type,
+                                       int start_iteration, int num_iteration,
+                                       const char* parameter,
                                        int64_t* out_len, double* out_result) {
   (void)is_row_major;  /* one row: both layouts identical */
   GilGuard gil;
   PyObject* r = call_helper(
-      "predict_single_row_into", "(OKiiiK)", static_cast<PyObject*>(handle),
+      "predict_single_row_into", "(OKiiiiisK)", static_cast<PyObject*>(handle),
       reinterpret_cast<unsigned long long>(data), static_cast<int>(ncol),
-      data_type, predict_type,
+      data_type, predict_type, start_iteration, num_iteration,
+      parameter == nullptr ? "" : parameter,
       reinterpret_cast<unsigned long long>(out_result));
   if (r == nullptr) return -1;
   *out_len = PyLong_AsLongLong(r);
@@ -576,14 +581,17 @@ int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle, const void* data,
 
 int LGBM_BoosterPredictForMatSingleRowFastInit(BoosterHandle handle,
                                                int predict_type,
+                                               int start_iteration,
+                                               int num_iteration,
                                                int data_type, int32_t ncol,
-                                               const char* parameters,
+                                               const char* parameter,
                                                FastConfigHandle* out) {
   GilGuard gil;
   PyObject* r = call_helper(
-      "predict_single_row_fast_init", "(Oiiis)",
-      static_cast<PyObject*>(handle), predict_type, data_type,
-      static_cast<int>(ncol), parameters == nullptr ? "" : parameters);
+      "predict_single_row_fast_init", "(Oiiiiis)",
+      static_cast<PyObject*>(handle), predict_type, start_iteration,
+      num_iteration, data_type,
+      static_cast<int>(ncol), parameter == nullptr ? "" : parameter);
   if (r == nullptr) return -1;
   *out = static_cast<FastConfigHandle>(r);
   return 0;
@@ -611,27 +619,21 @@ int LGBM_FastConfigFree(FastConfigHandle fast_config) {
   return 0;
 }
 
-int LGBM_BoosterPredictForMat(BoosterHandle handle, const double* data,
-                              int32_t nrow, int32_t ncol,
-                              int32_t is_row_major, int32_t predict_type,
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter,
                               int64_t* out_len, double* out_result) {
   GilGuard gil;
-  PyObject* mod = helpers();
-  if (mod == nullptr) {
-    set_error_from_python();
-    return -1;
-  }
-  PyObject* r = PyObject_CallMethod(
-      mod, "predict_into", "OKiiiiK", static_cast<PyObject*>(handle),
-      reinterpret_cast<unsigned long long>(data), static_cast<int>(nrow),
-      static_cast<int>(ncol), static_cast<int>(is_row_major),
-      static_cast<int>(predict_type),
+  PyObject* r = call_helper(
+      "predict_into", "(OKiiiiiiisK)", static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<int>(nrow), static_cast<int>(ncol),
+      static_cast<int>(is_row_major), static_cast<int>(predict_type),
+      start_iteration, num_iteration, parameter == nullptr ? "" : parameter,
       reinterpret_cast<unsigned long long>(out_result));
-  Py_DECREF(mod);
-  if (r == nullptr) {
-    set_error_from_python();
-    return -1;
-  }
+  if (r == nullptr) return -1;
   *out_len = PyLong_AsLongLong(r);
   Py_DECREF(r);
   return 0;
@@ -664,16 +666,18 @@ int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
                               int col_ptr_type, const int32_t* indices,
                               const void* data, int data_type,
                               int64_t ncol_ptr, int64_t nelem, int64_t num_row,
-                              int predict_type, int64_t* out_len,
-                              double* out_result) {
+                              int predict_type, int start_iteration,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
   GilGuard gil;
   PyObject* r = call_helper(
-      "predict_csc_into", "(OKiKKiLLLiK)", static_cast<PyObject*>(handle),
+      "predict_csc_into", "(OKiKKiLLLiiisK)", static_cast<PyObject*>(handle),
       reinterpret_cast<unsigned long long>(col_ptr), col_ptr_type,
       reinterpret_cast<unsigned long long>(indices),
       reinterpret_cast<unsigned long long>(data), data_type,
       static_cast<long long>(ncol_ptr), static_cast<long long>(nelem),
-      static_cast<long long>(num_row), predict_type,
+      static_cast<long long>(num_row), predict_type, start_iteration,
+      num_iteration, parameter == nullptr ? "" : parameter,
       reinterpret_cast<unsigned long long>(out_result));
   if (r == nullptr) return -1;
   *out_len = PyLong_AsLongLong(r);
@@ -704,13 +708,16 @@ int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data, int data_type,
 int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
                                int data_type, int32_t nmat, int32_t* nrow,
                                int32_t ncol, int predict_type,
+                               int start_iteration, int num_iteration,
+                               const char* parameter,
                                int64_t* out_len, double* out_result) {
   GilGuard gil;
   PyObject* r = call_helper(
-      "predict_mats_into", "(OiKiKiiK)", static_cast<PyObject*>(handle),
+      "predict_mats_into", "(OiKiKiiiisK)", static_cast<PyObject*>(handle),
       static_cast<int>(nmat), reinterpret_cast<unsigned long long>(data),
       data_type, reinterpret_cast<unsigned long long>(nrow),
-      static_cast<int>(ncol), predict_type,
+      static_cast<int>(ncol), predict_type, start_iteration, num_iteration,
+      parameter == nullptr ? "" : parameter,
       reinterpret_cast<unsigned long long>(out_result));
   if (r == nullptr) return -1;
   *out_len = PyLong_AsLongLong(r);
@@ -1217,16 +1224,19 @@ int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
                                        const void* data, int data_type,
                                        int64_t nindptr, int64_t nelem,
                                        int64_t num_col, int predict_type,
+                                       int start_iteration, int num_iteration,
+                                       const char* parameter,
                                        int64_t* out_len, double* out_result) {
   GilGuard gil;
   PyObject* r = call_helper(
-      "predict_csr_single_row_into", "(OKiKKiLLLiK)",
+      "predict_csr_single_row_into", "(OKiKKiLLLiiisK)",
       static_cast<PyObject*>(handle),
       reinterpret_cast<unsigned long long>(indptr), indptr_type,
       reinterpret_cast<unsigned long long>(indices),
       reinterpret_cast<unsigned long long>(data), data_type,
       static_cast<long long>(nindptr), static_cast<long long>(nelem),
-      static_cast<long long>(num_col), predict_type,
+      static_cast<long long>(num_col), predict_type, start_iteration,
+      num_iteration, parameter == nullptr ? "" : parameter,
       reinterpret_cast<unsigned long long>(out_result));
   if (r == nullptr) return -1;
   *out_len = PyLong_AsLongLong(r);
@@ -1235,15 +1245,19 @@ int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
 }
 
 int LGBM_BoosterPredictForCSRSingleRowFastInit(BoosterHandle handle,
-                                               int predict_type, int data_type,
+                                               int predict_type,
+                                               int start_iteration,
+                                               int num_iteration,
+                                               int data_type,
                                                int64_t num_col,
-                                               const char* parameters,
+                                               const char* parameter,
                                                FastConfigHandle* out) {
   GilGuard gil;
   PyObject* r = call_helper(
-      "predict_csr_single_row_fast_init", "(Oiiis)",
-      static_cast<PyObject*>(handle), predict_type, data_type,
-      static_cast<int>(num_col), parameters == nullptr ? "" : parameters);
+      "predict_csr_single_row_fast_init", "(Oiiiiis)",
+      static_cast<PyObject*>(handle), predict_type, start_iteration,
+      num_iteration, data_type,
+      static_cast<int>(num_col), parameter == nullptr ? "" : parameter);
   if (r == nullptr) return -1;
   *out = static_cast<FastConfigHandle>(r);
   return 0;
@@ -1310,14 +1324,16 @@ int LGBM_DatasetSetFieldFromArrow(DatasetHandle handle, const char* field_name,
 int LGBM_BoosterPredictForArrow(BoosterHandle handle, int64_t n_chunks,
                                 const struct ArrowArray* chunks,
                                 const struct ArrowSchema* schema,
-                                int predict_type, int64_t* out_len,
-                                double* out_result) {
+                                int predict_type, int start_iteration,
+                                int num_iteration, const char* parameter,
+                                int64_t* out_len, double* out_result) {
   GilGuard gil;
   PyObject* r = call_helper(
-      "predict_arrow_into", "(OLKKiK)", static_cast<PyObject*>(handle),
+      "predict_arrow_into", "(OLKKiiisK)", static_cast<PyObject*>(handle),
       static_cast<long long>(n_chunks),
       reinterpret_cast<unsigned long long>(chunks),
       reinterpret_cast<unsigned long long>(schema), predict_type,
+      start_iteration, num_iteration, parameter == nullptr ? "" : parameter,
       reinterpret_cast<unsigned long long>(out_result));
   if (r == nullptr) return -1;
   *out_len = PyLong_AsLongLong(r);
@@ -1349,11 +1365,14 @@ int LGBM_NetworkFree(void) {
 int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
                                   void* reduce_scatter_ext_fun,
                                   void* allgather_ext_fun) {
-  (void)reduce_scatter_ext_fun;
-  (void)allgather_ext_fun; /* XLA owns the transport; see header note */
+  /* XLA owns the transport; the helper errors when the host supplied real
+   * collective fns for a multi-machine run without the explicit opt-in
+   * (see header note). */
   GilGuard gil;
-  PyObject* r = call_helper("network_init_with_functions", "(ii)",
-                            num_machines, rank);
+  PyObject* r = call_helper("network_init_with_functions", "(iiii)",
+                            num_machines, rank,
+                            reduce_scatter_ext_fun != nullptr ? 1 : 0,
+                            allgather_ext_fun != nullptr ? 1 : 0);
   if (r == nullptr) return -1;
   Py_DECREF(r);
   return 0;

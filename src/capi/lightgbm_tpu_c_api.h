@@ -182,6 +182,9 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle,
                               int64_t nelem,
                               int64_t num_col,
                               int predict_type,
+                              int start_iteration,
+                              int num_iteration,
+                              const char* parameter,
                               int64_t* out_len,
                               double* out_result);
 
@@ -196,14 +199,19 @@ int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
                                        int32_t ncol,
                                        int is_row_major,
                                        int predict_type,
+                                       int start_iteration,
+                                       int num_iteration,
+                                       const char* parameter,
                                        int64_t* out_len,
                                        double* out_result);
 
 int LGBM_BoosterPredictForMatSingleRowFastInit(BoosterHandle handle,
                                                int predict_type,
+                                               int start_iteration,
+                                               int num_iteration,
                                                int data_type,
                                                int32_t ncol,
-                                               const char* parameters,
+                                               const char* parameter,
                                                FastConfigHandle* out);
 
 int LGBM_BoosterPredictForMatSingleRowFast(FastConfigHandle fast_config,
@@ -213,15 +221,21 @@ int LGBM_BoosterPredictForMatSingleRowFast(FastConfigHandle fast_config,
 
 int LGBM_FastConfigFree(FastConfigHandle fast_config);
 
-/* data: row-major (nrow x ncol) float64 matrix. out_result must hold
- * nrow (normal/raw), nrow*num_class (multiclass), or nrow*num_trees
- * (leaf index) doubles; *out_len receives the count written. */
+/* data: (nrow x ncol) matrix of `data_type` (C_API_DTYPE code).
+ * out_result must hold nrow (normal/raw), nrow*num_class (multiclass), or
+ * nrow*num_trees (leaf index) doubles; *out_len receives the count
+ * written.  start_iteration/num_iteration window the trees used (-1 =
+ * all); parameter carries "k=v" predict params. */
 int LGBM_BoosterPredictForMat(BoosterHandle handle,
-                              const double* data,
+                              const void* data,
+                              int data_type,
                               int32_t nrow,
                               int32_t ncol,
-                              int32_t is_row_major,
-                              int32_t predict_type,
+                              int is_row_major,
+                              int predict_type,
+                              int start_iteration,
+                              int num_iteration,
+                              const char* parameter,
                               int64_t* out_len,
                               double* out_result);
 
@@ -250,6 +264,9 @@ int LGBM_BoosterPredictForCSC(BoosterHandle handle,
                               int64_t nelem,
                               int64_t num_row,
                               int predict_type,
+                              int start_iteration,
+                              int num_iteration,
+                              const char* parameter,
                               int64_t* out_len,
                               double* out_result);
 
@@ -272,6 +289,9 @@ int LGBM_BoosterPredictForMats(BoosterHandle handle,
                                int32_t* nrow,
                                int32_t ncol,
                                int predict_type,
+                               int start_iteration,
+                               int num_iteration,
+                               const char* parameter,
                                int64_t* out_len,
                                double* out_result);
 
@@ -497,14 +517,19 @@ int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
                                        int64_t nelem,
                                        int64_t num_col,
                                        int predict_type,
+                                       int start_iteration,
+                                       int num_iteration,
+                                       const char* parameter,
                                        int64_t* out_len,
                                        double* out_result);
 
 int LGBM_BoosterPredictForCSRSingleRowFastInit(BoosterHandle handle,
                                                int predict_type,
+                                               int start_iteration,
+                                               int num_iteration,
                                                int data_type,
                                                int64_t num_col,
-                                               const char* parameters,
+                                               const char* parameter,
                                                FastConfigHandle* out);
 
 int LGBM_BoosterPredictForCSRSingleRowFast(FastConfigHandle fast_config,
@@ -544,6 +569,9 @@ int LGBM_BoosterPredictForArrow(BoosterHandle handle,
                                 const struct ArrowArray* chunks,
                                 const struct ArrowSchema* schema,
                                 int predict_type,
+                                int start_iteration,
+                                int num_iteration,
+                                const char* parameter,
                                 int64_t* out_len,
                                 double* out_result);
 
@@ -558,7 +586,10 @@ int LGBM_NetworkInit(const char* machines,
 int LGBM_NetworkFree(void);
 
 /* External collective fn pointers are not callable from the XLA-compiled
- * path; topology is honored, transport is XLA's (docs/BINDINGS.md). */
+ * path.  With num_machines > 1 and non-null pointers this entry FAILS
+ * unless the host opts into the XLA-transport substitution by setting
+ * LIGHTGBM_TPU_ACCEPT_XLA_TRANSPORT=1 in the environment; topology is
+ * then honored, transport is XLA's (docs/BINDINGS.md). */
 int LGBM_NetworkInitWithFunctions(int num_machines,
                                   int rank,
                                   void* reduce_scatter_ext_fun,

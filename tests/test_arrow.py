@@ -145,15 +145,15 @@ def test_c_api_arrow_roundtrip():
     batch2._export_to_c(ctypes.addressof(a2), ctypes.addressof(s2))
     rc = lib.LGBM_BoosterPredictForArrow(
         bh, ctypes.c_int64(1), ctypes.byref(a2), ctypes.byref(s2), 0,
-        ctypes.byref(n),
+        0, -1, b"", ctypes.byref(n),
         pa_out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     assert rc == 0, lib.LGBM_GetLastError()
 
     mat_out = np.zeros(len(y))
     Xc = np.ascontiguousarray(X, np.float64)
     rc = lib.LGBM_BoosterPredictForMat(
-        bh, Xc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), X.shape[0],
-        X.shape[1], 1, 0, ctypes.byref(n),
+        bh, Xc.ctypes.data_as(ctypes.c_void_p), 1, X.shape[0],
+        X.shape[1], 1, 0, 0, -1, b"", ctypes.byref(n),
         mat_out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     assert rc == 0, lib.LGBM_GetLastError()
     np.testing.assert_allclose(pa_out, mat_out, rtol=1e-12)

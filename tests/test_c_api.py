@@ -64,9 +64,10 @@ def test_c_api_roundtrip(tmp_path):
     out_len = ctypes.c_int64()
     rc = lib.LGBM_BoosterPredictForMat(
         handle,
-        X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
         ctypes.c_int32(X.shape[0]), ctypes.c_int32(X.shape[1]),
-        ctypes.c_int32(1), ctypes.c_int32(0),
+        ctypes.c_int(1), ctypes.c_int(0),
+        ctypes.c_int(0), ctypes.c_int(-1), b"",
         ctypes.byref(out_len),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
     )
@@ -344,7 +345,7 @@ def test_c_api_csr_and_single_row_fast(tmp_path):
         indices.ctypes.data_as(ctypes.c_void_p),
         data.ctypes.data_as(ctypes.c_void_p), 1,
         ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
-        ctypes.c_int64(6), 0, ctypes.byref(out_len),
+        ctypes.c_int64(6), 0, 0, -1, b"", ctypes.byref(out_len),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     assert rc == 0, lib.LGBM_GetLastError()
     assert out_len.value == 600
@@ -354,14 +355,14 @@ def test_c_api_csr_and_single_row_fast(tmp_path):
     one = np.zeros(1, np.float64)
     row = np.ascontiguousarray(Xd[17], np.float64)
     rc = lib.LGBM_BoosterPredictForMatSingleRow(
-        bh, row.ctypes.data_as(ctypes.c_void_p), 1, 6, 1, 0,
+        bh, row.ctypes.data_as(ctypes.c_void_p), 1, 6, 1, 0, 0, -1, b"",
         ctypes.byref(out_len), one.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     assert rc == 0, lib.LGBM_GetLastError()
     assert one[0] == pytest.approx(expect[17], rel=1e-6)
 
     fch = ctypes.c_void_p()
     rc = lib.LGBM_BoosterPredictForMatSingleRowFastInit(
-        bh, 0, 1, 6, b"", ctypes.byref(fch))
+        bh, 0, 0, -1, 1, 6, b"", ctypes.byref(fch))
     assert rc == 0, lib.LGBM_GetLastError()
     for i in (3, 99, 400):
         row = np.ascontiguousarray(Xd[i], np.float64)

@@ -52,8 +52,8 @@ def test_get_last_error_is_thread_local_and_predict_during_update():
         n_out = ctypes.c_int64()
         for _ in range(15):
             rc = lib.LGBM_BoosterPredictForMat(
-                bh, Xc.ctypes.data_as(ctypes.c_void_p), 4000, 6, 1, 0,
-                ctypes.byref(n_out),
+                bh, Xc.ctypes.data_as(ctypes.c_void_p), 1, 4000, 6, 1, 0,
+                0, -1, b"", ctypes.byref(n_out),
                 out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
             if rc != 0:
                 errors.append(("predict", lib.LGBM_GetLastError()))

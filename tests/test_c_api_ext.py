@@ -89,13 +89,13 @@ def test_csc_dataset_and_predict(lib, data):
         csc.indices.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
         csc.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
         ctypes.c_int64(len(csc.indptr)), ctypes.c_int64(csc.nnz),
-        ctypes.c_int64(X.shape[0]), 0, ctypes.byref(n_out),
+        ctypes.c_int64(X.shape[0]), 0, 0, -1, b"", ctypes.byref(n_out),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
     ref = np.zeros(X.shape[0])
     Xc = np.ascontiguousarray(X, np.float64)
     _check(lib.LGBM_BoosterPredictForMat(
-        bh, Xc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), X.shape[0],
-        X.shape[1], 1, 0, ctypes.byref(n_out),
+        bh, Xc.ctypes.data_as(ctypes.c_void_p), 1, X.shape[0],
+        X.shape[1], 1, 0, 0, -1, b"", ctypes.byref(n_out),
         ref.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
     np.testing.assert_allclose(out, ref, rtol=1e-12)
     lib.LGBM_BoosterFree(bh)
@@ -134,7 +134,8 @@ def test_mats_dataset_and_predict(lib, data):
     out = np.zeros(X.shape[0])
     n_out = ctypes.c_int64()
     _check(lib.LGBM_BoosterPredictForMats(
-        bh, ptrs, 1, 2, nrows, X.shape[1], 0, ctypes.byref(n_out),
+        bh, ptrs, 1, 2, nrows, X.shape[1], 0, 0, -1, b"",
+        ctypes.byref(n_out),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
     assert n_out.value == X.shape[0]
     assert np.isfinite(out).all()
@@ -445,16 +446,17 @@ def test_refit_and_get_predict(lib, data):
         scores.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
     assert np.isfinite(scores).all() and scores.std() > 0
 
-    # refit with the model's own leaf assignments shrinks leaf values toward
-    # the training optimum but keeps them finite/valid
+    # refit with the model's own leaf assignments on the SAME data is
+    # (approximately) a fixed point: gradients are recomputed at the
+    # model's init score exactly as training did (advisor r3 fix)
     pred_before = _predict_dense(lib, bh, X)
     nt = ctypes.c_int()
     _check(lib.LGBM_BoosterNumberOfTotalModel(bh, ctypes.byref(nt)), lib)
     leaf = np.zeros((len(y), nt.value), np.int32)
     out = np.zeros(len(y) * nt.value)
     _check(lib.LGBM_BoosterPredictForMat(
-        bh, np.ascontiguousarray(X).ctypes.data_as(
-            ctypes.POINTER(ctypes.c_double)), X.shape[0], X.shape[1], 1, 2,
+        bh, np.ascontiguousarray(X).ctypes.data_as(ctypes.c_void_p), 1,
+        X.shape[0], X.shape[1], 1, 2, 0, -1, b"",
         ctypes.byref(n64), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))),
         lib)
     leaf[:] = out.reshape(len(y), nt.value).astype(np.int32)
@@ -463,7 +465,17 @@ def test_refit_and_get_predict(lib, data):
         nt.value), lib)
     pred_after = _predict_dense(lib, bh, X)
     assert np.isfinite(pred_after).all()
-    assert not np.allclose(pred_before, pred_after)
+    np.testing.assert_allclose(pred_after, pred_before, rtol=1e-3, atol=1e-5)
+
+    # flipped labels -> different gradients -> refit must move predictions
+    yf = (1.0 - y).astype(np.float32)
+    _check(lib.LGBM_DatasetSetField(
+        h, b"label", yf.ctypes.data_as(ctypes.c_void_p), len(yf), 0), lib)
+    _check(lib.LGBM_BoosterRefit(
+        bh, leaf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(y),
+        nt.value), lib)
+    pred_flipped = _predict_dense(lib, bh, X)
+    assert not np.allclose(pred_flipped, pred_after)
     lib.LGBM_BoosterFree(bh)
     lib.LGBM_DatasetFree(h)
 
@@ -472,8 +484,8 @@ def _predict_dense(lib, bh, X):
     out = np.zeros(X.shape[0])
     n = ctypes.c_int64()
     _check(lib.LGBM_BoosterPredictForMat(
-        bh, np.ascontiguousarray(X).ctypes.data_as(
-            ctypes.POINTER(ctypes.c_double)), X.shape[0], X.shape[1], 1, 0,
+        bh, np.ascontiguousarray(X).ctypes.data_as(ctypes.c_void_p), 1,
+        X.shape[0], X.shape[1], 1, 0, 0, -1, b"",
         ctypes.byref(n), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))),
         lib)
     return out
@@ -509,13 +521,14 @@ def test_csr_single_row_and_fast(lib, data):
         row.indices.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
         row.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p), 1,
         ctypes.c_int64(len(row.indptr)), ctypes.c_int64(row.nnz),
-        ctypes.c_int64(X.shape[1]), 0, ctypes.byref(n),
+        ctypes.c_int64(X.shape[1]), 0, 0, -1, b"", ctypes.byref(n),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
     np.testing.assert_allclose(out, expect, rtol=1e-12)
 
     fc = ctypes.c_void_p()
     _check(lib.LGBM_BoosterPredictForCSRSingleRowFastInit(
-        bh, 0, 1, ctypes.c_int64(X.shape[1]), b"", ctypes.byref(fc)), lib)
+        bh, 0, 0, -1, 1, ctypes.c_int64(X.shape[1]), b"",
+        ctypes.byref(fc)), lib)
     out2 = np.zeros(1)
     _check(lib.LGBM_BoosterPredictForCSRSingleRowFast(
         fc, row.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p), 2,
@@ -580,4 +593,10 @@ def test_global_config_entries(lib):
     _check(lib.LGBM_NetworkInitWithFunctions(2, 0, None, None), lib)
     assert any(b"XLA collectives" in m for m in seen)
     _check(lib.LGBM_NetworkFree(), lib)
+    # real collective fn pointers for a multi-machine run must FAIL without
+    # the explicit opt-in (the host's transport cannot be silently swapped
+    # for XLA's)
+    fake_fn = ctypes.c_void_p(1)
+    assert lib.LGBM_NetworkInitWithFunctions(2, 0, fake_fn, fake_fn) == -1
+    assert b"ACCEPT_XLA_TRANSPORT" in lib.LGBM_GetLastError()
     set_verbosity(prev_verbosity)

@@ -187,3 +187,72 @@ def test_capi_get_eval_uses_registration_order():
         n = booster_get_eval_into(bst, idx, out.ctypes.data)
         assert n >= 1
         assert out[0] == pytest.approx(expected[name])
+
+
+def test_capi_refit_uses_init_score_and_weights():
+    """Advisor r3 (medium): LGBM_BoosterRefit must compute first-iteration
+    gradients at the model's init score (boost_from_average) with the
+    training weights, not at zero/unweighted (reference: GBDT::RefitTree).
+
+    With refit_decay_rate=0, identical data/weights/leaf assignments make
+    the refitted leaf values reproduce training's own first-tree values —
+    only if score init and weighting match training exactly."""
+    from lightgbm_tpu.capi_helpers import booster_refit_leaf_preds
+
+    rng = np.random.RandomState(5)
+    n = 600
+    X = rng.randn(n, 5)
+    y = ((X @ rng.randn(5) + 0.8) > 0).astype(np.float64)  # unbalanced
+    w = rng.uniform(0.5, 2.0, n)
+    ds = lgb.Dataset(X, label=y, weight=w)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "refit_decay_rate": 0.0,
+                     "min_data_in_leaf": 5}, ds, 1,
+                    keep_training_booster=True)
+    assert bst._gbdt.init_scores and bst._gbdt.init_scores[0] != 0.0
+    tree = bst._gbdt.models[0]
+    before = np.asarray(tree.leaf_value).copy()
+    leaf = np.ascontiguousarray(
+        bst.predict(X, pred_leaf=True).astype(np.int32).reshape(n, -1))
+    assert booster_refit_leaf_preds(bst, leaf.ctypes.data, n, leaf.shape[1])
+    after = np.asarray(bst._gbdt.models[0].leaf_value)
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-7)
+
+
+def test_serialized_reference_is_inert_data():
+    """Advisor r3 (medium): the schema buffer crossing process/machine
+    boundaries must be data (magic + npz arrays), never pickle."""
+    import ctypes
+
+    from lightgbm_tpu.capi_helpers import (
+        _SCHEMA_MAGIC, dataset_from_serialized_reference,
+        dataset_serialize_reference)
+
+    rng = np.random.RandomState(6)
+    X = rng.randn(300, 4)
+    X[rng.rand(300, 4) < 0.2] = np.nan
+    ds = lgb.Dataset(X, label=(X[:, 0] > 0).astype(float),
+                     params={"max_bin": 31})
+    buf = dataset_serialize_reference(ds)
+    assert buf.startswith(_SCHEMA_MAGIC)
+    assert b"pickle" not in buf and b"BinMapper" not in buf
+
+    # round trip preserves every mapper field
+    arr = (ctypes.c_uint8 * len(buf)).from_buffer(bytearray(buf))
+    sds = dataset_from_serialized_reference(ctypes.addressof(arr), len(buf),
+                                            300, "")
+    src = ds.construct().binner.mappers
+    got = sds.reference.binner.mappers
+    assert len(src) == len(got)
+    for a, b in zip(src, got):
+        assert a.missing_type == b.missing_type
+        assert a.is_categorical == b.is_categorical
+        np.testing.assert_array_equal(np.asarray(a.upper_bounds),
+                                      np.asarray(b.upper_bounds))
+
+    # tampered magic is rejected, not deserialized
+    bad = b"XX" + buf[2:]
+    arr2 = (ctypes.c_uint8 * len(bad)).from_buffer(bytearray(bad))
+    with pytest.raises(ValueError, match="magic"):
+        dataset_from_serialized_reference(ctypes.addressof(arr2), len(bad),
+                                          300, "")
