@@ -188,6 +188,8 @@ _ALIASES: Dict[str, str] = {
     "hist_pool_size": "histogram_pool_size",
     "linear_trees": "linear_tree",
     "max_bins": "max_bin",
+    "extra_tree": "extra_trees",
+    "data_seed": "data_random_seed",
 }
 
 _OBJECTIVE_ALIASES: Dict[str, str] = {
@@ -231,6 +233,7 @@ class Config:
     """
 
     # --- core ---
+    config: str = ""  # path of a config file (CLI `config=`; cli.py reads it)
     task: str = "train"
     objective: str = "regression"
     boosting: str = "gbdt"

@@ -341,15 +341,13 @@ class GBDT:
                 lazy[i] = self.cfg.cegb_tradeoff * float(v)
             self._cegb_lazy = jnp.asarray(lazy)
             self._cegb_lazy_used = jnp.zeros((train_set.num_data(), f), bool)
-            if self._use_fast or (
-                self.cfg.tree_learner != "serial" and jax.device_count() > 1
-            ):
-                # single-device non-serial learners fall back to the strict
-                # serial grower, which DOES apply the penalty
+            if self.cfg.tree_learner != "serial" and jax.device_count() > 1:
+                # the (N, F) charge state is row-global; the distributed
+                # wrappers do not thread it across shards
                 log_warning(
-                    "cegb_penalty_feature_lazy is applied by the strict "
-                    "serial grower only (tree_growth_mode=strict, single-"
-                    "device); this configuration IGNORES it."
+                    "cegb_penalty_feature_lazy is applied by the single-"
+                    "device growers only (strict or rounds); this "
+                    "distributed configuration IGNORES it."
                 )
         else:
             self._cegb_lazy = None
@@ -362,14 +360,24 @@ class GBDT:
                     "implemented; using 'intermediate'."
                 )
             if mmethod in ("intermediate", "advanced") and (
-                self._use_fast
-                or (self.cfg.tree_learner != "serial" and jax.device_count() > 1)
+                self.cfg.tree_learner in ("feature", "voting")
+                and jax.device_count() > 1
             ):
                 log_warning(
-                    "monotone intermediate bounds are implemented on the "
-                    "serial strict grower (tree_growth_mode=strict); this "
-                    "configuration falls back to 'basic' — still monotone, "
-                    "more conservative splits."
+                    "monotone intermediate bounds are not implemented for "
+                    "feature/voting-parallel (shard-partial histograms); "
+                    "this configuration falls back to 'basic' — still "
+                    "monotone, more conservative splits."
+                )
+            if (mmethod in ("intermediate", "advanced")
+                    and self.cfg.use_quantized_grad
+                    and self.cfg.quant_train_renew_leaf):
+                log_warning(
+                    "quant_train_renew_leaf is skipped under intermediate "
+                    "monotone bounds: renewed leaf values cannot be "
+                    "re-clipped to evolving bounds without crossing a "
+                    "monotone split; leaf values keep their creation-time "
+                    "(clipped, quantized) outputs."
                 )
         self._linear = bool(self.cfg.linear_tree) and self.cfg.tree_learner == "serial"
         if self.cfg.linear_tree and not self._linear:
@@ -587,6 +595,17 @@ class GBDT:
         mask[chosen] = True
         return jnp.asarray(mask) & self._allowed_features
 
+    @property
+    def _monotone_method(self) -> str:
+        """Effective monotone method for the growers: 'advanced' downgrades
+        to 'intermediate' (reference: LeafConstraintsBase::Create; the
+        advanced cost-based refinement is descoped, warned at setup)."""
+        if self._monotone is None:
+            return "basic"
+        return ("intermediate"
+                if self.cfg.monotone_constraints_method
+                in ("intermediate", "advanced") else "basic")
+
     def _leaf_tile(self, ts, use_efb: bool = True) -> int:
         quant = bool(self.cfg.use_quantized_grad)
         if ts.max_num_bins <= 64 and self._on_tpu:
@@ -676,6 +695,9 @@ class GBDT:
             and not self.objective.need_renew
             and self.objective.is_fusable()
             and self._cegb_coupled is None
+            # lazy charges carry (N, F) state across iterations — kept on
+            # the unfused loop rather than threading it through the step
+            and self._cegb_lazy is None
             and not self._needs_node_rng
             and not self.cfg.use_quantized_grad
         )
@@ -745,6 +767,7 @@ class GBDT:
             self.cfg.top_rate,
             self.cfg.other_rate,
             self.cfg.forcedsplits_filename,
+            self._monotone_method,
         )
 
     def _get_fused_step(self):
@@ -775,6 +798,7 @@ class GBDT:
             # entries past num_leaves-1 can never apply; clamping avoids
             # unrolling dead traced rounds
             n_forced=(min(fs[3], self.cfg.num_leaves - 1) if fs else 0),
+            monotone_method=self._monotone_method,
         )
 
         use_goss = self._is_goss
@@ -1009,6 +1033,7 @@ class GBDT:
                     stochastic_rounding=bool(self.cfg.stochastic_rounding),
                     quant_renew=bool(self.cfg.quant_train_renew_leaf),
                     track_path=self._linear,
+                    monotone_method=self._monotone_method,
                 )
                 arrays, leaf_id_pad = self._localize_tree(arrays, leaf_id_pad)
                 leaf_id = leaf_id_pad[: ts.num_data()]
@@ -1034,6 +1059,7 @@ class GBDT:
                     params=self._split_params,
                     parallel_mode=("voting" if self.cfg.tree_learner == "voting" else "data"),
                     top_k=self.cfg.top_k,
+                    monotone_method=self._monotone_method,
                 )
                 arrays, leaf_id_pad = self._localize_tree(arrays, leaf_id_pad)
                 leaf_id = leaf_id_pad[: ts.num_data()]
@@ -1043,7 +1069,7 @@ class GBDT:
                 quant = self.cfg.use_quantized_grad
                 efb_tabs = ts.efb_device_tables() if getattr(ts, "efb", None) is not None else None
                 fs = self._forced_schedule()
-                arrays, leaf_id = grow_tree_fast(
+                grow_out = grow_tree_fast(
                     ts.bins_device,
                     gc,
                     hc,
@@ -1067,6 +1093,8 @@ class GBDT:
                     fs[0] if fs else None,
                     fs[1] if fs else None,
                     fs[2] if fs else None,
+                    self._cegb_lazy,
+                    self._cegb_lazy_used,
                     n_forced=(min(fs[3], self.cfg.num_leaves - 1) if fs else 0),
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
@@ -1086,7 +1114,12 @@ class GBDT:
                     stochastic_rounding=bool(self.cfg.stochastic_rounding),
                     quant_renew=bool(self.cfg.quant_train_renew_leaf),
                     track_path=self._linear,
+                    monotone_method=self._monotone_method,
                 )
+                if self._cegb_lazy is not None and len(grow_out) == 3:
+                    arrays, leaf_id, self._cegb_lazy_used = grow_out
+                else:
+                    arrays, leaf_id = grow_out
             else:
                 fs = self._forced_schedule()
                 grow_out = grow_tree(
@@ -1116,12 +1149,7 @@ class GBDT:
                     hist_strategy="auto",
                     track_path=self._linear,
                     n_forced=(fs[3] if fs else 0),
-                    monotone_method=(
-                        "intermediate"
-                        if self.cfg.monotone_constraints_method
-                        in ("intermediate", "advanced")
-                        else "basic"
-                    ),
+                    monotone_method=self._monotone_method,
                 )
                 if self._cegb_lazy is not None and len(grow_out) == 3:
                     arrays, leaf_id, self._cegb_lazy_used = grow_out

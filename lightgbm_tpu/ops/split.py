@@ -258,7 +258,12 @@ def gain_plane(
             if monotone_constraints is not None:
                 mono = monotone_constraints[:, None]
                 viol = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
-                ok = ok & ~viol
+                # a leaf whose [lo, hi] band has gone EMPTY (stacked
+                # constraints from different monotone ancestors can
+                # conflict as bounds evolve) is unsplittable: any child
+                # output would breach one of the ancestors.  clip() above
+                # silently returns hi in that case, so gate explicitly.
+                ok = ok & ~viol & (lo <= hi)
         g = jnp.where(ok, g, KMIN_SCORE)
         return g, (left_g, left_h, left_c)
 

@@ -199,7 +199,7 @@ def grow_tree(
     top_k: int = 20,  # voting mode: per-shard feature votes (reference: top_k)
     track_path: bool = False,  # maintain per-leaf path features (linear trees)
     n_forced: int = 0,
-    monotone_method: str = "basic",  # basic | intermediate (serial mode only)
+    monotone_method: str = "basic",  # basic | intermediate (serial/data modes)
 ) -> tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree; returns (tree, final leaf_id per row).
 
@@ -223,7 +223,12 @@ def grow_tree(
     use_intermediate = (
         monotone_method == "intermediate"
         and monotone_constraints is not None
-        and mode == "serial"
+        # serial: sequential splits, the textbook case.  data: every shard
+        # holds identical replicated leaf state (hists are psummed before
+        # split search), so the bound recomputation is SPMD-safe.  feature/
+        # voting keep basic: their hist state is shard-partial and the
+        # re-evaluate-all path would need the cross-shard merge per leaf.
+        and mode in ("serial", "data")
     )
 
     def psum(x):

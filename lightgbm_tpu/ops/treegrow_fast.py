@@ -24,9 +24,12 @@ documents minor tree differences vs CPU).  `tree_growth_mode=strict`
 strict, TPU runs to rounds.
 
 Supported here: numerical + categorical splits, missing handling, monotone
-(basic) + interaction constraints, max_depth, extra_trees/bynode sampling,
-data-parallel via shard_map psum (axis_name).  Feature- and voting-parallel
-modes stay on the strict grower (their cost is comms-, not pass-, shaped).
+(basic AND intermediate — same-round splits under a shared monotone node
+are deferred so bound evolution stays sequential, see round_body) +
+interaction constraints, max_depth, extra_trees/bynode sampling, CEGB
+(split/coupled/lazy per-row charges; lazy is single-device), data-parallel
+via shard_map psum (axis_name).  Feature- and voting-parallel modes stay
+on the strict grower (their cost is comms-, not pass-, shaped).
 """
 
 from __future__ import annotations
@@ -44,7 +47,7 @@ from .split import (
     BestSplit, SplitParams, find_best_split, forced_split_candidate,
     leaf_output, leaf_output_smoothed, KMIN_SCORE,
 )
-from .treegrow import TreeArrays, _empty_best, _set_best
+from .treegrow import TreeArrays, _empty_best, _intermediate_bounds, _set_best
 
 
 @jax.jit
@@ -97,6 +100,13 @@ class FastState(NamedTuple):
     sib: jnp.ndarray  # (L,) i32 — sibling leaf of each fresh leaf (-1 otherwise)
     progress: jnp.ndarray  # bool — this round applied at least one split
     tree: TreeArrays
+    anc: jnp.ndarray = False  # (L, L-1) bool ancestor masks, or () placeholder
+    aside: jnp.ndarray = False  # (L, L-1) bool — leaf on the RIGHT side of m
+    # (maintained only for monotone_method="intermediate"; see treegrow.py)
+    lazy_used: jnp.ndarray = False  # (N, F) bool — rows charged per feature
+    lazy_counts: jnp.ndarray = False  # (L, F) f32 — per-leaf uncharged rows
+    # (maintained only for CEGB cegb_penalty_feature_lazy; reference:
+    # CostEfficientGradientBoosting feature_used_in_data bitset)
 
 
 def _batched_best(
@@ -106,6 +116,7 @@ def _batched_best(
     feature_mask, categorical_mask, monotone, interaction_sets,
     out_lo, out_hi, used, node_ids, rng_key,
     depth=None, parent_out=None, cegb_pen=None, feature_contri=None,
+    lazy_pen=None, lazy_counts=None,  # (F,) penalties x (L, F) uncharged rows
 ):
     """find_best_split vmapped over leaves."""
     if depth is None:
@@ -113,25 +124,33 @@ def _batched_best(
     if parent_out is None:
         parent_out = jnp.zeros_like(sum_g)
 
-    def one(hist, g, h, c, lo, hi, u, nid, dep, pout):
+    def one(hist, g, h, c, lo, hi, u, nid, dep, pout, lzc):
         fmask = feature_mask
         if interaction_sets is not None and u is not None:
             ok_s = ~jnp.any(u[None, :] & ~interaction_sets, axis=1)
             allowed = jnp.any(interaction_sets & ok_s[:, None], axis=0)
             fmask = allowed if fmask is None else (fmask & allowed)
         key = jax.random.fold_in(rng_key, nid) if rng_key is not None else None
+        pen = cegb_pen
+        if lzc is not None:
+            # CEGB lazy per-row fetch charges: penalty scales with this
+            # leaf's uncharged in-bag rows per feature (reference:
+            # CostEfficientGradientBoosting::DetailedSplitGain)
+            lz = lazy_pen * lzc
+            pen = lz if pen is None else pen + lz
         return find_best_split(
             hist, g, h, c, num_bins_pf, missing_bin_pf, params,
             feature_mask=fmask, categorical_mask=categorical_mask,
             monotone_constraints=monotone, out_lo=lo, out_hi=hi, rng_key=key,
             depth=dep.astype(jnp.float32), parent_output=pout,
-            cegb_feature_penalty=cegb_pen, feature_contri=feature_contri,
+            cegb_feature_penalty=pen, feature_contri=feature_contri,
         )
 
-    in_axes = (0, 0, 0, 0, 0, 0, 0 if used is not None else None, 0, 0, 0)
+    in_axes = (0, 0, 0, 0, 0, 0, 0 if used is not None else None, 0, 0, 0,
+               0 if lazy_counts is not None else None)
     return jax.vmap(one, in_axes=in_axes)(
         hist_batch, sum_g, sum_h, count, out_lo, out_hi, used, node_ids,
-        depth, parent_out,
+        depth, parent_out, lazy_counts,
     )
 
 
@@ -141,6 +160,7 @@ def _batched_best(
         "num_leaves", "num_bins", "max_depth", "params", "axis_name",
         "leaf_tile", "hist_precision", "use_pallas", "quantize_bins",
         "stochastic_rounding", "quant_renew", "track_path", "n_forced",
+        "monotone_method",
     ),
 )
 def grow_tree_fast(
@@ -168,6 +188,8 @@ def grow_tree_fast(
     forced_leaf: jnp.ndarray = None,  # (K,) i32 — forced-split schedule
     forced_feature: jnp.ndarray = None,  # (K,) i32   (reference: ForceSplits
     forced_bin: jnp.ndarray = None,  # (K,) i32        from forcedsplits JSON)
+    cegb_lazy_penalty: jnp.ndarray = None,  # (F,) pre-scaled lazy penalties
+    cegb_lazy_used: jnp.ndarray = None,  # (N, F) bool — rows already charged
     *,
     num_leaves: int,
     num_bins: int,
@@ -182,6 +204,7 @@ def grow_tree_fast(
     quant_renew: bool = False,
     track_path: bool = False,
     n_forced: int = 0,
+    monotone_method: str = "basic",  # basic | intermediate
 ) -> tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree in rounds; returns (tree, final leaf_id per row).
 
@@ -325,6 +348,13 @@ def grow_tree_fast(
 
     use_used = interaction_sets is not None or track_path
     used0 = jnp.zeros((L, f), bool) if use_used else jnp.zeros((), bool)
+    use_intermediate = (
+        monotone_method == "intermediate" and monotone_constraints is not None
+    )
+    # CEGB lazy charges are row-global state; the distributed wrappers do
+    # not thread them (rows are sharded), mirroring the strict grower
+    use_lazy = (cegb_lazy_penalty is not None and cegb_lazy_used is not None
+                and axis_name is None)
     leaf_out0 = leaf_output(g0, h0, params)
     cegb_used0 = jnp.zeros((f,), bool)
     cegb_pen0 = (
@@ -332,6 +362,11 @@ def grow_tree_fast(
         if cegb_feature_penalty is not None else None
     )
 
+    if use_lazy:
+        lazy_used0 = cegb_lazy_used
+        lazy_counts0 = jnp.einsum(
+            "n,nf->f", row_mask.astype(jnp.float32),
+            (~lazy_used0).astype(jnp.float32))
     best0 = _set_best(
         _empty_best(L, num_bins), jnp.asarray(0),
         jax.tree.map(
@@ -349,6 +384,8 @@ def grow_tree_fast(
                 parent_out=jnp.asarray([leaf_out0]),
                 cegb_pen=cegb_pen0,
                 feature_contri=feature_contri,
+                lazy_pen=cegb_lazy_penalty if use_lazy else None,
+                lazy_counts=lazy_counts0[None] if use_lazy else None,
             ),
         ),
     )
@@ -374,6 +411,13 @@ def grow_tree_fast(
         sib=jnp.full((L,), -1, jnp.int32),
         progress=jnp.asarray(True),
         tree=tree0,
+        anc=(jnp.zeros((L, L - 1), bool) if use_intermediate
+             else jnp.zeros((), bool)),
+        aside=(jnp.zeros((L, L - 1), bool) if use_intermediate
+               else jnp.zeros((), bool)),
+        lazy_used=(lazy_used0 if use_lazy else jnp.zeros((), bool)),
+        lazy_counts=(jnp.zeros((L, f), jnp.float32).at[0].set(lazy_counts0)
+                     if use_lazy else jnp.zeros((), bool)),
     )
 
     eps = KMIN_SCORE / 2
@@ -385,6 +429,33 @@ def grow_tree_fast(
             can = gains > eps
             if max_depth > 0:
                 can = can & (state.leaf_depth < max_depth)
+            if use_intermediate:
+                # Intermediate bounds make same-round splits INTERACT when
+                # their leaves sit under a common monotone node: applying
+                # one moves the opposite-subtree extremes the other was
+                # searched against, and stacked constraints from different
+                # ancestors can then clip a child into an EMPTY interval
+                # (clip returns hi, breaching lo — a real monotonicity
+                # violation, caught by the stress test).  Admit at most one
+                # split per monotone-connected component and defer the
+                # rest: a deferred leaf is re-searched next round under the
+                # updated bounds (hist_and_eval re-evaluates every live
+                # leaf), which reproduces the strict grower's sequential
+                # semantics split-for-split.  A candidate conflicting with
+                # ANY better-ranked candidate is deferred (slightly more
+                # conservative than greedy-vs-admitted; one extra round at
+                # worst).
+                d_nodes = jnp.where(
+                    state.tree.is_cat, 0,
+                    monotone_constraints[state.tree.split_feature])
+                mono_anc = (state.anc & (d_nodes != 0)[None, :]).astype(
+                    jnp.float32)  # (L, L-1)
+                conflict = (mono_anc @ mono_anc.T) > 0.5  # shared mono anc
+                pre_rank = jnp.argsort(jnp.argsort(
+                    jnp.where(can, -gains, jnp.inf)))
+                better = pre_rank[None, :] < pre_rank[:, None]
+                veto = jnp.any(conflict & better & can[None, :], axis=1) & can
+                can = can & ~veto
             budget = L - state.num_leaves_cur  # how many new leaves fit
             # best-gain-first admission within budget, but at most leaf_tile
             # splits per round (one multi-hist pass)
@@ -495,28 +566,100 @@ def grow_tree_fast(
                                        state.leaf_out, params)
         out_r_c = leaf_output_smoothed(s.right_sum_g, s.right_sum_h, s.right_count,
                                        state.leaf_out, params)
-        if monotone_constraints is not None:
-            mono_c = monotone_constraints[s.feature]
-            out_l_c = jnp.clip(out_l_c, p_lo, p_hi)
-            out_r_c = jnp.clip(out_r_c, p_lo, p_hi)
-            mid = 0.5 * (out_l_c + out_r_c)
-            l_hi = jnp.where(mono_c > 0, jnp.minimum(p_hi, mid), p_hi)
-            r_lo = jnp.where(mono_c > 0, jnp.maximum(p_lo, mid), p_lo)
-            l_lo = jnp.where(mono_c < 0, jnp.maximum(p_lo, mid), p_lo)
-            r_hi = jnp.where(mono_c < 0, jnp.minimum(p_hi, mid), p_hi)
+        if use_intermediate:
+            # --- intermediate bounds under round-batched splits ---
+            # Masks update vectorized: the left child keeps the parent's
+            # leaf slot (ancestors + the new node, left side); the right
+            # child's row adds the new node on the right side.
+            node_oh = jax.nn.one_hot(
+                jnp.where(accept, node_of, L), L - 1, dtype=bool)  # (L, L-1)
+            anc_child = state.anc | node_oh
+            anc = jnp.where(accept[:, None], anc_child, state.anc)
+            anc = anc.at[right_pos].set(anc_child, mode="drop")
+            aside = state.aside.at[right_pos].set(
+                state.aside | node_oh, mode="drop")
+
+            # Creation-time clipping: admitted splits are pairwise
+            # NON-interacting (admission defers leaves sharing a monotone
+            # ancestor, see phase 1), so each child's bounds are exactly
+            # the parent's CURRENT stored bounds (state.leaf_out_lo/hi are
+            # the end-of-last-round recompute over this same state).
+            # Bounds are evaluated at the parent's slot: both children
+            # share all ancestor constraints, and the new node's own
+            # column contributes nothing at creation (its opposite side is
+            # the not-yet-live sibling); sibling ordering is enforced by
+            # the split search and preserved by clipping both children
+            # into the same interval.
+            lo_all, hi_all = state.leaf_out_lo, state.leaf_out_hi
+            ol = jnp.clip(out_l_c, lo_all, hi_all)
+            orr = jnp.clip(out_r_c, lo_all, hi_all)
+            leaf_out = jnp.where(accept, ol, state.leaf_out)
+            leaf_out = leaf_out.at[right_pos].set(orr, mode="drop")
+            leaf_out_lo, leaf_out_hi = _intermediate_bounds(
+                anc, aside, tree, monotone_constraints, leaf_out,
+                state.num_leaves_cur + k_acc, L,
+            )
         else:
-            l_lo, l_hi, r_lo, r_hi = p_lo, p_hi, p_lo, p_hi
-        leaf_out_lo = jnp.where(accept, l_lo, state.leaf_out_lo)
-        leaf_out_lo = leaf_out_lo.at[right_pos].set(r_lo, mode="drop")
-        leaf_out_hi = jnp.where(accept, l_hi, state.leaf_out_hi)
-        leaf_out_hi = leaf_out_hi.at[right_pos].set(r_hi, mode="drop")
-        leaf_out = jnp.where(accept, out_l_c, state.leaf_out)
-        leaf_out = leaf_out.at[right_pos].set(out_r_c, mode="drop")
+            if monotone_constraints is not None:
+                mono_c = monotone_constraints[s.feature]
+                out_l_c = jnp.clip(out_l_c, p_lo, p_hi)
+                out_r_c = jnp.clip(out_r_c, p_lo, p_hi)
+                mid = 0.5 * (out_l_c + out_r_c)
+                l_hi = jnp.where(mono_c > 0, jnp.minimum(p_hi, mid), p_hi)
+                r_lo = jnp.where(mono_c > 0, jnp.maximum(p_lo, mid), p_lo)
+                l_lo = jnp.where(mono_c < 0, jnp.maximum(p_lo, mid), p_lo)
+                r_hi = jnp.where(mono_c < 0, jnp.minimum(p_hi, mid), p_hi)
+            else:
+                l_lo, l_hi, r_lo, r_hi = p_lo, p_hi, p_lo, p_hi
+            leaf_out_lo = jnp.where(accept, l_lo, state.leaf_out_lo)
+            leaf_out_lo = leaf_out_lo.at[right_pos].set(r_lo, mode="drop")
+            leaf_out_hi = jnp.where(accept, l_hi, state.leaf_out_hi)
+            leaf_out_hi = leaf_out_hi.at[right_pos].set(r_hi, mode="drop")
+            leaf_out = jnp.where(accept, out_l_c, state.leaf_out)
+            leaf_out = leaf_out.at[right_pos].set(out_r_c, mode="drop")
+            anc, aside = state.anc, state.aside
         cegb_used = state.cegb_used
         if cegb_feature_penalty is not None:
             cegb_used = cegb_used.at[
                 jnp.where(accept, s.feature, 2 * f)
             ].set(True, mode="drop")
+
+        if use_lazy:
+            # charge every accepted leaf's in-bag rows for its split
+            # feature, THEN count each child's uncharged rows (a child
+            # split on the same feature is free) — the round-batched
+            # mirror of the strict grower's per-split charge (reference:
+            # CostEfficientGradientBoosting::UpdateUsedFeature)
+            lazy_used = state.lazy_used
+            for r in range(leaf_tile):
+                leaf_r = inv_rank[r]
+                live_r = accept[leaf_r]
+                feat_r = s.feature[leaf_r]
+                sel = live_r & (lid == leaf_r) & row_mask
+                lazy_used = lazy_used.at[:, feat_r].set(
+                    lazy_used[:, feat_r] | sel)
+            # one pass counts all LEFT children (they keep the parent's
+            # slot); the right child is the parent remainder with the
+            # split feature zeroed on both sides
+            oh_left = jnp.stack(
+                [(accept[inv_rank[r]] & (leaf_id == inv_rank[r]) & row_mask)
+                 for r in range(leaf_tile)], axis=1).astype(jnp.float32)
+            counts_left = jnp.einsum(
+                "nt,nf->tf", oh_left, (~lazy_used).astype(jnp.float32))
+            lazy_counts = state.lazy_counts
+            for r in range(leaf_tile):
+                leaf_r = inv_rank[r]
+                live_r = accept[leaf_r]
+                feat_r = s.feature[leaf_r]
+                parent_cnt = lazy_counts[leaf_r].at[feat_r].set(0.0)
+                cl = counts_left[r].at[feat_r].set(0.0)
+                cr = jnp.maximum(parent_cnt - cl, 0.0)
+                rp = jnp.clip(right_of[leaf_r], 0, L - 1)
+                lazy_counts = jnp.where(
+                    live_r, lazy_counts.at[leaf_r].set(cl).at[rp].set(cr),
+                    lazy_counts)
+        else:
+            lazy_used, lazy_counts = state.lazy_used, state.lazy_counts
 
         if use_used:
             used_child = jnp.where(
@@ -573,6 +716,10 @@ def grow_tree_fast(
             sib=sib,
             progress=k_acc > 0,
             tree=tree,
+            anc=anc,
+            aside=aside,
+            lazy_used=lazy_used,
+            lazy_counts=lazy_counts,
         )
 
     def hist_and_eval(state: FastState) -> FastState:
@@ -602,6 +749,36 @@ def grow_tree_fast(
         hist = jnp.where(is_big[:, None, None, None], big_sub, hist)
 
         # ---------- phase 3: evaluate fresh leaves (one vmapped search) ----------
+        node_ids = jnp.clip(state.leaf_parent, 0, None) * 2 + state.leaf_side + 1
+        cegb_pen = (
+            jnp.where(state.cegb_used, 0.0, cegb_feature_penalty)
+            if cegb_feature_penalty is not None else None
+        )
+        if use_intermediate:
+            # bounds of EVERY leaf may have moved this round (their opposite
+            # subtrees changed), so cached best splits are stale — re-search
+            # all live leaves (reference: IntermediateLeafConstraints'
+            # leaves_to_update set; recompute-all is the vectorized exact
+            # equivalent, same trade as the strict grower makes)
+            bb = _batched_best(
+                hist, state.leaf_sum_g, state.leaf_sum_h, state.leaf_count,
+                num_bins_per_feature, missing_bin_per_feature, params,
+                feature_mask, categorical_mask, monotone_constraints,
+                interaction_sets, state.leaf_out_lo, state.leaf_out_hi,
+                state.used_features if interaction_sets is not None else None,
+                node_ids, rng_key,
+                depth=state.leaf_depth, parent_out=state.leaf_out,
+                cegb_pen=cegb_pen,
+                feature_contri=feature_contri,
+                lazy_pen=cegb_lazy_penalty if use_lazy else None,
+                lazy_counts=state.lazy_counts if use_lazy else None,
+            )
+            live = idx < state.num_leaves_cur
+            best = bb._replace(gain=jnp.where(live, bb.gain, KMIN_SCORE))
+            return state._replace(hist=hist, best=best,
+                                  fresh=jnp.zeros((L,), bool),
+                                  small_slot=jnp.full((L,), -1, jnp.int32),
+                                  sib=jnp.full((L,), -1, jnp.int32))
         # only the <= 2*leaf_tile fresh leaves need evaluation; gather them
         # into a fixed-size slot batch instead of evaluating all L leaves
         # (matters at num_leaves=255: 8x less split-search per round)
@@ -609,11 +786,6 @@ def grow_tree_fast(
         frm = state.fresh
         fr_idx = jnp.argsort(jnp.where(frm, idx, L + idx))[:m_slots]  # fresh first
         fr_ok = frm[fr_idx]  # padding slots carry non-fresh leaves
-        node_ids = jnp.clip(state.leaf_parent, 0, None) * 2 + state.leaf_side + 1
-        cegb_pen = (
-            jnp.where(state.cegb_used, 0.0, cegb_feature_penalty)
-            if cegb_feature_penalty is not None else None
-        )
         bb = _batched_best(
             hist[fr_idx], state.leaf_sum_g[fr_idx], state.leaf_sum_h[fr_idx],
             state.leaf_count[fr_idx],
@@ -625,6 +797,8 @@ def grow_tree_fast(
             depth=state.leaf_depth[fr_idx], parent_out=state.leaf_out[fr_idx],
             cegb_pen=cegb_pen,
             feature_contri=feature_contri,
+            lazy_pen=cegb_lazy_penalty if use_lazy else None,
+            lazy_counts=state.lazy_counts[fr_idx] if use_lazy else None,
         )
         scatter_pos = jnp.where(fr_ok, fr_idx, 2 * L)  # drop padding slots
 
@@ -691,7 +865,7 @@ def grow_tree_fast(
 
     state = jax.lax.while_loop(cond, body, state)
 
-    if quant_renew and quantize_bins:
+    if quant_renew and quantize_bins and not use_intermediate:
         # recompute leaf outputs from the TRUE f32 gradients (reference:
         # GBDT::Train -> RenewIntGradTreeOutput after quantized growth)
         mrow = row_mask.astype(jnp.float32)
@@ -700,8 +874,14 @@ def grow_tree_fast(
         leaf_value = leaf_output(Gt, Ht, params)
         if monotone_constraints is not None:
             leaf_value = jnp.clip(leaf_value, state.leaf_out_lo, state.leaf_out_hi)
-    elif params.path_smooth > 0:
-        leaf_value = state.leaf_out  # smoothed (and clipped) at creation
+    elif params.path_smooth > 0 or use_intermediate:
+        # smoothed / monotone-clipped AT CREATION.  Under intermediate
+        # bounds this is required for correctness: bounds keep evolving
+        # after a leaf is created, and re-clipping recomputed outputs to
+        # the FINAL bounds can cross a monotone split (see treegrow.py) —
+        # which is also why quantized renewal is skipped above when
+        # intermediate is active.
+        leaf_value = state.leaf_out
     else:
         leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
         if monotone_constraints is not None:
@@ -716,4 +896,8 @@ def grow_tree_fast(
         leaf_depth=state.leaf_depth,
         path_features=(state.used_features if track_path else None),
     )
+    if use_lazy:
+        # hand the cross-tree charge state back (reference: the
+        # feature_used_in_data bitset persists across trees)
+        return tree, state.leaf_id, state.lazy_used
     return tree, state.leaf_id

@@ -177,6 +177,7 @@ def grow_tree_data_parallel(
     hist_strategy: str = "auto",
     parallel_mode: str = "data",  # "data" or "voting" (rows sharded in both)
     top_k: int = 20,
+    monotone_method: str = "basic",
 ) -> Tuple[TreeArrays, jnp.ndarray]:
     """SPMD tree growth: identical trees on every shard, shard-local leaf ids.
 
@@ -194,6 +195,7 @@ def grow_tree_data_parallel(
         num_leaves=num_leaves, num_bins=num_bins, max_depth=max_depth,
         params=params, hist_strategy=hist_strategy, axis_name=DATA_AXIS,
         parallel_mode=parallel_mode, top_k=top_k,
+        monotone_method=monotone_method,
     )
     return _run_sharded(sharded, grow_tree, opt, kw, grad, hess, row_mask,
                         sample_weight, feature_mask)
@@ -225,12 +227,15 @@ def grow_tree_fast_data_parallel(
     stochastic_rounding: bool = True,
     quant_renew: bool = False,
     track_path: bool = False,
+    monotone_method: str = "basic",
 ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Round-batched grower under SPMD data parallelism: each shard runs the
     multi-leaf histogram pass over its rows, one psum per round merges the
     (tile, F, B, 3) block, and every shard applies the identical splits
     (reference analogue: DataParallelTreeLearner with the multi-leaf pass
-    replacing per-split ReduceScatter rounds)."""
+    replacing per-split ReduceScatter rounds).  Intermediate monotone
+    bounds work unchanged: leaf aggregates are psummed, so every shard's
+    bound recomputation sees identical state."""
     from ..ops.treegrow_fast import grow_tree_fast
 
     opt = {
@@ -248,6 +253,7 @@ def grow_tree_fast_data_parallel(
         hist_precision=hist_precision, use_pallas=use_pallas,
         quantize_bins=quantize_bins, stochastic_rounding=stochastic_rounding,
         quant_renew=quant_renew, track_path=track_path,
+        monotone_method=monotone_method,
     )
     return _run_sharded(sharded, grow_tree_fast, opt, kw, grad, hess,
                         row_mask, sample_weight, feature_mask)

@@ -11,3 +11,107 @@ def test_parameters_doc_is_current():
     assert committed.read_text() == generate(), (
         "docs/Parameters.md is stale; run python helpers/parameter_docs.py"
     )
+
+
+# The reference's full parameter surface (include/LightGBM/config.h 4.x,
+# reconstructed by group while the reference mount is empty — re-anchor
+# against docs/Parameters.rst when it appears).  This is the parity
+# contract VERDICT r3 item 5 asks to enumerate: every name below must be a
+# Config field (or resolve through the alias table); counting closes the
+# "param tail" explicitly instead of against SURVEY §9's rough ~180
+# estimate, which double-counted aliases.
+UPSTREAM_PARAMS = """
+config task objective boosting data_sample_strategy data valid num_iterations
+learning_rate num_leaves tree_learner num_threads device_type seed deterministic
+force_col_wise force_row_wise histogram_pool_size max_depth min_data_in_leaf
+min_sum_hessian_in_leaf bagging_fraction pos_bagging_fraction neg_bagging_fraction
+bagging_freq bagging_seed bagging_by_query feature_fraction feature_fraction_bynode
+feature_fraction_seed extra_trees extra_seed early_stopping_round
+early_stopping_min_delta first_metric_only max_delta_step lambda_l1 lambda_l2
+linear_lambda min_gain_to_split drop_rate max_drop skip_drop xgboost_dart_mode
+uniform_drop drop_seed top_rate other_rate min_data_per_group max_cat_threshold
+cat_l2 cat_smooth max_cat_to_onehot top_k monotone_constraints
+monotone_constraints_method monotone_penalty feature_contri forcedsplits_filename
+refit_decay_rate cegb_tradeoff cegb_penalty_split cegb_penalty_feature_lazy
+cegb_penalty_feature_coupled path_smooth interaction_constraints verbosity
+input_model output_model saved_feature_importance_type snapshot_freq
+use_quantized_grad num_grad_quant_bins quant_train_renew_leaf stochastic_rounding
+linear_tree max_bin max_bin_by_feature min_data_in_bin bin_construct_sample_cnt
+data_random_seed is_enable_sparse enable_bundle use_missing zero_as_missing
+feature_pre_filter pre_partition two_round header label_column weight_column
+group_column ignore_column categorical_feature forcedbins_filename save_binary
+precise_float_parser parser_config_file
+start_iteration_predict num_iteration_predict predict_raw_score
+predict_leaf_index predict_contrib predict_disable_shape_check pred_early_stop
+pred_early_stop_freq pred_early_stop_margin output_result
+convert_model_language convert_model
+objective_seed num_class is_unbalance scale_pos_weight sigmoid
+boost_from_average reg_sqrt alpha fair_c poisson_max_delta_step
+tweedie_variance_power lambdarank_truncation_level lambdarank_norm
+lambdarank_position_bias_regularization label_gain
+metric metric_freq is_provide_training_metric eval_at multi_error_top_k
+auc_mu_weights
+num_machines local_listen_port time_out machine_list_filename machines
+gpu_platform_id gpu_device_id gpu_use_dp num_gpu
+""".split()
+
+# the reference's alias table (src/io/config_auto.cpp parameter2aliases),
+# same reconstruction caveat
+UPSTREAM_ALIASES = {
+    "config_file", "task_type", "objective_type", "app", "application",
+    "loss", "boosting_type", "boost", "train", "train_data",
+    "train_data_file", "data_filename", "test", "valid_data",
+    "valid_data_file", "test_data", "test_data_file", "valid_filenames",
+    "num_iteration", "n_iter", "num_tree", "num_trees", "num_round",
+    "num_rounds", "nrounds", "num_boost_round", "n_estimators", "max_iter",
+    "shrinkage_rate", "eta", "num_leaf", "max_leaves", "max_leaf",
+    "max_leaf_nodes", "tree", "tree_type", "tree_learner_type",
+    "num_thread", "nthread", "nthreads", "n_jobs", "device", "random_seed",
+    "random_state", "min_data_per_leaf", "min_data", "min_child_samples",
+    "min_samples_leaf", "min_sum_hessian_per_leaf", "min_sum_hessian",
+    "min_hessian", "min_child_weight", "sub_row", "subsample", "bagging",
+    "pos_sub_row", "pos_subsample", "pos_bagging", "neg_sub_row",
+    "neg_subsample", "neg_bagging", "subsample_freq",
+    "bagging_fraction_seed", "sub_feature", "colsample_bytree",
+    "sub_feature_bynode", "colsample_bynode", "extra_tree",
+    "early_stopping_rounds", "early_stopping", "n_iter_no_change",
+    "max_tree_output", "max_leaf_output", "reg_alpha", "l1_regularization",
+    "reg_lambda", "lambda", "l2_regularization", "min_split_gain",
+    "rate_drop", "topk", "mc", "monotone_constraint", "monotonic_cst",
+    "monotone_constraining_method", "mc_method", "monotone_splits_penalty",
+    "ms_penalty", "mc_penalty", "feature_contrib", "fc", "fp",
+    "feature_penalty", "fs", "forced_splits_filename", "forced_splits_file",
+    "forced_splits", "interaction_constraint", "verbose", "model_output",
+    "model_out", "save_period", "model_input", "model_in", "predict_result",
+    "prediction_result", "predict_name", "prediction_name", "pred_name",
+    "name_pred", "is_pre_partition", "is_enable_bundle", "bundle",
+    "is_sparse", "enable_sparse", "sparse", "two_round_loading",
+    "use_two_round_loading", "is_save_binary", "is_save_binary_file",
+    "has_header", "label", "weight", "group", "group_id", "query_column",
+    "query", "query_id", "ignore_feature", "blacklist", "cat_feature",
+    "categorical_column", "cat_column", "is_predict_raw_score",
+    "predict_rawscore", "raw_score", "is_predict_leaf_index", "leaf_index",
+    "is_predict_contrib", "contrib", "convert_model_file", "num_classes",
+    "unbalance", "unbalanced_sets", "metrics", "metric_types",
+    "output_freq", "training_metric", "is_training_metric", "train_metric",
+    "ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at", "num_machine",
+    "local_port", "port", "machine_list_file", "machine_list", "mlist",
+    "workers", "nodes", "subsample_for_bin", "hist_pool_size",
+    "linear_trees", "data_seed",
+}
+
+
+def test_upstream_parameter_contract_is_closed():
+    import dataclasses
+
+    from lightgbm_tpu.config import _ALIASES, Config
+
+    ours = {f.name for f in dataclasses.fields(Config)}
+    missing = set(UPSTREAM_PARAMS) - ours
+    assert not missing, f"reference params without a Config field: {missing}"
+    # every alias must resolve to a real field
+    bad_targets = {a for a, c in _ALIASES.items() if c not in ours}
+    assert not bad_targets, f"aliases pointing at unknown fields: {bad_targets}"
+    missing_aliases = UPSTREAM_ALIASES - set(_ALIASES)
+    assert not missing_aliases, (
+        f"reference aliases missing from the table: {missing_aliases}")
