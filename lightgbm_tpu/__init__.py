@@ -40,6 +40,17 @@ try:  # sklearn wrappers are optional at import time (mirrors compat.py)
 except ImportError:  # pragma: no cover
     pass
 
+try:  # distributed estimators (reference: lightgbm.dask exposes DaskLGBM*)
+    from .dask import (  # noqa: F401
+        DaskLGBMClassifier,
+        DaskLGBMRanker,
+        DaskLGBMRegressor,
+    )
+
+    __all__ += ["DaskLGBMClassifier", "DaskLGBMRegressor", "DaskLGBMRanker"]
+except ImportError:  # pragma: no cover
+    pass
+
 # plotting imports matplotlib/graphviz only at call time, so the module
 # itself is always importable
 from .plotting import (  # noqa: F401

@@ -52,6 +52,7 @@ ds = lgb.Dataset(
     shard["X"],
     label=shard["y"],
     weight=(shard["w"] if shard["w"].size > 0 else None),
+    group=(shard["g"] if "g" in shard and shard["g"].size > 0 else None),
 )
 bst = lgb.train(params, ds, int(os.environ["LGBM_TPU_ROUNDS"]))
 out = os.environ["LGBM_TPU_MODEL_OUT"]
@@ -81,6 +82,7 @@ def train_distributed(
     *,
     num_machines: int = 2,
     weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
     devices_per_machine: int = 1,
     timeout_s: int = 600,
     env_extra: Optional[Dict[str, str]] = None,
@@ -88,19 +90,55 @@ def train_distributed(
     """Shard rows over `num_machines` local worker processes, train with
     tree_learner=data under pre_partition, and return rank 0's model as a
     Booster.  Rows are padded to equal shard sizes with weight-0 rows when
-    the split is uneven (equal shards are a pre_partition requirement)."""
+    the split is uneven (equal shards are a pre_partition requirement).
+
+    With `group` (query sizes, ranking), shard boundaries snap to query
+    boundaries (greedy contiguous fill, like the reference's dask module
+    keeping partitions intact per worker) and each shard's padding rows
+    form one trailing weight-0 query."""
     import lightgbm_tpu as lgb
 
     n = X.shape[0]
-    per = -(-n // num_machines)
-    pad = per * num_machines - n
-    if pad:
-        X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], X.dtype)])
-        y = np.concatenate([y, np.zeros(pad, np.asarray(y).dtype)])
-        weight = np.concatenate([
-            np.ones(n) if weight is None else np.asarray(weight, np.float64),
-            np.zeros(pad),
-        ])
+    if group is not None:
+        group = np.asarray(group, np.int64)
+        if group.sum() != n:
+            raise ValueError(
+                f"group sizes sum to {group.sum()} but X has {n} rows")
+        if len(group) < num_machines:
+            raise ValueError(
+                f"not enough queries ({len(group)}) for {num_machines} "
+                "machines")
+        bounds = np.concatenate([[0], np.cumsum(group)])
+        # greedy contiguous fill: each rank takes whole queries until its
+        # proportional row share, always taking at least one and leaving
+        # at least one per remaining rank
+        shard_slices, shard_groups, q = [], [], 0
+        for rank in range(num_machines):
+            target = (n * (rank + 1)) // num_machines
+            q0, q_cap = q, len(group) - (num_machines - rank - 1)
+            q += 1  # at least one query per rank
+            while q < q_cap and bounds[q + 1] <= target:
+                q += 1
+            if rank == num_machines - 1:
+                q = len(group)
+            shard_slices.append((int(bounds[q0]), int(bounds[q])))
+            shard_groups.append(group[q0:q])
+        per = max(hi - lo for lo, hi in shard_slices)
+        if weight is None:
+            weight = np.ones(n, np.float64)
+    else:
+        per = -(-n // num_machines)
+        pad = per * num_machines - n
+        if pad:
+            X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], X.dtype)])
+            y = np.concatenate([y, np.zeros(pad, np.asarray(y).dtype)])
+            weight = np.concatenate([
+                np.ones(n) if weight is None
+                else np.asarray(weight, np.float64),
+                np.zeros(pad),
+            ])
+        shard_slices = [(r * per, (r + 1) * per) for r in range(num_machines)]
+        shard_groups = [None] * num_machines
     ports = _free_ports(num_machines)
     machines = ",".join(f"127.0.0.1:{p}" for p in ports)
 
@@ -113,13 +151,27 @@ def train_distributed(
 
     procs = []
     for rank in range(num_machines):
-        lo, hi = rank * per, (rank + 1) * per
+        lo, hi = shard_slices[rank]
+        Xs, ys = X[lo:hi], np.asarray(y)[lo:hi]
+        ws = (np.asarray(weight, np.float64)[lo:hi]
+              if weight is not None else np.asarray(()))
+        gs = shard_groups[rank]
+        pad_s = per - (hi - lo)
+        if pad_s:
+            # equal shard sizes are a pre_partition requirement; pad rows
+            # carry weight 0 (and, for ranking, one trailing pad query)
+            Xs = np.concatenate([Xs, np.zeros((pad_s,) + Xs.shape[1:],
+                                              Xs.dtype)])
+            ys = np.concatenate([ys, np.zeros(pad_s, ys.dtype)])
+            ws = np.concatenate([ws if ws.size else np.ones(hi - lo),
+                                 np.zeros(pad_s)])
+            if gs is not None:
+                gs = np.concatenate([gs, [pad_s]])
         shard_path = os.path.join(tmp, f"shard{rank}.npz")
         np.savez(
             shard_path,
-            X=X[lo:hi], y=np.asarray(y)[lo:hi],
-            w=(np.asarray(weight, np.float64)[lo:hi]
-               if weight is not None else np.asarray(())),
+            X=Xs, y=ys, w=ws,
+            g=(gs if gs is not None else np.asarray(())),
             num_machines=num_machines, machines=machines,
             local_listen_port=ports[rank], time_out=2,
         )

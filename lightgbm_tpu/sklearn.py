@@ -319,7 +319,9 @@ class LGBMRegressor(RegressorMixin, LGBMModel):
 class LGBMClassifier(ClassifierMixin, LGBMModel):
     """reference: sklearn.py LGBMClassifier (LabelEncoder + predict_proba)."""
 
-    def fit(self, X, y, **kwargs) -> "LGBMClassifier":
+    def _prepare_class_labels(self, y) -> np.ndarray:
+        """Encode labels and resolve the classification objective; shared
+        with the distributed estimators (dask.py)."""
         y = np.asarray(y).ravel()
         self._le = LabelEncoder().fit(y)
         y_enc = self._le.transform(y)
@@ -328,9 +330,15 @@ class LGBMClassifier(ClassifierMixin, LGBMModel):
         if self.n_classes_ > 2:
             obj = self.objective if isinstance(self.objective, str) else None
             if obj is None or obj == "binary":
-                self.objective = self.objective or "multiclass"
+                # binary cannot represent >2 classes — promote (reference
+                # wrapper: ova/multiclass switch on n_classes)
+                self.objective = "multiclass"
             self._other_params["num_class"] = self.n_classes_
             setattr(self, "num_class", self.n_classes_)
+        return y_enc
+
+    def fit(self, X, y, **kwargs) -> "LGBMClassifier":
+        y_enc = self._prepare_class_labels(y)
         super().fit(X, y_enc, **kwargs)
         return self
 

@@ -52,3 +52,83 @@ def test_launcher_end_to_end_loopback():
     # and the returned booster predicts
     p = bst.predict(X[:100])
     assert np.isfinite(p).all()
+
+
+_CPU_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    "PALLAS_AXON_POOL_IPS": "",
+}
+
+
+def _patched_env(monkeypatch):
+    """Route the estimators' worker processes to CPU (the launcher workers
+    inherit os.environ)."""
+    for k, v in _CPU_ENV.items():
+        monkeypatch.setenv(k, v)
+
+
+def test_distributed_regressor_estimator(monkeypatch):
+    """VERDICT r3 item 8: a user-facing fit-an-estimator-across-processes
+    API (reference: dask.py DaskLGBMRegressor -> _train)."""
+    _patched_env(monkeypatch)
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(12)
+    n = 3000
+    X = rng.randn(n, 5)
+    y = X @ rng.randn(5) + 0.2 * rng.randn(n)
+    est = lgb.DaskLGBMRegressor(num_machines=2, n_estimators=4, num_leaves=8,
+                                min_child_samples=5,
+                                subsample_for_bin=n)
+    est.fit(X, y)
+    p = est.predict(X)
+    assert np.isfinite(p).all()
+    # distributed model ~ local estimator (same data, same params)
+    local = lgb.LGBMRegressor(n_estimators=4, num_leaves=8,
+                              min_child_samples=5, subsample_for_bin=n)
+    local.fit(X, y)
+    np.testing.assert_allclose(p, local.predict(X), rtol=5e-2, atol=5e-2)
+
+
+def test_distributed_classifier_estimator(monkeypatch):
+    _patched_env(monkeypatch)
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(13)
+    n = 3000
+    X = rng.randn(n, 5)
+    y_raw = (X @ rng.randn(5) > 0)
+    y = np.where(y_raw, "pos", "neg")  # string labels exercise the encoder
+    est = lgb.DaskLGBMClassifier(num_machines=2, n_estimators=4, num_leaves=8,
+                                 min_child_samples=5, subsample_for_bin=n)
+    est.fit(X, y)
+    assert set(est.classes_) == {"neg", "pos"}
+    proba = est.predict_proba(X)
+    assert proba.shape == (n, 2)
+    pred = est.predict(X)
+    assert (pred == y).mean() > 0.8
+
+
+def test_distributed_ranker_estimator(monkeypatch):
+    _patched_env(monkeypatch)
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(14)
+    # UNEVEN query sizes: shards can't split evenly, so the query-boundary
+    # snap AND the trailing weight-0 pad query path both run
+    group = rng.randint(30, 70, 47)
+    n = int(group.sum())
+    X = rng.randn(n, 6)
+    rel = X[:, 0] * 0.8 + 0.3 * rng.randn(n)
+    y = np.clip(np.floor(rel) + 2, 0, 4).astype(np.float64)
+    est = lgb.DaskLGBMRanker(num_machines=2, n_estimators=4, num_leaves=8,
+                             min_child_samples=5, subsample_for_bin=n)
+    est.fit(X, y, group=group)
+    p = est.predict(X)
+    assert np.isfinite(p).all()
+    # scores must rank the relevant docs above within queries on average
+    bounds = np.concatenate([[0], np.cumsum(group)])
+    gained = np.array([y[lo:hi][p[lo:hi].argmax()]
+                       for lo, hi in zip(bounds[:-1], bounds[1:])])
+    assert gained.mean() > y.mean()
