@@ -33,6 +33,8 @@ def generate() -> str:
         "|---|---|---|---|",
     ]
     for f in fields(Config):
+        if f.name == "_explicit":  # bookkeeping, not a parameter
+            continue
         if f.default is not MISSING:
             default = f.default
         elif f.default_factory is not MISSING:  # type: ignore[misc]
@@ -44,7 +46,8 @@ def generate() -> str:
         default_s = repr(default) if default != "" or isinstance(default, str) else ""
         lines.append(f"| `{f.name}` | `{default_s}` | {tname} | {aliases} |")
     lines.append("")
-    lines.append(f"Total: {len(fields(Config))} parameters, {len(_ALIASES)} aliases.")
+    n_params = sum(1 for f in fields(Config) if f.name != "_explicit")
+    lines.append(f"Total: {n_params} parameters, {len(_ALIASES)} aliases.")
     lines.append("")
     return "\n".join(lines)
 

@@ -251,7 +251,48 @@ class Dataset:
                 group_column=cfg.group_column,
                 ignore_column=cfg.ignore_column,
             )
-            if cfg.two_round:
+            with open(path, "rb") as _fh:
+                _magic = _fh.read(4)
+            if _magic == b"PK\x03\x04":
+                # save_binary npz checkpoint (reference:
+                # DatasetLoader::LoadFromBinFile) — binned matrix + mappers
+                # reload directly, no raw parsing or re-binning
+                from .binning import BinMapper
+
+                with np.load(path, allow_pickle=False) as z:
+                    sizes = z["upper_sizes"]
+                    uppers = z["uppers"]
+                    mt = z["missing_types"]
+                    cat_sizes = (z["cat_sizes"] if "cat_sizes" in z.files
+                                 else np.zeros(len(sizes), np.int64))
+                    cats = z["cats"] if "cats" in z.files else np.zeros(0)
+                    minv = (z["min_values"] if "min_values" in z.files
+                            else np.zeros(len(sizes)))
+                    maxv = (z["max_values"] if "max_values" in z.files
+                            else np.zeros(len(sizes)))
+                    mappers, off, coff = [], 0, 0
+                    for i, s in enumerate(sizes):
+                        s = int(s)
+                        cs = int(cat_sizes[i])
+                        mappers.append(BinMapper(
+                            upper_bounds=uppers[off:off + s],
+                            missing_type=int(mt[i]),
+                            is_categorical=cs > 0,
+                            categories=(cats[coff:coff + cs] if cs else None),
+                            min_value=float(minv[i]),
+                            max_value=float(maxv[i]),
+                        ))
+                        off += s
+                        coff += cs
+                    pre_binner = DatasetBinner(mappers=mappers)
+                    pre_bins = np.asarray(z["bins"])
+                    loaded = {
+                        "label": (z["label"] if z["label"].size else None),
+                        "weight": (z["weight"] if z["weight"].size else None),
+                        "group": (z["group"] if z["group"].size else None),
+                        "feature_names": [str(x) for x in z["feature_names"]],
+                    }
+            elif cfg.two_round:
                 import jax as _jax
 
                 if ref is not None:
@@ -670,20 +711,39 @@ class Dataset:
 
     def save_binary(self, filename: str) -> "Dataset":
         """Binned dataset checkpoint (reference: Dataset::SaveBinaryFile).
-        Uses npz rather than the reference's custom byte format."""
+        Uses npz rather than the reference's custom byte format; a Dataset
+        constructed from the saved path reloads the binned matrix and
+        mappers directly, skipping raw parsing/binning (reference:
+        DatasetLoader::LoadFromBinFile)."""
         self.construct()
+        # write to the EXACT filename (np.savez appends .npz to bare paths;
+        # the reference honors the given name)
+        with open(filename, "wb") as fh:
+            self._savez_binary(fh)
+        return self
+
+    def _savez_binary(self, fh) -> None:
+        ms = self.binner.mappers
         np.savez_compressed(
-            filename,
+            fh,
             bins=self.bins,
             label=self.label if self.label is not None else np.zeros(0),
             weight=self.weight if self.weight is not None else np.zeros(0),
             group=self.group if self.group is not None else np.zeros(0, np.int64),
-            uppers=np.concatenate([m.upper_bounds for m in self.binner.mappers]),
-            upper_sizes=np.asarray([len(m.upper_bounds) for m in self.binner.mappers]),
-            missing_types=np.asarray([m.missing_type for m in self.binner.mappers]),
+            uppers=np.concatenate([np.asarray(m.upper_bounds, np.float64)
+                                   for m in ms]),
+            upper_sizes=np.asarray([len(m.upper_bounds) for m in ms]),
+            missing_types=np.asarray([m.missing_type for m in ms]),
+            cats=np.concatenate([
+                np.asarray(m.categories, np.float64)
+                if m.categories is not None else np.zeros(0) for m in ms]),
+            cat_sizes=np.asarray([
+                len(m.categories) if m.categories is not None else 0
+                for m in ms]),
+            min_values=np.asarray([m.min_value for m in ms], np.float64),
+            max_values=np.asarray([m.max_value for m in ms], np.float64),
             feature_names=np.asarray(self.feature_names),
         )
-        return self
 
     # -- tree traversal on binned data ----------------------------------
     def predict_leaf_binned_tree(self, tree: Tree) -> jnp.ndarray:

@@ -413,6 +413,13 @@ class Config:
 
     # unknown/passthrough params preserved here
     extra: Dict[str, Any] = field(default_factory=dict)
+    # names the user explicitly set (vs defaults) — lets device-specific
+    # default resolution (e.g. quantized training on wide-bin TPU runs)
+    # respect an explicit user choice either way
+    _explicit: set = field(default_factory=set, repr=False, compare=False)
+
+    def is_set(self, name: str) -> bool:
+        return name in self._explicit
 
     # ------------------------------------------------------------------
     @classmethod
@@ -430,6 +437,7 @@ class Config:
             if key in known and key != "extra":
                 cur = getattr(self, key)
                 setattr(self, key, _coerce(value, cur, known[key].type))
+                self._explicit.add(key)
             else:
                 self.extra[key] = value
         # derived conveniences
@@ -453,7 +461,7 @@ class Config:
     def to_dict(self) -> Dict[str, Any]:
         out = {}
         for f in fields(self):
-            if f.name == "extra":
+            if f.name in ("extra", "_explicit"):
                 continue
             out[f.name] = copy.deepcopy(getattr(self, f.name))
         out.update(self.extra)

@@ -136,6 +136,16 @@ class DaskLGBMClassifier(_DistributedFitMixin, LGBMClassifier):
 
     def fit(self, X, y, sample_weight=None) -> "DaskLGBMClassifier":
         y_enc = self._prepare_class_labels(y)
+        if self.class_weight is not None and self.n_classes_ >= 2:
+            # the local wrapper folds class_weight into sample weights
+            # (LGBMModel.fit); mirror it here so the distributed model
+            # matches rather than silently ignoring the option
+            from sklearn.utils.class_weight import compute_sample_weight
+
+            cw = compute_sample_weight(self.class_weight, y_enc)
+            sample_weight = (cw if sample_weight is None
+                             else np.asarray(sample_weight,
+                                             np.float64).ravel() * cw)
         return self._fit_distributed(X, y_enc, sample_weight=sample_weight)
 
 

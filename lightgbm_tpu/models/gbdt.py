@@ -289,6 +289,37 @@ class GBDT:
         )
         # growth scheduling: round-batched grower on TPU (tree_growth_mode)
         self._on_tpu = jax.devices()[0].platform == "tpu"
+        _mode = self.cfg.tree_growth_mode
+        _rounds_grower = (
+            self.cfg.tree_learner in ("serial", "data")
+            and (_mode == "rounds" or (_mode == "auto" and self._on_tpu))
+        )
+        if (self._on_tpu and train_set.max_num_bins > 64
+                and train_set.num_feature() >= 256
+                and _rounds_grower  # quantization lives on the rounds grower
+                and not self.cfg.is_set("use_quantized_grad")
+                and self._monotone is None):
+            # TPU device default for the WIDE wide-bin regime: int8
+            # quantized training.  The int8 payload carries 3 channels/leaf
+            # (no bf16x2 split), doubling the Mosaic kernel's leaf tile and
+            # halving admission rounds — measured Epsilon-class 400k x 2000
+            # x 255 bins: 8.0 -> 5.1 s/iter.  At NARROW shapes the pass is
+            # a single feature chunk and quantized ~= float within run
+            # variance (measured 1M x 28 x 255: 10.7-10.9 vs 11.8 it/s),
+            # so the default stays float there.  Stochastic rounding +
+            # exact int32 accumulation + f32 leaf renewal keep AUC at parity
+            # (0.93101 vs 0.93116 measured; docs/PERF_NOTES.md round 4).
+            # An explicit use_quantized_grad either way always wins;
+            # monotone runs stay float (renewal interplay, see warning
+            # below).
+            from ..utils.log import log_info
+            self.cfg.use_quantized_grad = True
+            if not self.cfg.is_set("quant_train_renew_leaf"):
+                self.cfg.quant_train_renew_leaf = True
+            log_info(
+                "wide data with max_bin > 64 on TPU: enabling int8 "
+                "quantized training (use_quantized_grad=true, leaf renewal "
+                "on); set use_quantized_grad=false for the float path.")
         mode = self.cfg.tree_growth_mode
         self._use_fast = (
             self.cfg.tree_learner == "serial"
@@ -594,6 +625,36 @@ class GBDT:
         mask = np.zeros(f, dtype=bool)
         mask[chosen] = True
         return jnp.asarray(mask) & self._allowed_features
+
+    def _use_windowed(self, ts) -> bool:
+        """Wide-regime windowed grower gate (ops/treegrow_windowed.py).
+
+        The windowed grower shrinks each histogram pass from full-N to the
+        round's small-children window.  Measured at Epsilon (400k x 2000 x
+        255 bins, 255 leaves, int8): the pass itself drops ~200 ms ->
+        ~30 ms as designed, but per-round FIXED costs (admit bookkeeping
+        ~0.14 s + ~0.2 s of hist-state ops whose (L, F, B, 3) trailing
+        dim forces 42x-padded tiled layouts — see PERF_NOTES round 4)
+        leave it at parity with the full-pass grower (~5.5 vs 5.06
+        s/iter).  OPT-IN until the hist-layout rework lands:
+        windowed_growth=true enables it.  Its v1 feature envelope
+        excludes the rarer options below; anything outside falls back to
+        the full-pass rounds grower, which supports everything."""
+        return (
+            self._on_tpu
+            and bool(self.cfg.extra.get("windowed_growth", False))
+            and jax.device_count() == 1
+            and ts.num_feature() >= 512
+            and self.cfg.num_leaves >= 64
+            and self._monotone is None
+            and self._interaction_sets is None
+            and self._categorical_mask is None
+            and getattr(ts, "efb", None) is None
+            and self._forced_schedule() is None
+            and self._cegb_lazy is None
+            and self._cegb_coupled is None
+            and not self._linear
+        )
 
     @property
     def _monotone_method(self) -> str:
@@ -1063,6 +1124,34 @@ class GBDT:
                 )
                 arrays, leaf_id_pad = self._localize_tree(arrays, leaf_id_pad)
                 leaf_id = leaf_id_pad[: ts.num_data()]
+            elif self._use_fast and self._use_windowed(ts):
+                from ..ops.treegrow_windowed import grow_tree_windowed
+
+                quant = self.cfg.use_quantized_grad
+                arrays, leaf_id = grow_tree_windowed(
+                    ts.bins_device_t(),
+                    gc,
+                    hc,
+                    row_mask,
+                    sample_weight,
+                    feature_mask,
+                    ts.num_bins_pf_device,
+                    ts.missing_bin_pf_device,
+                    node_rng,
+                    (jax.random.PRNGKey(self.cfg.seed * 1000003 + self.iter_ * 31 + c)
+                     if quant else None),
+                    self._feature_contri,
+                    num_leaves=self.cfg.num_leaves,
+                    num_bins=ts.max_num_bins,
+                    max_depth=self.cfg.max_depth,
+                    params=self._split_params,
+                    leaf_tile=self._leaf_tile(ts),
+                    hist_precision=self.cfg.hist_precision,
+                    use_pallas=self._on_tpu,
+                    quantize_bins=(self.cfg.num_grad_quant_bins if quant else 0),
+                    stochastic_rounding=bool(self.cfg.stochastic_rounding),
+                    quant_renew=bool(self.cfg.quant_train_renew_leaf),
+                )
             elif self._use_fast:
                 from ..ops.treegrow_fast import grow_tree_fast
 

@@ -1,0 +1,605 @@
+"""Windowed round-batched growth — the wide-regime (Epsilon-class) grower.
+
+The round-batched grower (treegrow_fast.py) pays one FULL-N multi-leaf
+histogram pass per round: at Epsilon shape (400k x 2000 x 255 bins, 255
+leaves) that is ~26 passes x ~200 ms streaming all rows every time, even
+though a round only needs histograms for its small children.  This grower
+keeps rows PHYSICALLY grouped by leaf (reference: DataPartition's
+[start, count) ranges — src/treelearner/data_partition.hpp) so each round
+gathers ONLY the small-children rows into a power-of-two window and runs
+the pass over that window: total row-touches drop from rounds*N toward
+~N (docs/PERF_NOTES.md round-4 plan; ops/partition.py holds the
+permutation op and its equivalence tests).
+
+Structure: a HOST round loop (the wide regime is exactly where the fused
+full-tree trace blows up — see _fused_eligible) with two jitted phases:
+
+  _round_admit   fixed shapes; gain admission, stable partition of the
+                 row order, leaf-range/tree/aggregate bookkeeping; returns
+                 the round's small-child windows as small arrays (the one
+                 host sync per round, ~23 ms through the tunnel).
+  _round_pass    static window size W (power-of-two quantized to bound
+                 recompiles); gathers window rows feature-major
+                 (bins_t[:, rows] — measured ~43 ms for ALL 400k rows at
+                 2000 features, so a window costs proportionally less),
+                 runs the multi-leaf Pallas pass in feature-major layout,
+                 recovers big siblings by subtraction, searches fresh
+                 leaves.
+
+Scope (v1, gated in models/gbdt.py): single device, numerical features,
+no EFB bundles / forced splits / interaction constraints / monotone
+constraints / CEGB-lazy — configurations outside this envelope fall back
+to the full-pass rounds grower, which supports everything.  Quantized
+int8 training IS supported (it is the wide-regime TPU default).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hist_pallas import (histogram_pallas_multi,
+                          histogram_pallas_multi_quantized)
+from .histogram import histogram
+from .partition import stable_partition_ranges
+from .split import BestSplit, SplitParams, leaf_output, KMIN_SCORE
+from .treegrow import TreeArrays, _empty_best, _set_best
+from .treegrow_fast import _batched_best
+
+
+class WState(NamedTuple):
+    order: jnp.ndarray  # (N,) i32 — row ids physically grouped by leaf
+    leaf_start: jnp.ndarray  # (L,) i32 — position of each leaf's range
+    leaf_cnt: jnp.ndarray  # (L,) i32
+    leaf_id: jnp.ndarray  # (N,) i32 — leaf per ROW (for score updates)
+    hist: jnp.ndarray  # (L, F, B, 3) f32
+    best: BestSplit
+    leaf_sum_g: jnp.ndarray
+    leaf_sum_h: jnp.ndarray
+    leaf_count: jnp.ndarray
+    leaf_depth: jnp.ndarray
+    leaf_parent: jnp.ndarray
+    leaf_side: jnp.ndarray
+    num_leaves_cur: jnp.ndarray
+    leaf_out: jnp.ndarray
+    tree: TreeArrays
+    fresh: jnp.ndarray  # (L,) bool
+    small_slot: jnp.ndarray  # (L,) i32 — window slot of fresh SMALL child
+    sib: jnp.ndarray  # (L,) i32
+
+
+def _pow2_ge(x: int, floor: int = 8192) -> int:
+    """Window size quantization.  Factor-4 steps (not 2): each distinct W
+    is a separate remote Mosaic compile of _round_pass (1-5 min on this
+    toolchain), so four sizes cover 8k..512k rows; the pass over the
+    padding costs far less than a compile ever would."""
+    w = floor
+    while w < x:
+        w *= 4
+    return w
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_leaves", "num_bins", "max_depth", "params",
+                     "leaf_tile"),
+)
+def _round_admit(
+    state: WState,
+    bins_t: jnp.ndarray,  # (F, N) int16 — FIXED original row order
+    missing_bin_pf: jnp.ndarray,
+    row_mask: jnp.ndarray,  # (N,) bool by ROW id
+    *,
+    num_leaves: int,
+    num_bins: int,
+    max_depth: int,
+    params: SplitParams,
+    leaf_tile: int,
+):
+    """Phase 1: admit this round's splits and repartition the row order.
+
+    Returns (state', info) where info = (k_acc, win_start (tile,),
+    win_cnt (tile,), gains_left) — the small arrays the host loop syncs.
+    """
+    L = num_leaves
+    n = state.order.shape[0]
+    eps = KMIN_SCORE / 2
+
+    gains = state.best.gain
+    can = gains > eps
+    if max_depth > 0:
+        can = can & (state.leaf_depth < max_depth)
+    budget = L - state.num_leaves_cur
+    order_rank = jnp.argsort(jnp.argsort(jnp.where(can, -gains, jnp.inf)))
+    accept = can & (order_rank < jnp.minimum(budget, leaf_tile))
+    s = state.best
+    k_acc = jnp.sum(accept.astype(jnp.int32))
+
+    acc_rank = jnp.where(accept, order_rank, L)
+    node_of = state.num_leaves_cur - 1 + acc_rank
+    right_of = state.num_leaves_cur + acc_rank
+    inv_rank = jnp.argsort(jnp.where(accept, order_rank, L))
+    idx = jnp.arange(L, dtype=jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
+
+    # ---- partition the physical row order at segment boundaries ----
+    # One fused gather instead of leaf_tile full-N column gathers (measured
+    # ~240 ms/round the sequential way at 400k x 2000): slice the <= tile
+    # accepted split features into a (tile, N) block (contiguous row reads
+    # of bins_t), gather the row order ONCE along the position axis, and
+    # select each position's own segment's row with an elementwise one-hot.
+    seg_id = jnp.full((n,), -1, jnp.int32)
+    seg_start = jnp.zeros((leaf_tile,), jnp.int32)
+    seg_len = jnp.zeros((leaf_tile,), jnp.int32)
+    ord_rows = state.order
+    leaf_of_rank = inv_rank[:leaf_tile]
+    live_rk = accept[leaf_of_rank]
+    feats_rk = jnp.where(live_rk, s.feature[leaf_of_rank], 0)
+    cols = bins_t[feats_rk]  # (tile, N) by ROW id
+    colv = cols[:, ord_rows].astype(jnp.int32)  # (tile, N) by POSITION
+    for r in range(leaf_tile):
+        leaf_r = leaf_of_rank[r]
+        live_r = live_rk[r]
+        st, ct = state.leaf_start[leaf_r], state.leaf_cnt[leaf_r]
+        seg_start = seg_start.at[r].set(jnp.where(live_r, st, 0))
+        seg_len = seg_len.at[r].set(jnp.where(live_r, ct, 0))
+        in_seg = live_r & (pos >= st) & (pos < st + ct)
+        seg_id = jnp.where(in_seg, r, seg_id)
+    sid = jnp.clip(seg_id, 0, leaf_tile - 1)
+    oh = (jnp.arange(leaf_tile, dtype=jnp.int32)[:, None] == sid[None, :])
+    # per-rank split scalars broadcast through the same one-hot — keeps
+    # every (N,)-shaped op elementwise (no small-table row gathers)
+    thr_rk = s.threshold_bin[leaf_of_rank][:, None]
+    dl_rk = s.default_left[leaf_of_rank][:, None]
+    mb_rk = missing_bin_pf[feats_rk][:, None]
+    vals = jnp.sum(jnp.where(oh, colv, 0), axis=0)
+    thr = jnp.sum(jnp.where(oh, thr_rk, 0), axis=0)
+    mb = jnp.sum(jnp.where(oh, mb_rk, -1), axis=0) + (leaf_tile - 1)
+    dl = jnp.any(oh & dl_rk, axis=0)
+    go_left = jnp.where(vals == mb, dl, vals <= thr)
+    new_order, left_counts = stable_partition_ranges(
+        ord_rows, seg_id, seg_start, seg_len, go_left)
+
+    # ---- leaf ranges + per-row leaf ids ----
+    leaf_start, leaf_cnt = state.leaf_start, state.leaf_cnt
+    lid_pos = state.leaf_id[new_order]  # leaf per POSITION (pre-split)
+    for r in range(leaf_tile):
+        leaf_r = inv_rank[r]
+        live_r = accept[leaf_r]
+        st = state.leaf_start[leaf_r]
+        lc = left_counts[r]
+        ct = state.leaf_cnt[leaf_r]
+        rp = jnp.clip(right_of[leaf_r], 0, L - 1)
+        leaf_start = jnp.where(
+            live_r, leaf_start.at[rp].set(st + lc), leaf_start)
+        leaf_cnt = jnp.where(
+            live_r, leaf_cnt.at[leaf_r].set(lc).at[rp].set(ct - lc), leaf_cnt)
+        in_right = live_r & (pos >= st + lc) & (pos < st + ct)
+        lid_pos = jnp.where(in_right, right_of[leaf_r], lid_pos)
+    leaf_id = jnp.zeros_like(state.leaf_id).at[new_order].set(lid_pos)
+
+    # ---- tree arrays (identical bookkeeping to round_body) ----
+    t = state.tree
+    parent_out = state.leaf_out
+    old_parent, old_side = state.leaf_parent, state.leaf_side
+    repoint_l = accept & (old_parent >= 0) & (old_side == 0)
+    repoint_r = accept & (old_parent >= 0) & (old_side == 1)
+    safe_node = jnp.clip(node_of, 0, L - 2)
+    lc_t = t.left_child.at[jnp.where(repoint_l, old_parent, 2 * L)].set(
+        safe_node, mode="drop")
+    rc_t = t.right_child.at[jnp.where(repoint_r, old_parent, 2 * L)].set(
+        safe_node, mode="drop")
+    node_pos = jnp.where(accept, node_of, 2 * L)
+    lc_t = lc_t.at[node_pos].set(-idx - 1, mode="drop")
+    rc_t = rc_t.at[node_pos].set(-right_of - 1, mode="drop")
+    tree = t._replace(
+        num_leaves=state.num_leaves_cur + k_acc,
+        split_feature=t.split_feature.at[node_pos].set(s.feature, mode="drop"),
+        threshold_bin=t.threshold_bin.at[node_pos].set(s.threshold_bin, mode="drop"),
+        default_left=t.default_left.at[node_pos].set(s.default_left, mode="drop"),
+        split_gain=t.split_gain.at[node_pos].set(s.gain, mode="drop"),
+        left_child=lc_t,
+        right_child=rc_t,
+        internal_value=t.internal_value.at[node_pos].set(parent_out, mode="drop"),
+        internal_weight=t.internal_weight.at[node_pos].set(state.leaf_sum_h, mode="drop"),
+        internal_count=t.internal_count.at[node_pos].set(state.leaf_count, mode="drop"),
+    )
+
+    right_pos = jnp.where(accept, right_of, 2 * L)
+
+    def upd(arr, left_val, right_val):
+        arr = jnp.where(accept, left_val, arr)
+        return arr.at[right_pos].set(right_val, mode="drop")
+
+    leaf_sum_g = upd(state.leaf_sum_g, s.left_sum_g, s.right_sum_g)
+    leaf_sum_h = upd(state.leaf_sum_h, s.left_sum_h, s.right_sum_h)
+    leaf_count = upd(state.leaf_count, s.left_count, s.right_count)
+    depth_child = state.leaf_depth + 1
+    leaf_depth = jnp.where(accept, depth_child, state.leaf_depth)
+    leaf_depth = leaf_depth.at[right_pos].set(depth_child, mode="drop")
+    leaf_parent = jnp.where(accept, node_of, state.leaf_parent)
+    leaf_parent = leaf_parent.at[right_pos].set(
+        jnp.where(accept, node_of, 0), mode="drop")
+    leaf_side = jnp.where(accept, 0, state.leaf_side)
+    leaf_side = leaf_side.at[right_pos].set(1, mode="drop")
+    out_l = leaf_output(s.left_sum_g, s.left_sum_h, params)
+    out_r = leaf_output(s.right_sum_g, s.right_sum_h, params)
+    leaf_out = jnp.where(accept, out_l, state.leaf_out)
+    leaf_out = leaf_out.at[right_pos].set(out_r, mode="drop")
+
+    # ---- fresh/small bookkeeping + the round's windows ----
+    left_smaller = s.left_count <= s.right_count
+    fresh = jnp.where(accept, True, jnp.zeros((L,), bool))
+    fresh = fresh.at[right_pos].set(True, mode="drop")
+    small_leaf = jnp.where(left_smaller, idx, right_of)
+    slot = jnp.where(accept, acc_rank, -1)
+    small_slot = jnp.full((L,), -1, jnp.int32)
+    small_pos = jnp.where(accept, small_leaf, 2 * L)
+    small_slot = small_slot.at[small_pos].set(slot, mode="drop")
+    sib = jnp.full((L,), -1, jnp.int32)
+    sib = jnp.where(accept, right_of, sib)
+    sib = sib.at[right_pos].set(idx, mode="drop")
+    hist = state.hist
+    hist = hist.at[right_pos].set(hist, mode="drop")  # parent snapshot
+
+    # windows: per admission rank, the SMALL child's [start, cnt)
+    win_start = jnp.zeros((leaf_tile,), jnp.int32)
+    win_cnt = jnp.zeros((leaf_tile,), jnp.int32)
+    for r in range(leaf_tile):
+        leaf_r = inv_rank[r]
+        live_r = accept[leaf_r]
+        sm = jnp.where(left_smaller[leaf_r], leaf_r,
+                       jnp.clip(right_of[leaf_r], 0, L - 1))
+        win_start = win_start.at[r].set(jnp.where(live_r, leaf_start[sm], 0))
+        win_cnt = win_cnt.at[r].set(jnp.where(live_r, leaf_cnt[sm], 0))
+
+    best = state.best._replace(
+        gain=jnp.where(fresh, jnp.full((L,), KMIN_SCORE, jnp.float32),
+                       state.best.gain))
+    state = WState(
+        order=new_order, leaf_start=leaf_start, leaf_cnt=leaf_cnt,
+        leaf_id=leaf_id, hist=hist, best=best,
+        leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h, leaf_count=leaf_count,
+        leaf_depth=leaf_depth, leaf_parent=leaf_parent, leaf_side=leaf_side,
+        num_leaves_cur=state.num_leaves_cur + k_acc, leaf_out=leaf_out,
+        tree=tree, fresh=fresh, small_slot=small_slot, sib=sib,
+    )
+    # one packed array -> ONE host transfer per round
+    info = jnp.concatenate([
+        k_acc[None], jnp.sum(win_cnt)[None], win_start, win_cnt,
+    ]).astype(jnp.int32)
+    return state, info
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_leaves", "num_bins", "params", "leaf_tile", "W",
+                     "use_pallas", "quantize_bins", "hist_precision"),
+)
+def _round_pass(
+    state: WState,
+    bins_t: jnp.ndarray,  # (F, N) int16
+    grad: jnp.ndarray,  # (N,) f32 by ROW id (dequantized under quant)
+    hess: jnp.ndarray,
+    gq: Optional[jnp.ndarray],  # (N,) int8 or None
+    hq: Optional[jnp.ndarray],
+    quant_scale: Optional[jnp.ndarray],  # (3,) or None
+    row_mask: jnp.ndarray,  # (N,) bool by ROW id
+    win_start: jnp.ndarray,  # (tile,)
+    win_cnt: jnp.ndarray,
+    num_bins_pf: jnp.ndarray,
+    missing_bin_pf: jnp.ndarray,
+    feature_mask: jnp.ndarray,
+    rng_key: Optional[jnp.ndarray],
+    feature_contri: Optional[jnp.ndarray],
+    *,
+    num_leaves: int,
+    num_bins: int,
+    params: SplitParams,
+    leaf_tile: int,
+    W: int,
+    use_pallas: bool,
+    quantize_bins: int,
+    hist_precision: str,
+):
+    """Phase 2: window gather -> one multi-leaf pass -> sibling subtraction
+    -> fresh-leaf split search."""
+    L = num_leaves
+    f = bins_t.shape[0]
+    idx = jnp.arange(L, dtype=jnp.int32)
+
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(win_cnt).astype(jnp.int32)])
+    total = offs[-1]
+    aw = jnp.arange(W, dtype=jnp.int32)
+    # slot per window element: number of window boundaries <= position
+    slot_of = jnp.sum((aw[:, None] >= offs[1:][None, :]).astype(jnp.int32),
+                      axis=1)
+    slot_of = jnp.clip(slot_of, 0, leaf_tile - 1)
+    wpos = win_start[slot_of] + (aw - offs[slot_of])
+    valid = aw < total
+    wpos = jnp.where(valid, wpos, 0)
+    rows = state.order[wpos]  # (W,) row ids
+
+    # feature-major window gather (a row gather on the (N, F) layout
+    # measured ~909 ms at 1M x 28; column slices of (F, N) are ~20x
+    # cheaper), then ONE contiguous transpose for the row-major kernel —
+    # a lane->sublane reshape per feature inside a feature-major kernel
+    # blew the 16M scoped-VMEM budget (measured 19.6M)
+    sub_bins = bins_t[:, rows].T  # (W, F)
+    mask_w = row_mask[rows] & valid
+    if quantize_bins and use_pallas:
+        hi = histogram_pallas_multi_quantized(
+            sub_bins, gq[rows], hq[rows], mask_w, slot_of, 0, leaf_tile,
+            num_bins)
+        fresh_hists = hi.astype(jnp.float32) * quant_scale
+    elif use_pallas:
+        fresh_hists = histogram_pallas_multi(
+            sub_bins, grad[rows], hess[rows], mask_w, slot_of, 0, leaf_tile,
+            num_bins, precision=hist_precision)
+    else:
+        # CPU/test fallback: masked scatter per slot over the window
+        g_w, h_w = grad[rows], hess[rows]
+
+        def one(sl):
+            m = (mask_w & (slot_of == sl)).astype(jnp.float32)
+            return histogram(sub_bins, g_w, h_w, m, num_bins,
+                             strategy="scatter")
+        fresh_hists = jax.vmap(one)(jnp.arange(leaf_tile, dtype=jnp.int32))
+
+    is_small = state.small_slot >= 0
+    small_pos = jnp.where(is_small, idx, 2 * L)
+    hist = state.hist.at[small_pos].set(
+        fresh_hists[jnp.clip(state.small_slot, 0, None)], mode="drop")
+    is_big = state.fresh & ~is_small
+    small_of_big = jnp.clip(state.sib, 0, L - 1)
+    big_sub = hist[idx] - hist[small_of_big]
+    hist = jnp.where(is_big[:, None, None, None], big_sub, hist)
+
+    # fresh-leaf split search (same slot-gather as treegrow_fast)
+    m_slots = min(2 * leaf_tile, L)
+    frm = state.fresh
+    fr_idx = jnp.argsort(jnp.where(frm, idx, L + idx))[:m_slots]
+    fr_ok = frm[fr_idx]
+    node_ids = jnp.clip(state.leaf_parent, 0, None) * 2 + state.leaf_side + 1
+    bb = _batched_best(
+        hist[fr_idx], state.leaf_sum_g[fr_idx], state.leaf_sum_h[fr_idx],
+        state.leaf_count[fr_idx], num_bins_pf, missing_bin_pf, params,
+        feature_mask, None, None, None,
+        jnp.full((m_slots,), -jnp.inf, jnp.float32),
+        jnp.full((m_slots,), jnp.inf, jnp.float32),
+        None, node_ids[fr_idx], rng_key,
+        depth=state.leaf_depth[fr_idx], parent_out=state.leaf_out[fr_idx],
+        feature_contri=feature_contri,
+    )
+    scatter_pos = jnp.where(fr_ok, fr_idx, 2 * L)
+
+    def merge(old, new):
+        return old.at[scatter_pos].set(new, mode="drop")
+
+    best = BestSplit(*[merge(o, nw) for o, nw in zip(state.best, bb)])
+    return state._replace(hist=hist, best=best,
+                          fresh=jnp.zeros((L,), bool),
+                          small_slot=jnp.full((L,), -1, jnp.int32),
+                          sib=jnp.full((L,), -1, jnp.int32))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_leaves", "num_bins", "params", "leaf_tile",
+                     "use_pallas", "quantize_bins", "hist_precision",
+                     "stochastic_rounding"),
+)
+def _w_init(
+    bins_t, grad, hess, row_mask, sample_weight, num_bins_pf,
+    missing_bin_pf, feature_mask, rng_key, quant_key, feature_contri,
+    *,
+    num_leaves: int,
+    num_bins: int,
+    params: SplitParams,
+    leaf_tile: int,
+    use_pallas: bool,
+    quantize_bins: int,
+    hist_precision: str,
+    stochastic_rounding: bool,
+):
+    """Root state: quantize gradients, run the one full-N pass, seed best."""
+    f, n = bins_t.shape
+    L = num_leaves
+    grad = grad.astype(jnp.float32) * sample_weight
+    hess = hess.astype(jnp.float32) * sample_weight
+    grad_true, hess_true = grad, hess
+
+    gq = hq = quant_scale = None
+    if quantize_bins:
+        half = max(quantize_bins // 2, 1)
+        inbag = row_mask.astype(jnp.float32)
+        g_scale = jnp.maximum(jnp.max(jnp.abs(grad) * inbag) / half, 1e-30)
+        h_scale = jnp.maximum(jnp.max(hess * inbag) / quantize_bins, 1e-30)
+        gs, hs = grad / g_scale, hess / h_scale
+        if stochastic_rounding:
+            kg, kh = jax.random.split(
+                quant_key if quant_key is not None else jax.random.PRNGKey(0))
+            gqf = jnp.floor(gs + jax.random.uniform(kg, gs.shape))
+            hqf = jnp.floor(hs + jax.random.uniform(kh, hs.shape))
+        else:
+            gqf, hqf = jnp.round(gs), jnp.round(hs)
+        gq = jnp.clip(gqf, -127, 127).astype(jnp.int8)
+        hq = jnp.clip(hqf, 0, 127).astype(jnp.int8)
+        grad = gq.astype(jnp.float32) * g_scale
+        hess = hq.astype(jnp.float32) * h_scale
+        quant_scale = jnp.stack([g_scale, h_scale, jnp.float32(1.0)])
+
+    if quantize_bins and use_pallas:
+        hist0 = histogram_pallas_multi_quantized(
+            bins_t.T, gq, hq, row_mask, jnp.zeros((n,), jnp.int32), 0, 1,
+            num_bins)[0].astype(jnp.float32) * quant_scale
+    elif use_pallas:
+        hist0 = histogram_pallas_multi(
+            bins_t.T, grad, hess, row_mask, jnp.zeros((n,), jnp.int32), 0, 1,
+            num_bins, precision=hist_precision)[0]
+    else:
+        hist0 = histogram(bins_t.T, grad, hess,
+                          row_mask.astype(jnp.float32), num_bins,
+                          strategy="scatter")
+    sum0 = jnp.sum(hist0[0], axis=0)
+    g0, h0, c0 = sum0[0], sum0[1], sum0[2]
+    leaf_out0 = leaf_output(g0, h0, params)
+
+    tree0 = TreeArrays(
+        num_leaves=jnp.asarray(1, jnp.int32),
+        split_feature=jnp.zeros((L - 1,), jnp.int32),
+        threshold_bin=jnp.zeros((L - 1,), jnp.int32),
+        default_left=jnp.zeros((L - 1,), bool),
+        split_gain=jnp.zeros((L - 1,), jnp.float32),
+        left_child=jnp.zeros((L - 1,), jnp.int32),
+        right_child=jnp.zeros((L - 1,), jnp.int32),
+        internal_value=jnp.zeros((L - 1,), jnp.float32),
+        internal_weight=jnp.zeros((L - 1,), jnp.float32),
+        internal_count=jnp.zeros((L - 1,), jnp.float32),
+        leaf_value=jnp.zeros((L,), jnp.float32),
+        leaf_weight=jnp.zeros((L,), jnp.float32),
+        leaf_count=jnp.zeros((L,), jnp.float32),
+        leaf_sum_g=jnp.zeros((L,), jnp.float32),
+        leaf_depth=jnp.zeros((L,), jnp.int32),
+        is_cat=jnp.zeros((L - 1,), bool),
+        cat_mask=jnp.zeros((L - 1, num_bins), bool),
+    )
+    best0 = _set_best(
+        _empty_best(L, num_bins), jnp.asarray(0),
+        jax.tree.map(
+            lambda a: a[0],
+            _batched_best(
+                hist0[None], jnp.asarray([g0]), jnp.asarray([h0]),
+                jnp.asarray([c0]), num_bins_pf, missing_bin_pf, params,
+                feature_mask, None, None, None,
+                jnp.asarray([-jnp.inf], jnp.float32),
+                jnp.asarray([jnp.inf], jnp.float32),
+                None, jnp.asarray([0], jnp.int32), rng_key,
+                depth=jnp.asarray([0.0], jnp.float32),
+                parent_out=jnp.asarray([leaf_out0]),
+                feature_contri=feature_contri,
+            ),
+        ),
+    )
+    state = WState(
+        order=jnp.arange(n, dtype=jnp.int32),
+        leaf_start=jnp.zeros((L,), jnp.int32),
+        leaf_cnt=jnp.zeros((L,), jnp.int32).at[0].set(n),
+        leaf_id=jnp.zeros((n,), jnp.int32),
+        hist=jnp.zeros((L, f, num_bins, 3), jnp.float32).at[0].set(hist0),
+        best=best0,
+        leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
+        leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(h0),
+        leaf_count=jnp.zeros((L,), jnp.float32).at[0].set(c0),
+        leaf_depth=jnp.zeros((L,), jnp.int32),
+        leaf_parent=jnp.full((L,), -1, jnp.int32),
+        leaf_side=jnp.zeros((L,), jnp.int32),
+        num_leaves_cur=jnp.asarray(1, jnp.int32),
+        leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(leaf_out0),
+        tree=tree0,
+        fresh=jnp.zeros((L,), bool),
+        small_slot=jnp.full((L,), -1, jnp.int32),
+        sib=jnp.full((L,), -1, jnp.int32),
+    )
+    return state, grad, hess, gq, hq, quant_scale, grad_true, hess_true
+
+
+@functools.partial(jax.jit, static_argnames=("params", "quant_renew"))
+def _w_finalize(state: WState, grad_true, hess_true, row_mask,
+                *, params: SplitParams, quant_renew: bool):
+    L = state.leaf_out.shape[0]
+    if quant_renew:
+        mrow = row_mask.astype(jnp.float32)
+        Gt = jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(
+            grad_true * mrow)
+        Ht = jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(
+            hess_true * mrow)
+        leaf_value = leaf_output(Gt, Ht, params)
+    else:
+        leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
+    active = jnp.arange(L, dtype=jnp.int32) < state.num_leaves_cur
+    tree = state.tree._replace(
+        num_leaves=state.num_leaves_cur,
+        leaf_value=jnp.where(active, leaf_value, 0.0),
+        leaf_weight=jnp.where(active, state.leaf_sum_h, 0.0),
+        leaf_count=jnp.where(active, state.leaf_count, 0.0),
+        leaf_sum_g=jnp.where(active, state.leaf_sum_g, 0.0),
+        leaf_depth=state.leaf_depth,
+    )
+    return tree, state.leaf_id
+
+
+def grow_tree_windowed(
+    bins_t: jnp.ndarray,  # (F, N) int16 feature-major
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    row_mask: jnp.ndarray,
+    sample_weight: jnp.ndarray,
+    feature_mask: jnp.ndarray,
+    num_bins_pf: jnp.ndarray,
+    missing_bin_pf: jnp.ndarray,
+    rng_key: Optional[jnp.ndarray] = None,
+    quant_key: Optional[jnp.ndarray] = None,
+    feature_contri: Optional[jnp.ndarray] = None,
+    *,
+    num_leaves: int,
+    num_bins: int,
+    max_depth: int = -1,
+    params: SplitParams = SplitParams(),
+    leaf_tile: int = 16,
+    hist_precision: str = "f32",
+    use_pallas: bool = True,
+    quantize_bins: int = 0,
+    stochastic_rounding: bool = True,
+    quant_renew: bool = False,
+) -> tuple[TreeArrays, jnp.ndarray]:
+    """Host-driven windowed growth; returns (tree, leaf_id per row)."""
+    common = dict(num_leaves=num_leaves, num_bins=num_bins, params=params,
+                  leaf_tile=leaf_tile)
+    state, g_d, h_d, gq, hq, qs, g_true, h_true = _w_init(
+        bins_t, grad, hess, row_mask, sample_weight, num_bins_pf,
+        missing_bin_pf, feature_mask, rng_key, quant_key, feature_contri,
+        use_pallas=use_pallas, quantize_bins=quantize_bins,
+        hist_precision=hist_precision,
+        stochastic_rounding=stochastic_rounding, **common)
+
+    import os
+    import time
+    prof = os.environ.get("LGBMTPU_WPROF") == "1"
+
+    n_leaves = 1
+    while n_leaves < num_leaves:
+        t0 = time.perf_counter() if prof else 0.0
+        state, info_d = _round_admit(
+            state, bins_t, missing_bin_pf, row_mask,
+            max_depth=max_depth, **common)
+        # the one host sync per round (~23 ms through the tunnel)
+        info = np.asarray(info_d)
+        t1 = time.perf_counter() if prof else 0.0
+        k_acc, total = int(info[0]), int(info[1])
+        if k_acc == 0:
+            break
+        n_leaves += k_acc
+        win_start = jnp.asarray(info[2:2 + leaf_tile])
+        win_cnt = jnp.asarray(info[2 + leaf_tile:])
+        W = _pow2_ge(total)
+        state = _round_pass(
+            state, bins_t, g_d, h_d, gq, hq, qs, row_mask,
+            win_start, win_cnt, num_bins_pf, missing_bin_pf, feature_mask,
+            rng_key, feature_contri,
+            W=W, use_pallas=use_pallas, quantize_bins=quantize_bins,
+            hist_precision=hist_precision, **common)
+        if prof:
+            _ = np.asarray(state.best.gain[:4])  # force the pass to finish
+            t2 = time.perf_counter()
+            print(f"[WPROF] k={k_acc:2d} total={total:7d} W={W:7d} "
+                  f"admit+sync={t1 - t0:6.3f}s pass={t2 - t1:6.3f}s",
+                  flush=True)
+
+    return _w_finalize(state, g_true, h_true, row_mask, params=params,
+                       quant_renew=bool(quant_renew and quantize_bins))

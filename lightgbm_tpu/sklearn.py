@@ -328,11 +328,14 @@ class LGBMClassifier(ClassifierMixin, LGBMModel):
         self.classes_ = self._le.classes_
         self.n_classes_ = len(self.classes_)
         if self.n_classes_ > 2:
-            obj = self.objective if isinstance(self.objective, str) else None
-            if obj is None or obj == "binary":
-                # binary cannot represent >2 classes — promote (reference
-                # wrapper: ova/multiclass switch on n_classes)
-                self.objective = "multiclass"
+            if not callable(self.objective):
+                obj = (self.objective
+                       if isinstance(self.objective, str) else None)
+                if obj is None or obj == "binary":
+                    # binary cannot represent >2 classes — promote
+                    # (reference wrapper: multiclass switch on n_classes);
+                    # callable custom objectives are kept as-is
+                    self.objective = "multiclass"
             self._other_params["num_class"] = self.n_classes_
             setattr(self, "num_class", self.n_classes_)
         return y_enc

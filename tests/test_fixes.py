@@ -256,3 +256,30 @@ def test_serialized_reference_is_inert_data():
     with pytest.raises(ValueError, match="magic"):
         dataset_from_serialized_reference(ctypes.addressof(arr2), len(bad),
                                           300, "")
+
+
+def test_save_binary_reload_trains_identically(tmp_path):
+    """save_binary checkpoints reload as a Dataset path (reference:
+    DatasetLoader::LoadFromBinFile): binned matrix + mappers round-trip and
+    training from the reload is bit-identical."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 6)
+    X[rng.rand(2000, 6) < 0.1] = np.nan
+    y = (np.nan_to_num(X) @ rng.randn(6) > 0).astype(float)
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 7,
+              "max_bin": 63}
+    ds = lgb.Dataset(X, label=y, params=params)
+    p = str(tmp_path / "d.bin")
+    ds.construct()
+    ds.save_binary(p)
+
+    ds2 = lgb.Dataset(p, params=params)
+    ds2.construct()
+    np.testing.assert_array_equal(np.asarray(ds.bins), np.asarray(ds2.bins))
+    for a, b in zip(ds.binner.mappers, ds2.binner.mappers):
+        assert a.missing_type == b.missing_type
+        np.testing.assert_array_equal(a.upper_bounds, b.upper_bounds)
+
+    b1 = lgb.train(params, lgb.Dataset(X, label=y, params=params), 5)
+    b2 = lgb.train(params, lgb.Dataset(p, params=params), 5)
+    assert b1.model_to_string() == b2.model_to_string()
