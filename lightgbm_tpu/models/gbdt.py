@@ -50,6 +50,24 @@ def _f32_threshold_upper(t: np.ndarray) -> np.ndarray:
     return np.where(bump, np.nextafter(t32, np.float32(np.inf)), t32)
 
 
+def _quantized_wide_default(*, on_tpu: bool, n_features: int,
+                            max_num_bins: int, tree_learner: str,
+                            tree_growth_mode: str, explicitly_set: bool,
+                            has_monotone: bool) -> bool:
+    """TPU device default for int8 quantized training: only the WIDE
+    wide-bin regime on the rounds grower, never overriding an explicit
+    user choice, never with monotone constraints (renewal interplay).
+    Pure predicate so the gate is unit-testable off-chip (the suite runs
+    CPU-pinned)."""
+    rounds_grower = (
+        tree_learner in ("serial", "data")
+        and (tree_growth_mode == "rounds"
+             or (tree_growth_mode == "auto" and on_tpu))
+    )
+    return (on_tpu and max_num_bins > 64 and n_features >= 256
+            and rounds_grower and not explicitly_set and not has_monotone)
+
+
 class GBDT:
     """reference: class GBDT in src/boosting/gbdt.h."""
 
@@ -289,16 +307,14 @@ class GBDT:
         )
         # growth scheduling: round-batched grower on TPU (tree_growth_mode)
         self._on_tpu = jax.devices()[0].platform == "tpu"
-        _mode = self.cfg.tree_growth_mode
-        _rounds_grower = (
-            self.cfg.tree_learner in ("serial", "data")
-            and (_mode == "rounds" or (_mode == "auto" and self._on_tpu))
-        )
-        if (self._on_tpu and train_set.max_num_bins > 64
-                and train_set.num_feature() >= 256
-                and _rounds_grower  # quantization lives on the rounds grower
-                and not self.cfg.is_set("use_quantized_grad")
-                and self._monotone is None):
+        if _quantized_wide_default(
+                on_tpu=self._on_tpu,
+                n_features=train_set.num_feature(),
+                max_num_bins=train_set.max_num_bins,
+                tree_learner=self.cfg.tree_learner,
+                tree_growth_mode=self.cfg.tree_growth_mode,
+                explicitly_set=self.cfg.is_set("use_quantized_grad"),
+                has_monotone=self._monotone is not None):
             # TPU device default for the WIDE wide-bin regime: int8
             # quantized training.  The int8 payload carries 3 channels/leaf
             # (no bf16x2 split), doubling the Mosaic kernel's leaf tile and

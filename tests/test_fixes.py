@@ -283,3 +283,40 @@ def test_save_binary_reload_trains_identically(tmp_path):
     b1 = lgb.train(params, lgb.Dataset(X, label=y, params=params), 5)
     b2 = lgb.train(params, lgb.Dataset(p, params=params), 5)
     assert b1.model_to_string() == b2.model_to_string()
+
+
+def test_quantized_wide_default_gate():
+    """The int8 wide-regime default is a TPU device default for the rounds
+    grower only; an explicit user choice or monotone constraints disable
+    it.  The gate is a pure predicate (models/gbdt.py) so the TPU branch
+    is testable on the CPU-pinned suite."""
+    from lightgbm_tpu.models.gbdt import _quantized_wide_default as gate
+
+    base = dict(on_tpu=True, n_features=2000, max_num_bins=256,
+                tree_learner="serial", tree_growth_mode="auto",
+                explicitly_set=False, has_monotone=False)
+    assert gate(**base) is True  # the Epsilon-class shape on TPU
+    assert gate(**{**base, "on_tpu": False}) is False  # CPU stays float
+    assert gate(**{**base, "n_features": 28}) is False  # narrow stays float
+    assert gate(**{**base, "max_num_bins": 64}) is False
+    assert gate(**{**base, "explicitly_set": True}) is False  # user wins
+    assert gate(**{**base, "has_monotone": True}) is False
+    assert gate(**{**base, "tree_growth_mode": "strict"}) is False
+    assert gate(**{**base, "tree_learner": "feature"}) is False
+    assert gate(**{**base, "tree_learner": "data"}) is True
+
+    # end-to-end on the CPU suite: the booster stays float and records an
+    # explicit choice
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 300).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    b = lgb.Booster(params={"objective": "binary", "max_bin": 255,
+                            "verbosity": -1},
+                    train_set=lgb.Dataset(X, label=y, params={"max_bin": 255}))
+    assert b._gbdt.cfg.use_quantized_grad is False
+    b2 = lgb.Booster(params={"objective": "binary", "max_bin": 255,
+                             "verbosity": -1, "use_quantized_grad": False},
+                     train_set=lgb.Dataset(X, label=y,
+                                           params={"max_bin": 255}))
+    assert b2._gbdt.cfg.is_set("use_quantized_grad")
+    assert b2._gbdt.cfg.use_quantized_grad is False
