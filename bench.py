@@ -184,13 +184,13 @@ def _pallas_smoke():
     out = np.asarray(jax.block_until_ready(out))
     elapsed = time.perf_counter() - t0
 
-    # numpy oracle for slot 0 / feature 0
+    # numpy oracle for slot 0 / feature 0 (out is channel-first (L, 3, F, B))
     ref = np.zeros((b, 3))
     sel = leaf == 0
     np.add.at(ref, bins[sel, 0], np.stack([g[sel], h[sel],
                                            np.ones(sel.sum())], axis=1))
-    ok = bool(np.allclose(out[0, 0, :, 0], ref[:, 0], atol=1e-2)
-              and np.allclose(out[0, 0, :, 2], ref[:, 2], atol=0.5))
+    ok = bool(np.allclose(out[0, 0, 0, :], ref[:, 0], atol=1e-2)
+              and np.allclose(out[0, 2, 0, :], ref[:, 2], atol=0.5))
     _STATE["workloads"]["pallas_smoke"] = {
         "ok": ok, "kernel_s": round(elapsed, 1),
         "platform": jax.devices()[0].platform}

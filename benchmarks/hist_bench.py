@@ -40,13 +40,13 @@ def main():
     hess = rng.rand(n).astype(np.float32)
     mask = (rng.rand(n) < 0.7)
 
-    # numpy reference
-    ref = np.zeros((f, b, 3), np.float64)
+    # numpy reference (channel-first (3, F, B) — package layout)
+    ref = np.zeros((3, f, b), np.float64)
     m = mask.astype(np.float64)
     for j in range(f):
-        ref[j, :, 0] = np.bincount(bins[:, j], weights=grad * m, minlength=b)
-        ref[j, :, 1] = np.bincount(bins[:, j], weights=hess * m, minlength=b)
-        ref[j, :, 2] = np.bincount(bins[:, j], weights=m, minlength=b)
+        ref[0, j] = np.bincount(bins[:, j], weights=grad * m, minlength=b)
+        ref[1, j] = np.bincount(bins[:, j], weights=hess * m, minlength=b)
+        ref[2, j] = np.bincount(bins[:, j], weights=m, minlength=b)
 
     db = jnp.asarray(bins)
     dg = jnp.asarray(grad)
@@ -94,14 +94,14 @@ def main():
                 ms, out = timeit(fn)
                 results[name] = ms
                 if refq is None:
-                    refq = np.zeros((f, b, 3), np.int64)
+                    refq = np.zeros((3, f, b), np.int64)
                     mq = mask.astype(np.int64)
                     gqn = np.asarray(gq, np.int64)
                     hqn = np.asarray(hq, np.int64)
                     for j in range(f):
-                        refq[j, :, 0] = np.bincount(bins[:, j], weights=gqn * mq, minlength=b)
-                        refq[j, :, 1] = np.bincount(bins[:, j], weights=hqn * mq, minlength=b)
-                        refq[j, :, 2] = np.bincount(bins[:, j], weights=mq, minlength=b)
+                        refq[0, j] = np.bincount(bins[:, j], weights=gqn * mq, minlength=b)
+                        refq[1, j] = np.bincount(bins[:, j], weights=hqn * mq, minlength=b)
+                        refq[2, j] = np.bincount(bins[:, j], weights=mq, minlength=b)
                 exact = np.array_equal(np.asarray(out, np.int64), refq)
                 print(f"  {name}: exact={'OK' if exact else 'FAIL'}")
             elif name.startswith("multi"):

@@ -41,7 +41,7 @@ def main():
     hess = jnp.asarray(rng.rand(n).astype(np.float32))
     mask = jnp.ones((n,), bool)
     leaf_id0 = jnp.asarray(rng.randint(0, 8, size=(n,)).astype(np.int32))
-    hist16 = jnp.asarray(rng.rand(16, F, B, 3).astype(np.float32))
+    hist16 = jnp.asarray(rng.rand(16, 3, F, B).astype(np.float32))
     params = SplitParams(min_data_in_leaf=20.0)
     nbpf = jnp.full((F,), B, jnp.int32)
     mbpf = jnp.full((F,), B - 1, jnp.int32)
@@ -120,7 +120,7 @@ def main():
     if "eval" in which:
         def one(hist, nid):
             return find_best_split(
-                hist, hist[:, :, 0].sum(), hist[:, :, 1].sum(), hist[:, :, 2].sum(),
+                hist, hist[0].sum(), hist[1].sum(), hist[2].sum(),
                 nbpf, mbpf, params, feature_mask=fmask, categorical_mask=None,
                 monotone_constraints=None,
                 out_lo=jnp.float32(-jnp.inf), out_hi=jnp.float32(jnp.inf),

@@ -35,7 +35,14 @@ methodology + full numbers in docs/PERF_NOTES.md):
   compile and run on-chip); the select-heavy experimental kernels that
   motivated the earlier 512 cap were removed after losing the benchmark.
 
-Channels convention of the package: (F, B, 3) = sum_grad, sum_hess, count.
+Channels convention of the package: CHANNEL-FIRST (3, F, B) with channels
+(sum_grad, sum_hess, count).  Channel-first is a measured TPU layout
+decision (docs/PERF_NOTES.md round 4/5): a trailing dim of 3 forces XLA's
+tiled layouts to pad the minor pair (B, 3) -> (B, 128) = 42.7x memory in
+every hist copy/scatter; with (3, F, B) the minor tile pair (F, B) pads
+~nothing at real shapes.  The reference makes the same device-driven
+layout choice in src/treelearner/cuda/cuda_histogram_constructor.cu
+(grad/hess interleaving picked for the GPU, not the host).
 """
 
 from __future__ import annotations
@@ -166,7 +173,7 @@ def histogram_pallas(
     precision: str = "f32",
     row_tile: int = 512,
 ) -> jnp.ndarray:
-    """Masked histogram -> (F, B, 3) f32, MXU-accumulated on device.
+    """Masked histogram -> (3, F, B) f32, MXU-accumulated on device.
 
     precision 'f32' packs bf16x2-split grad/hess into 8 payload lanes (same
     MXU cost as bf16; ~17-bit-mantissa products — see module docstring);
@@ -190,12 +197,12 @@ def histogram_pallas(
     )  # (F, NC, B)
     if precision == "f32":
         out3 = jnp.stack(
-            [out[:, 0] + out[:, 4], out[:, 1] + out[:, 5], out[:, 2]], axis=-1
-        )  # (F, B, 3)
+            [out[:, 0] + out[:, 4], out[:, 1] + out[:, 5], out[:, 2]], axis=0
+        )  # (3, F, B)
     else:
-        out3 = out[:, :3, :].transpose(0, 2, 1)
-    if out3.shape[1] != num_bins:
-        out3 = out3[:, :num_bins, :]
+        out3 = out[:, :3, :].transpose(1, 0, 2)
+    if out3.shape[2] != num_bins:
+        out3 = out3[:, :, :num_bins]
     return out3
 
 
@@ -214,7 +221,7 @@ def histogram_pallas_multi(
 ) -> jnp.ndarray:
     """Per-leaf histograms for a tile of leaves in ONE data pass.
 
-    Returns (L_tile, F, B, 3).  Channels are leaf-onehot x payload: lane
+    Returns (L_tile, 3, F, B).  Channels are leaf-onehot x payload: lane
     l*NCL + c holds payload channel c masked to leaf leaf_base+l.  With
     NCL=8 (f32 precision) a 128-lane payload covers 16 leaves per pass.
     This is the TPU replacement for per-leaf row-index histogramming
@@ -253,13 +260,13 @@ def histogram_pallas_multi(
     if precision == "f32":
         out3 = jnp.stack(
             [out[:, :, 0] + out[:, :, 3], out[:, :, 1] + out[:, :, 4], out[:, :, 2]],
-            axis=-1,
-        )  # (F, L_tile, B, 3)
+            axis=2,
+        )  # (F, L_tile, 3, B)
     else:
-        out3 = jnp.moveaxis(out[:, :, :3, :], 2, 3)
-    out3 = jnp.moveaxis(out3, 0, 1)  # (L_tile, F, B, 3)
-    if out3.shape[2] != num_bins:
-        out3 = out3[:, :, :num_bins, :]
+        out3 = out[:, :, :3, :]
+    out3 = jnp.transpose(out3, (1, 2, 0, 3))  # (L_tile, 3, F, B)
+    if out3.shape[3] != num_bins:
+        out3 = out3[:, :, :, :num_bins]
     return out3
 
 
@@ -295,7 +302,7 @@ def histogram_pallas_multi_quantized(
     row_tile: int = 1024,
 ) -> jnp.ndarray:
     """Quantized per-leaf histograms for a tile of leaves in one pass ->
-    (L_tile, F, B, 3) int32: exact integer accumulation on the int8 MXU
+    (L_tile, 3, F, B) int32: exact integer accumulation on the int8 MXU
     (reference: gradient_discretizer.cpp + per-leaf ConstructHistograms).
     Lanes are leaf-onehot x (grad_q, hess_q, count) int8 payload."""
     pay = quantized_leaf_payload(grad_q, hess_q, mask, leaf_id, leaf_base,
@@ -310,9 +317,9 @@ def histogram_pallas_multi_quantized(
     out = out[:, : num_leaves_tile * ncl, :].reshape(
         bins.shape[1], num_leaves_tile, ncl, -1
     )
-    out = jnp.moveaxis(jnp.moveaxis(out, 2, 3), 0, 1)  # (L_tile, F, B, 3)
-    if out.shape[2] != num_bins:
-        out = out[:, :, :num_bins, :]
+    out = jnp.transpose(out, (1, 2, 0, 3))  # (L_tile, 3, F, B)
+    if out.shape[3] != num_bins:
+        out = out[:, :, :, :num_bins]
     return out
 
 
@@ -325,7 +332,7 @@ def histogram_pallas_quantized(
     *,
     row_tile: int = 512,
 ) -> jnp.ndarray:
-    """Quantized histogram -> (F, B, 3) int32 (grad_sum, hess_sum, count):
+    """Quantized histogram -> (3, F, B) int32 (grad_sum, hess_sum, count):
     exact int32 accumulation on the int8 MXU (reference:
     src/treelearner/gradient_discretizer.cpp quantized-training path)."""
     m8 = mask.astype(jnp.int8)
@@ -336,7 +343,7 @@ def histogram_pallas_quantized(
     )
     out = _hist_pallas_raw(bins, pay, num_bins=num_bins, row_tile=row_tile,
                            matmul_dtype=jnp.int8)
-    out = out[:, :3, :].transpose(0, 2, 1)
-    if out.shape[1] != num_bins:
-        out = out[:, :num_bins, :]
+    out = out[:, :3, :].transpose(1, 0, 2)
+    if out.shape[2] != num_bins:
+        out = out[:, :, :num_bins]
     return out

@@ -14,7 +14,10 @@ choice (reference: src/io/train_share_states.cpp).
 
 Channels: 0 = sum_grad, 1 = sum_hess, 2 = count (reference keeps 2 doubles and
 recovers count; we keep an explicit count channel since f32 hessians do not
-always encode counts).
+always encode counts).  Layout is CHANNEL-FIRST (3, F, B) / (L, 3, F, B)
+everywhere — a trailing channel dim of 3 forces TPU tiled layouts to pad
+the minor pair (B, 3) -> (B, 128) = 42.7x in every hist copy (measured,
+docs/PERF_NOTES.md), while (F, B) minor tiles pad ~nothing.
 """
 
 from __future__ import annotations
@@ -34,7 +37,7 @@ def histogram_scatter(
     mask: jnp.ndarray,  # (N,) bool or f32 — rows contributing to this hist
     num_bins: int,
 ) -> jnp.ndarray:
-    """Masked histogram over all features: returns (F, B, 3) f32.
+    """Masked histogram over all features: returns (3, F, B) f32.
 
     Rows with mask=0 contribute zeros (they still scatter, but with zero
     payload) — this is the TPU analogue of histogramming only the rows of one
@@ -43,11 +46,11 @@ def histogram_scatter(
     n, f = bins.shape
     m = mask.astype(grad.dtype)
     flat_idx = bins.astype(jnp.int32) + (jnp.arange(f, dtype=jnp.int32) * num_bins)[None, :]
-    payload = jnp.stack([grad * m, hess * m, m], axis=-1)  # (N, 3)
-    payload = jnp.broadcast_to(payload[:, None, :], (n, f, NUM_CHANNELS))
-    hist = jnp.zeros((f * num_bins, NUM_CHANNELS), dtype=grad.dtype)
-    hist = hist.at[flat_idx].add(payload, mode="drop")
-    return hist.reshape(f, num_bins, NUM_CHANNELS)
+    payload = jnp.stack([grad * m, hess * m, m], axis=0)  # (3, N)
+    payload = jnp.broadcast_to(payload[:, :, None], (NUM_CHANNELS, n, f))
+    hist = jnp.zeros((NUM_CHANNELS, f * num_bins), dtype=grad.dtype)
+    hist = hist.at[:, flat_idx].add(payload, mode="drop")
+    return hist.reshape(NUM_CHANNELS, f, num_bins)
 
 
 def histogram_onehot_matmul(
@@ -79,11 +82,11 @@ def histogram_onehot_matmul(
     def body(acc, inp):
         b_tile, p_tile = inp  # (T, F), (T, 3)
         onehot = jax.nn.one_hot(b_tile.T, num_bins, dtype=grad.dtype)  # (F, T, B)
-        # (F, B, T) @ (T, 3) -> (F, B, 3)
-        h = jnp.einsum("ftb,tc->fbc", onehot, p_tile, precision=jax.lax.Precision.HIGHEST)
+        # (3, T) @ (F, T, B) -> (3, F, B)
+        h = jnp.einsum("ftb,tc->cfb", onehot, p_tile, precision=jax.lax.Precision.HIGHEST)
         return acc + h, None
 
-    init = jnp.zeros((f, num_bins, NUM_CHANNELS), dtype=grad.dtype)
+    init = jnp.zeros((NUM_CHANNELS, f, num_bins), dtype=grad.dtype)
     hist, _ = jax.lax.scan(body, init, (bins_t, pay_t))
     return hist
 
@@ -102,7 +105,7 @@ def histogram_onehot_multi(
     row_tile: int = 8192,
 ) -> jnp.ndarray:
     """Per-leaf histograms for a tile of leaves in ONE data pass, pure-XLA
-    einsum formulation -> (L_tile, F, B, 3) f32.
+    einsum formulation -> (L_tile, 3, F, B) f32.
 
     Same contract as hist_pallas.histogram_pallas_multi; payload lanes are
     leaf-onehot x bf16x2-split (grad, hess, count) so products carry ~17
@@ -146,21 +149,25 @@ def histogram_onehot_multi(
     def body(acc, inp):
         b_tile, p_tile = inp
         onehot = jax.nn.one_hot(b_tile.T, num_bins, dtype=jnp.bfloat16)  # (F, T, B)
+        # natural dot output (f, b, c) — the CPU backend's dot thunk
+        # rejects the lhs/rhs swap a "->cfb" spec induces for bf16 inputs
         hh = jnp.einsum("ftb,tc->fbc", onehot, p_tile,
                         preferred_element_type=jnp.float32)
         return acc + hh, None
 
     init = jnp.zeros((f, num_bins, c), jnp.float32)
     hist, _ = jax.lax.scan(body, init, (bins_t, pay_t))
-    hist = hist.reshape(f, num_bins, num_leaves_tile, ncl)
+    # one transpose per pass to the package's channel-first layout
+    hist = jnp.transpose(hist, (2, 0, 1)).reshape(
+        num_leaves_tile, ncl, f, num_bins)
     if precision == "f32":
         out3 = jnp.stack(
-            [hist[..., 0] + hist[..., 3], hist[..., 1] + hist[..., 4], hist[..., 2]],
-            axis=-1,
-        )  # (F, B, L_tile, 3)
+            [hist[:, 0] + hist[:, 3], hist[:, 1] + hist[:, 4], hist[:, 2]],
+            axis=1,
+        )  # (L_tile, 3, F, B)
     else:
         out3 = hist
-    return jnp.moveaxis(out3, 2, 0)  # (L_tile, F, B, 3)
+    return out3
 
 
 def histogram_onehot_multi_quantized(
@@ -176,7 +183,7 @@ def histogram_onehot_multi_quantized(
     row_tile: int = 8192,
 ) -> jnp.ndarray:
     """Quantized per-leaf histograms, pure-XLA int8 one-hot dot ->
-    (L_tile, F, B, 3) int32 with EXACT integer accumulation (reference:
+    (L_tile, 3, F, B) int32 with EXACT integer accumulation (reference:
     gradient_discretizer.cpp int16/int32 histogram buffers).
 
     The narrow-bin sibling of hist_pallas.histogram_pallas_multi_quantized:
@@ -203,14 +210,15 @@ def histogram_onehot_multi_quantized(
     def body(acc, inp):
         b_tile, p_tile = inp
         onehot = jax.nn.one_hot(b_tile.T, num_bins, dtype=jnp.int8)  # (F,T,B)
+        # natural dot output (f, b, c) — see histogram_onehot_multi
         hh = jnp.einsum("ftb,tc->fbc", onehot, p_tile,
                         preferred_element_type=jnp.int32)
         return acc + hh, None
 
     init = jnp.zeros((f, num_bins, c), jnp.int32)
     hist, _ = jax.lax.scan(body, init, (bins_t, pay_t))
-    hist = hist.reshape(f, num_bins, num_leaves_tile, ncl)
-    return jnp.moveaxis(hist, 2, 0)  # (L_tile, F, B, 3)
+    return jnp.transpose(hist, (2, 0, 1)).reshape(
+        num_leaves_tile, ncl, f, num_bins)  # (L_tile, 3, F, B)
 
 
 def histogram(

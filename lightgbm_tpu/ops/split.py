@@ -141,7 +141,8 @@ def _gain_l2(sum_g, sum_h, l1, l2, max_delta_step):
 
 
 def gain_plane(
-    hist: jnp.ndarray,  # (F, B, 3) f32 — per-feature histograms for ONE leaf
+    hist: jnp.ndarray,  # (3, F, B) f32 — per-feature histograms for ONE leaf
+    # (channel-first: the minor (F, B) tile pair lays out pad-free on TPU)
     parent_sum_g: jnp.ndarray,
     parent_sum_h: jnp.ndarray,
     parent_count: jnp.ndarray,
@@ -169,17 +170,16 @@ def gain_plane(
     the default direction.  Missing bin sits at index (num_bins-1) when
     present (binning.py), and is excluded from the cumulative scan.
     """
-    f, b, _ = hist.shape
+    _, f, b = hist.shape
     bins_idx = jnp.arange(b, dtype=jnp.int32)
 
     # zero-out the missing bin from the scan; keep its mass separately
     has_missing = missing_bin_per_feature >= 0  # (F,)
     is_missing_bin = bins_idx[None, :] == missing_bin_per_feature[:, None]  # (F, B)
-    hist_nm = jnp.where(is_missing_bin[..., None], 0.0, hist)
-    miss = jnp.sum(jnp.where(is_missing_bin[..., None], hist, 0.0), axis=1)  # (F, 3)
+    hist_nm = jnp.where(is_missing_bin[None], 0.0, hist)  # (3, F, B)
+    miss = jnp.sum(jnp.where(is_missing_bin[None], hist, 0.0), axis=2)  # (3, F)
 
-    cum = jnp.cumsum(hist_nm, axis=1)  # (F, B, 3) left stats at threshold=b
-    total_nm = cum[:, -1, :]  # (F, 3) non-missing totals
+    cum = jnp.cumsum(hist_nm, axis=2)  # (3, F, B) left stats at threshold=b
 
     # candidate validity: threshold t splits between bin t and t+1; the last
     # non-missing bin cannot be a threshold.
@@ -217,10 +217,10 @@ def gain_plane(
         gain_parent = leaf_gain(parent_g, parent_h, params)
 
     def eval_direction(missing_left: bool):
-        add = miss if missing_left else jnp.zeros_like(miss)
-        left_g = cum[..., 0] + add[:, None, 0]
-        left_h = cum[..., 1] + add[:, None, 1]
-        left_c = cum[..., 2] + add[:, None, 2]
+        add = miss if missing_left else jnp.zeros_like(miss)  # (3, F)
+        left_g = cum[0] + add[0][:, None]
+        left_h = cum[1] + add[1][:, None]
+        left_c = cum[2] + add[2][:, None]
         right_g = parent_g - left_g
         right_h = parent_h - left_h
         right_c = parent_count - left_c
@@ -295,11 +295,11 @@ def gain_plane(
             return _gain_l2(g_, h_, params.lambda_l1, l2c, params.max_delta_step)
 
         gain_parent_cat = cgain(parent_g, parent_h)
-        used = (hist_nm[..., 2] > 0) & ~is_missing_bin  # (F, B)
+        used = (hist_nm[2] > 0) & ~is_missing_bin  # (F, B)
         num_used = jnp.sum(used, axis=1)  # (F,)
         ratio = jnp.where(
             used,
-            hist_nm[..., 0] / (hist_nm[..., 1] + params.cat_smooth),
+            hist_nm[0] / (hist_nm[1] + params.cat_smooth),
             jnp.inf,
         )
 
@@ -314,10 +314,10 @@ def gain_plane(
         def eval_sorted(keys):
             order = jnp.argsort(keys, axis=1)  # (F, B) bin ids, unused last
             rank = jnp.argsort(order, axis=1)  # rank of each bin in the order
-            sh = jnp.take_along_axis(hist_nm, order[..., None], axis=1)
-            cum = jnp.cumsum(sh, axis=1)  # prefix stats; index k-1 = prefix len k
+            sh = jnp.take_along_axis(hist_nm, order[None], axis=2)  # (3, F, B)
+            cum = jnp.cumsum(sh, axis=2)  # prefix stats; index k-1 = prefix len k
             k_len = bins_idx[None, :] + 1  # (1, B) prefix length at index b
-            lg_, lh_, lc_ = cum[..., 0], cum[..., 1], cum[..., 2]
+            lg_, lh_, lc_ = cum[0], cum[1], cum[2]
             rg_, rh_, rc_ = parent_g - lg_, parent_h - lh_, parent_count - lc_
             # reference additionally caps each scan direction at half the
             # used bins ((used_bin + 1) / 2 in
@@ -338,17 +338,17 @@ def gain_plane(
             jnp.where(used, -ratio, jnp.inf)
         )
         # one-hot: bin b alone goes left
-        oh_l = hist_nm  # (F, B, 3)
+        oh_l = hist_nm  # (3, F, B)
         oh_ok = (
             used
             & cat_ok(
-                oh_l[..., 2], parent_count - oh_l[..., 2],
-                oh_l[..., 1], parent_h - oh_l[..., 1],
+                oh_l[2], parent_count - oh_l[2],
+                oh_l[1], parent_h - oh_l[1],
             )
         )
         gain_oh = (
-            cgain(oh_l[..., 0], oh_l[..., 1])
-            + cgain(parent_g - oh_l[..., 0], parent_h - oh_l[..., 1])
+            cgain(oh_l[0], oh_l[1])
+            + cgain(parent_g - oh_l[0], parent_h - oh_l[1])
             - gain_parent_cat
         )
         gain_oh = jnp.where(oh_ok, gain_oh, KMIN_SCORE)
@@ -460,7 +460,7 @@ def select_from_plane(gain: jnp.ndarray, ctx: dict) -> BestSplit:
 
         def pick_cat():
             stats = [
-                (oh_l[..., 0], oh_l[..., 1], oh_l[..., 2]),
+                (oh_l[0], oh_l[1], oh_l[2]),
                 st_asc,
                 st_desc,
             ]
@@ -521,7 +521,7 @@ def find_best_split(
 
 
 def forced_split_candidate(
-    hist: jnp.ndarray,  # (F, B, 3) — the target leaf's histograms
+    hist: jnp.ndarray,  # (3, F, B) — the target leaf's histograms
     parent_sum_g, parent_sum_h, parent_count,
     num_bins_per_feature, missing_bin_per_feature,
     params: SplitParams,
@@ -535,7 +535,7 @@ def forced_split_candidate(
     gain machinery so min_data/min_hess/monotone gates still apply).  Shared
     by the strict and rounds growers; validity = `gain > KMIN_SCORE / 2` on
     the returned split, checked by the caller along with leaf/depth gates."""
-    f, b, _ = hist.shape
+    _, f, b = hist.shape
     cell = (
         (jnp.arange(f, dtype=jnp.int32)[:, None] == forced_feature)
         & (jnp.arange(b, dtype=jnp.int32)[None, :] == forced_bin)

@@ -66,7 +66,7 @@ class TreeArrays(NamedTuple):
 
 class GrowState(NamedTuple):
     leaf_id: jnp.ndarray  # (N,) i32
-    hist: jnp.ndarray  # (L, F, B, 3)
+    hist: jnp.ndarray  # (L, 3, F, B) — channel-first (see ops/histogram.py)
     best: BestSplit  # vectorized over L
     leaf_sum_g: jnp.ndarray  # (L,)
     leaf_sum_h: jnp.ndarray
@@ -283,7 +283,7 @@ def grow_tree(
             # PV-Tree (reference: voting_parallel_tree_learner.cpp): each
             # shard votes its top_k features by LOCAL gain; the global tally
             # elects ~2*top_k features whose histograms alone are merged.
-            loc = jnp.sum(hist_leaf[0], axis=0)  # local leaf totals (3,)
+            loc = jnp.sum(hist_leaf[:, 0, :], axis=1)  # local leaf totals (3,)
             local_gain, _ = gain_plane(
                 hist_leaf, loc[0], loc[1], loc[2],
                 num_bins_per_feature, missing_bin_per_feature, params, **kw,
@@ -302,7 +302,7 @@ def grow_tree(
             # (built from the psum'd tally), so el_idx is identical on every
             # shard and the collective stays congruent.
             _, el_idx = jax.lax.top_k(score, n_elect)
-            sub_hist = jax.lax.psum(hist_leaf[el_idx], axis_name)  # (E, B, 3)
+            sub_hist = jax.lax.psum(hist_leaf[:, el_idx], axis_name)  # (3, E, B)
 
             def sub(arr):
                 return None if arr is None else arr[el_idx]
@@ -366,7 +366,7 @@ def grow_tree(
     # --- leaf 0: all in-bag rows ---
     mask0 = row_mask.astype(jnp.float32)
     hist0 = leaf_hist(mask0)
-    sum0 = jnp.sum(hist0[0], axis=0)  # totals from feature 0's hist: (3,)
+    sum0 = jnp.sum(hist0[:, 0, :], axis=1)  # totals from feature 0's hist: (3,)
     if mode == "voting":
         sum0 = psum(sum0)  # local hists in voting mode; leaf stats are global
     g0, h0, c0 = sum0[0], sum0[1], sum0[2]
@@ -400,7 +400,7 @@ def grow_tree(
 
     state = GrowState(
         leaf_id=jnp.zeros((n,), jnp.int32),
-        hist=jnp.zeros((L, f, num_bins, 3), jnp.float32).at[0].set(hist0),
+        hist=jnp.zeros((L, 3, f, num_bins), jnp.float32).at[0].set(hist0),
         best=_set_best(
             _empty_best(L, num_bins), jnp.asarray(0),
             best_for(

@@ -81,7 +81,8 @@ def predict_leaf_arrays(
 
 class FastState(NamedTuple):
     leaf_id: jnp.ndarray  # (N,) i32
-    hist: jnp.ndarray  # (L, F, B, 3) f32
+    hist: jnp.ndarray  # (L, 3, F, B) f32 — channel-first: the minor (F, B)
+    # tile pair pads ~nothing on TPU, vs 42.7x for a trailing dim of 3
     best: BestSplit  # vectorized over L (gain=KMIN for unevaluated leaves)
     leaf_sum_g: jnp.ndarray  # (L,)
     leaf_sum_h: jnp.ndarray
@@ -110,7 +111,7 @@ class FastState(NamedTuple):
 
 
 def _batched_best(
-    hist_batch,  # (L, F, B, 3)
+    hist_batch,  # (L, 3, F, B)
     sum_g, sum_h, count,  # (L,)
     num_bins_pf, missing_bin_pf, params,
     feature_mask, categorical_mask, monotone, interaction_sets,
@@ -259,27 +260,27 @@ def grow_tree_fast(
     hist_bins = bins if efb_bins is None else efb_bins
 
     def unbundle(h):
-        """(tile, F_b, B, 3) bundle hists -> (tile, F, B, 3) per-feature
+        """(tile, 3, F_b, B) bundle hists -> (tile, 3, F, B) per-feature
         hists: gather each feature's non-default slots; its default-bin row
         is leaf_total - sum(non-default) (reference most-freq-bin
         subtraction; see io/efb.py)."""
         if efb_gather is None:
             return h
         tile = h.shape[0]
-        flat = h.reshape(tile, -1, 3)
+        flat = h.reshape(tile, 3, -1)
         flat = jnp.concatenate(
-            [flat, jnp.zeros((tile, 1, 3), h.dtype)], axis=1
+            [flat, jnp.zeros((tile, 3, 1), h.dtype)], axis=2
         )
-        hf = flat[:, efb_gather.reshape(-1), :].reshape(tile, f, num_bins, 3)
-        leaf_tot = jnp.sum(h[:, 0, :, :], axis=1)  # (tile, 3)
-        nondef = jnp.sum(hf, axis=2)  # (tile, F, 3)
-        fill = leaf_tot[:, None, :] - nondef
+        hf = flat[:, :, efb_gather.reshape(-1)].reshape(tile, 3, f, num_bins)
+        leaf_tot = jnp.sum(h[:, :, 0, :], axis=2)  # (tile, 3)
+        nondef = jnp.sum(hf, axis=3)  # (tile, 3, F)
+        fill = leaf_tot[:, :, None] - nondef
         return hf + jnp.where(
-            efb_default[None, :, :, None], fill[:, :, None, :], jnp.zeros((), h.dtype)
+            efb_default[None, None], fill[..., None], jnp.zeros((), h.dtype)
         )
 
     def multi_hist(leaf_slot, tile):
-        """(N,)-slot -> (tile, F, B, 3) f32: per-slot histograms, one pass."""
+        """(N,)-slot -> (tile, 3, F, B) f32: per-slot histograms, one pass."""
         if use_pallas and quantize_bins:
             if num_bins <= 64:
                 # same measured strategy selection as the float path: XLA's
@@ -294,7 +295,7 @@ def grow_tree_fast(
                     hist_bins, gq, hq, row_mask & (leaf_slot >= 0),
                     jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
                 )
-            h = unbundle(hi).astype(jnp.float32) * quant_scale
+            h = unbundle(hi).astype(jnp.float32) * quant_scale[:, None, None]
         elif use_pallas and num_bins <= 64:
             # measured strategy selection (ops/histogram.py docstring): at
             # narrow bins XLA's fused one-hot einsum beats the Pallas kernel
@@ -323,7 +324,7 @@ def grow_tree_fast(
 
     # ---- root ----
     hist0 = multi_hist(jnp.where(row_mask, 0, -1).astype(jnp.int32), 1)[0]
-    sum0 = jnp.sum(hist0[0], axis=0)
+    sum0 = jnp.sum(hist0[:, 0, :], axis=1)  # totals from feature 0: (3,)
     g0, h0, c0 = sum0[0], sum0[1], sum0[2]
 
     tree0 = TreeArrays(
@@ -392,7 +393,7 @@ def grow_tree_fast(
 
     state = FastState(
         leaf_id=jnp.zeros((n,), jnp.int32),
-        hist=jnp.zeros((L, f, num_bins, 3), jnp.float32).at[0].set(hist0),
+        hist=jnp.zeros((L, 3, f, num_bins), jnp.float32).at[0].set(hist0),
         best=best0,
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
         leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(h0),
@@ -733,7 +734,7 @@ def grow_tree_fast(
             leaf_r = jnp.argmax(has_r).astype(jnp.int32)
             exists = jnp.any(has_r)
             leaf_slot = jnp.where(exists & (lid == leaf_r), r, leaf_slot)
-        fresh_hists = multi_hist(leaf_slot, leaf_tile)  # (leaf_tile, F, B, 3)
+        fresh_hists = multi_hist(leaf_slot, leaf_tile)  # (leaf_tile, 3, F, B)
         idx = jnp.arange(L, dtype=jnp.int32)
         is_small = state.small_slot >= 0
         # write small-child hists

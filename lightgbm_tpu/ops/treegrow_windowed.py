@@ -56,7 +56,7 @@ class WState(NamedTuple):
     leaf_start: jnp.ndarray  # (L,) i32 — position of each leaf's range
     leaf_cnt: jnp.ndarray  # (L,) i32
     leaf_id: jnp.ndarray  # (N,) i32 — leaf per ROW (for score updates)
-    hist: jnp.ndarray  # (L, F, B, 3) f32
+    hist: jnp.ndarray  # (L, 3, F, B) f32 — channel-first (ops/histogram.py)
     best: BestSplit
     leaf_sum_g: jnp.ndarray
     leaf_sum_h: jnp.ndarray
@@ -336,7 +336,7 @@ def _round_pass(
         hi = histogram_pallas_multi_quantized(
             sub_bins, gq[rows], hq[rows], mask_w, slot_of, 0, leaf_tile,
             num_bins)
-        fresh_hists = hi.astype(jnp.float32) * quant_scale
+        fresh_hists = hi.astype(jnp.float32) * quant_scale[:, None, None]
     elif use_pallas:
         fresh_hists = histogram_pallas_multi(
             sub_bins, grad[rows], hess[rows], mask_w, slot_of, 0, leaf_tile,
@@ -437,7 +437,7 @@ def _w_init(
     if quantize_bins and use_pallas:
         hist0 = histogram_pallas_multi_quantized(
             bins_t.T, gq, hq, row_mask, jnp.zeros((n,), jnp.int32), 0, 1,
-            num_bins)[0].astype(jnp.float32) * quant_scale
+            num_bins)[0].astype(jnp.float32) * quant_scale[:, None, None]
     elif use_pallas:
         hist0 = histogram_pallas_multi(
             bins_t.T, grad, hess, row_mask, jnp.zeros((n,), jnp.int32), 0, 1,
@@ -446,7 +446,7 @@ def _w_init(
         hist0 = histogram(bins_t.T, grad, hess,
                           row_mask.astype(jnp.float32), num_bins,
                           strategy="scatter")
-    sum0 = jnp.sum(hist0[0], axis=0)
+    sum0 = jnp.sum(hist0[:, 0, :], axis=1)  # totals from feature 0: (3,)
     g0, h0, c0 = sum0[0], sum0[1], sum0[2]
     leaf_out0 = leaf_output(g0, h0, params)
 
@@ -491,7 +491,7 @@ def _w_init(
         leaf_start=jnp.zeros((L,), jnp.int32),
         leaf_cnt=jnp.zeros((L,), jnp.int32).at[0].set(n),
         leaf_id=jnp.zeros((n,), jnp.int32),
-        hist=jnp.zeros((L, f, num_bins, 3), jnp.float32).at[0].set(hist0),
+        hist=jnp.zeros((L, 3, f, num_bins), jnp.float32).at[0].set(hist0),
         best=best0,
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
         leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(h0),

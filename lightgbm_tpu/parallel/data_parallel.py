@@ -9,7 +9,7 @@ Allreduce(max-gain SplitInfo), GlobalSyncUpBySum).
 Mapping (SURVEY.md §3.5):
   * rows sharded over the mesh DATA_AXIS (reference: pre_partition row split);
   * each shard histograms its local rows, then `jax.lax.psum` merges the
-    (F, B, 3) histogram across the axis — standing in for the reference's
+    (3, F, B) histogram across the axis — standing in for the reference's
     ReduceScatter + per-rank feature ownership.  Because every shard then
     holds the GLOBAL histogram, split finding is replicated and the
     SyncUpGlobalBestSplit Allreduce disappears entirely: all shards compute
@@ -231,7 +231,7 @@ def grow_tree_fast_data_parallel(
 ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Round-batched grower under SPMD data parallelism: each shard runs the
     multi-leaf histogram pass over its rows, one psum per round merges the
-    (tile, F, B, 3) block, and every shard applies the identical splits
+    (tile, 3, F, B) block, and every shard applies the identical splits
     (reference analogue: DataParallelTreeLearner with the multi-leaf pass
     replacing per-split ReduceScatter rounds).  Intermediate monotone
     bounds work unchanged: leaf aggregates are psummed, so every shard's

@@ -58,12 +58,12 @@ def test_efb_histograms_match_unbundled():
     hb = np.asarray(histogram_scatter(
         jnp.asarray(efb.bundled_bins), jnp.asarray(grad), jnp.asarray(hess),
         jnp.ones((n,), bool), B,
-    ))
-    flat = np.concatenate([hb.reshape(-1, 3), np.zeros((1, 3))], axis=0)
-    hf = flat[efb.gather_idx.reshape(-1)].reshape(f, B, 3)
-    tot = hb[0].sum(axis=0)
-    fill = tot[None, :] - hf.sum(axis=1)
-    hf = hf + efb.default_mask[:, :, None] * fill[:, None, :]
+    ))  # (3, F_b, B) channel-first
+    flat = np.concatenate([hb.reshape(3, -1), np.zeros((3, 1))], axis=1)
+    hf = flat[:, efb.gather_idx.reshape(-1)].reshape(3, f, B)
+    tot = hb[:, 0].sum(axis=1)  # (3,) leaf totals
+    fill = tot[:, None] - hf.sum(axis=2)  # (3, F)
+    hf = hf + efb.default_mask[None] * fill[:, :, None]
     direct = np.asarray(histogram_scatter(
         ds.bins_device, jnp.asarray(grad), jnp.asarray(hess),
         jnp.ones((n,), bool), B,

@@ -166,7 +166,7 @@ def test_onehot_multi_bf16_precision():
     lid = jnp.asarray(rng.randint(0, L, size=(n,)).astype(np.int32))
     out = histogram_onehot_multi(bins, grad, hess, mask, lid, 0, L, B,
                                  precision="bf16")
-    assert out.shape == (L, F, B, 3)
+    assert out.shape == (L, 3, F, B)
     ref = histogram_scatter(bins, grad, hess, (lid == 0).astype(jnp.float32), B)
     scale = np.abs(np.asarray(ref)).max() + 1
     rel = np.max(np.abs(np.asarray(out[0]) - np.asarray(ref))) / scale

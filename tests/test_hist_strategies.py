@@ -22,7 +22,7 @@ def test_onehot_multi_matches_scatter_per_leaf(B):
     lid = jnp.asarray(rng.randint(0, L, size=(n,)).astype(np.int32))
 
     out = histogram_onehot_multi(bins, grad, hess, mask, lid, 0, L, B)
-    assert out.shape == (L, F, B, 3)
+    assert out.shape == (L, 3, F, B)
     for leaf in range(L):
         m = (mask & (lid == leaf)).astype(jnp.float32)
         ref = histogram_scatter(bins, grad, hess, m, B)
@@ -64,13 +64,13 @@ def test_pallas_hist_paths_trace_on_cpu():
         out = jax.eval_shape(
             lambda b, g_, h_, m_: histogram_pallas(b, g_, h_, m_, 63),
             bins, g, h, m)
-        assert out.shape == (f, 63, 3)
+        assert out.shape == (3, f, 63)
         lid = jnp.zeros((n,), jnp.int32)
         out = jax.eval_shape(
             lambda b, g_, h_, m_, l_: histogram_pallas_multi(
                 b, g_, h_, m_, l_, 0, 4, 63),
             bins, g, h, m, lid)
-        assert out.shape == (4, f, 63, 3)
+        assert out.shape == (4, 3, f, 63)
 
 
 def test_quantized_onehot_multi_exact_int32():
@@ -89,13 +89,13 @@ def test_quantized_onehot_multi_exact_int32():
         jnp.asarray(bins), jnp.asarray(gq), jnp.asarray(hq),
         jnp.asarray(mask), jnp.asarray(leaf), 0, tile, B))
     assert out.dtype == np.int32
-    ref = np.zeros((tile, f, B, 3), np.int64)
+    ref = np.zeros((tile, 3, f, B), np.int64)
     for l in range(tile):
         m = mask & (leaf == l)
         for c, v in enumerate((gq.astype(np.int64), hq.astype(np.int64),
                                np.ones(n, np.int64))):
             for j in range(f):
-                ref[l, j, :, c] = np.bincount(
+                ref[l, c, j, :] = np.bincount(
                     bins[m, j], weights=v[m], minlength=B)[:B]
     np.testing.assert_array_equal(out.astype(np.int64), ref)
 

@@ -34,7 +34,8 @@ def test_histogram_matches_oracle(fn):
     mask = (rng.rand(n) < 0.7).astype(np.float32)
     hist = np.asarray(fn(jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(mask), b))
     oracle = _oracle_hist(bins, grad, hess, mask, b)
-    np.testing.assert_allclose(hist, oracle, rtol=1e-4, atol=1e-4)
+    # package layout is channel-first (3, F, B); oracle builds (F, B, 3)
+    np.testing.assert_allclose(hist, oracle.transpose(2, 0, 1), rtol=1e-4, atol=1e-4)
 
 
 def _oracle_best_split(hist, nbins, miss_bin, params: SplitParams):
@@ -89,7 +90,7 @@ def test_split_matches_oracle():
         hist[j] *= scale[None, :]
 
     s = find_best_split(
-        jnp.asarray(hist),
+        jnp.asarray(hist.transpose(2, 0, 1)),  # channel-first (3, F, B)
         jnp.asarray(tot[0]),
         jnp.asarray(tot[1]),
         jnp.asarray(tot[2]),
