@@ -290,6 +290,14 @@ class Dataset:
                         "label": (z["label"] if z["label"].size else None),
                         "weight": (z["weight"] if z["weight"].size else None),
                         "group": (z["group"] if z["group"].size else None),
+                        "init_score": (
+                            z["init_score"]
+                            if "init_score" in z.files and z["init_score"].size
+                            else None),
+                        "position": (
+                            z["position"]
+                            if "position" in z.files and z["position"].size
+                            else None),
                         "feature_names": [str(x) for x in z["feature_names"]],
                     }
             elif cfg.two_round:
@@ -357,6 +365,10 @@ class Dataset:
                 self.weight = np.asarray(loaded["weight"], np.float64).ravel()
             if self.group is None and loaded.get("group") is not None:
                 self.group = np.asarray(loaded["group"], np.int64).ravel()
+            if self.init_score is None and loaded.get("init_score") is not None:
+                self.init_score = np.asarray(loaded["init_score"], np.float64)
+            if self.position is None and loaded.get("position") is not None:
+                self.position = np.asarray(loaded["position"], np.int64).ravel()
             if self.feature_name == "auto":
                 self.feature_name = list(loaded["feature_names"])
         # sparse inputs are binned straight from CSC (reference:
@@ -730,6 +742,12 @@ class Dataset:
             label=self.label if self.label is not None else np.zeros(0),
             weight=self.weight if self.weight is not None else np.zeros(0),
             group=self.group if self.group is not None else np.zeros(0, np.int64),
+            # reference Metadata persists init_score and positions too
+            # (SaveBinaryFile/LoadFromBinFile round-trip)
+            init_score=(self.init_score if self.init_score is not None
+                        else np.zeros(0)),
+            position=(self.position if self.position is not None
+                      else np.zeros(0, np.int64)),
             uppers=np.concatenate([np.asarray(m.upper_bounds, np.float64)
                                    for m in ms]),
             upper_sizes=np.asarray([len(m.upper_bounds) for m in ms]),

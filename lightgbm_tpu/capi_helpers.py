@@ -345,8 +345,17 @@ def _predict_kw(start_iteration: int = 0, num_iteration: int = -1,
     """Predict kwargs from the reference C predict-entry triple
     (start_iteration, num_iteration, parameter).  The explicit C arguments
     win over any duplicates inside the parameter string (reference:
-    LGBM_BoosterPredictForMat passes them straight into the Config)."""
+    LGBM_BoosterPredictForMat passes them straight into the Config).
+    Predict-MODE keys are dropped too: the C predict_type argument is
+    authoritative and _predict_any_into passes the matching kwarg
+    explicitly — forwarding a duplicate from the string would raise
+    TypeError where the reference Config just tolerates it."""
     kw = _parse_params(parameter or "")
+    for mode_key in ("raw_score", "predict_raw_score", "pred_leaf",
+                     "predict_leaf_index", "pred_contrib", "predict_contrib",
+                     "leaf_index", "contrib", "is_predict_raw_score",
+                     "is_predict_leaf_index", "is_predict_contrib"):
+        kw.pop(mode_key, None)
     kw["start_iteration"] = int(start_iteration)
     kw["num_iteration"] = int(num_iteration)
     return kw

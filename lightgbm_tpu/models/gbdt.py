@@ -53,14 +53,18 @@ def _f32_threshold_upper(t: np.ndarray) -> np.ndarray:
 def _quantized_wide_default(*, on_tpu: bool, n_features: int,
                             max_num_bins: int, tree_learner: str,
                             tree_growth_mode: str, explicitly_set: bool,
-                            has_monotone: bool) -> bool:
+                            has_monotone: bool, device_count: int = 1) -> bool:
     """TPU device default for int8 quantized training: only the WIDE
     wide-bin regime on the rounds grower, never overriding an explicit
     user choice, never with monotone constraints (renewal interplay).
     Pure predicate so the gate is unit-testable off-chip (the suite runs
-    CPU-pinned)."""
+    CPU-pinned).  tree_learner='data' takes the rounds grower only with
+    multiple devices (_use_fast_dp's gate); single-device 'data' runs the
+    strict grower, which trains float — enabling the default there would
+    just produce contradictory logs."""
     rounds_grower = (
-        tree_learner in ("serial", "data")
+        (tree_learner == "serial"
+         or (tree_learner == "data" and device_count > 1))
         and (tree_growth_mode == "rounds"
              or (tree_growth_mode == "auto" and on_tpu))
     )
@@ -314,7 +318,8 @@ class GBDT:
                 tree_learner=self.cfg.tree_learner,
                 tree_growth_mode=self.cfg.tree_growth_mode,
                 explicitly_set=self.cfg.is_set("use_quantized_grad"),
-                has_monotone=self._monotone is not None):
+                has_monotone=self._monotone is not None,
+                device_count=jax.device_count()):
             # TPU device default for the WIDE wide-bin regime: int8
             # quantized training.  The int8 payload carries 3 channels/leaf
             # (no bf16x2 split), doubling the Mosaic kernel's leaf tile and
@@ -646,16 +651,15 @@ class GBDT:
         """Wide-regime windowed grower gate (ops/treegrow_windowed.py).
 
         The windowed grower shrinks each histogram pass from full-N to the
-        round's small-children window.  Measured at Epsilon (400k x 2000 x
-        255 bins, 255 leaves, int8): the pass itself drops ~200 ms ->
-        ~30 ms as designed, but per-round FIXED costs (admit bookkeeping
-        ~0.14 s + ~0.2 s of hist-state ops whose (L, F, B, 3) trailing
-        dim forces 42x-padded tiled layouts — see PERF_NOTES round 4)
-        leave it at parity with the full-pass grower (~5.5 vs 5.06
-        s/iter).  OPT-IN until the hist-layout rework lands:
-        windowed_growth=true enables it.  Its v1 feature envelope
-        excludes the rarer options below; anything outside falls back to
-        the full-pass rounds grower, which supports everything."""
+        round's small-children window (pass ~200 ms -> ~30 ms at Epsilon,
+        400k x 2000 x 255 bins).  The round-4 parity blocker — per-round
+        fixed costs from the old (L, F, B, 3) hist state's 42x-padded
+        tiled layouts — is addressed by the round-5 channel-first
+        (L, 3, F, B) rework (see ops/histogram.py); measured numbers in
+        docs/PERF_NOTES.md round 5.  Still OPT-IN via
+        windowed_growth=true.  Its v1 feature envelope excludes the rarer
+        options below; anything outside falls back to the full-pass
+        rounds grower, which supports everything."""
         return (
             self._on_tpu
             and bool(self.cfg.extra.get("windowed_growth", False))

@@ -285,6 +285,33 @@ def test_save_binary_reload_trains_identically(tmp_path):
     assert b1.model_to_string() == b2.model_to_string()
 
 
+def test_save_binary_preserves_init_score_and_position(tmp_path):
+    """Metadata init_score/position survive the binary round-trip
+    (reference: Metadata::SaveBinaryToFile persists init_score_ and
+    positions_; a reload that silently dropped them would retrain
+    differently)."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(500, 4)
+    y = (X @ rng.randn(4) > 0).astype(float)
+    init = rng.randn(500)
+    pos = rng.randint(0, 10, 500).astype(np.int64)
+    params = {"objective": "binary", "verbosity": -1, "max_bin": 63}
+    ds = lgb.Dataset(X, label=y, init_score=init, position=pos, params=params)
+    p = str(tmp_path / "d.bin")
+    ds.construct()
+    ds.save_binary(p)
+
+    ds2 = lgb.Dataset(p, params=params)
+    ds2.construct()
+    np.testing.assert_array_equal(ds2.get_init_score(), init)
+    np.testing.assert_array_equal(ds2.get_position(), pos)
+    # training from the reload matches training from the original metadata
+    b1 = lgb.train(params, lgb.Dataset(X, label=y, init_score=init,
+                                       params=params), 5)
+    b2 = lgb.train(params, lgb.Dataset(p, params=params), 5)
+    assert b1.model_to_string() == b2.model_to_string()
+
+
 def test_quantized_wide_default_gate():
     """The int8 wide-regime default is a TPU device default for the rounds
     grower only; an explicit user choice or monotone constraints disable
@@ -303,7 +330,10 @@ def test_quantized_wide_default_gate():
     assert gate(**{**base, "has_monotone": True}) is False
     assert gate(**{**base, "tree_growth_mode": "strict"}) is False
     assert gate(**{**base, "tree_learner": "feature"}) is False
-    assert gate(**{**base, "tree_learner": "data"}) is True
+    # 'data' rides the rounds grower only multi-device (_use_fast_dp gate);
+    # single-device 'data' falls to the strict grower, which trains float
+    assert gate(**{**base, "tree_learner": "data"}) is False
+    assert gate(**{**base, "tree_learner": "data", "device_count": 8}) is True
 
     # end-to-end on the CPU suite: the booster stays float and records an
     # explicit choice
