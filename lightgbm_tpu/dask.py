@@ -18,11 +18,11 @@ attributes) matches the local sklearn wrappers, so
 `DaskLGBMRegressor(...).fit(X, y)` is a drop-in for the reference's
 workflow minus the Client plumbing.
 
-Like the reference's module, `fit` here does not take eval_set — early
-stopping against validation data is a local-estimator feature; train the
-distributed model for a fixed n_estimators (the reference's dask module
-accepts eval_set but evaluates it per-worker; descope documented in
-docs/DISTRIBUTED.md).
+Like the reference's module, `fit` accepts eval_set: each eval set is
+row-sharded across ranks alongside the training data, evaluated through
+the pre_partition synced metric path (every rank sees identical values —
+Network::GlobalSyncUpBySum analogue), and `early_stopping_rounds` fires
+identically on every rank (reference: dask.py _train(eval_set...)).
 """
 
 from __future__ import annotations
@@ -38,6 +38,15 @@ __all__ = [
     "DaskLGBMRegressor",
     "DaskLGBMRanker",
 ]
+
+
+def _normalize_eval_set(eval_set):
+    """One (X, y) tuple or a list of them -> list of (ndarray, 1-D ndarray)."""
+    if eval_set is None:
+        return None
+    if isinstance(eval_set, tuple):
+        eval_set = [eval_set]
+    return [(np.asarray(Xe), np.asarray(ye).ravel()) for Xe, ye in eval_set]
 
 
 class _DistributedFitMixin:
@@ -89,7 +98,10 @@ class _DistributedFitMixin:
             importance_type=importance_type, **kwargs,
         )
 
-    def _fit_distributed(self, X, y, sample_weight=None, group=None):
+    def _fit_distributed(self, X, y, sample_weight=None, group=None,
+                         eval_set=None, eval_names=None,
+                         eval_sample_weight=None, eval_group=None,
+                         eval_metric=None, early_stopping_rounds=None):
         params = self._process_params(self._default_objective())
         if params.get("objective") == "none":
             raise LightGBMError(
@@ -99,6 +111,14 @@ class _DistributedFitMixin:
         # estimator-orchestration params must not leak into training config
         for k in ("num_machines", "launch_timeout_s"):
             params.pop(k, None)
+        if eval_metric is not None:
+            if callable(eval_metric):
+                raise LightGBMError(
+                    "custom eval_metric callables are not supported by the "
+                    "distributed estimators (metrics must be "
+                    "reconstructable by name on every worker)")
+            params["metric"] = eval_metric
+        eval_set = _normalize_eval_set(eval_set)
         booster, _ = train_distributed(
             params,
             np.asarray(X),
@@ -108,17 +128,22 @@ class _DistributedFitMixin:
             weight=(None if sample_weight is None
                     else np.asarray(sample_weight, np.float64).ravel()),
             group=group,
+            eval_set=eval_set,
+            eval_names=eval_names,
+            eval_weight=eval_sample_weight,
+            eval_group=eval_group,
+            early_stopping_rounds=early_stopping_rounds,
             timeout_s=self.launch_timeout_s,
         )
         self._Booster = booster
         self._fobj = None
         self._feval = None
-        self._evals_result = {}
+        self._evals_result = getattr(booster, "_distributed_evals_result", {})
         self._n_features = booster.num_feature()
         self.n_features_in_ = self._n_features
         self.fitted_ = True
         self._best_iteration = booster.best_iteration
-        self._best_score = {}
+        self._best_score = booster.best_score
         return self
 
 
@@ -126,15 +151,23 @@ class DaskLGBMRegressor(_DistributedFitMixin, LGBMRegressor):
     """reference: dask.py DaskLGBMRegressor."""
 
 
-    def fit(self, X, y, sample_weight=None) -> "DaskLGBMRegressor":
-        return self._fit_distributed(X, y, sample_weight=sample_weight)
+    def fit(self, X, y, sample_weight=None, eval_set=None, eval_names=None,
+            eval_sample_weight=None, eval_metric=None,
+            early_stopping_rounds=None) -> "DaskLGBMRegressor":
+        return self._fit_distributed(
+            X, y, sample_weight=sample_weight, eval_set=eval_set,
+            eval_names=eval_names, eval_sample_weight=eval_sample_weight,
+            eval_metric=eval_metric,
+            early_stopping_rounds=early_stopping_rounds)
 
 
 class DaskLGBMClassifier(_DistributedFitMixin, LGBMClassifier):
     """reference: dask.py DaskLGBMClassifier."""
 
 
-    def fit(self, X, y, sample_weight=None) -> "DaskLGBMClassifier":
+    def fit(self, X, y, sample_weight=None, eval_set=None, eval_names=None,
+            eval_sample_weight=None, eval_metric=None,
+            early_stopping_rounds=None) -> "DaskLGBMClassifier":
         y_enc = self._prepare_class_labels(y)
         if self.class_weight is not None and self.n_classes_ >= 2:
             # the local wrapper folds class_weight into sample weights
@@ -146,7 +179,14 @@ class DaskLGBMClassifier(_DistributedFitMixin, LGBMClassifier):
             sample_weight = (cw if sample_weight is None
                              else np.asarray(sample_weight,
                                              np.float64).ravel() * cw)
-        return self._fit_distributed(X, y_enc, sample_weight=sample_weight)
+        if eval_set is not None:
+            eval_set = [(Xe, self._le.transform(ye))
+                        for Xe, ye in _normalize_eval_set(eval_set)]
+        return self._fit_distributed(
+            X, y_enc, sample_weight=sample_weight, eval_set=eval_set,
+            eval_names=eval_names, eval_sample_weight=eval_sample_weight,
+            eval_metric=eval_metric,
+            early_stopping_rounds=early_stopping_rounds)
 
 
 class DaskLGBMRanker(_DistributedFitMixin, LGBMRanker):
@@ -155,12 +195,21 @@ class DaskLGBMRanker(_DistributedFitMixin, LGBMRanker):
     the reference keeps dask partitions whole)."""
 
 
-    def fit(self, X, y, group=None, sample_weight=None,
+    def fit(self, X, y, group=None, sample_weight=None, eval_set=None,
+            eval_names=None, eval_sample_weight=None, eval_group=None,
+            eval_metric=None, early_stopping_rounds=None,
             eval_at=(1, 2, 3, 4, 5)) -> "DaskLGBMRanker":
         if group is None:
             raise ValueError("Should set group for ranking task")
+        if eval_set is not None and eval_group is None:
+            raise ValueError(
+                "eval_group must be provided with eval_set for ranking")
         self._other_params["eval_at"] = list(eval_at)
         setattr(self, "eval_at", list(eval_at))
         return self._fit_distributed(
             X, y, sample_weight=sample_weight,
-            group=np.asarray(group, np.int64))
+            group=np.asarray(group, np.int64),
+            eval_set=eval_set, eval_names=eval_names,
+            eval_sample_weight=eval_sample_weight, eval_group=eval_group,
+            eval_metric=eval_metric,
+            early_stopping_rounds=early_stopping_rounds)

@@ -13,6 +13,14 @@ sample, the global device array is assembled from process-local shards, and
 no rank ever materializes the full dataset).  Every rank ends up with the
 identical model; the launcher returns rank 0's.
 
+eval_set support (reference: dask.py _train accepts eval_set and evaluates
+per-worker): each eval set is row-sharded across ranks exactly like the
+training data; workers build valid Datasets against the train shard's
+binner and evaluate through the pre_partition synced metric path
+(models/gbdt.py::_eval_at_synced — Network::GlobalSyncUpBySum analogue),
+so every rank sees identical metric values and early stopping fires
+identically everywhere.
+
 This launcher is the single-host (loopback) form; on a real multi-host pod
 run one worker per host with the same `machines` list — the worker body is
 ordinary `lightgbm_tpu.train`, exactly like the reference's `_train_part`.
@@ -20,17 +28,18 @@ ordinary `lightgbm_tpu.train`, exactly like the reference's `_train_part`.
 
 from __future__ import annotations
 
+import json
 import os
 import socket
 import subprocess
 import sys
 import tempfile
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 _WORKER_SRC = r"""
-import os, sys
+import os, sys, json
 sys.path.insert(0, os.environ["LGBM_TPU_REPO"])
 import numpy as np
 from lightgbm_tpu.config import Config
@@ -54,10 +63,40 @@ ds = lgb.Dataset(
     weight=(shard["w"] if shard["w"].size > 0 else None),
     group=(shard["g"] if "g" in shard and shard["g"].size > 0 else None),
 )
-bst = lgb.train(params, ds, int(os.environ["LGBM_TPU_ROUNDS"]))
+valid_sets, valid_names = [], []
+n_eval = int(shard["n_eval"].item()) if "n_eval" in shard else 0
+for i in range(n_eval):
+    valid_sets.append(lgb.Dataset(
+        shard[f"ev{i}_X"],
+        label=shard[f"ev{i}_y"],
+        weight=(shard[f"ev{i}_w"] if shard[f"ev{i}_w"].size > 0 else None),
+        group=(shard[f"ev{i}_g"] if shard[f"ev{i}_g"].size > 0 else None),
+        reference=ds,
+    ))
+    valid_names.append(str(shard[f"ev{i}_name"].item()))
+callbacks = []
+evals_result = {}
+es_rounds = int(os.environ.get("LGBM_TPU_ES_ROUNDS", "0"))
+if es_rounds > 0 and valid_sets:
+    callbacks.append(lgb.early_stopping(es_rounds, verbose=False))
+if valid_sets:
+    callbacks.append(lgb.record_evaluation(evals_result))
+bst = lgb.train(params, ds, int(os.environ["LGBM_TPU_ROUNDS"]),
+                valid_sets=valid_sets or None,
+                valid_names=valid_names or None,
+                callbacks=callbacks)
 out = os.environ["LGBM_TPU_MODEL_OUT"]
-bst.save_model(out + f".rank{os.environ['LIGHTGBM_TPU_RANK']}")
-print("LAUNCHER_RANK_OK", os.environ["LIGHTGBM_TPU_RANK"], flush=True)
+rank = os.environ["LIGHTGBM_TPU_RANK"]
+bst.save_model(out + f".rank{rank}")
+if rank == "0":
+    meta = {"best_iteration": bst.best_iteration,
+            "best_score": {d: dict(m) for d, m in bst.best_score.items()},
+            "evals_result": {d: {k: list(map(float, v))
+                                 for k, v in m.items()}
+                             for d, m in evals_result.items()}}
+    with open(out + ".meta.json", "w") as fh:
+        json.dump(meta, fh)
+print("LAUNCHER_RANK_OK", rank, flush=True)
 """
 
 
@@ -74,44 +113,23 @@ def _free_ports(k: int) -> list:
     return ports
 
 
-def train_distributed(
-    params: Dict,
-    X: np.ndarray,
-    y: np.ndarray,
-    num_boost_round: int = 100,
-    *,
-    num_machines: int = 2,
-    weight: Optional[np.ndarray] = None,
-    group: Optional[np.ndarray] = None,
-    devices_per_machine: int = 1,
-    timeout_s: int = 600,
-    env_extra: Optional[Dict[str, str]] = None,
-):
-    """Shard rows over `num_machines` local worker processes, train with
-    tree_learner=data under pre_partition, and return rank 0's model as a
-    Booster.  Rows are padded to equal shard sizes with weight-0 rows when
-    the split is uneven (equal shards are a pre_partition requirement).
-
-    With `group` (query sizes, ranking), shard boundaries snap to query
+def _shard_plan(n: int, num_machines: int,
+                group: Optional[np.ndarray]) -> Tuple[List[Tuple[int, int]],
+                                                      List, int]:
+    """Row-shard plan: ((lo, hi) per rank, per-rank query sizes, padded
+    per-rank size).  With `group`, shard boundaries snap to query
     boundaries (greedy contiguous fill, like the reference's dask module
-    keeping partitions intact per worker) and each shard's padding rows
-    form one trailing weight-0 query."""
-    import lightgbm_tpu as lgb
-
-    n = X.shape[0]
+    keeping partitions intact per worker)."""
     if group is not None:
         group = np.asarray(group, np.int64)
         if group.sum() != n:
             raise ValueError(
-                f"group sizes sum to {group.sum()} but X has {n} rows")
+                f"group sizes sum to {group.sum()} but data has {n} rows")
         if len(group) < num_machines:
             raise ValueError(
                 f"not enough queries ({len(group)}) for {num_machines} "
                 "machines")
         bounds = np.concatenate([[0], np.cumsum(group)])
-        # greedy contiguous fill: each rank takes whole queries until its
-        # proportional row share, always taking at least one and leaving
-        # at least one per remaining rank
         shard_slices, shard_groups, q = [], [], 0
         for rank in range(num_machines):
             target = (n * (rank + 1)) // num_machines
@@ -124,21 +142,90 @@ def train_distributed(
             shard_slices.append((int(bounds[q0]), int(bounds[q])))
             shard_groups.append(group[q0:q])
         per = max(hi - lo for lo, hi in shard_slices)
+        return shard_slices, shard_groups, per
+    per = -(-n // num_machines)
+    shard_slices = [(r * per, min((r + 1) * per, n))
+                    for r in range(num_machines)]
+    return shard_slices, [None] * num_machines, per
+
+
+def _rank_arrays(rank_slices, rank_groups, per, rank, X, y, weight):
+    """One rank's (X, y, w, g) with weight-0 padding to the plan's `per`
+    (equal shard sizes are a pre_partition requirement; padding rows carry
+    weight 0 and, for ranking, one trailing pad query)."""
+    lo, hi = rank_slices[rank]
+    Xs, ys = X[lo:hi], np.asarray(y)[lo:hi]
+    gs = rank_groups[rank]
+    pad_s = per - (hi - lo)
+    if weight is None and pad_s == 0:
+        # no padding, no user weights: keep the unweighted fast paths
+        return Xs, ys, np.asarray(()), gs
+    ws = (np.asarray(weight, np.float64)[lo:hi]
+          if weight is not None else np.ones(hi - lo, np.float64))
+    if pad_s:
+        Xs = np.concatenate([Xs, np.zeros((pad_s,) + Xs.shape[1:], Xs.dtype)])
+        ys = np.concatenate([ys, np.zeros(pad_s, ys.dtype)])
+        ws = np.concatenate([ws, np.zeros(pad_s)])
+        if gs is not None:
+            gs = np.concatenate([gs, [pad_s]])
+    return Xs, ys, ws, gs
+
+
+def train_distributed(
+    params: Dict,
+    X: np.ndarray,
+    y: np.ndarray,
+    num_boost_round: int = 100,
+    *,
+    num_machines: int = 2,
+    weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
+    eval_set: Optional[Sequence[Tuple]] = None,  # [(Xe, ye), ...]
+    eval_weight: Optional[Sequence] = None,
+    eval_group: Optional[Sequence] = None,
+    eval_names: Optional[Sequence[str]] = None,
+    early_stopping_rounds: Optional[int] = None,
+    devices_per_machine: int = 1,
+    timeout_s: int = 600,
+    env_extra: Optional[Dict[str, str]] = None,
+):
+    """Shard rows over `num_machines` local worker processes, train with
+    tree_learner=data under pre_partition, and return (rank 0's Booster,
+    per-rank model paths).  With eval_set, each eval set is row-sharded the
+    same way; metrics sync across ranks (GlobalSyncUpBySum analogue) and
+    early stopping fires identically on every rank."""
+    import lightgbm_tpu as lgb
+
+    n = X.shape[0]
+    if group is not None:
+        group = np.asarray(group, np.int64)
         if weight is None:
             weight = np.ones(n, np.float64)
-    else:
-        per = -(-n // num_machines)
-        pad = per * num_machines - n
-        if pad:
-            X = np.concatenate([X, np.zeros((pad,) + X.shape[1:], X.dtype)])
-            y = np.concatenate([y, np.zeros(pad, np.asarray(y).dtype)])
-            weight = np.concatenate([
-                np.ones(n) if weight is None
-                else np.asarray(weight, np.float64),
-                np.zeros(pad),
-            ])
-        shard_slices = [(r * per, (r + 1) * per) for r in range(num_machines)]
-        shard_groups = [None] * num_machines
+    shard_slices, shard_groups, per = _shard_plan(n, num_machines, group)
+
+    for arg_name, arg in (("eval_names", eval_names),
+                          ("eval_weight", eval_weight),
+                          ("eval_group", eval_group)):
+        if arg is not None and len(arg) != len(eval_set or ()):
+            raise ValueError(
+                f"{arg_name} has {len(arg)} entries but eval_set has "
+                f"{len(eval_set or ())}")
+    eval_plans = []
+    for i, ev in enumerate(eval_set or ()):
+        Xe, ye = ev[0], ev[1]
+        ge = (np.asarray(eval_group[i], np.int64)
+              if eval_group is not None and eval_group[i] is not None
+              else None)
+        we = (np.asarray(eval_weight[i], np.float64).ravel()
+              if eval_weight is not None and eval_weight[i] is not None
+              else None)
+        ne = np.asarray(Xe).shape[0]
+        sl, gr, pe = _shard_plan(ne, num_machines, ge)
+        name = (eval_names[i] if eval_names is not None
+                else f"valid_{i}")
+        eval_plans.append((np.asarray(Xe), np.asarray(ye).ravel(), we,
+                           sl, gr, pe, name))
+
     ports = _free_ports(num_machines)
     machines = ",".join(f"127.0.0.1:{p}" for p in ports)
 
@@ -151,30 +238,25 @@ def train_distributed(
 
     procs = []
     for rank in range(num_machines):
-        lo, hi = shard_slices[rank]
-        Xs, ys = X[lo:hi], np.asarray(y)[lo:hi]
-        ws = (np.asarray(weight, np.float64)[lo:hi]
-              if weight is not None else np.asarray(()))
-        gs = shard_groups[rank]
-        pad_s = per - (hi - lo)
-        if pad_s:
-            # equal shard sizes are a pre_partition requirement; pad rows
-            # carry weight 0 (and, for ranking, one trailing pad query)
-            Xs = np.concatenate([Xs, np.zeros((pad_s,) + Xs.shape[1:],
-                                              Xs.dtype)])
-            ys = np.concatenate([ys, np.zeros(pad_s, ys.dtype)])
-            ws = np.concatenate([ws if ws.size else np.ones(hi - lo),
-                                 np.zeros(pad_s)])
-            if gs is not None:
-                gs = np.concatenate([gs, [pad_s]])
-        shard_path = os.path.join(tmp, f"shard{rank}.npz")
-        np.savez(
-            shard_path,
+        Xs, ys, ws, gs = _rank_arrays(shard_slices, shard_groups, per,
+                                      rank, X, y, weight)
+        shard_arrays = dict(
             X=Xs, y=ys, w=ws,
             g=(gs if gs is not None else np.asarray(())),
             num_machines=num_machines, machines=machines,
             local_listen_port=ports[rank], time_out=2,
+            n_eval=len(eval_plans),
         )
+        for i, (Xe, ye, we, sl, gr, pe, name) in enumerate(eval_plans):
+            Xv, yv, wv, gv = _rank_arrays(sl, gr, pe, rank, Xe, ye, we)
+            shard_arrays[f"ev{i}_X"] = Xv
+            shard_arrays[f"ev{i}_y"] = yv
+            shard_arrays[f"ev{i}_w"] = wv
+            shard_arrays[f"ev{i}_g"] = (gv if gv is not None
+                                        else np.asarray(()))
+            shard_arrays[f"ev{i}_name"] = name
+        shard_path = os.path.join(tmp, f"shard{rank}.npz")
+        np.savez(shard_path, **shard_arrays)
         env = dict(os.environ)
         env.update(env_extra or {})
         env["LIGHTGBM_TPU_RANK"] = str(rank)
@@ -183,6 +265,7 @@ def train_distributed(
         env["LGBM_TPU_PARAMS"] = params_path
         env["LGBM_TPU_ROUNDS"] = str(num_boost_round)
         env["LGBM_TPU_MODEL_OUT"] = model_out
+        env["LGBM_TPU_ES_ROUNDS"] = str(early_stopping_rounds or 0)
         env.pop("PYTEST_CURRENT_TEST", None)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", _WORKER_SRC], env=env,
@@ -196,6 +279,14 @@ def train_distributed(
         if p.returncode != 0:
             raise RuntimeError(
                 f"launcher worker rank {rank} failed:\n{out[-4000:]}")
-    return lgb.Booster(model_file=model_out + ".rank0"), [
+    booster = lgb.Booster(model_file=model_out + ".rank0")
+    meta_path = model_out + ".meta.json"
+    if os.path.exists(meta_path):
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        booster.best_iteration = int(meta.get("best_iteration", -1))
+        booster.best_score = meta.get("best_score", {})
+        booster._distributed_evals_result = meta.get("evals_result", {})
+    return booster, [
         model_out + f".rank{r}" for r in range(num_machines)
     ]
