@@ -135,6 +135,16 @@ def dataset_get_num_feature(ds) -> int:
     return int(_as_dataset(ds).num_feature())
 
 
+def dataset_get_feature_num_bin(ds, feature_idx: int) -> int:
+    """reference: LGBM_DatasetGetFeatureNumBin -> Dataset::FeatureNumBin."""
+    d = _as_dataset(ds)
+    d.construct()
+    nbpf = d.binner.num_bins_per_feature
+    if not (0 <= feature_idx < len(nbpf)):
+        raise IndexError(f"feature index {feature_idx} out of range")
+    return int(nbpf[feature_idx])
+
+
 class StreamingDataset:
     """Push-rows accumulator (reference: LGBM_DatasetCreateByReference +
     LGBM_DatasetPushRows streaming construction).  Rows stream into a
@@ -238,6 +248,15 @@ def booster_num_feature(bst: Booster) -> int:
 
 def booster_reset_parameter(bst: Booster, parameters: str) -> bool:
     bst.reset_parameter(_parse_params(parameters))
+    return True
+
+
+def booster_reset_training_data(bst: Booster, train_set) -> bool:
+    """reference: LGBM_BoosterResetTrainingData -> GBDT::ResetTrainingData
+    (existing trees kept; subsequent updates train on the new data)."""
+    ds = _as_dataset(train_set)
+    bst._train_set = ds
+    bst._gbdt.reset_training_data(ds)
     return True
 
 
@@ -452,6 +471,63 @@ def dataset_from_csc(colptr_addr: int, colptr_type: int, indices_addr: int,
     return Dataset(x, params=_parse_params(parameters),
                    reference=reference if isinstance(reference, Dataset) else None,
                    free_raw_data=False)
+
+
+def predict_sparse_output(bst: Booster, indptr_addr: int, indptr_type: int,
+                          indices_addr: int, data_addr: int, data_type: int,
+                          nindptr: int, nelem: int, num_col_or_row: int,
+                          predict_type: int, start_iteration: int,
+                          num_iteration: int, parameter: str,
+                          matrix_type: int) -> tuple:
+    """reference: LGBM_BoosterPredictSparseOutput — SHAP contributions as a
+    library-allocated sparse matrix (CSR for matrix_type 0, CSC for 1; the
+    input shares the same layout).  Only C_API_PREDICT_CONTRIB is legal,
+    matching the reference's check.  Returns
+    (indptr_addr, indices_addr, data_addr, n_indptr, nnz) where the three
+    buffers are malloc()'d here (libc) so LGBM_BoosterFreePredictSparse can
+    free() them from C; indptr is written in indptr_type, data in f64 (the
+    reference allocates f32/f64 per data_type — f64 here, enforced by the
+    C entry rejecting f32 requests).  Multiclass contribs are laid out as
+    (nrow, num_class*(num_feature+1)), the reference's dense flattening."""
+    import ctypes.util
+    import scipy.sparse as sp
+
+    if predict_type != _PREDICT_CONTRIB:
+        raise ValueError(
+            "LGBM_BoosterPredictSparseOutput only supports predict_type="
+            "C_API_PREDICT_CONTRIB (reference: c_api.cpp same check)")
+    if matrix_type == 0:  # CSR input/output
+        x = _wrap_csr(indptr_addr, indptr_type, indices_addr, data_addr,
+                      data_type, nindptr, nelem, num_col_or_row)
+    else:  # CSC
+        x = _wrap_csc(indptr_addr, indptr_type, indices_addr, data_addr,
+                      data_type, nindptr, nelem, num_col_or_row)
+    contrib = bst.predict(
+        x, pred_contrib=True,
+        **_predict_kw(start_iteration, num_iteration, parameter))
+    contrib = np.ascontiguousarray(
+        np.asarray(contrib, np.float64).reshape(x.shape[0], -1))
+    mat = (sp.csr_matrix(contrib) if matrix_type == 0
+           else sp.csc_matrix(contrib))
+    out_indptr = np.asarray(
+        mat.indptr, np.int64 if indptr_type == 3 else np.int32)
+    out_indices = np.asarray(mat.indices, np.int32)
+    out_data = np.asarray(mat.data, np.float64)
+
+    libc = ctypes.CDLL(None)
+    libc.malloc.restype = ctypes.c_void_p
+    libc.malloc.argtypes = [ctypes.c_size_t]
+
+    def _to_c(arr):
+        nb = max(arr.nbytes, 1)
+        addr = libc.malloc(nb)
+        if not addr:
+            raise MemoryError(f"malloc({nb}) failed")
+        ctypes.memmove(addr, arr.ctypes.data, arr.nbytes)
+        return addr
+
+    return (_to_c(out_indptr), _to_c(out_indices), _to_c(out_data),
+            int(len(out_indptr)), int(len(out_data)))
 
 
 def predict_csc_into(bst: Booster, colptr_addr: int, colptr_type: int,

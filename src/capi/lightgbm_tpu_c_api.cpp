@@ -13,8 +13,12 @@
 
 #include <cstdarg>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <functional>
 #include <string>
+#include <utility>
+#include <vector>
 
 namespace {
 
@@ -555,6 +559,122 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
       reinterpret_cast<unsigned long long>(out_result));
   if (r == nullptr) return -1;
   *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictSparseOutput(BoosterHandle handle, const void* indptr,
+                                    int indptr_type, const int32_t* indices,
+                                    const void* data, int data_type,
+                                    int64_t nindptr, int64_t nelem,
+                                    int64_t num_col_or_row, int predict_type,
+                                    int start_iteration, int num_iteration,
+                                    const char* parameter, int matrix_type,
+                                    int64_t* out_len, void** out_indptr,
+                                    int32_t** out_indices, void** out_data) {
+  if (data_type != C_API_DTYPE_FLOAT64) {
+    /* enumerated deviation (docs/BINDINGS.md): output data is f64-only */
+    set_last_error(
+        "LGBM_BoosterPredictSparseOutput: data_type must be "
+        "C_API_DTYPE_FLOAT64 (f32 output buffers are not supported)");
+    return -1;
+  }
+  GilGuard gil;
+  PyObject* r = call_helper(
+      "predict_sparse_output", "(OKiKKiLLLiiisi)",
+      static_cast<PyObject*>(handle),
+      reinterpret_cast<unsigned long long>(indptr), indptr_type,
+      reinterpret_cast<unsigned long long>(indices),
+      reinterpret_cast<unsigned long long>(data), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col_or_row), predict_type, start_iteration,
+      num_iteration, parameter == nullptr ? "" : parameter, matrix_type);
+  if (r == nullptr) return -1;
+  /* (indptr_addr, indices_addr, data_addr, n_indptr, nnz) — buffers were
+   * malloc()'d on the Python side via libc so free() releases them */
+  unsigned long long a_indptr = PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 0));
+  unsigned long long a_indices = PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 1));
+  unsigned long long a_data = PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 2));
+  long long n_indptr = PyLong_AsLongLong(PyTuple_GetItem(r, 3));
+  long long nnz = PyLong_AsLongLong(PyTuple_GetItem(r, 4));
+  Py_DECREF(r);
+  if (PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  *out_indptr = reinterpret_cast<void*>(a_indptr);
+  *out_indices = reinterpret_cast<int32_t*>(a_indices);
+  *out_data = reinterpret_cast<void*>(a_data);
+  out_len[0] = n_indptr;
+  out_len[1] = nnz;
+  return 0;
+}
+
+int LGBM_BoosterFreePredictSparse(void* indptr, int32_t* indices, void* data,
+                                  int indptr_type, int data_type) {
+  (void)indptr_type;
+  (void)data_type;
+  std::free(indptr);
+  std::free(indices);
+  std::free(data);
+  return 0;
+}
+
+int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
+                                  int64_t num_col, const char* parameters,
+                                  const DatasetHandle reference,
+                                  DatasetHandle* out) {
+  /* the reference's contract: funptr is a C++ std::function pointer,
+   * invoked once per row OUTSIDE the GIL (the callback may be arbitrary
+   * caller code); rows materialize dense, then the mat path ingests */
+  using RowFn = std::function<void(int, std::vector<std::pair<int, double>>&)>;
+  auto* fn = reinterpret_cast<RowFn*>(get_row_funptr);
+  if (fn == nullptr || num_rows < 0 || num_col <= 0) {
+    set_last_error("LGBM_DatasetCreateFromCSRFunc: bad arguments");
+    return -1;
+  }
+  std::vector<double> buf(static_cast<size_t>(num_rows) * num_col, 0.0);
+  std::vector<std::pair<int, double>> row;
+  for (int i = 0; i < num_rows; ++i) {
+    row.clear();
+    (*fn)(i, row);
+    for (const auto& kv : row) {
+      if (kv.first >= 0 && kv.first < num_col) {
+        buf[static_cast<size_t>(i) * num_col + kv.first] = kv.second;
+      }
+    }
+  }
+  GilGuard gil;
+  PyObject* ref = reference != nullptr ? static_cast<PyObject*>(reference)
+                                       : Py_None;
+  PyObject* r = call_helper(
+      "dataset_from_mat", "(KiiiisO)",
+      reinterpret_cast<unsigned long long>(buf.data()), C_API_DTYPE_FLOAT64,
+      num_rows, static_cast<int>(num_col), 1,
+      parameters == nullptr ? "" : parameters, ref);
+  if (r == nullptr) return -1;
+  *out = static_cast<DatasetHandle>(r);
+  return 0;
+}
+
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data) {
+  GilGuard gil;
+  PyObject* r = call_helper("booster_reset_training_data", "(OO)",
+                            static_cast<PyObject*>(handle),
+                            static_cast<PyObject*>(train_data));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetFeatureNumBin(DatasetHandle handle, int feature_idx,
+                                 int* out) {
+  GilGuard gil;
+  PyObject* r = call_helper("dataset_get_feature_num_bin", "(Oi)",
+                            static_cast<PyObject*>(handle), feature_idx);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
   Py_DECREF(r);
   return 0;
 }

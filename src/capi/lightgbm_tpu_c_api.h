@@ -107,6 +107,16 @@ int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
 
 int LGBM_BoosterResetParameter(BoosterHandle handle, const char* parameters);
 
+/* Swap the training data under an existing booster; trees already grown
+ * are kept (reference: GBDT::ResetTrainingData). */
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data);
+
+/* Number of bins of one feature, incl. missing/offset slots (reference:
+ * LGBM_DatasetGetFeatureNumBin -> Dataset::FeatureNumBin). */
+int LGBM_DatasetGetFeatureNumBin(DatasetHandle handle, int feature_idx,
+                                 int* out);
+
 int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
 
 /* data_idx: 0 = train, i = i-th validation set. */
@@ -187,6 +197,51 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle,
                               const char* parameter,
                               int64_t* out_len,
                               double* out_result);
+
+/* ---- sparse-output SHAP prediction (reference:
+ * LGBM_BoosterPredictSparseOutput / LGBM_BoosterFreePredictSparse).
+ * predict_type must be C_API_PREDICT_CONTRIB; matrix_type 0 = CSR input
+ * and output, 1 = CSC (num_col_or_row = #cols for CSR, #rows for CSC).
+ * The library malloc()s *out_indptr/*out_indices/*out_data; release them
+ * with LGBM_BoosterFreePredictSparse.  data_type must be
+ * C_API_DTYPE_FLOAT64 (deviation: the reference also allocates f32;
+ * enumerated in docs/BINDINGS.md).  out_len[0] = indptr length,
+ * out_len[1] = nnz. */
+#define C_API_MATRIX_TYPE_CSR 0
+#define C_API_MATRIX_TYPE_CSC 1
+
+int LGBM_BoosterPredictSparseOutput(BoosterHandle handle,
+                                    const void* indptr,
+                                    int indptr_type,
+                                    const int32_t* indices,
+                                    const void* data,
+                                    int data_type,
+                                    int64_t nindptr,
+                                    int64_t nelem,
+                                    int64_t num_col_or_row,
+                                    int predict_type,
+                                    int start_iteration,
+                                    int num_iteration,
+                                    const char* parameter,
+                                    int matrix_type,
+                                    int64_t* out_len,
+                                    void** out_indptr,
+                                    int32_t** out_indices,
+                                    void** out_data);
+
+int LGBM_BoosterFreePredictSparse(void* indptr, int32_t* indices, void* data,
+                                  int indptr_type, int data_type);
+
+/* Row-callback dataset construction (reference:
+ * LGBM_DatasetCreateFromCSRFunc): get_row_funptr is a
+ * std::function<void(int idx, std::vector<std::pair<int, double>>&)>*
+ * invoked once per row, exactly the reference's C++-ABI contract. */
+int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr,
+                                  int num_rows,
+                                  int64_t num_col,
+                                  const char* parameters,
+                                  const DatasetHandle reference,
+                                  DatasetHandle* out);
 
 /* ---- single-row predict, plain and Fast (reference: SingleRowPredictor,
  * FastConfigHandle — the Fast variants freeze predict settings into an

