@@ -1,0 +1,67 @@
+"""Measured bound on what monotone_constraints_method='advanced' could add.
+
+Advanced (reference: monotone_constraints.hpp AdvancedLeafConstraints)
+still enforces monotonicity, so its training fit is bounded above by the
+UNCONSTRAINED model's: gap(advanced, intermediate) <= gap(none,
+intermediate).  This script measures that bound on three fixtures whose
+generative functions are genuinely monotone in the constrained features
+(a mis-signed constraint would inflate the gap artificially).
+
+Round-5 measured results (CPU, 6000 rows, 60 rounds, lr 0.1, 31 leaves):
+
+| fixture          | mse none | basic   | intermediate | advanced headroom |
+|------------------|----------|---------|--------------|-------------------|
+| steps            | 0.05108  | 0.06767 | 0.06716      | <= 0.01608        |
+| smooth-interact  | 0.06434  | 0.04723 | 0.04278      | <= 0 (negative)   |
+| all-mono         | 0.05048  | 0.08669 | 0.08023      | <= 0.02975        |
+
+On smooth-interact the constraint acts as a regularizer and intermediate
+BEATS unconstrained — advanced cannot help there at all.  See
+PARITY.md's monotone section for the descope argument this backs.
+"""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def gap_experiment(name, X, y, mono, rounds=60, leaves=31):
+    res = {}
+    for method, extra in (
+            ("none", {}),
+            ("basic", {"monotone_constraints": mono,
+                       "monotone_constraints_method": "basic"}),
+            ("intermediate", {"monotone_constraints": mono,
+                              "monotone_constraints_method": "intermediate"})):
+        p = {"objective": "regression", "num_leaves": leaves,
+             "verbosity": -1, "learning_rate": 0.1, "min_data_in_leaf": 10,
+             **extra}
+        bst = lgb.train(p, lgb.Dataset(X, label=y), rounds)
+        res[method] = float(np.mean((bst.predict(X) - y) ** 2))
+    un, ba, it = res["none"], res["basic"], res["intermediate"]
+    print(f"{name}: mse none={un:.5f} basic={ba:.5f} inter={it:.5f} | "
+          f"advanced headroom <= {max(it - un, 0.0):.5f}")
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n = 6000
+    x = rng.randn(n, 3)
+    y = (np.where(x[:, 0] > 0, 10.0, 0.0) + np.where(x[:, 1] > 0, 8.0, 0.0)
+         + 0.5 * x[:, 2] + 0.05 * rng.randn(n))
+    gap_experiment("steps", x, y, [1, 1, 0])
+
+    x = rng.randn(n, 4)
+    y = (np.exp(0.5 * x[:, 0]) + np.log1p(np.exp(x[:, 1]))
+         + x[:, 2] * x[:, 3] + 0.1 * rng.randn(n))
+    gap_experiment("smooth-interact", x, y, [1, 1, 0, 0])
+
+    x = rng.randn(n, 4)
+    y = (x[:, 0] ** 3 / 5 + np.tanh(x[:, 1]) + 0.5 * x[:, 2]
+         + np.sqrt(np.abs(x[:, 3])) * np.sign(x[:, 3])
+         + 0.1 * rng.randn(n))
+    gap_experiment("all-mono", x, y, [1, 1, 1, 1])
+
+
+if __name__ == "__main__":
+    main()

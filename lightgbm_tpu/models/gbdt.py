@@ -409,17 +409,8 @@ class GBDT:
             if mmethod == "advanced":
                 log_warning(
                     "monotone_constraints_method='advanced' is not "
-                    "implemented; using 'intermediate'."
-                )
-            if mmethod in ("intermediate", "advanced") and (
-                self.cfg.tree_learner in ("feature", "voting")
-                and jax.device_count() > 1
-            ):
-                log_warning(
-                    "monotone intermediate bounds are not implemented for "
-                    "feature/voting-parallel (shard-partial histograms); "
-                    "this configuration falls back to 'basic' — still "
-                    "monotone, more conservative splits."
+                    "implemented; using 'intermediate' (measured headroom "
+                    "bound: benchmarks/monotone_advanced_headroom.py)."
                 )
             if (mmethod in ("intermediate", "advanced")
                     and self.cfg.use_quantized_grad
@@ -668,8 +659,6 @@ class GBDT:
             and self.cfg.num_leaves >= 64
             and self._monotone is None
             and self._interaction_sets is None
-            and self._categorical_mask is None
-            and getattr(ts, "efb", None) is None
             and self._forced_schedule() is None
             and self._cegb_lazy is None
             and self._cegb_coupled is None
@@ -1081,6 +1070,7 @@ class GBDT:
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,
                     params=self._split_params,
+                    monotone_method=self._monotone_method,
                 )
                 arrays, leaf_id = self._localize_tree(arrays, leaf_id)
             elif self._dp is not None and self._use_fast_dp:
@@ -1148,6 +1138,8 @@ class GBDT:
                 from ..ops.treegrow_windowed import grow_tree_windowed
 
                 quant = self.cfg.use_quantized_grad
+                efb_tabs_w = (ts.efb_device_tables()
+                              if getattr(ts, "efb", None) is not None else None)
                 arrays, leaf_id = grow_tree_windowed(
                     ts.bins_device_t(),
                     gc,
@@ -1161,6 +1153,10 @@ class GBDT:
                     (jax.random.PRNGKey(self.cfg.seed * 1000003 + self.iter_ * 31 + c)
                      if quant else None),
                     self._feature_contri,
+                    self._categorical_mask,
+                    ts.efb_bins_device_t() if getattr(ts, "efb", None) is not None else None,
+                    efb_tabs_w[1] if efb_tabs_w else None,
+                    efb_tabs_w[2] if efb_tabs_w else None,
                     num_leaves=self.cfg.num_leaves,
                     num_bins=ts.max_num_bins,
                     max_depth=self.cfg.max_depth,

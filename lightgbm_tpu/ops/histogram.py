@@ -239,6 +239,25 @@ def histogram(
     return histogram_scatter(bins, grad, hess, mask, num_bins)
 
 
+def unbundle_hists(h: jnp.ndarray, efb_gather: jnp.ndarray,
+                   efb_default: jnp.ndarray, num_feature: int,
+                   num_bins: int) -> jnp.ndarray:
+    """(tile, 3, F_b, B) bundle hists -> (tile, 3, F, B) per-feature hists:
+    gather each feature's non-default slots; its default-bin row is
+    leaf_total - sum(non-default) (reference most-freq-bin subtraction; see
+    io/efb.py).  Shared by the fast and windowed growers."""
+    tile = h.shape[0]
+    flat = h.reshape(tile, 3, -1)
+    flat = jnp.concatenate([flat, jnp.zeros((tile, 3, 1), h.dtype)], axis=2)
+    hf = flat[:, :, efb_gather.reshape(-1)].reshape(
+        tile, 3, num_feature, num_bins)
+    leaf_tot = jnp.sum(h[:, :, 0, :], axis=2)  # (tile, 3)
+    nondef = jnp.sum(hf, axis=3)  # (tile, 3, F)
+    fill = leaf_tot[:, :, None] - nondef
+    return hf + jnp.where(
+        efb_default[None, None], fill[..., None], jnp.zeros((), h.dtype))
+
+
 def fix_histogram_subtract(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:
     """Sibling histogram by subtraction (reference: Dataset::FixHistogram /
     the histogram subtraction trick) — exact because bins are identical."""

@@ -88,6 +88,9 @@ class GrowState(NamedTuple):
     anc: jnp.ndarray = False  # (L, L-1) bool ancestor masks, or () placeholder
     aside: jnp.ndarray = False  # (L, L-1) bool — leaf on the RIGHT side of m
     # (maintained only for monotone_method="intermediate")
+    node_mono: jnp.ndarray = False  # (L-1,) i32 monotone dir per node (0 at
+    # cat nodes) — feature-parallel shards the constraint vector, so the
+    # per-node direction must be recorded at split time (intermediate only)
     lazy_used: jnp.ndarray = False  # (N, F) bool — rows charged per feature
     lazy_counts: jnp.ndarray = False  # (L, F) f32 — per-leaf uncharged rows
     # (maintained only for CEGB cegb_penalty_feature_lazy; reference:
@@ -118,7 +121,7 @@ def _set_best(best: BestSplit, i: jnp.ndarray, s: BestSplit) -> BestSplit:
 
 
 def _intermediate_bounds(anc, aside, tree, monotone_constraints, leaf_out,
-                         n_live, L):
+                         n_live, L, node_mono=None):
     """Monotone 'intermediate' bounds (reference: monotone_constraints.hpp ->
     IntermediateLeafConstraints): instead of compounding midpoint fences
     (basic), each leaf is bounded by the ACTUAL output extremes of the
@@ -127,7 +130,9 @@ def _intermediate_bounds(anc, aside, tree, monotone_constraints, leaf_out,
     future opposite-side leaves respect it in turn.
 
     anc/aside: (L, L-1) ancestor masks (aside = leaf on the right side).
-    Returns (lo, hi) of shape (L,)."""
+    node_mono: (L-1,) per-node monotone direction, for callers whose
+    monotone_constraints array is feature-SHARDED (feature-parallel) while
+    tree.split_feature holds global ids.  Returns (lo, hi) of shape (L,)."""
     live = (jnp.arange(L, dtype=jnp.int32) < n_live)[:, None]  # (L, 1)
     left_m = anc & ~aside & live  # (L, M) leaf ℓ lives in m's left subtree
     right_m = anc & aside & live
@@ -137,7 +142,11 @@ def _intermediate_bounds(anc, aside, tree, monotone_constraints, leaf_out,
     l_min = jnp.min(jnp.where(left_m, o, pinf), axis=0)
     r_max = jnp.max(jnp.where(right_m, o, ninf), axis=0)
     r_min = jnp.min(jnp.where(right_m, o, pinf), axis=0)
-    d = jnp.where(tree.is_cat, 0, monotone_constraints[tree.split_feature])  # (M,)
+    if node_mono is not None:
+        d = node_mono  # (M,) already 0 at categorical nodes
+    else:
+        d = jnp.where(tree.is_cat, 0,
+                      monotone_constraints[tree.split_feature])  # (M,)
     # d=+1 (non-decreasing): right-side leaves >= max(left outputs),
     #                        left-side leaves <= min(right outputs)
     # d=-1 mirrored
@@ -225,10 +234,14 @@ def grow_tree(
         and monotone_constraints is not None
         # serial: sequential splits, the textbook case.  data: every shard
         # holds identical replicated leaf state (hists are psummed before
-        # split search), so the bound recomputation is SPMD-safe.  feature/
-        # voting keep basic: their hist state is shard-partial and the
-        # re-evaluate-all path would need the cross-shard merge per leaf.
-        and mode in ("serial", "data")
+        # split search).  feature/voting (round 5): the re-evaluate-all
+        # path vmaps best_for over leaves, batching its collectives
+        # (pmax/psum merges and the voting election) across the leaf dim —
+        # every shard still computes identical bounds because leaf outputs
+        # and node directions are replicated (node_mono records the split
+        # feature's direction at split time, since the constraint vector
+        # itself is feature-sharded in feature mode).
+        and mode in ("serial", "data", "feature", "voting")
     )
 
     def psum(x):
@@ -434,6 +447,8 @@ def grow_tree(
              else jnp.zeros((), bool)),
         aside=(jnp.zeros((L, L - 1), bool) if use_intermediate
                else jnp.zeros((), bool)),
+        node_mono=(jnp.zeros((L - 1,), jnp.int32) if use_intermediate
+                   else jnp.zeros((), bool)),
         lazy_used=(lazy_used0 if use_lazy else jnp.zeros((), bool)),
         lazy_counts=(jnp.zeros((L, f), jnp.float32).at[0].set(lazy_counts0)
                      if use_lazy else jnp.zeros((), bool)),
@@ -622,12 +637,17 @@ def grow_tree(
             aside_r = aside_l.at[node].set(True)
             anc = state.anc.at[best_leaf].set(anc_child).at[new_leaf].set(anc_child)
             aside = state.aside.at[best_leaf].set(aside_l).at[new_leaf].set(aside_r)
+            # record this node's monotone direction (mono_c was computed
+            # above, psum-broadcast from the owner shard in feature mode)
+            node_mono = state.node_mono.at[node].set(
+                jnp.where(s.is_cat, 0, mono_c))
             leaf_out_lo, leaf_out_hi = _intermediate_bounds(
                 anc, aside, tree, monotone_constraints, leaf_out,
-                state.num_leaves_cur + 1, L,
+                state.num_leaves_cur + 1, L, node_mono=node_mono,
             )
         else:
             anc, aside = state.anc, state.aside
+            node_mono = state.node_mono
 
         if interaction_sets is not None or track_path:
             if mode == "feature":
@@ -705,6 +725,7 @@ def grow_tree(
             forced_active=state.forced_active,
             anc=anc,
             aside=aside,
+            node_mono=node_mono,
             lazy_used=lazy_used,
             lazy_counts=lazy_counts,
         )

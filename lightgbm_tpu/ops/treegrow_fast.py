@@ -42,7 +42,7 @@ import jax.numpy as jnp
 
 from .hist_pallas import histogram_pallas_multi, histogram_pallas_multi_quantized
 from .histogram import (histogram, histogram_onehot_multi,
-                        histogram_onehot_multi_quantized)
+                        histogram_onehot_multi_quantized, unbundle_hists)
 from .split import (
     BestSplit, SplitParams, find_best_split, forced_split_candidate,
     leaf_output, leaf_output_smoothed, KMIN_SCORE,
@@ -98,7 +98,14 @@ class FastState(NamedTuple):
     used_features: jnp.ndarray  # (L, F) bool or () placeholder
     fresh: jnp.ndarray  # (L,) bool — leaves created this round, need hist+eval
     small_slot: jnp.ndarray  # (L,) i32 — pass slot of each fresh SMALL child, -1 otherwise
-    sib: jnp.ndarray  # (L,) i32 — sibling leaf of each fresh leaf (-1 otherwise)
+    slot_left: jnp.ndarray  # (tile,) i32 — left-child leaf per pass slot (-1
+    # inactive).  The parent's hist lives in the LEFT child's state slot
+    # (left keeps the parent's leaf id), so the pass can gather parents and
+    # do the sibling subtraction on COMPACT (tile,...) arrays instead of
+    # the full (L,...) state (measured 57 ms/round of full-state
+    # scatter+subtract at Epsilon shape — benchmarks/probe_r5_fixed.py)
+    slot_right: jnp.ndarray  # (tile,) i32 — right-child leaf per slot (-1)
+    slot_small_left: jnp.ndarray  # (tile,) bool — slot's small child is left
     progress: jnp.ndarray  # bool — this round applied at least one split
     tree: TreeArrays
     anc: jnp.ndarray = False  # (L, L-1) bool ancestor masks, or () placeholder
@@ -260,24 +267,9 @@ def grow_tree_fast(
     hist_bins = bins if efb_bins is None else efb_bins
 
     def unbundle(h):
-        """(tile, 3, F_b, B) bundle hists -> (tile, 3, F, B) per-feature
-        hists: gather each feature's non-default slots; its default-bin row
-        is leaf_total - sum(non-default) (reference most-freq-bin
-        subtraction; see io/efb.py)."""
         if efb_gather is None:
             return h
-        tile = h.shape[0]
-        flat = h.reshape(tile, 3, -1)
-        flat = jnp.concatenate(
-            [flat, jnp.zeros((tile, 3, 1), h.dtype)], axis=2
-        )
-        hf = flat[:, :, efb_gather.reshape(-1)].reshape(tile, 3, f, num_bins)
-        leaf_tot = jnp.sum(h[:, :, 0, :], axis=2)  # (tile, 3)
-        nondef = jnp.sum(hf, axis=3)  # (tile, 3, F)
-        fill = leaf_tot[:, :, None] - nondef
-        return hf + jnp.where(
-            efb_default[None, None], fill[..., None], jnp.zeros((), h.dtype)
-        )
+        return unbundle_hists(h, efb_gather, efb_default, f, num_bins)
 
     def multi_hist(leaf_slot, tile):
         """(N,)-slot -> (tile, 3, F, B) f32: per-slot histograms, one pass."""
@@ -409,7 +401,9 @@ def grow_tree_fast(
         used_features=used0,
         fresh=jnp.zeros((L,), bool),
         small_slot=jnp.full((L,), -1, jnp.int32),
-        sib=jnp.full((L,), -1, jnp.int32),
+        slot_left=jnp.full((leaf_tile,), -1, jnp.int32),
+        slot_right=jnp.full((leaf_tile,), -1, jnp.int32),
+        slot_small_left=jnp.zeros((leaf_tile,), bool),
         progress=jnp.asarray(True),
         tree=tree0,
         anc=(jnp.zeros((L, L - 1), bool) if use_intermediate
@@ -682,14 +676,19 @@ def grow_tree_fast(
         small_slot = jnp.full((L,), -1, jnp.int32)
         small_pos = jnp.where(accept, small_leaf, 2 * L)
         small_slot = small_slot.at[small_pos].set(slot, mode="drop")
-        sib = jnp.full((L,), -1, jnp.int32)
-        sib = jnp.where(accept, right_of, sib)  # left child's sibling = right
-        sib = sib.at[right_pos].set(idx, mode="drop")  # right's sibling = left
-        # parent hist snapshot: copy parent's hist into the right child's slot
-        # so subtraction works whichever child is smaller
+        # per-slot child maps: the parent's hist stays in the LEFT child's
+        # state slot (left keeps the parent's leaf id), so the pass phase
+        # gathers parents and subtracts on compact (tile,...) arrays — no
+        # full-state parent snapshot (it measured 17 ms/round at Epsilon
+        # shape; benchmarks/probe_r5_fixed.py)
+        pos_r = jnp.where(accept, acc_rank, leaf_tile)
+        slot_left = jnp.full((leaf_tile,), -1, jnp.int32).at[pos_r].set(
+            idx, mode="drop")
+        slot_right = jnp.full((leaf_tile,), -1, jnp.int32).at[pos_r].set(
+            right_of, mode="drop")
+        slot_small_left = jnp.zeros((leaf_tile,), bool).at[pos_r].set(
+            left_smaller, mode="drop")
         hist = state.hist
-        parent_hist_of_right = hist  # hist[l] is parent hist for accepted l
-        hist = hist.at[right_pos].set(parent_hist_of_right, mode="drop")
 
         # invalidate best for split leaves (children evaluated next round)
         best = state.best
@@ -714,7 +713,9 @@ def grow_tree_fast(
             used_features=used_features,
             fresh=fresh,
             small_slot=small_slot,
-            sib=sib,
+            slot_left=slot_left,
+            slot_right=slot_right,
+            slot_small_left=slot_small_left,
             progress=k_acc > 0,
             tree=tree,
             anc=anc,
@@ -736,18 +737,23 @@ def grow_tree_fast(
             leaf_slot = jnp.where(exists & (lid == leaf_r), r, leaf_slot)
         fresh_hists = multi_hist(leaf_slot, leaf_tile)  # (leaf_tile, 3, F, B)
         idx = jnp.arange(L, dtype=jnp.int32)
-        is_small = state.small_slot >= 0
-        # write small-child hists
-        small_pos = jnp.where(is_small, idx, 2 * L)
-        hist = state.hist.at[small_pos].set(
-            fresh_hists[jnp.clip(state.small_slot, 0, None)], mode="drop"
-        )
-        # big sibling = parent snapshot - small  (parent snapshot lives in the
-        # big sibling's own slot after round_body's copy)
-        is_big = state.fresh & ~is_small
-        small_of_big = jnp.clip(state.sib, 0, L - 1)
-        big_sub = hist[idx] - hist[small_of_big]
-        hist = jnp.where(is_big[:, None, None, None], big_sub, hist)
+        # COMPACT sibling recovery (round 5): parent hists live in the left
+        # children's slots; gather the <= tile parents, subtract, and
+        # scatter both children once — O(tile) state traffic instead of the
+        # full-(L,...) scatter/subtract/where chain (measured 57 ms/round
+        # at Epsilon shape; benchmarks/probe_r5_fixed.py)
+        active = state.slot_left >= 0  # (tile,)
+        sl = jnp.clip(state.slot_left, 0, L - 1)
+        sr = jnp.clip(state.slot_right, 0, L - 1)
+        parent_hists = state.hist[sl]  # (tile, 3, F, B)
+        big_hists = parent_hists - fresh_hists
+        sml = state.slot_small_left[:, None, None, None]
+        left_hists = jnp.where(sml, fresh_hists, big_hists)
+        right_hists = jnp.where(sml, big_hists, fresh_hists)
+        lpos = jnp.where(active, sl, 2 * L)
+        rpos = jnp.where(active, sr, 2 * L)
+        hist = state.hist.at[lpos].set(left_hists, mode="drop").at[rpos].set(
+            right_hists, mode="drop")
 
         # ---------- phase 3: evaluate fresh leaves (one vmapped search) ----------
         node_ids = jnp.clip(state.leaf_parent, 0, None) * 2 + state.leaf_side + 1
@@ -776,41 +782,48 @@ def grow_tree_fast(
             )
             live = idx < state.num_leaves_cur
             best = bb._replace(gain=jnp.where(live, bb.gain, KMIN_SCORE))
-            return state._replace(hist=hist, best=best,
-                                  fresh=jnp.zeros((L,), bool),
-                                  small_slot=jnp.full((L,), -1, jnp.int32),
-                                  sib=jnp.full((L,), -1, jnp.int32))
-        # only the <= 2*leaf_tile fresh leaves need evaluation; gather them
-        # into a fixed-size slot batch instead of evaluating all L leaves
-        # (matters at num_leaves=255: 8x less split-search per round)
-        m_slots = min(2 * leaf_tile, L)
-        frm = state.fresh
-        fr_idx = jnp.argsort(jnp.where(frm, idx, L + idx))[:m_slots]  # fresh first
-        fr_ok = frm[fr_idx]  # padding slots carry non-fresh leaves
+            return state._replace(
+                hist=hist, best=best,
+                fresh=jnp.zeros((L,), bool),
+                small_slot=jnp.full((L,), -1, jnp.int32),
+                slot_left=jnp.full((leaf_tile,), -1, jnp.int32),
+                slot_right=jnp.full((leaf_tile,), -1, jnp.int32),
+                slot_small_left=jnp.zeros((leaf_tile,), bool))
+        # only the fresh children need evaluation, and their hists are
+        # ALREADY compact (left_hists/right_hists above): feed the search
+        # directly instead of re-gathering (2*tile, 3, F, B) from the state
+        # (that gather measured 18 ms/round at Epsilon shape)
+        cand = jnp.concatenate([sl, sr])  # (2*tile,) candidate leaf ids
+        cand_ok = jnp.concatenate([active, active])
+        cand_hists = jnp.concatenate([left_hists, right_hists], axis=0)
+        ci = jnp.where(cand_ok, cand, 0)
         bb = _batched_best(
-            hist[fr_idx], state.leaf_sum_g[fr_idx], state.leaf_sum_h[fr_idx],
-            state.leaf_count[fr_idx],
+            cand_hists, state.leaf_sum_g[ci], state.leaf_sum_h[ci],
+            state.leaf_count[ci],
             num_bins_per_feature, missing_bin_per_feature, params,
             feature_mask, categorical_mask, monotone_constraints,
-            interaction_sets, state.leaf_out_lo[fr_idx], state.leaf_out_hi[fr_idx],
-            state.used_features[fr_idx] if interaction_sets is not None else None,
-            node_ids[fr_idx], rng_key,
-            depth=state.leaf_depth[fr_idx], parent_out=state.leaf_out[fr_idx],
+            interaction_sets, state.leaf_out_lo[ci], state.leaf_out_hi[ci],
+            state.used_features[ci] if interaction_sets is not None else None,
+            node_ids[ci], rng_key,
+            depth=state.leaf_depth[ci], parent_out=state.leaf_out[ci],
             cegb_pen=cegb_pen,
             feature_contri=feature_contri,
             lazy_pen=cegb_lazy_penalty if use_lazy else None,
-            lazy_counts=state.lazy_counts[fr_idx] if use_lazy else None,
+            lazy_counts=state.lazy_counts[ci] if use_lazy else None,
         )
-        scatter_pos = jnp.where(fr_ok, fr_idx, 2 * L)  # drop padding slots
+        scatter_pos = jnp.where(cand_ok, cand, 2 * L)  # drop inactive slots
 
         def merge(old, new):
             return old.at[scatter_pos].set(new, mode="drop")
 
         best = BestSplit(*[merge(o, nw) for o, nw in zip(state.best, bb)])
-        return state._replace(hist=hist, best=best,
-                              fresh=jnp.zeros((L,), bool),
-                              small_slot=jnp.full((L,), -1, jnp.int32),
-                              sib=jnp.full((L,), -1, jnp.int32))
+        return state._replace(
+            hist=hist, best=best,
+            fresh=jnp.zeros((L,), bool),
+            small_slot=jnp.full((L,), -1, jnp.int32),
+            slot_left=jnp.full((leaf_tile,), -1, jnp.int32),
+            slot_right=jnp.full((leaf_tile,), -1, jnp.int32),
+            slot_small_left=jnp.zeros((leaf_tile,), bool))
 
     def cond(state: FastState):
         more_leaves = state.num_leaves_cur < L

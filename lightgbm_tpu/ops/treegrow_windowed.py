@@ -26,11 +26,12 @@ full-tree trace blows up — see _fused_eligible) with two jitted phases:
                  recovers big siblings by subtraction, searches fresh
                  leaves.
 
-Scope (v1, gated in models/gbdt.py): single device, numerical features,
-no EFB bundles / forced splits / interaction constraints / monotone
-constraints / CEGB-lazy — configurations outside this envelope fall back
-to the full-pass rounds grower, which supports everything.  Quantized
-int8 training IS supported (it is the wide-regime TPU default).
+Scope (gated in models/gbdt.py): single device; numerical AND (round 5)
+categorical splits + EFB bundles; no forced splits / interaction
+constraints / monotone constraints / CEGB-lazy — configurations outside
+this envelope fall back to the full-pass rounds grower, which supports
+everything.  Quantized int8 training IS supported (it is the wide-regime
+TPU default).
 """
 
 from __future__ import annotations
@@ -44,7 +45,7 @@ import numpy as np
 
 from .hist_pallas import (histogram_pallas_multi,
                           histogram_pallas_multi_quantized)
-from .histogram import histogram
+from .histogram import histogram, unbundle_hists
 from .partition import stable_partition_ranges
 from .split import BestSplit, SplitParams, leaf_output, KMIN_SCORE
 from .treegrow import TreeArrays, _empty_best, _set_best
@@ -68,25 +69,34 @@ class WState(NamedTuple):
     leaf_out: jnp.ndarray
     tree: TreeArrays
     fresh: jnp.ndarray  # (L,) bool
-    small_slot: jnp.ndarray  # (L,) i32 — window slot of fresh SMALL child
-    sib: jnp.ndarray  # (L,) i32
+    slot_left: jnp.ndarray  # (tile,) i32 — left-child leaf per slot (-1
+    # inactive); parent hists live in left slots (see treegrow_fast)
+    slot_right: jnp.ndarray  # (tile,) i32
+    slot_small_left: jnp.ndarray  # (tile,) bool
 
 
-def _pow2_ge(x: int, floor: int = 8192) -> int:
-    """Window size quantization.  Factor-4 steps (not 2): each distinct W
-    is a separate remote Mosaic compile of _round_pass (1-5 min on this
-    toolchain), so four sizes cover 8k..512k rows; the pass over the
-    padding costs far less than a compile ever would."""
+def _window_size(x: int, n: int, floor: int = 8192) -> int:
+    """Window size quantization.  Factor-4 steps to 128k, then factor-2,
+    clamped to round_up(N, floor): each distinct W is a separate remote
+    Mosaic compile of _round_pass (1-5 min on this toolchain), so the
+    ladder stays short — but r5 WPROF showed early rounds with ~130-170k
+    small-children rows landing on W=524288 (> N=400k itself!) under pure
+    factor-4, paying 2.5-4x window overshoot exactly where passes are
+    biggest.  Ladder for N=400k: 8k, 32k, 128k, 256k, 400k-pad (5 sizes)."""
+    cap = -(-n // floor) * floor
     w = floor
-    while w < x:
-        w *= 4
-    return w
+    while w < x and w < cap:
+        w *= 4 if w < 131072 else 2
+    return min(w, cap)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "max_depth", "params",
-                     "leaf_tile"),
+                     "leaf_tile", "has_cat"),
+    donate_argnums=(0,),  # the 1.5 GB-at-Epsilon hist state threads
+    # linearly through the host round loop; donation lets XLA update it in
+    # place instead of alloc+copy per call (benchmarks/probe_r5_fixed.py)
 )
 def _round_admit(
     state: WState,
@@ -99,6 +109,7 @@ def _round_admit(
     max_depth: int,
     params: SplitParams,
     leaf_tile: int,
+    has_cat: bool = False,
 ):
     """Phase 1: admit this round's splits and repartition the row order.
 
@@ -161,6 +172,16 @@ def _round_admit(
     mb = jnp.sum(jnp.where(oh, mb_rk, -1), axis=0) + (leaf_tile - 1)
     dl = jnp.any(oh & dl_rk, axis=0)
     go_left = jnp.where(vals == mb, dl, vals <= thr)
+    if has_cat:
+        # categorical winners route by bitset membership (reference:
+        # Tree::CategoricalDecision — not-in-subset, incl. missing, goes
+        # right); same per-rank one-hot select as the numeric scalars
+        cat_rk = s.is_cat[leaf_of_rank][:, None]  # (tile, 1)
+        cmask_rk = s.cat_mask[leaf_of_rank]  # (tile, B)
+        go_cat_rk = jnp.take_along_axis(cmask_rk, colv, axis=1)  # (tile, N)
+        in_cat = jnp.any(oh & cat_rk, axis=0)
+        gc = jnp.any(oh & go_cat_rk, axis=0)
+        go_left = jnp.where(in_cat, gc, go_left)
     new_order, left_counts = stable_partition_ranges(
         ord_rows, seg_id, seg_start, seg_len, go_left)
 
@@ -207,6 +228,8 @@ def _round_admit(
         internal_value=t.internal_value.at[node_pos].set(parent_out, mode="drop"),
         internal_weight=t.internal_weight.at[node_pos].set(state.leaf_sum_h, mode="drop"),
         internal_count=t.internal_count.at[node_pos].set(state.leaf_count, mode="drop"),
+        is_cat=t.is_cat.at[node_pos].set(s.is_cat, mode="drop"),
+        cat_mask=t.cat_mask.at[node_pos].set(s.cat_mask, mode="drop"),
     )
 
     right_pos = jnp.where(accept, right_of, 2 * L)
@@ -235,16 +258,17 @@ def _round_admit(
     left_smaller = s.left_count <= s.right_count
     fresh = jnp.where(accept, True, jnp.zeros((L,), bool))
     fresh = fresh.at[right_pos].set(True, mode="drop")
-    small_leaf = jnp.where(left_smaller, idx, right_of)
-    slot = jnp.where(accept, acc_rank, -1)
-    small_slot = jnp.full((L,), -1, jnp.int32)
-    small_pos = jnp.where(accept, small_leaf, 2 * L)
-    small_slot = small_slot.at[small_pos].set(slot, mode="drop")
-    sib = jnp.full((L,), -1, jnp.int32)
-    sib = jnp.where(accept, right_of, sib)
-    sib = sib.at[right_pos].set(idx, mode="drop")
+    # per-slot child maps (no full-state parent snapshot: the pass gathers
+    # parent hists from the left-child slots and subtracts compactly —
+    # see treegrow_fast round-5 notes / benchmarks/probe_r5_fixed.py)
+    pos_r = jnp.where(accept, acc_rank, leaf_tile)
+    slot_left = jnp.full((leaf_tile,), -1, jnp.int32).at[pos_r].set(
+        idx, mode="drop")
+    slot_right = jnp.full((leaf_tile,), -1, jnp.int32).at[pos_r].set(
+        right_of, mode="drop")
+    slot_small_left = jnp.zeros((leaf_tile,), bool).at[pos_r].set(
+        left_smaller, mode="drop")
     hist = state.hist
-    hist = hist.at[right_pos].set(hist, mode="drop")  # parent snapshot
 
     # windows: per admission rank, the SMALL child's [start, cnt)
     win_start = jnp.zeros((leaf_tile,), jnp.int32)
@@ -266,7 +290,9 @@ def _round_admit(
         leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h, leaf_count=leaf_count,
         leaf_depth=leaf_depth, leaf_parent=leaf_parent, leaf_side=leaf_side,
         num_leaves_cur=state.num_leaves_cur + k_acc, leaf_out=leaf_out,
-        tree=tree, fresh=fresh, small_slot=small_slot, sib=sib,
+        tree=tree, fresh=fresh,
+        slot_left=slot_left, slot_right=slot_right,
+        slot_small_left=slot_small_left,
     )
     # one packed array -> ONE host transfer per round
     info = jnp.concatenate([
@@ -279,6 +305,7 @@ def _round_admit(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "params", "leaf_tile", "W",
                      "use_pallas", "quantize_bins", "hist_precision"),
+    donate_argnums=(0,),  # see _round_admit
 )
 def _round_pass(
     state: WState,
@@ -296,6 +323,10 @@ def _round_pass(
     feature_mask: jnp.ndarray,
     rng_key: Optional[jnp.ndarray],
     feature_contri: Optional[jnp.ndarray],
+    categorical_mask: Optional[jnp.ndarray] = None,
+    efb_bins_t: Optional[jnp.ndarray] = None,  # (F_b, N) bundled matrix
+    efb_gather: Optional[jnp.ndarray] = None,  # (F, B) -> flat (F_b*B)+pad
+    efb_default: Optional[jnp.ndarray] = None,  # (F, B) bool default slots
     *,
     num_leaves: int,
     num_bins: int,
@@ -330,17 +361,24 @@ def _round_pass(
     # cheaper), then ONE contiguous transpose for the row-major kernel —
     # a lane->sublane reshape per feature inside a feature-major kernel
     # blew the 16M scoped-VMEM budget (measured 19.6M)
-    sub_bins = bins_t[:, rows].T  # (W, F)
+    hist_src = bins_t if efb_bins_t is None else efb_bins_t
+    sub_bins = hist_src[:, rows].T  # (W, F) or (W, F_b)
     mask_w = row_mask[rows] & valid
+
+    def unbundle(h):
+        if efb_gather is None:
+            return h
+        return unbundle_hists(h, efb_gather, efb_default, f, num_bins)
+
     if quantize_bins and use_pallas:
         hi = histogram_pallas_multi_quantized(
             sub_bins, gq[rows], hq[rows], mask_w, slot_of, 0, leaf_tile,
             num_bins)
-        fresh_hists = hi.astype(jnp.float32) * quant_scale[:, None, None]
+        fresh_hists = unbundle(hi).astype(jnp.float32) * quant_scale[:, None, None]
     elif use_pallas:
-        fresh_hists = histogram_pallas_multi(
+        fresh_hists = unbundle(histogram_pallas_multi(
             sub_bins, grad[rows], hess[rows], mask_w, slot_of, 0, leaf_tile,
-            num_bins, precision=hist_precision)
+            num_bins, precision=hist_precision))
     else:
         # CPU/test fallback: masked scatter per slot over the window
         g_w, h_w = grad[rows], hess[rows]
@@ -349,34 +387,42 @@ def _round_pass(
             m = (mask_w & (slot_of == sl)).astype(jnp.float32)
             return histogram(sub_bins, g_w, h_w, m, num_bins,
                              strategy="scatter")
-        fresh_hists = jax.vmap(one)(jnp.arange(leaf_tile, dtype=jnp.int32))
+        fresh_hists = unbundle(
+            jax.vmap(one)(jnp.arange(leaf_tile, dtype=jnp.int32)))
 
-    is_small = state.small_slot >= 0
-    small_pos = jnp.where(is_small, idx, 2 * L)
-    hist = state.hist.at[small_pos].set(
-        fresh_hists[jnp.clip(state.small_slot, 0, None)], mode="drop")
-    is_big = state.fresh & ~is_small
-    small_of_big = jnp.clip(state.sib, 0, L - 1)
-    big_sub = hist[idx] - hist[small_of_big]
-    hist = jnp.where(is_big[:, None, None, None], big_sub, hist)
+    # COMPACT sibling recovery (round 5, mirrors treegrow_fast): gather the
+    # <= tile parent hists from the left-child slots, subtract, scatter
+    # both children once — O(tile) state traffic instead of full-(L,...)
+    active = state.slot_left >= 0  # (tile,)
+    sl = jnp.clip(state.slot_left, 0, L - 1)
+    sr = jnp.clip(state.slot_right, 0, L - 1)
+    parent_hists = state.hist[sl]  # (tile, 3, F, B)
+    big_hists = parent_hists - fresh_hists
+    sml = state.slot_small_left[:, None, None, None]
+    left_hists = jnp.where(sml, fresh_hists, big_hists)
+    right_hists = jnp.where(sml, big_hists, fresh_hists)
+    lpos = jnp.where(active, sl, 2 * L)
+    rpos = jnp.where(active, sr, 2 * L)
+    hist = state.hist.at[lpos].set(left_hists, mode="drop").at[rpos].set(
+        right_hists, mode="drop")
 
-    # fresh-leaf split search (same slot-gather as treegrow_fast)
-    m_slots = min(2 * leaf_tile, L)
-    frm = state.fresh
-    fr_idx = jnp.argsort(jnp.where(frm, idx, L + idx))[:m_slots]
-    fr_ok = frm[fr_idx]
+    # fresh-leaf split search directly on the compact child hists
     node_ids = jnp.clip(state.leaf_parent, 0, None) * 2 + state.leaf_side + 1
+    cand = jnp.concatenate([sl, sr])
+    cand_ok = jnp.concatenate([active, active])
+    cand_hists = jnp.concatenate([left_hists, right_hists], axis=0)
+    ci = jnp.where(cand_ok, cand, 0)
     bb = _batched_best(
-        hist[fr_idx], state.leaf_sum_g[fr_idx], state.leaf_sum_h[fr_idx],
-        state.leaf_count[fr_idx], num_bins_pf, missing_bin_pf, params,
-        feature_mask, None, None, None,
-        jnp.full((m_slots,), -jnp.inf, jnp.float32),
-        jnp.full((m_slots,), jnp.inf, jnp.float32),
-        None, node_ids[fr_idx], rng_key,
-        depth=state.leaf_depth[fr_idx], parent_out=state.leaf_out[fr_idx],
+        cand_hists, state.leaf_sum_g[ci], state.leaf_sum_h[ci],
+        state.leaf_count[ci], num_bins_pf, missing_bin_pf, params,
+        feature_mask, categorical_mask, None, None,
+        jnp.full((2 * leaf_tile,), -jnp.inf, jnp.float32),
+        jnp.full((2 * leaf_tile,), jnp.inf, jnp.float32),
+        None, node_ids[ci], rng_key,
+        depth=state.leaf_depth[ci], parent_out=state.leaf_out[ci],
         feature_contri=feature_contri,
     )
-    scatter_pos = jnp.where(fr_ok, fr_idx, 2 * L)
+    scatter_pos = jnp.where(cand_ok, cand, 2 * L)
 
     def merge(old, new):
         return old.at[scatter_pos].set(new, mode="drop")
@@ -384,8 +430,9 @@ def _round_pass(
     best = BestSplit(*[merge(o, nw) for o, nw in zip(state.best, bb)])
     return state._replace(hist=hist, best=best,
                           fresh=jnp.zeros((L,), bool),
-                          small_slot=jnp.full((L,), -1, jnp.int32),
-                          sib=jnp.full((L,), -1, jnp.int32))
+                          slot_left=jnp.full((leaf_tile,), -1, jnp.int32),
+                          slot_right=jnp.full((leaf_tile,), -1, jnp.int32),
+                          slot_small_left=jnp.zeros((leaf_tile,), bool))
 
 
 @functools.partial(
@@ -397,6 +444,8 @@ def _round_pass(
 def _w_init(
     bins_t, grad, hess, row_mask, sample_weight, num_bins_pf,
     missing_bin_pf, feature_mask, rng_key, quant_key, feature_contri,
+    categorical_mask=None, efb_bins_t=None, efb_gather=None,
+    efb_default=None,
     *,
     num_leaves: int,
     num_bins: int,
@@ -434,18 +483,25 @@ def _w_init(
         hess = hq.astype(jnp.float32) * h_scale
         quant_scale = jnp.stack([g_scale, h_scale, jnp.float32(1.0)])
 
+    hist_src = (bins_t if efb_bins_t is None else efb_bins_t).T
+
+    def unbundle1(h):
+        if efb_gather is None:
+            return h[0]
+        return unbundle_hists(h, efb_gather, efb_default, f, num_bins)[0]
+
     if quantize_bins and use_pallas:
-        hist0 = histogram_pallas_multi_quantized(
-            bins_t.T, gq, hq, row_mask, jnp.zeros((n,), jnp.int32), 0, 1,
-            num_bins)[0].astype(jnp.float32) * quant_scale[:, None, None]
+        hist0 = unbundle1(histogram_pallas_multi_quantized(
+            hist_src, gq, hq, row_mask, jnp.zeros((n,), jnp.int32), 0, 1,
+            num_bins)).astype(jnp.float32) * quant_scale[:, None, None]
     elif use_pallas:
-        hist0 = histogram_pallas_multi(
-            bins_t.T, grad, hess, row_mask, jnp.zeros((n,), jnp.int32), 0, 1,
-            num_bins, precision=hist_precision)[0]
+        hist0 = unbundle1(histogram_pallas_multi(
+            hist_src, grad, hess, row_mask, jnp.zeros((n,), jnp.int32), 0, 1,
+            num_bins, precision=hist_precision))
     else:
-        hist0 = histogram(bins_t.T, grad, hess,
-                          row_mask.astype(jnp.float32), num_bins,
-                          strategy="scatter")
+        hist0 = unbundle1(histogram(
+            hist_src, grad, hess, row_mask.astype(jnp.float32), num_bins,
+            strategy="scatter")[None])
     sum0 = jnp.sum(hist0[:, 0, :], axis=1)  # totals from feature 0: (3,)
     g0, h0, c0 = sum0[0], sum0[1], sum0[2]
     leaf_out0 = leaf_output(g0, h0, params)
@@ -476,7 +532,7 @@ def _w_init(
             _batched_best(
                 hist0[None], jnp.asarray([g0]), jnp.asarray([h0]),
                 jnp.asarray([c0]), num_bins_pf, missing_bin_pf, params,
-                feature_mask, None, None, None,
+                feature_mask, categorical_mask, None, None,
                 jnp.asarray([-jnp.inf], jnp.float32),
                 jnp.asarray([jnp.inf], jnp.float32),
                 None, jnp.asarray([0], jnp.int32), rng_key,
@@ -503,8 +559,9 @@ def _w_init(
         leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(leaf_out0),
         tree=tree0,
         fresh=jnp.zeros((L,), bool),
-        small_slot=jnp.full((L,), -1, jnp.int32),
-        sib=jnp.full((L,), -1, jnp.int32),
+        slot_left=jnp.full((leaf_tile,), -1, jnp.int32),
+        slot_right=jnp.full((leaf_tile,), -1, jnp.int32),
+        slot_small_left=jnp.zeros((leaf_tile,), bool),
     )
     return state, grad, hess, gq, hq, quant_scale, grad_true, hess_true
 
@@ -546,6 +603,10 @@ def grow_tree_windowed(
     rng_key: Optional[jnp.ndarray] = None,
     quant_key: Optional[jnp.ndarray] = None,
     feature_contri: Optional[jnp.ndarray] = None,
+    categorical_mask: Optional[jnp.ndarray] = None,
+    efb_bins_t: Optional[jnp.ndarray] = None,  # (F_b, N) bundled matrix
+    efb_gather: Optional[jnp.ndarray] = None,
+    efb_default: Optional[jnp.ndarray] = None,
     *,
     num_leaves: int,
     num_bins: int,
@@ -564,6 +625,7 @@ def grow_tree_windowed(
     state, g_d, h_d, gq, hq, qs, g_true, h_true = _w_init(
         bins_t, grad, hess, row_mask, sample_weight, num_bins_pf,
         missing_bin_pf, feature_mask, rng_key, quant_key, feature_contri,
+        categorical_mask, efb_bins_t, efb_gather, efb_default,
         use_pallas=use_pallas, quantize_bins=quantize_bins,
         hist_precision=hist_precision,
         stochastic_rounding=stochastic_rounding, **common)
@@ -577,7 +639,8 @@ def grow_tree_windowed(
         t0 = time.perf_counter() if prof else 0.0
         state, info_d = _round_admit(
             state, bins_t, missing_bin_pf, row_mask,
-            max_depth=max_depth, **common)
+            max_depth=max_depth,
+            has_cat=categorical_mask is not None, **common)
         # the one host sync per round (~23 ms through the tunnel)
         info = np.asarray(info_d)
         t1 = time.perf_counter() if prof else 0.0
@@ -587,11 +650,12 @@ def grow_tree_windowed(
         n_leaves += k_acc
         win_start = jnp.asarray(info[2:2 + leaf_tile])
         win_cnt = jnp.asarray(info[2 + leaf_tile:])
-        W = _pow2_ge(total)
+        W = _window_size(total, bins_t.shape[1])
         state = _round_pass(
             state, bins_t, g_d, h_d, gq, hq, qs, row_mask,
             win_start, win_cnt, num_bins_pf, missing_bin_pf, feature_mask,
-            rng_key, feature_contri,
+            rng_key, feature_contri, categorical_mask,
+            efb_bins_t, efb_gather, efb_default,
             W=W, use_pallas=use_pallas, quantize_bins=quantize_bins,
             hist_precision=hist_precision, **common)
         if prof:

@@ -96,6 +96,7 @@ def grow_tree_feature_parallel(
     max_depth: int = -1,
     params: SplitParams = SplitParams(),
     hist_strategy: str = "auto",
+    monotone_method: str = "basic",
 ) -> Tuple[TreeArrays, jnp.ndarray]:
     """SPMD feature-parallel growth: identical trees on every shard.
 
@@ -144,6 +145,7 @@ def grow_tree_feature_parallel(
             hist_strategy=hist_strategy,
             axis_name=DATA_AXIS,
             parallel_mode="feature",
+            monotone_method=monotone_method,
         )
 
     fn = jax.jit(

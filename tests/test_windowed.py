@@ -95,3 +95,166 @@ def test_windowed_quantized_matches_fast_grower_quantized():
         np.asarray(t_q.leaf_value[:nl]), np.asarray(t_fast.leaf_value[:nl]),
         rtol=1e-4, atol=1e-6)
     np.testing.assert_array_equal(np.asarray(lid_q), np.asarray(lid_fast))
+
+
+def test_windowed_categorical_matches_fast_grower():
+    """Round-5 envelope widening: categorical splits in the windowed grower
+    (bitset partition in _round_admit + cat search in _round_pass) must
+    reproduce the fast grower tree-for-tree."""
+    rng = np.random.RandomState(5)
+    n, f, n_cat = 3000, 10, 8
+    X = rng.randn(n, f)
+    cats = rng.randint(0, n_cat, n)
+    X[:, 0] = cats
+    effect = rng.randn(n_cat) * 2.0
+    y = effect[cats] + X[:, 1] + 0.2 * rng.randn(n)
+    binner = DatasetBinner.fit(X, max_bin=63, categorical_features=[0])
+    bins = jnp.asarray(binner.transform(X), jnp.int16)
+    grad = jnp.asarray(2.0 * 0.3 * y, jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    cat_mask = jnp.asarray(np.arange(f) == 0)
+    ones = jnp.ones((n,), bool)
+    sw = jnp.ones((n,), jnp.float32)
+    fm = jnp.ones((f,), bool)
+    nbpf = jnp.asarray(binner.num_bins_per_feature)
+    mbpf = jnp.asarray(binner.missing_bin_per_feature)
+    params = SplitParams(min_data_in_leaf=5.0)
+    kw = dict(num_leaves=15, num_bins=64, params=params, leaf_tile=8,
+              use_pallas=False)
+
+    t_fast, lid_fast = grow_tree_fast(
+        bins, grad, hess, ones, sw, fm, nbpf, mbpf,
+        categorical_mask=cat_mask, **kw)
+    t_win, lid_win = grow_tree_windowed(
+        bins.T, grad, hess, ones, sw, fm, nbpf, mbpf,
+        categorical_mask=cat_mask, **kw)
+
+    assert int(t_win.num_leaves) == int(t_fast.num_leaves)
+    nl = int(t_fast.num_leaves)
+    # the fixture must actually produce categorical splits
+    assert bool(np.asarray(t_fast.is_cat[: nl - 1]).any())
+    np.testing.assert_array_equal(
+        np.asarray(t_win.split_feature[: nl - 1]),
+        np.asarray(t_fast.split_feature[: nl - 1]))
+    np.testing.assert_array_equal(
+        np.asarray(t_win.is_cat[: nl - 1]),
+        np.asarray(t_fast.is_cat[: nl - 1]))
+    np.testing.assert_array_equal(
+        np.asarray(t_win.cat_mask[: nl - 1]),
+        np.asarray(t_fast.cat_mask[: nl - 1]))
+    np.testing.assert_allclose(
+        np.asarray(t_win.leaf_value[:nl]), np.asarray(t_fast.leaf_value[:nl]),
+        rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(lid_win), np.asarray(lid_fast))
+
+
+def test_windowed_efb_matches_fast_grower():
+    """Round-5 envelope widening: EFB bundles in the windowed grower (the
+    window gathers bundled columns; hists unbundle before search) must
+    reproduce the fast grower tree-for-tree on the same bundles."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(6)
+    n, groups = 3000, 12
+    # 8-way one-hot blocks: 87.5% sparse, above EFB's min_sparse_rate
+    blocks = []
+    for g in range(groups):
+        col = rng.randint(0, 8, n)
+        oh = np.zeros((n, 8))
+        oh[np.arange(n), col] = 1.0
+        blocks.append(oh)
+    X = np.concatenate(blocks + [rng.randn(n, 2)], axis=1)
+    y = X @ rng.randn(X.shape[1]) + 0.1 * rng.randn(n)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    assert ds.efb is not None
+    tabs = ds.efb_device_tables()
+    f = ds.bins.shape[1]
+    bins = jnp.asarray(ds.bins, jnp.int16)
+    efb_t = ds.efb_bins_device_t()
+    grad = jnp.asarray(2.0 * 0.3 * y, jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    ones = jnp.ones((n,), bool)
+    sw = jnp.ones((n,), jnp.float32)
+    fm = jnp.ones((f,), bool)
+    nbpf = ds.num_bins_pf_device
+    mbpf = ds.missing_bin_pf_device
+    params = SplitParams(min_data_in_leaf=5.0)
+    kw = dict(num_leaves=15, num_bins=ds.max_num_bins, params=params,
+              leaf_tile=8, use_pallas=False)
+
+    t_fast, lid_fast = grow_tree_fast(
+        bins, grad, hess, ones, sw, fm, nbpf, mbpf,
+        efb_bins=tabs[0], efb_gather=tabs[1], efb_default=tabs[2], **kw)
+    t_win, lid_win = grow_tree_windowed(
+        bins.T, grad, hess, ones, sw, fm, nbpf, mbpf,
+        efb_bins_t=efb_t, efb_gather=tabs[1], efb_default=tabs[2], **kw)
+
+    assert int(t_win.num_leaves) == int(t_fast.num_leaves)
+    nl = int(t_fast.num_leaves)
+    np.testing.assert_array_equal(
+        np.asarray(t_win.split_feature[: nl - 1]),
+        np.asarray(t_fast.split_feature[: nl - 1]))
+    np.testing.assert_array_equal(
+        np.asarray(t_win.threshold_bin[: nl - 1]),
+        np.asarray(t_fast.threshold_bin[: nl - 1]))
+    np.testing.assert_allclose(
+        np.asarray(t_win.leaf_value[:nl]), np.asarray(t_fast.leaf_value[:nl]),
+        rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(lid_win), np.asarray(lid_fast))
+
+
+def test_windowed_efb_quantized_matches_fast_grower():
+    """The production wide-regime DEFAULT combination — int8 quantized +
+    EFB bundles — must also hold tree-for-tree between the growers
+    (deterministic rounding makes both paths exact int histograms; the
+    unbundle's integer default-bin fill is the piece under test)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(7)
+    n, groups = 3000, 8
+    blocks = []
+    for g in range(groups):
+        col = rng.randint(0, 8, n)
+        oh = np.zeros((n, 8))
+        oh[np.arange(n), col] = 1.0
+        blocks.append(oh)
+    X = np.concatenate(blocks + [rng.randn(n, 2)], axis=1)
+    y = X @ rng.randn(X.shape[1]) + 0.1 * rng.randn(n)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    assert ds.efb is not None
+    tabs = ds.efb_device_tables()
+    f = ds.bins.shape[1]
+    bins = jnp.asarray(ds.bins, jnp.int16)
+    grad = jnp.asarray(2.0 * 0.3 * y, jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    ones = jnp.ones((n,), bool)
+    sw = jnp.ones((n,), jnp.float32)
+    fm = jnp.ones((f,), bool)
+    params = SplitParams(min_data_in_leaf=5.0)
+    kw = dict(num_leaves=15, num_bins=ds.max_num_bins, params=params,
+              leaf_tile=8, use_pallas=False)
+    qkw = dict(quantize_bins=16, stochastic_rounding=False, quant_renew=True)
+
+    t_fast, lid_fast = grow_tree_fast(
+        bins, grad, hess, ones, sw, fm, ds.num_bins_pf_device,
+        ds.missing_bin_pf_device,
+        efb_bins=tabs[0], efb_gather=tabs[1], efb_default=tabs[2],
+        **kw, **qkw)
+    t_win, lid_win = grow_tree_windowed(
+        bins.T, grad, hess, ones, sw, fm, ds.num_bins_pf_device,
+        ds.missing_bin_pf_device,
+        efb_bins_t=ds.efb_bins_device_t(), efb_gather=tabs[1],
+        efb_default=tabs[2], **kw, **qkw)
+
+    assert int(t_win.num_leaves) == int(t_fast.num_leaves)
+    nl = int(t_fast.num_leaves)
+    assert nl > 1
+    np.testing.assert_array_equal(
+        np.asarray(t_win.split_feature[: nl - 1]),
+        np.asarray(t_fast.split_feature[: nl - 1]))
+    np.testing.assert_allclose(
+        np.asarray(t_win.leaf_value[:nl]), np.asarray(t_fast.leaf_value[:nl]),
+        rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(lid_win), np.asarray(lid_fast))
