@@ -1,0 +1,63 @@
+"""Round-5 probe: can the 1M x 28 x 255-bin config reach vs_baseline 1.0?
+
+The r3 floor analysis: ~7.6 ms per 255-bin Pallas pass across every dot
+reorganization tried, ~6-7 passes per 31-leaf tree => ~12-13 it/s upper
+region; vs_baseline 1.0 needs 21.8 it/s.  The compact-pair rework (r5)
+removed most per-round fixed costs, so re-test the remaining levers that
+change PASS COUNT or PASS COST:
+
+  tile8-f32   shipped default (8 leaves/pass, 48 lanes)
+  tile10-f32  60 lanes
+  tile16-bf16 bf16 payload halves lanes/leaf -> 16 leaves at 64 lanes
+              (fewer admission rounds; ~8-bit-mantissa hists)
+  tile20-q16  int8 quantized, 3 lanes/leaf -> 20 leaves at 60 lanes
+
+Each one trains 20 iterations end-to-end (host-pull sync).
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def run(tag, params, X, y, iters=20):
+    import lightgbm_tpu as lgb
+
+    ds = lgb.Dataset(X, label=y)
+    t0 = time.perf_counter()
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.update()
+    _ = np.asarray(bst._gbdt._score[:8])
+    warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        bst.update()
+    _ = np.asarray(bst._gbdt._score[:8])
+    spi = (time.perf_counter() - t0) / iters
+    print(f"{tag:14s} {1.0/spi:6.2f} it/s ({spi*1e3:6.1f} ms/iter) "
+          f"warmup {warm:.0f}s", flush=True)
+
+
+def main():
+    n, f = 1_000_000, 28
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f) / np.sqrt(f)
+    y = ((X @ w + 0.3 * rng.randn(n)) > 0).astype(np.float64)
+    base = {"objective": "binary", "num_leaves": 31, "max_bin": 255,
+            "verbosity": -1, "min_data_in_leaf": 20}
+    which = sys.argv[1:] or ["tile8-f32", "tile16-bf16", "tile20-q16"]
+    if "tile8-f32" in which:
+        run("tile8-f32", dict(base), X, y)
+    if "tile16-bf16" in which:
+        run("tile16-bf16", dict(base, hist_precision="bf16"), X, y)
+    if "tile20-q16" in which:
+        run("tile20-q16", dict(base, use_quantized_grad=True,
+                               quant_train_renew_leaf=True), X, y)
+
+
+if __name__ == "__main__":
+    main()

@@ -697,14 +697,16 @@ class GBDT:
         # the wide-data budget is ~60 payload lanes: 10 leaves x 6ch float,
         # or 20 leaves x 3ch quantized (the int path needs no bf16x2 split
         # — half the lanes per leaf buys half the admission rounds)
-        ncl = 3 if quant else 6
+        ncl = 3 if (quant or self.cfg.hist_precision == "bf16") else 6
         fb = min(f_eff if f_eff > 0 else 1, 128)
         fb_pad = max((fb + 7) // 8 * 8, 8)
         budget = 8_000_000  # bytes of VMEM accumulator headroom
         bpad = (max(ts.max_num_bins, 8) + 7) // 8 * 8  # kernel pads B to 8
         per_leaf = fb_pad * bpad * 4 * ncl  # f32/int32 accumulator lanes
         if f_eff <= 128:
-            cap = 8  # narrow: measured optimum is 8
+            # narrow: measured optimum is ~48 payload lanes — 8 leaves for
+            # the 6-channel bf16x2 payload, 16 for 3-channel (int8/bf16)
+            cap = 8 if ncl == 6 else 16
         else:
             cap = 20 if quant else 10  # both = ~60 lanes
         return max(1, min(cap, budget // max(per_leaf, 1), self.cfg.num_leaves))

@@ -120,8 +120,7 @@ def _set_best(best: BestSplit, i: jnp.ndarray, s: BestSplit) -> BestSplit:
     return BestSplit(*[arr.at[i].set(v) for arr, v in zip(best, s)])
 
 
-def _intermediate_bounds(anc, aside, tree, monotone_constraints, leaf_out,
-                         n_live, L, node_mono=None):
+def _intermediate_bounds(anc, aside, node_mono, leaf_out, n_live, L):
     """Monotone 'intermediate' bounds (reference: monotone_constraints.hpp ->
     IntermediateLeafConstraints): instead of compounding midpoint fences
     (basic), each leaf is bounded by the ACTUAL output extremes of the
@@ -130,9 +129,10 @@ def _intermediate_bounds(anc, aside, tree, monotone_constraints, leaf_out,
     future opposite-side leaves respect it in turn.
 
     anc/aside: (L, L-1) ancestor masks (aside = leaf on the right side).
-    node_mono: (L-1,) per-node monotone direction, for callers whose
-    monotone_constraints array is feature-SHARDED (feature-parallel) while
-    tree.split_feature holds global ids.  Returns (lo, hi) of shape (L,)."""
+    node_mono: (L-1,) per-node monotone direction, 0 at categorical nodes —
+    recorded at split time because in feature-parallel mode the constraint
+    vector is feature-SHARDED while tree.split_feature holds global ids
+    (indexing it there would silently misindex).  Returns (lo, hi) (L,)."""
     live = (jnp.arange(L, dtype=jnp.int32) < n_live)[:, None]  # (L, 1)
     left_m = anc & ~aside & live  # (L, M) leaf ℓ lives in m's left subtree
     right_m = anc & aside & live
@@ -142,11 +142,7 @@ def _intermediate_bounds(anc, aside, tree, monotone_constraints, leaf_out,
     l_min = jnp.min(jnp.where(left_m, o, pinf), axis=0)
     r_max = jnp.max(jnp.where(right_m, o, ninf), axis=0)
     r_min = jnp.min(jnp.where(right_m, o, pinf), axis=0)
-    if node_mono is not None:
-        d = node_mono  # (M,) already 0 at categorical nodes
-    else:
-        d = jnp.where(tree.is_cat, 0,
-                      monotone_constraints[tree.split_feature])  # (M,)
+    d = node_mono  # (M,)
     # d=+1 (non-decreasing): right-side leaves >= max(left outputs),
     #                        left-side leaves <= min(right outputs)
     # d=-1 mirrored
@@ -642,8 +638,8 @@ def grow_tree(
             node_mono = state.node_mono.at[node].set(
                 jnp.where(s.is_cat, 0, mono_c))
             leaf_out_lo, leaf_out_hi = _intermediate_bounds(
-                anc, aside, tree, monotone_constraints, leaf_out,
-                state.num_leaves_cur + 1, L, node_mono=node_mono,
+                anc, aside, node_mono, leaf_out,
+                state.num_leaves_cur + 1, L,
             )
         else:
             anc, aside = state.anc, state.aside

@@ -590,8 +590,12 @@ def grow_tree_fast(
             orr = jnp.clip(out_r_c, lo_all, hi_all)
             leaf_out = jnp.where(accept, ol, state.leaf_out)
             leaf_out = leaf_out.at[right_pos].set(orr, mode="drop")
+            # rounds grower runs serial/data only — the constraint vector
+            # is full-width here, so the per-node direction is a lookup
+            node_mono = jnp.where(
+                tree.is_cat, 0, monotone_constraints[tree.split_feature])
             leaf_out_lo, leaf_out_hi = _intermediate_bounds(
-                anc, aside, tree, monotone_constraints, leaf_out,
+                anc, aside, node_mono, leaf_out,
                 state.num_leaves_cur + k_acc, L,
             )
         else:
