@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""Standalone jaxlint runner for pre-commit use:
+
+    python helpers/run_jaxlint.py                  # scan lightgbm_tpu/
+    python helpers/run_jaxlint.py --show-suppressed
+    python helpers/run_jaxlint.py lightgbm_tpu/ops --rules R1,R3
+
+Exit code 0 = clean (same contract tests/test_jaxlint_gate.py enforces in
+tier-1), 1 = unsuppressed findings, 2 = bad usage.  Runs without touching
+JAX device state, so it is safe anywhere — no TPU, no compile cache.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from lightgbm_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if not any(not a.startswith("-") for a in argv):
+        pkg = Path(__file__).resolve().parent.parent / "lightgbm_tpu"
+        argv = [str(pkg)] + argv
+    sys.exit(main(argv))

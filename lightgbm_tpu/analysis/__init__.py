@@ -1,0 +1,42 @@
+"""jaxlint — static purity/recompile analysis for the TPU hot paths.
+
+CI analogue of the reference's ASan/UBSan sanitizer builds (SURVEY §6.2),
+specialized to the failure modes of a jitted JAX codebase:
+
+====  =======================  =============================================
+R1    host-sync-in-hot-path    np.asarray/.item()/float() on device values
+                               in traced code or jit-dispatching host loops
+R2    recompile-hazard         per-call jax.jit construction; unhashable
+                               static-arg literals
+R3    use-after-donate         reads of a variable after it was passed in a
+                               donate_argnums position
+R4    collective-axis-name     psum/all_gather/... axis strings must match
+                               the mesh module's declared axis constants
+R5    impure-under-jit         Python RNG / time.* / global mutation inside
+                               traced functions
+====  =======================  =============================================
+
+Usage::
+
+    python -m lightgbm_tpu.analysis lightgbm_tpu/            # full package
+    python -m lightgbm_tpu.analysis --rules R1,R3 ops/        # subset
+
+or from tests::
+
+    from lightgbm_tpu.analysis import run
+    report = run([pkg_dir])
+    assert report.ok, "\\n".join(f.format() for f in report.findings)
+
+Suppressions are inline pragmas with a mandatory reason::
+
+    info = np.asarray(info_d)  # jaxlint: disable=R1 (the one sync per round)
+
+See docs/ANALYSIS.md for the rule catalogue and how to add a rule.
+"""
+
+from .core import (Finding, PackageIndex, Pragma, Report, RULES,
+                   register_rule, run)
+from . import rules  # noqa: F401  — registers R1-R5 on import
+
+__all__ = ["Finding", "PackageIndex", "Pragma", "Report", "RULES",
+           "register_rule", "run"]

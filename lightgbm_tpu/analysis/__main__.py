@@ -1,0 +1,74 @@
+"""CLI: ``python -m lightgbm_tpu.analysis [paths...]``.
+
+Exit status 0 when no unsuppressed findings, 1 otherwise, 2 on bad usage —
+so the pytest gate (tests/test_jaxlint_gate.py) and pre-commit runs
+(helpers/run_jaxlint.py) share one entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import RULES, run
+from . import rules  # noqa: F401
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.analysis",
+        description="jaxlint: JAX/TPU purity & recompile static analysis")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to scan (default: the "
+                             "installed lightgbm_tpu package)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids (default: all)")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also list pragma-suppressed findings")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            rule = RULES[rid]
+            print(f"{rid}  {rule.name}")
+            for line in rule.doc.splitlines():
+                print(f"      {line.strip()}")
+        return 0
+
+    if args.paths:
+        roots = [Path(p) for p in args.paths]
+    else:
+        roots = [Path(__file__).resolve().parent.parent]
+    for r in roots:
+        if not r.exists():
+            print(f"error: no such path: {r}", file=sys.stderr)
+            return 2
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            print(f"error: unknown rules {unknown}; known: {sorted(RULES)}",
+                  file=sys.stderr)
+            return 2
+
+    report = run(roots, rule_ids)
+    for f in report.findings:
+        print(f.format())
+    if args.show_suppressed:
+        for f, p in report.suppressed:
+            print(f"[suppressed: {p.reason}] {f.format()}")
+    n, s = len(report.findings), len(report.suppressed)
+    print(f"jaxlint: {n} finding(s), {s} suppressed", file=sys.stderr)
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `... | head` closed the pipe
+        sys.exit(0)

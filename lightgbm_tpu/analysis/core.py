@@ -1,0 +1,561 @@
+"""jaxlint core: package indexing, pragma handling, rule registry.
+
+The reference project keeps its C++ tree learner honest with ASan/UBSan CI
+builds (SURVEY §6.2).  The jit-purity analogue for this TPU-native
+reproduction is a static pass over the package source: the bug classes this
+codebase actually breeds are JAX-specific — hidden host syncs in hot loops,
+silent per-round recompiles, reads of donated buffers, axis-name drift
+between collectives and the mesh, and impure Python under trace.  None of
+those are caught by type checkers or flake8; all of them are visible in the
+AST.
+
+Architecture
+------------
+``PackageIndex`` parses every ``.py`` file under the given roots ONCE and
+builds the shared facts rules need:
+
+* per-module ASTs, source lines and ``# jaxlint: disable=`` pragmas,
+* every function definition (module-level and nested) with its jit
+  decoration info (``static_argnums/argnames``, ``donate_argnums/argnames``),
+* a package-local call graph (calls resolved through ``from .x import y``
+  relative imports and module-level names),
+* the *hot set*: functions that are jit-decorated, reachable from a
+  jit-decorated function through the call graph, or host driver loops that
+  dispatch a jitted function from inside ``for``/``while``,
+* declared mesh axis names (module-level ``NAME_AXIS = "literal"``).
+
+Rules live in ``rules.py`` and register themselves with ``@register_rule``;
+each receives the ``PackageIndex`` and yields ``Finding`` objects.  The
+runner applies pragma suppression afterwards so suppressed findings can
+still be listed (``--show-suppressed``).
+
+Pragma format (every exception must be documented)::
+
+    x = np.asarray(d)  # jaxlint: disable=R1 (reason why this is intended)
+
+A pragma on a comment-only line suppresses the next code line.  A pragma
+without a parenthesised reason, or naming an unknown rule, is itself a
+finding (``P0``) and cannot be suppressed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=(?P<rules>[A-Za-z0-9_,\s]+?)"
+    r"(?:\s*\((?P<reason>.*)\))?\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit: location + rule + message + one-line fix hint."""
+
+    file: str
+    line: int
+    rule: str
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        s = f"{self.file}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            s += f"  | hint: {self.hint}"
+        return s
+
+
+@dataclasses.dataclass(frozen=True)
+class Pragma:
+    line: int  # line the pragma suppresses (resolved for comment-only lines)
+    pragma_line: int  # line the pragma text sits on
+    rules: Tuple[str, ...]
+    reason: str
+
+
+class JitInfo:
+    """Decoration facts for a jit-wrapped function."""
+
+    def __init__(self) -> None:
+        self.static_argnums: Tuple[int, ...] = ()
+        self.static_argnames: Tuple[str, ...] = ()
+        self.donate_argnums: Tuple[int, ...] = ()
+        self.donate_argnames: Tuple[str, ...] = ()
+
+
+class FuncInfo:
+    def __init__(self, module: "ModuleInfo", node: ast.FunctionDef,
+                 qualname: str, parent: Optional["FuncInfo"]) -> None:
+        self.module = module
+        self.node = node
+        self.qualname = qualname
+        self.parent = parent
+        self.jit: Optional[JitInfo] = _jit_info_from_decorators(node)
+        self.params: Tuple[str, ...] = tuple(
+            a.arg for a in (node.args.posonlyargs + node.args.args
+                            + node.args.kwonlyargs))
+        # resolved package-local callees: set of (modname, funcname)
+        self.callees: Set[Tuple[str, str]] = set()
+        # resolved jitted callees invoked from inside a for/while loop
+        self.loop_jit_calls: Set[Tuple[str, str]] = set()
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module.name, self.qualname)
+
+
+def _const_int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _const_str_tuple(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant) and isinstance(e.value, str))
+    return ()
+
+
+def is_jax_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / bare ``jit`` reference."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        v = node.value
+        return isinstance(v, ast.Name) and v.id == "jax"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _fill_jit_kwargs(info: JitInfo, keywords: Iterable[ast.keyword]) -> None:
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            info.static_argnums = _const_int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            info.static_argnames = _const_str_tuple(kw.value)
+        elif kw.arg == "donate_argnums":
+            info.donate_argnums = _const_int_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            info.donate_argnames = _const_str_tuple(kw.value)
+
+
+def jit_info_from_call(node: ast.Call) -> Optional[JitInfo]:
+    """``jax.jit(...)`` or ``functools.partial(jax.jit, ...)`` call."""
+    f = node.func
+    if is_jax_jit_expr(f):
+        info = JitInfo()
+        _fill_jit_kwargs(info, node.keywords)
+        return info
+    is_partial = (
+        (isinstance(f, ast.Attribute) and f.attr == "partial")
+        or (isinstance(f, ast.Name) and f.id == "partial")
+    )
+    if is_partial and node.args and is_jax_jit_expr(node.args[0]):
+        info = JitInfo()
+        _fill_jit_kwargs(info, node.keywords)
+        return info
+    return None
+
+
+def _jit_info_from_decorators(node: ast.FunctionDef) -> Optional[JitInfo]:
+    for dec in node.decorator_list:
+        if is_jax_jit_expr(dec):
+            return JitInfo()
+        if isinstance(dec, ast.Call):
+            info = jit_info_from_call(dec)
+            if info is not None:
+                return info
+    return None
+
+
+def has_cache_decorator(node: ast.FunctionDef) -> bool:
+    """``functools.lru_cache`` / ``functools.cache`` (bare or called)."""
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = target.attr if isinstance(target, ast.Attribute) else (
+            target.id if isinstance(target, ast.Name) else "")
+        if name in ("lru_cache", "cache"):
+            return True
+    return False
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` expression -> "a.b.c", else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleInfo:
+    def __init__(self, path: Path, name: str, source: str) -> None:
+        self.path = path
+        self.name = name  # dotted name relative to the scan root
+        self.is_package = path.name == "__init__.py"
+        self.source_lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        self.pragmas: List[Pragma] = []
+        self.bad_pragmas: List[Tuple[int, str]] = []  # (line, why)
+        self.functions: Dict[str, FuncInfo] = {}
+        # name visible at module level -> ("module", modname) for package
+        # modules, or ("func", (modname, funcname)) for imported functions
+        self.imports: Dict[str, Tuple[str, object]] = {}
+        self.axis_constants: Dict[str, str] = {}  # NAME_AXIS -> "literal"
+        self.str_constants: Dict[str, str] = {}  # any NAME -> "literal"
+        self._collect_pragmas()
+        self._collect_axis_constants()
+
+    # -- pragmas ---------------------------------------------------------
+    def _collect_pragmas(self) -> None:
+        for i, text in enumerate(self.source_lines, start=1):
+            m = PRAGMA_RE.search(text)
+            if not m:
+                continue
+            rules = tuple(r.strip() for r in m.group("rules").split(",")
+                          if r.strip())
+            reason = (m.group("reason") or "").strip()
+            target = i
+            if text.lstrip().startswith("#"):
+                # comment-only pragma line: applies to the next CODE line
+                # (skipping further comments and blank lines)
+                j = i
+                while j < len(self.source_lines) and (
+                        not self.source_lines[j].strip()
+                        or self.source_lines[j].lstrip().startswith("#")):
+                    j += 1
+                target = j + 1 if j < len(self.source_lines) else i
+            if not reason:
+                self.bad_pragmas.append(
+                    (i, "pragma has no reason; write "
+                        "`# jaxlint: disable=R<n> (<why>)`"))
+                continue
+            self.pragmas.append(Pragma(target, i, rules, reason))
+
+    def _collect_axis_constants(self) -> None:
+        for node in self.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                name = node.targets[0].id
+                self.str_constants[name] = node.value.value
+                if name.endswith("_AXIS"):
+                    self.axis_constants[name] = node.value.value
+
+    def suppressed(self, finding: Finding) -> Optional[Pragma]:
+        for p in self.pragmas:
+            if p.line == finding.line and (
+                    finding.rule in p.rules or "ALL" in p.rules):
+                return p
+        return None
+
+
+class PackageIndex:
+    """Parsed view of every module under the scan roots."""
+
+    def __init__(self, roots: Iterable[Path]) -> None:
+        self.roots = [Path(r).resolve() for r in roots]
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.errors: List[Finding] = []
+        for root in self.roots:
+            for path in self._iter_py(root):
+                name = self._module_name(root, path)
+                try:
+                    src = path.read_text()
+                    self.modules[name] = ModuleInfo(path, name, src)
+                except (SyntaxError, UnicodeDecodeError) as e:
+                    self.errors.append(Finding(
+                        str(path), getattr(e, "lineno", 1) or 1, "E0",
+                        f"failed to parse: {e}",
+                        "fix the syntax error; jaxlint needs a valid AST"))
+        self._index_functions()
+        self._resolve_imports()
+        self._build_call_graph()
+        self.hot: Set[Tuple[str, str]] = self._compute_hot_set()
+        self.axis_names: Set[str] = set()
+        for mod in self.modules.values():
+            self.axis_names.update(mod.axis_constants.values())
+
+    # -- discovery -------------------------------------------------------
+    @staticmethod
+    def _iter_py(root: Path) -> Iterator[Path]:
+        if root.is_file():
+            yield root
+            return
+        for p in sorted(root.rglob("*.py")):
+            yield p
+
+    @staticmethod
+    def _module_name(root: Path, path: Path) -> str:
+        if root.is_file():
+            return path.stem
+        rel = path.relative_to(root).with_suffix("")
+        parts = [root.name] + list(rel.parts)
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    # -- function index --------------------------------------------------
+    def _index_functions(self) -> None:
+        for mod in self.modules.values():
+            def visit(body, prefix: str, parent: Optional[FuncInfo]) -> None:
+                for node in body:
+                    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        qual = f"{prefix}{node.name}"
+                        fi = FuncInfo(mod, node, qual, parent)
+                        mod.functions[qual] = fi
+                        visit(node.body, qual + ".", fi)
+                    elif isinstance(node, ast.ClassDef):
+                        visit(node.body, f"{prefix}{node.name}.", parent)
+
+            visit(mod.tree.body, "", None)
+
+    # -- imports ---------------------------------------------------------
+    def _resolve_imports(self) -> None:
+        for mod in self.modules.values():
+            # containing package: a package module (__init__.py) IS its own
+            # package — its name already lost the __init__ segment, so
+            # stripping another level would resolve relative imports one
+            # package too high
+            pkg_parts = mod.name.split(".")
+            if not mod.is_package:
+                pkg_parts = pkg_parts[:-1]
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ImportFrom) and node.level > 0:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    target = ".".join(base + (node.module or "").split("."))
+                    target = target.rstrip(".")
+                    for alias in node.names:
+                        local = alias.asname or alias.name
+                        sub = f"{target}.{alias.name}"
+                        # `from ..ops import predict` imports the SUBMODULE
+                        # predict, not a function — check that first (the
+                        # parent package's __init__ is always indexed, so
+                        # "target in modules" alone cannot discriminate)
+                        if sub in self.modules:
+                            mod.imports[local] = ("module", sub)
+                        elif target in self.modules:
+                            mod.imports[local] = ("func", (target, alias.name))
+
+    def _resolve_export(self, modname: str, funcname: str,
+                        _seen: Optional[Set[Tuple[str, str]]] = None
+                        ) -> Optional[Tuple[str, str]]:
+        """Find the defining module of `modname.funcname`, following
+        re-export chains (`__init__.py` doing `from .impl import f`)."""
+        mod = self.modules.get(modname)
+        if mod is None:
+            return None
+        if funcname in mod.functions:
+            return (modname, funcname)
+        imp = mod.imports.get(funcname)
+        if imp and imp[0] == "func":
+            key = (modname, funcname)
+            _seen = _seen or set()
+            if key in _seen:
+                return None
+            _seen.add(key)
+            return self._resolve_export(imp[1][0], imp[1][1], _seen)
+        return None
+
+    def resolve_call(self, mod: ModuleInfo, func_expr: ast.AST
+                     ) -> Optional[Tuple[str, str]]:
+        """Resolve a call's target to a (modname, funcname) in the package."""
+        if isinstance(func_expr, ast.Name):
+            name = func_expr.id
+            if name in mod.functions:
+                return (mod.name, name)
+            imp = mod.imports.get(name)
+            if imp and imp[0] == "func":
+                return self._resolve_export(imp[1][0], imp[1][1])
+        elif isinstance(func_expr, ast.Attribute) and isinstance(
+                func_expr.value, ast.Name):
+            imp = mod.imports.get(func_expr.value.id)
+            if imp and imp[0] == "module":
+                return self._resolve_export(imp[1], func_expr.attr)
+        return None
+
+    def lookup(self, key: Tuple[str, str]) -> Optional[FuncInfo]:
+        mod = self.modules.get(key[0])
+        return mod.functions.get(key[1]) if mod else None
+
+    # -- call graph ------------------------------------------------------
+    def _build_call_graph(self) -> None:
+        for mod in self.modules.values():
+            for fi in mod.functions.values():
+                # direct statements only (nested defs carry their own edges)
+                own_nodes = self._own_body_walk(fi)
+                loop_nodes = self._loop_body_walk(fi)
+                for node in own_nodes:
+                    if isinstance(node, ast.Call):
+                        target = self.resolve_call(mod, node.func)
+                        if target is not None:
+                            fi.callees.add(target)
+                            callee = self.lookup(target)
+                            if (node in loop_nodes and callee is not None
+                                    and callee.jit is not None):
+                                fi.loop_jit_calls.add(target)
+
+    @staticmethod
+    def _own_body_walk(fi: FuncInfo) -> List[ast.AST]:
+        """All nodes in fi's body EXCLUDING nested function bodies."""
+        out: List[ast.AST] = []
+
+        def rec(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                out.append(child)
+                rec(child)
+
+        for stmt in fi.node.body:  # body only: decorators are not "inside"
+            out.append(stmt)
+            rec(stmt)
+        return out
+
+    @staticmethod
+    def _loop_body_walk(fi: FuncInfo) -> Set[ast.AST]:
+        """Nodes inside a for/while in fi's own body (no nested defs)."""
+        out: Set[ast.AST] = set()
+
+        def rec(node: ast.AST, in_loop: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                child_in_loop = in_loop or isinstance(
+                    child, (ast.For, ast.While))
+                if in_loop:
+                    out.add(child)
+                rec(child, child_in_loop)
+
+        for stmt in fi.node.body:
+            rec(stmt, isinstance(stmt, (ast.For, ast.While)))
+        return out
+
+    # -- hot set ---------------------------------------------------------
+    def _compute_hot_set(self) -> Set[Tuple[str, str]]:
+        """Jit-decorated functions plus everything reachable from them."""
+        hot: Set[Tuple[str, str]] = set()
+        stack: List[Tuple[str, str]] = []
+        for mod in self.modules.values():
+            for fi in mod.functions.values():
+                if fi.jit is not None:
+                    hot.add(fi.key)
+                    stack.append(fi.key)
+        while stack:
+            fi = self.lookup(stack.pop())
+            if fi is None:
+                continue
+            for target in fi.callees:
+                if target not in hot:
+                    hot.add(target)
+                    stack.append(target)
+        return hot
+
+    def is_hot(self, fi: FuncInfo) -> bool:
+        """In the traced hot path: jitted, jit-reachable, or nested in one."""
+        cur: Optional[FuncInfo] = fi
+        while cur is not None:
+            if cur.key in self.hot:
+                return True
+            cur = cur.parent
+        return False
+
+    def is_host_driver(self, fi: FuncInfo) -> bool:
+        """Host loop dispatching a jitted function per iteration."""
+        return bool(fi.loop_jit_calls) and not self.is_hot(fi)
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+RuleFn = Callable[[PackageIndex], Iterable[Finding]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    name: str
+    fn: RuleFn
+    doc: str
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(rule_id: str, name: str) -> Callable[[RuleFn], RuleFn]:
+    def deco(fn: RuleFn) -> RuleFn:
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULES[rule_id] = Rule(rule_id, name, fn, (fn.__doc__ or "").strip())
+        return fn
+
+    return deco
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]
+    suppressed: List[Tuple[Finding, Pragma]]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def run(roots: Iterable[Path], rule_ids: Optional[Iterable[str]] = None
+        ) -> Report:
+    """Run the selected rules over the roots; apply pragma suppression."""
+    from . import rules as _rules  # noqa: F401  (registers built-in rules)
+
+    pkg = PackageIndex(roots)
+    selected = sorted(rule_ids) if rule_ids else sorted(RULES)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule ids: {unknown} (have {sorted(RULES)})")
+
+    raw: List[Finding] = list(pkg.errors)
+    for rid in selected:
+        raw.extend(RULES[rid].fn(pkg))
+
+    # pragma validation: unknown rule names and missing reasons are findings
+    for mod in pkg.modules.values():
+        for line, why in mod.bad_pragmas:
+            raw.append(Finding(str(mod.path), line, "P0", why,
+                               "document every suppression with a reason"))
+        for p in mod.pragmas:
+            for rid in p.rules:
+                if rid != "ALL" and rid not in RULES:
+                    raw.append(Finding(
+                        str(mod.path), p.pragma_line, "P0",
+                        f"pragma names unknown rule {rid!r}",
+                        f"known rules: {', '.join(sorted(RULES))}"))
+
+    path_to_mod = {str(m.path): m for m in pkg.modules.values()}
+    findings: List[Finding] = []
+    suppressed: List[Tuple[Finding, Pragma]] = []
+    for f in raw:
+        mod = path_to_mod.get(f.file)
+        p = mod.suppressed(f) if (mod and f.rule != "P0") else None
+        if p is not None:
+            suppressed.append((f, p))
+        else:
+            findings.append(f)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return Report(findings=findings, suppressed=suppressed)

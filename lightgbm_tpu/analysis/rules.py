@@ -1,0 +1,422 @@
+"""jaxlint built-in rules R1-R5.
+
+Each rule is a generator over the :class:`~.core.PackageIndex`; see
+``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
+Scope vocabulary used below:
+
+* *hot function* — jit-decorated, reachable from a jit-decorated function
+  through the package call graph, or nested inside one (its body is traced);
+* *host driver* — a non-traced function whose ``for``/``while`` loop calls a
+  jit-decorated function (the boosting/growth round loops).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import (Finding, FuncInfo, PackageIndex, dotted_name,
+                   has_cache_decorator, jit_info_from_call, register_rule)
+
+_NUMPY_ALIASES = ("np", "numpy", "onp")
+_SYNC_ATTRS = ("item", "tolist")
+_NP_SYNC_FUNCS = ("asarray", "array")
+_CAST_BUILTINS = ("float", "int", "bool")
+_SHAPE_ATTRS = ("shape", "ndim", "size", "dtype")
+_COLLECTIVES = ("psum", "pmax", "pmin", "pmean", "all_gather", "psum_scatter",
+                "all_to_all", "ppermute", "pshuffle", "axis_index")
+_PY_IMPURE_MODULES = ("time", "random")
+
+
+def _own_body(fi: FuncInfo, include_nested: bool = False
+              ) -> Iterator[ast.AST]:
+    """Walk fi's body.  With include_nested=False (the default), nested
+    function defs are skipped — each nested def is its own FuncInfo, so
+    per-function iteration visits every node exactly ONCE (no duplicate
+    findings) while lambdas, which have no FuncInfo, stay with the
+    enclosing function.  include_nested=True additionally descends into
+    nested defs; use it only when iterating top-level functions exclusively
+    (R3 does, to see closure reads)."""
+
+    def rec(node: ast.AST) -> Iterator[ast.AST]:
+        for child in ast.iter_child_nodes(node):
+            if (not include_nested and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef))):
+                continue
+            yield child
+            yield from rec(child)
+
+    def top() -> Iterator[ast.AST]:
+        # statement body only — decorators/defaults/annotations are the
+        # ENCLOSING scope's code (a @partial(jax.jit, ...) decorator is not
+        # a jit constructed "inside" the function it decorates)
+        for stmt in fi.node.body:
+            yield stmt
+            if (not include_nested and isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef))):
+                continue  # direct nested def: own FuncInfo covers its body
+            yield from rec(stmt)
+
+    return top()
+
+
+def _is_np_attr(node: ast.AST, attrs) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr in attrs
+            and isinstance(node.value, ast.Name)
+            and node.value.id in _NUMPY_ALIASES)
+
+
+def _mentions_param(node: ast.AST, params) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in params
+               for n in ast.walk(node))
+
+
+def _is_shape_like(node: ast.AST) -> bool:
+    """Expressions like x.shape[0] / len(x) / x.ndim are Python ints at
+    trace time — casting them is NOT a host sync."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _SHAPE_ATTRS:
+            return True
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "len"):
+            return True
+    return False
+
+
+def _finding(fi: FuncInfo, node: ast.AST, rule: str, msg: str, hint: str
+             ) -> Finding:
+    return Finding(str(fi.module.path), getattr(node, "lineno", fi.node.lineno),
+                   rule, msg, hint)
+
+
+# ---------------------------------------------------------------------------
+# R1 — host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+@register_rule("R1", "host-sync-in-hot-path")
+def r1_host_sync(pkg: PackageIndex) -> Iterator[Finding]:
+    """``np.asarray``/``np.array``/``.item()``/``.tolist()`` force a device
+    pull (or break the trace outright inside jit); builtin ``float``/``int``/
+    ``bool`` applied to a traced parameter concretize it.  In a hot function
+    any of these is a trace error or a silent sync; in a host driver loop it
+    is a per-round round-trip (the ~45 ms/round tunnel syncs of
+    docs/NEXT.md)."""
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            hot = pkg.is_hot(fi)
+            driver = pkg.is_host_driver(fi)
+            if not hot and not driver:
+                continue
+            where = "jit-traced code" if hot else "a jit-dispatching host loop"
+            # in a host driver only the LOOP body is hot: a pull before/after
+            # the loop is a once-per-call cost (e.g. a numpy-returning API
+            # boundary), not the per-round sync class this rule hunts
+            loop_nodes = PackageIndex._loop_body_walk(fi) if driver else None
+            for node in _own_body(fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                if loop_nodes is not None and node not in loop_nodes:
+                    continue
+                if _is_np_attr(node.func, _NP_SYNC_FUNCS):
+                    name = dotted_name(node.func)
+                    yield _finding(
+                        fi, node, "R1",
+                        f"{name}(...) in {where} ({fi.qualname})",
+                        "use jnp inside traces; hoist host pulls out of the "
+                        "round loop or batch them into one sync")
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SYNC_ATTRS and not node.args):
+                    yield _finding(
+                        fi, node, "R1",
+                        f".{node.func.attr}() device pull in {where} "
+                        f"({fi.qualname})",
+                        "keep scalars on device (0-d arrays) until the host "
+                        "actually needs them")
+                elif (hot and isinstance(node.func, ast.Name)
+                        and node.func.id in _CAST_BUILTINS
+                        and len(node.args) == 1
+                        and _mentions_param(node.args[0], fi.params)
+                        and not _is_shape_like(node.args[0])):
+                    yield _finding(
+                        fi, node, "R1",
+                        f"{node.func.id}() concretizes a traced argument in "
+                        f"{fi.qualname}",
+                        "operate on the traced value with jnp, or mark the "
+                        "argument static if it is genuinely a Python scalar")
+
+
+# ---------------------------------------------------------------------------
+# R2 — recompile-hazard
+# ---------------------------------------------------------------------------
+
+def _enclosing_is_cached(fi: FuncInfo) -> bool:
+    cur: Optional[FuncInfo] = fi
+    while cur is not None:
+        if has_cache_decorator(cur.node):
+            return True
+        cur = cur.parent
+    return False
+
+
+@register_rule("R2", "recompile-hazard")
+def r2_recompile(pkg: PackageIndex) -> Iterator[Finding]:
+    """Two statically-detectable recompile classes: (a) a ``jax.jit`` created
+    inside a function body keys a FRESH trace cache per call — every
+    invocation of the enclosing function retraces (and leaks compiled
+    executables), unless the enclosing function is memoized; (b) a
+    list/dict/set literal passed for a ``static_argnames``/``static_argnums``
+    parameter is unhashable and raises at call time.  Per-round retraces
+    from *varying* static values are a runtime property — the compile
+    counter in ``utils/sanitizer.py`` is the matching runtime check."""
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if _enclosing_is_cached(fi):
+                continue
+            # (a) nested jit: decorated nested defs...
+            if fi.jit is not None and fi.parent is not None:
+                if not _enclosing_is_cached(fi.parent):
+                    yield _finding(
+                        fi, fi.node, "R2",
+                        f"jit-decorated {fi.qualname} is created per call of "
+                        f"{fi.parent.qualname} (fresh trace cache each time)",
+                        "hoist the jit to module level, or memoize the "
+                        "factory (functools.lru_cache / an explicit cache)")
+            # ...and jax.jit(...) call expressions in the body
+            for node in _own_body(fi):
+                if isinstance(node, ast.Call) and \
+                        jit_info_from_call(node) is not None:
+                    yield _finding(
+                        fi, node, "R2",
+                        f"jax.jit(...) constructed inside {fi.qualname} "
+                        "(fresh trace cache per call)",
+                        "hoist to module level or memoize the factory "
+                        "(functools.lru_cache) keyed by the static config")
+
+        # (b) unhashable static args at resolved jitted call sites
+        for fi in mod.functions.values():
+            for node in _own_body(fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = pkg.resolve_call(mod, node.func)
+                callee = pkg.lookup(target) if target else None
+                if callee is None or callee.jit is None:
+                    continue
+                static_idx = set(callee.jit.static_argnums)
+                static_names = set(callee.jit.static_argnames)
+                pos_params = callee.params
+                for i, arg in enumerate(node.args):
+                    name = pos_params[i] if i < len(pos_params) else None
+                    if (i in static_idx or name in static_names) and \
+                            isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                        yield _finding(
+                            fi, arg, "R2",
+                            f"unhashable literal for static arg "
+                            f"{name or i} of {callee.qualname}",
+                            "pass a tuple/frozenset — static args are "
+                            "hashed into the jit cache key")
+                for kw in node.keywords:
+                    by_num = (kw.arg in pos_params
+                              and pos_params.index(kw.arg) in static_idx)
+                    if (kw.arg in static_names or by_num) and isinstance(
+                            kw.value, (ast.List, ast.Dict, ast.Set)):
+                        yield _finding(
+                            fi, kw.value, "R2",
+                            f"unhashable literal for static arg {kw.arg} of "
+                            f"{callee.qualname}",
+                            "pass a tuple/frozenset — static args are "
+                            "hashed into the jit cache key")
+
+
+# ---------------------------------------------------------------------------
+# R3 — use-after-donate
+# ---------------------------------------------------------------------------
+
+def _donated_arg_names(callee: FuncInfo, call: ast.Call):
+    """Names of simple variables the call site passes in donated positions."""
+    jit = callee.jit
+    donated_idx = set(jit.donate_argnums)
+    donated_names = set(jit.donate_argnames)
+    pos_params = callee.params
+    for i, arg in enumerate(call.args):
+        pname = pos_params[i] if i < len(pos_params) else None
+        if i in donated_idx or pname in donated_names:
+            dn = dotted_name(arg)
+            if dn:
+                yield dn
+    for kw in call.keywords:
+        if kw.arg in donated_names or (
+                kw.arg in pos_params and pos_params.index(kw.arg) in donated_idx):
+            dn = dotted_name(kw.value)
+            if dn:
+                yield dn
+
+
+@register_rule("R3", "use-after-donate")
+def r3_use_after_donate(pkg: PackageIndex) -> Iterator[Finding]:
+    """A buffer passed through a ``donate_argnums`` position is DEAD after
+    the call — XLA may have reused its memory for the output.  Reading the
+    old variable afterwards raises at best (deleted-buffer error) and
+    corrupts silently at worst (sharded aliasing edge cases).  The windowed
+    grower donates its 1.5 GB-at-Epsilon hist state, so its host loop must
+    thread the state linearly: always rebind (``state = f(state, ...)``),
+    never touch the pre-call name again."""
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if fi.parent is not None:
+                # nested defs are covered by their top-level ancestor's
+                # include_nested walk (closure reads of a donated name must
+                # be visible); iterating them again would double-report
+                continue
+            calls = []  # (lineno, donated-name)
+            rebinds = {}  # name -> sorted lines where it is (re)assigned
+            loads = {}  # name -> lines where it is read
+            for node in _own_body(fi, include_nested=True):
+                if isinstance(node, ast.Call):
+                    target = pkg.resolve_call(mod, node.func)
+                    callee = pkg.lookup(target) if target else None
+                    if callee is not None and callee.jit is not None and (
+                            callee.jit.donate_argnums
+                            or callee.jit.donate_argnames):
+                        for dn in _donated_arg_names(callee, node):
+                            calls.append((node.lineno, dn, callee.qualname))
+                if isinstance(node, (ast.Name, ast.Attribute)):
+                    dn = dotted_name(node)
+                    if dn is None:
+                        continue
+                    ctx = getattr(node, "ctx", None)
+                    if isinstance(ctx, ast.Store):
+                        rebinds.setdefault(dn, []).append(node.lineno)
+                    elif isinstance(ctx, ast.Load):
+                        loads.setdefault(dn, []).append(node.lineno)
+            for call_line, dn, callee_name in calls:
+                # first rebind at/after the call line kills the old binding
+                # (x = f(x) rebinds on the call line itself)
+                rebind_line = min(
+                    (ln for ln in rebinds.get(dn, []) if ln >= call_line),
+                    default=None)
+                for load_line in loads.get(dn, []):
+                    if load_line <= call_line:
+                        continue
+                    if rebind_line is not None and load_line >= rebind_line:
+                        continue
+                    yield Finding(
+                        str(mod.path), load_line, "R3",
+                        f"{dn} read after being donated to {callee_name} "
+                        f"(line {call_line}) in {fi.qualname}",
+                        "rebind the donated variable to the call result "
+                        "(state = f(state, ...)) and only use the new value")
+
+
+# ---------------------------------------------------------------------------
+# R4 — collective-axis-name
+# ---------------------------------------------------------------------------
+
+@register_rule("R4", "collective-axis-name")
+def r4_axis_names(pkg: PackageIndex) -> Iterator[Finding]:
+    """Every string-literal axis name fed to a collective must be one of the
+    axis constants the mesh module declares (``DATA_AXIS``/``FEATURE_AXIS``
+    in ``parallel/mesh.py``): a typo'd axis name fails only when that code
+    path finally runs under ``shard_map``, usually on real hardware.  Names
+    that flow in as function parameters are dynamic and skipped."""
+    declared = pkg.axis_names
+    if not declared:
+        return
+    for mod in pkg.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            if fn is None:
+                continue
+            parts = fn.split(".")
+            if parts[-1] not in _COLLECTIVES:
+                continue
+            if not (len(parts) == 1 or parts[-2] == "lax"):
+                continue
+            axis_arg = None
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis"):
+                    axis_arg = kw.value
+            if axis_arg is None:
+                want = 0 if parts[-1] == "axis_index" else 1
+                if len(node.args) > want:
+                    axis_arg = node.args[want]
+            if axis_arg is None:
+                continue
+            if isinstance(axis_arg, ast.Constant) and isinstance(
+                    axis_arg.value, str):
+                if axis_arg.value not in declared:
+                    yield Finding(
+                        str(mod.path), axis_arg.lineno, "R4",
+                        f"collective axis name {axis_arg.value!r} is not a "
+                        f"declared mesh axis {sorted(declared)}",
+                        "use the axis constants from parallel/mesh.py "
+                        "(DATA_AXIS / FEATURE_AXIS), not ad-hoc strings")
+            elif isinstance(axis_arg, ast.Name):
+                # resolve the name to a module-level string constant (local
+                # or imported); unresolvable names (parameters, locals) are
+                # dynamic and out of static reach
+                nm = axis_arg.id
+                value = mod.str_constants.get(nm)
+                if value is None:
+                    imp = mod.imports.get(nm)
+                    if imp is not None and imp[0] == "func":
+                        src = pkg.modules.get(imp[1][0])
+                        if src is not None:
+                            value = src.str_constants.get(imp[1][1])
+                if value is not None and value not in declared:
+                    yield Finding(
+                        str(mod.path), axis_arg.lineno, "R4",
+                        f"collective axis name {nm}={value!r} is not a "
+                        f"declared mesh axis {sorted(declared)}",
+                        "use the axis constants from parallel/mesh.py "
+                        "(DATA_AXIS / FEATURE_AXIS), not ad-hoc strings")
+
+
+# ---------------------------------------------------------------------------
+# R5 — impure-under-jit
+# ---------------------------------------------------------------------------
+
+@register_rule("R5", "impure-under-jit")
+def r5_impure(pkg: PackageIndex) -> Iterator[Finding]:
+    """Python-level side effects inside traced code run ONCE at trace time
+    and never again: ``time.*`` / stdlib ``random`` / ``np.random`` calls
+    bake a single host value into the compiled program, and ``global``/
+    ``nonlocal`` writes mutate host state from inside a trace (executed at
+    trace time, silently skipped on cached calls).  Use ``jax.random`` with
+    threaded keys, pass times in as arguments, and carry state through
+    function returns."""
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if not pkg.is_hot(fi):
+                continue
+            for node in _own_body(fi):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    kind = ("global" if isinstance(node, ast.Global)
+                            else "nonlocal")
+                    yield _finding(
+                        fi, node, "R5",
+                        f"{kind} write ({', '.join(node.names)}) inside "
+                        f"traced {fi.qualname} runs at trace time only",
+                        "thread state through arguments/returns instead of "
+                        "mutating host scope under jit")
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = dotted_name(node.func)
+                if fn is None:
+                    continue
+                parts = fn.split(".")
+                if parts[0] in _PY_IMPURE_MODULES and len(parts) > 1:
+                    yield _finding(
+                        fi, node, "R5",
+                        f"{fn}() inside traced {fi.qualname} is evaluated "
+                        "once at trace time",
+                        "pass host values in as arguments; use jax.random "
+                        "for in-trace randomness")
+                elif (len(parts) >= 3 and parts[0] in _NUMPY_ALIASES
+                        and parts[1] == "random"):
+                    yield _finding(
+                        fi, node, "R5",
+                        f"{fn}() host RNG inside traced {fi.qualname} "
+                        "(one sample baked into the trace)",
+                        "use jax.random with an explicitly threaded key")

@@ -642,7 +642,7 @@ def grow_tree_windowed(
             max_depth=max_depth,
             has_cat=categorical_mask is not None, **common)
         # the one host sync per round (~23 ms through the tunnel)
-        info = np.asarray(info_d)
+        info = np.asarray(info_d)  # jaxlint: disable=R1 (by design: k_acc/total must reach the host to pick the next static window size W)
         t1 = time.perf_counter() if prof else 0.0
         k_acc, total = int(info[0]), int(info[1])
         if k_acc == 0:
@@ -659,7 +659,7 @@ def grow_tree_windowed(
             W=W, use_pallas=use_pallas, quantize_bins=quantize_bins,
             hist_precision=hist_precision, **common)
         if prof:
-            _ = np.asarray(state.best.gain[:4])  # force the pass to finish
+            _ = np.asarray(state.best.gain[:4])  # jaxlint: disable=R1 (LGBMTPU_WPROF-gated profiling pull, off by default)
             t2 = time.perf_counter()
             print(f"[WPROF] k={k_acc:2d} total={total:7d} W={W:7d} "
                   f"admit+sync={t1 - t0:6.3f}s pass={t2 - t1:6.3f}s",

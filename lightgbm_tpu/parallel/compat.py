@@ -1,0 +1,21 @@
+"""JAX version compatibility for the SPMD learners.
+
+The learners target the stable ``jax.shard_map(..., check_vma=...)`` API
+(JAX >= 0.6).  On older toolchains (0.4.x, where shard_map lives in
+``jax.experimental.shard_map`` and the kwarg is ``check_rep``) the wrapper
+below translates — so the loopback distributed tests and the tier-1
+sanitizer runs work on whichever JAX the container bakes in.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma, **kw)

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.split import SplitParams
 from ..ops.treegrow import TreeArrays, grow_tree
+from .compat import shard_map
 from .mesh import DATA_AXIS
 
 
@@ -128,7 +129,7 @@ def _sharded_grower(mesh, grower, extra_names: tuple, grower_kwargs: tuple):
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             wrapped,
             mesh=mesh,
             in_specs=(
@@ -264,11 +265,12 @@ def _psum_scalar(x, axis_name: str):
     return jax.lax.psum(x, axis_name)
 
 
-def distributed_metric_sums(mesh: Mesh, local_loss_sum: jnp.ndarray, local_weight_sum: jnp.ndarray):
-    """Distributed metric reduction (reference: Network::GlobalSyncUpBySum used
-    by Metric::Eval in every distributed mode)."""
-    fn = jax.jit(
-        jax.shard_map(
+@functools.lru_cache(maxsize=8)
+def _metric_sums_fn(mesh: Mesh):
+    """Cached per-mesh reduction jit: building it inline in
+    distributed_metric_sums keyed a fresh trace every eval round (jaxlint R2)."""
+    return jax.jit(
+        shard_map(
             lambda l, w: (jax.lax.psum(l, DATA_AXIS), jax.lax.psum(w, DATA_AXIS)),
             mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
@@ -276,4 +278,9 @@ def distributed_metric_sums(mesh: Mesh, local_loss_sum: jnp.ndarray, local_weigh
             check_vma=False,
         )
     )
-    return fn(local_loss_sum, local_weight_sum)
+
+
+def distributed_metric_sums(mesh: Mesh, local_loss_sum: jnp.ndarray, local_weight_sum: jnp.ndarray):
+    """Distributed metric reduction (reference: Network::GlobalSyncUpBySum used
+    by Metric::Eval in every distributed mode)."""
+    return _metric_sums_fn(mesh)(local_loss_sum, local_weight_sum)

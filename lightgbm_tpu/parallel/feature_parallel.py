@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.split import SplitParams
 from ..ops.treegrow import TreeArrays, grow_tree
+from .compat import shard_map
 from .mesh import DATA_AXIS
 
 
@@ -76,6 +77,59 @@ class FeatureShardedData:
                 [arr, np.zeros((arr.shape[0], pad), arr.dtype)], axis=1
             )
         return jax.device_put(arr, NamedSharding(self.mesh, P(None, DATA_AXIS)))
+
+
+@functools.lru_cache(maxsize=64)
+def _fp_grower(mesh: Mesh, names: tuple, num_leaves: int, num_bins: int,
+               max_depth: int, params: SplitParams, hist_strategy: str,
+               monotone_method: str):
+    """Cached jitted shard_map wrapper for feature-parallel growth: building
+    the closure inline retraced EVERY boosting iteration (jaxlint R2); caching
+    on (mesh, extras, static config) reuses one trace/compile, matching
+    data_parallel._sharded_grower."""
+    spec_of = {
+        "categorical_mask": P(DATA_AXIS),
+        "monotone_constraints": P(DATA_AXIS),
+        "interaction_sets": P(None, DATA_AXIS),
+        "rng_key": P(),
+        "feature_contri": P(DATA_AXIS),
+    }
+
+    def wrapped(bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_, *extras):
+        return grow_tree(
+            bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_,
+            **dict(zip(names, extras)),
+            num_leaves=num_leaves,
+            num_bins=num_bins,
+            max_depth=max_depth,
+            params=params,
+            hist_strategy=hist_strategy,
+            axis_name=DATA_AXIS,
+            parallel_mode="feature",
+            monotone_method=monotone_method,
+        )
+
+    return jax.jit(
+        shard_map(
+            wrapped,
+            mesh=mesh,
+            in_specs=(
+                P(None, DATA_AXIS),  # bins: columns sharded
+                P(),  # grad (replicated rows)
+                P(),  # hess
+                P(),  # row_mask
+                P(),  # sample_weight
+                P(DATA_AXIS),  # feature_mask
+                P(DATA_AXIS),  # num_bins_pf
+                P(DATA_AXIS),  # missing_bin_pf
+            ) + tuple(spec_of[k] for k in names),
+            out_specs=(
+                TreeArrays(*([P()] * len(TreeArrays._fields))),  # replicated
+                P(),  # leaf_id replicated (all shards hold all rows)
+            ),
+            check_vma=False,
+        )
+    )
 
 
 def grow_tree_feature_parallel(
@@ -126,49 +180,8 @@ def grow_tree_feature_parallel(
         )
     names = list(opt.keys())
     vals = tuple(opt[k] for k in names)
-    spec_of = {
-        "categorical_mask": P(DATA_AXIS),
-        "monotone_constraints": P(DATA_AXIS),
-        "interaction_sets": P(None, DATA_AXIS),
-        "rng_key": P(),
-        "feature_contri": P(DATA_AXIS),
-    }
-
-    def wrapped(bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_, *extras):
-        return grow_tree(
-            bins, grad_, hess_, mask_, sw_, fmask_, nbpf_, mbpf_,
-            **dict(zip(names, extras)),
-            num_leaves=num_leaves,
-            num_bins=num_bins,
-            max_depth=max_depth,
-            params=params,
-            hist_strategy=hist_strategy,
-            axis_name=DATA_AXIS,
-            parallel_mode="feature",
-            monotone_method=monotone_method,
-        )
-
-    fn = jax.jit(
-        jax.shard_map(
-            wrapped,
-            mesh=mesh,
-            in_specs=(
-                P(None, DATA_AXIS),  # bins: columns sharded
-                P(),  # grad (replicated rows)
-                P(),  # hess
-                P(),  # row_mask
-                P(),  # sample_weight
-                P(DATA_AXIS),  # feature_mask
-                P(DATA_AXIS),  # num_bins_pf
-                P(DATA_AXIS),  # missing_bin_pf
-            ) + tuple(spec_of[k] for k in names),
-            out_specs=(
-                TreeArrays(*([P()] * len(TreeArrays._fields))),  # replicated
-                P(),  # leaf_id replicated (all shards hold all rows)
-            ),
-            check_vma=False,
-        )
-    )
+    fn = _fp_grower(mesh, tuple(names), num_leaves, num_bins, max_depth,
+                    params, hist_strategy, monotone_method)
     rep = sharded.rep_sharding
     return fn(
         sharded.bins,

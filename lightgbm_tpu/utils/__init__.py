@@ -1,0 +1,11 @@
+"""Host-side utilities: logging, profiling, runtime sanitizers."""
+
+from .sanitizer import (CompileCounter, RetraceError, assert_donation_consumed,
+                        compile_totals, donation_consumed, donation_supported,
+                        expect_compiles)
+
+__all__ = [
+    "CompileCounter", "RetraceError", "assert_donation_consumed",
+    "compile_totals", "donation_consumed", "donation_supported",
+    "expect_compiles",
+]

@@ -1,0 +1,174 @@
+"""Runtime retrace/donation sanitizer.
+
+The static pass (``lightgbm_tpu/analysis``, jaxlint R2) catches recompile
+hazards visible in the AST; *varying* static arguments and shape drift are
+runtime properties.  This module turns them into executable assertions: a
+process-global ``jax.monitoring`` listener counts every jaxpr trace and every
+XLA backend compile, and :class:`CompileCounter` exposes deltas so a test can
+pin "N boosting rounds at fixed shape compile exactly once" (the per-round
+recompile class docs/NEXT.md suspects in the windowed admit phase).
+
+Counting is cumulative and process-wide — the listener is installed once and
+never removed (``jax.monitoring`` has no unregister; ``clear_event_listeners``
+would nuke listeners we don't own).  Counters snapshot on ``__enter__`` and
+report deltas, so nesting and interleaving are safe.
+
+Donation side: XLA silently ignores ``donate_argnums`` on platforms without
+buffer aliasing (CPU warns and copies), so "the windowed grower donates its
+state" is only true where donation is supported.  :func:`donation_consumed`
+reports whether a donated input was actually invalidated, and
+:func:`assert_donation_consumed` asserts it on platforms that support
+donation while degrading to a no-op where XLA ignores it — tests stay green
+on the CPU tier-1 mesh and bite on device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional
+
+import jax
+
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+_lock = threading.Lock()
+_counts = {"compiles": 0, "traces": 0}
+_installed = False
+
+
+def _listener(event: str, duration: float, **_kw) -> None:  # noqa: ARG001
+    if event == COMPILE_EVENT:
+        with _lock:
+            _counts["compiles"] += 1
+    elif event == TRACE_EVENT:
+        with _lock:
+            _counts["traces"] += 1
+
+
+def _install() -> None:
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+
+
+def compile_totals() -> dict:
+    """Cumulative (process-lifetime) compile/trace counts since install."""
+    _install()
+    with _lock:
+        return dict(_counts)
+
+
+class RetraceError(AssertionError):
+    """A jit compiled/retraced more than the test's contract allows."""
+
+
+class CompileCounter:
+    """Context manager counting XLA backend compiles and jaxpr traces in the
+    enclosed block.
+
+    >>> with CompileCounter() as c:
+    ...     train_some_rounds()
+    >>> assert c.compiles == 0  # everything was warm
+
+    ``compiles`` counts backend (HLO -> executable) compiles: the expensive
+    event, and the one "exactly one compile per (shape, dtype) config" pins.
+    ``traces`` counts jaxpr traces: cheaper, but a per-round retrace that
+    hits the persistent compile cache still shows up here.
+    """
+
+    def __init__(self) -> None:
+        self._c0: Optional[int] = None
+        self._t0: Optional[int] = None
+
+    def __enter__(self) -> "CompileCounter":
+        _install()
+        with _lock:
+            self._c0 = _counts["compiles"]
+            self._t0 = _counts["traces"]
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    @property
+    def compiles(self) -> int:
+        with _lock:
+            return _counts["compiles"] - self._c0
+
+    @property
+    def traces(self) -> int:
+        with _lock:
+            return _counts["traces"] - self._t0
+
+    def assert_compiles(self, expected: int, what: str = "block") -> None:
+        got = self.compiles
+        if got != expected:
+            raise RetraceError(
+                f"{what}: expected exactly {expected} backend compile(s), "
+                f"observed {got} (traces: {self.traces}) — a static arg or "
+                "shape is varying per call; see docs/ANALYSIS.md")
+
+    def assert_no_recompile(self, what: str = "block") -> None:
+        """The steady-state contract: zero compiles AND zero traces —
+        every dispatch in the block hit a warm jit cache."""
+        got_c, got_t = self.compiles, self.traces
+        if got_c or got_t:
+            raise RetraceError(
+                f"{what}: expected a warm cache but observed {got_c} "
+                f"compile(s) / {got_t} trace(s) — something retraces per "
+                "call (varying static arg, new closure identity, or shape "
+                "drift); see docs/ANALYSIS.md")
+
+
+def expect_compiles(expected: int, what: str = "block") -> "_ExpectCompiles":
+    """``with expect_compiles(1): ...`` — raises RetraceError on mismatch."""
+    return _ExpectCompiles(expected, what)
+
+
+class _ExpectCompiles(CompileCounter):
+    def __init__(self, expected: int, what: str) -> None:
+        super().__init__()
+        self._expected = expected
+        self._what = what
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.assert_compiles(self._expected, self._what)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+def donation_supported() -> bool:
+    """Whether the default backend honors donate_argnums (CPU ignores it)."""
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def donation_consumed(*arrays) -> bool:
+    """True when every given donated INPUT buffer was actually invalidated
+    by the call it was donated to (``Array.is_deleted``)."""
+    return all(getattr(a, "is_deleted", lambda: False)() for a in arrays)
+
+
+def assert_donation_consumed(arrays: Iterable, what: str = "donated state"
+                             ) -> None:
+    """Assert donated inputs were consumed — i.e. the donation actually
+    took (the donated jit aliased the buffers) AND the caller cannot be
+    holding a live reference it might read after the call.  No-op on
+    platforms where XLA ignores donation."""
+    if not donation_supported():
+        return
+    arrays = list(arrays)
+    if not donation_consumed(*arrays):
+        alive = sum(1 for a in arrays
+                    if not getattr(a, "is_deleted", lambda: False)())
+        raise AssertionError(
+            f"{what}: {alive}/{len(arrays)} donated buffer(s) still alive "
+            "after the call — donation was dropped (aliasing rejected) or "
+            "the state is not threaded linearly (jaxlint R3)")

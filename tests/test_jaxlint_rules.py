@@ -1,0 +1,575 @@
+"""jaxlint rule self-tests: positive / negative / pragma-suppressed fixture
+snippets per rule (R1-R5), so rule regressions are caught independently of
+the package's own code (which the gate in test_jaxlint_gate.py covers)."""
+
+import textwrap
+
+import pytest
+
+from lightgbm_tpu.analysis import run
+
+
+def _scan(tmp_path, sources, rules=None):
+    """sources: {filename: code} written into one scanned root."""
+    root = tmp_path / "fixture_pkg"
+    root.mkdir()
+    for name, code in sources.items():
+        (root / name).write_text(textwrap.dedent(code))
+    return run([root], rules)
+
+
+# ---------------------------------------------------------------------------
+# R1 host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+def test_r1_positive_sync_in_jit(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            y = np.asarray(x)
+            z = x.item()
+            return float(x) + y + z
+    """}, rules=["R1"])
+    lines = sorted(f.line for f in rep.findings)
+    assert len(rep.findings) == 3, rep.findings
+    assert all(f.rule == "R1" for f in rep.findings)
+    assert lines == [7, 8, 9]
+
+
+def test_r1_positive_sync_in_host_driver_loop(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(s):
+            return s + 1
+
+        def drive(s):
+            for _ in range(3):
+                s = step(s)
+                k = np.asarray(s)
+            return k
+    """}, rules=["R1"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].line == 12
+
+
+def test_r1_positive_reachable_helper_in_other_module(tmp_path):
+    """Host-sync in a helper REACHABLE from a jitted function through a
+    relative import is still hot."""
+    rep = _scan(tmp_path, {
+        "helper.py": """
+            def pull(x):
+                return x.item()
+        """,
+        "mod.py": """
+            import jax
+            from .helper import pull
+
+            @jax.jit
+            def f(x):
+                return pull(x)
+        """,
+    }, rules=["R1"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].file.endswith("helper.py")
+
+
+def test_r1_positive_submodule_attribute_call(tmp_path):
+    """`from . import sub; sub.jitted(x)` in a host loop must resolve —
+    the module-attribute call style gbdt/basic use for the predict ops."""
+    rep = _scan(tmp_path, {
+        "kern.py": """
+            import jax
+
+            @jax.jit
+            def f(s):
+                return s + 1
+        """,
+        "mod.py": """
+            import numpy as np
+            from . import kern
+
+            def drive(s):
+                for _ in range(3):
+                    s = kern.f(s)
+                    k = np.asarray(s)
+                return k
+        """,
+    }, rules=["R1"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].file.endswith("mod.py")
+
+
+def test_r1_positive_through_init_reexport(tmp_path):
+    """A hot-path sync reached through a package __init__ re-export
+    (`from .sub import helper` where sub/__init__.py re-exports it from
+    sub/impl.py) must still resolve: relative imports inside __init__
+    modules resolve at the package's own level, and re-export chains are
+    followed to the defining module."""
+    root = tmp_path / "fixture_pkg"
+    (root / "sub").mkdir(parents=True)
+    (root / "sub" / "__init__.py").write_text(
+        "from .impl import helper\n")
+    (root / "sub" / "impl.py").write_text(
+        "def helper(x):\n    return x.item()\n")
+    (root / "main.py").write_text(
+        "import jax\nfrom .sub import helper\n\n"
+        "@jax.jit\ndef f(x):\n    return helper(x)\n")
+    rep = run([root], ["R1"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].file.endswith("impl.py")
+
+
+def test_r1_negative_shape_and_cold_code(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            n = float(x.shape[0])
+            m = int(len(x))
+            return x * n * m
+
+        def host_setup(data):
+            return np.asarray(data)
+    """}, rules=["R1"])
+    assert rep.findings == []
+
+
+def test_r1_pragma_suppressed(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            y = np.asarray(x)  # jaxlint: disable=R1 (fixture: documented exception)
+            return y
+    """}, rules=["R1"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+    assert rep.suppressed[0][1].reason == "fixture: documented exception"
+
+
+# ---------------------------------------------------------------------------
+# R2 recompile-hazard
+# ---------------------------------------------------------------------------
+
+def test_r2_positive_jit_per_call(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        def make(x):
+            f = jax.jit(lambda v: v + 1)
+            return f(x)
+
+        def outer(x):
+            @jax.jit
+            def inner(v):
+                return v * 2
+            return inner(x)
+    """}, rules=["R2"])
+    assert len(rep.findings) == 2
+    assert all(f.rule == "R2" for f in rep.findings)
+
+
+def test_r2_negative_cached_factory_and_module_jit(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.lru_cache(maxsize=4)
+        def make():
+            return jax.jit(lambda v: v + 1)
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def g(x, k):
+            return x * k
+    """}, rules=["R2"])
+    assert rep.findings == []
+
+
+def test_r2_positive_unhashable_static_literal(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("opts",))
+        def g(x, opts):
+            return x
+
+        def call(x):
+            return g(x, opts=[1, 2])
+    """}, rules=["R2"])
+    assert len(rep.findings) == 1
+    assert "unhashable" in rep.findings[0].message
+
+
+def test_r2_positive_unhashable_static_kwarg_by_argnum(tmp_path):
+    """A static param named via static_argnums but passed by KEYWORD must
+    still be checked for unhashable literals."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def g(x, cfg):
+            return x
+
+        def call(x):
+            return g(x, cfg=[1, 2])
+    """}, rules=["R2"])
+    assert len(rep.findings) == 1
+    assert "unhashable" in rep.findings[0].message
+
+
+def test_r2_negative_hashable_static(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("opts",))
+        def g(x, opts):
+            return x
+
+        def call(x):
+            return g(x, opts=(1, 2))
+    """}, rules=["R2"])
+    assert rep.findings == []
+
+
+def test_r2_pragma_suppressed(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        def make(x):
+            f = jax.jit(lambda v: v + 1)  # jaxlint: disable=R2 (fixture: cached by caller)
+            return f(x)
+    """}, rules=["R2"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R3 use-after-donate
+# ---------------------------------------------------------------------------
+
+def test_r3_positive_read_after_donate(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def upd(state, d):
+            return state + d
+
+        def bad(state, d):
+            out = upd(state, d)
+            return state + out
+    """}, rules=["R3"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].line == 11
+    assert "donated" in rep.findings[0].message
+
+
+def test_r3_negative_linear_threading(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def upd(state, d):
+            return state + d
+
+        def good(state, d):
+            for _ in range(3):
+                state = upd(state, d)
+            return state
+    """}, rules=["R3"])
+    assert rep.findings == []
+
+
+def test_r3_positive_donate_argnames(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnames=("state",))
+        def upd(state, d):
+            return state + d
+
+        def bad(state, d):
+            out = upd(state=state, d=d)
+            probe = state.sum()
+            return out, probe
+    """}, rules=["R3"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].line == 11
+
+
+def test_r3_pragma_suppressed(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def upd(state, d):
+            return state + d
+
+        def checked(state, d):
+            out = upd(state, d)
+            assert state.is_deleted()  # jaxlint: disable=R3 (fixture: donation assertion itself)
+            return out
+    """}, rules=["R3"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R4 collective-axis-name
+# ---------------------------------------------------------------------------
+
+_MESH = """
+    DATA_AXIS = "data"
+    FEATURE_AXIS = "feature"
+"""
+
+
+def test_r4_positive_undeclared_literal(tmp_path):
+    rep = _scan(tmp_path, {
+        "mesh.py": _MESH,
+        "mod.py": """
+            import jax
+
+            def reduce(x):
+                return jax.lax.psum(x, "rows")
+        """,
+    }, rules=["R4"])
+    assert len(rep.findings) == 1
+    assert "'rows'" in rep.findings[0].message
+
+
+def test_r4_negative_declared_and_dynamic(tmp_path):
+    rep = _scan(tmp_path, {
+        "mesh.py": _MESH,
+        "mod.py": """
+            import jax
+            from .mesh import DATA_AXIS
+
+            def reduce(x):
+                return jax.lax.psum(x, DATA_AXIS)
+
+            def literal(x):
+                return jax.lax.pmax(x, "feature")
+
+            def dynamic(x, axis_name):
+                return jax.lax.psum(x, axis_name)
+        """,
+    }, rules=["R4"])
+    assert rep.findings == []
+
+
+def test_r4_axis_index_first_positional(tmp_path):
+    rep = _scan(tmp_path, {
+        "mesh.py": _MESH,
+        "mod.py": """
+            import jax
+
+            def rank(x):
+                return jax.lax.axis_index("machines")
+        """,
+    }, rules=["R4"])
+    assert len(rep.findings) == 1
+
+
+def test_r4_positive_imported_nonaxis_constant(tmp_path):
+    """A Name-bound axis arg that resolves to a module-level string
+    constant which is NOT a declared axis must be flagged."""
+    rep = _scan(tmp_path, {
+        "mesh.py": _MESH,
+        "misc.py": """
+            SOME_NAME = "rows"
+        """,
+        "mod.py": """
+            import jax
+            from .misc import SOME_NAME
+
+            def reduce(x):
+                return jax.lax.psum(x, SOME_NAME)
+        """,
+    }, rules=["R4"])
+    assert len(rep.findings) == 1
+    assert "'rows'" in rep.findings[0].message
+
+
+def test_r4_pragma_suppressed(tmp_path):
+    rep = _scan(tmp_path, {
+        "mesh.py": _MESH,
+        "mod.py": """
+            import jax
+
+            def reduce(x):
+                return jax.lax.psum(x, "rows")  # jaxlint: disable=R4 (fixture: axis from a test-only mesh)
+        """,
+    }, rules=["R4"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R5 impure-under-jit
+# ---------------------------------------------------------------------------
+
+def test_r5_positive_time_rng_global(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import numpy as np
+        import jax
+
+        COUNT = 0
+
+        @jax.jit
+        def f(x):
+            global COUNT
+            COUNT += 1
+            t = time.time()
+            r = np.random.rand()
+            return x + t + r
+    """}, rules=["R5"])
+    assert len(rep.findings) == 3, rep.findings
+    assert any("global" in f.message for f in rep.findings)
+    assert any("time.time" in f.message for f in rep.findings)
+    assert any("np.random.rand" in f.message for f in rep.findings)
+
+
+def test_r5_negative_jax_random_and_host_code(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def f(x, key):
+            return x + jax.random.uniform(key, x.shape)
+
+        def host_bench():
+            t0 = time.time()
+            rng = np.random.RandomState(0)
+            return time.time() - t0, rng.rand()
+    """}, rules=["R5"])
+    assert rep.findings == []
+
+
+def test_r5_pragma_suppressed(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def f(x):
+            t = time.time()  # jaxlint: disable=R5 (fixture: trace-time stamp is intended)
+            return x + t
+    """}, rules=["R5"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# pragma hygiene + CLI plumbing
+# ---------------------------------------------------------------------------
+
+def test_pragma_without_reason_is_a_finding(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x)  # jaxlint: disable=R1
+    """})
+    assert any(f.rule == "P0" for f in rep.findings)
+    # and the R1 is NOT suppressed by the reasonless pragma
+    assert any(f.rule == "R1" for f in rep.findings)
+
+
+def test_pragma_unknown_rule_is_a_finding(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        x = 1  # jaxlint: disable=R99 (no such rule)
+    """})
+    assert any(f.rule == "P0" for f in rep.findings)
+
+
+def test_comment_only_pragma_covers_next_line(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            # jaxlint: disable=R1 (fixture: pragma on its own line)
+            return np.asarray(x)
+    """}, rules=["R1"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+def test_no_duplicate_findings_for_nested_defs(tmp_path):
+    """A defect inside a nested def must be reported exactly once (nested
+    functions are their own FuncInfos AND appear in include_nested walks —
+    a regression here double-reports every nested finding)."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import time
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("cfg",))
+        def g(x, cfg):
+            return x
+
+        def outer(x):
+            def inner(v):
+                return g(v, cfg=[1, 2])
+            return inner(x)
+
+        @jax.jit
+        def traced(x):
+            def helper(v):
+                return v + time.time()
+            return helper(x)
+    """})
+    r2 = [f for f in rep.findings if f.rule == "R2"]
+    r5 = [f for f in rep.findings if f.rule == "R5"]
+    assert len(r2) == 1, r2
+    assert len(r5) == 1, r5
+
+
+def test_comment_only_pragma_skips_blank_lines(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            # jaxlint: disable=R1 (fixture: blank line between pragma and code)
+
+            return np.asarray(x)
+    """}, rules=["R1"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+def test_unknown_rule_selection_raises(tmp_path):
+    with pytest.raises(ValueError):
+        _scan(tmp_path, {"mod.py": "x = 1\n"}, rules=["R42"])
+
+
+def test_syntax_error_is_reported_not_fatal(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": "def broken(:\n"})
+    assert any(f.rule == "E0" for f in rep.findings)

@@ -1,9 +1,14 @@
 """Sanitizer-job analogue (SURVEY §6.2): the reference's CI runs an
 ASan/UBSan build; the jit-purity equivalent here is training under
 jax.enable_checks (internal invariant checking) and jax.debug_nans
-(NaN propagation detection)."""
+(NaN propagation detection) — across every grower the engine can select:
+strict, rounds, int8-quantized rounds, windowed, and a loopback
+data-parallel round.  The static half of the sanitizer story is jaxlint
+(lightgbm_tpu/analysis, gated by test_jaxlint_gate.py); the retrace half
+is utils/sanitizer.py (gated by test_retrace.py)."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -30,6 +35,94 @@ def test_train_under_enable_checks():
 def test_train_under_enable_checks_rounds_grower():
     with jax.enable_checks(True):
         _train_small({"tree_growth_mode": "rounds"})
+
+
+def test_train_under_debug_nans():
+    """jax.debug_nans historically conflated the growers' -inf gain
+    sentinels with NaNs on some paths; the sentinel plumbing is now clean
+    enough to train under it — keep it that way."""
+    with jax.debug_nans(True):
+        _train_small()
+
+
+def test_train_quantized_under_checks_and_debug_nans():
+    """int8 discretized gradients (stochastic rounding, int32 accumulate,
+    dequantized split eval) on the rounds grower under both sanitizers."""
+    with jax.enable_checks(True), jax.debug_nans(True):
+        _train_small({"tree_growth_mode": "rounds",
+                      "use_quantized_grad": True})
+
+
+def _windowed_inputs(n=1500, f=10, seed=0):
+    from lightgbm_tpu.binning import DatasetBinner
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X @ rng.randn(f)
+    binner = DatasetBinner.fit(X, max_bin=63)
+    bins_t = jnp.asarray(binner.transform(X).T, jnp.int16)
+    return binner, bins_t, jnp.asarray(0.6 * y, jnp.float32)
+
+
+def test_windowed_grower_under_enable_checks():
+    """The windowed grower donates its hist state and drives growth from a
+    host loop — the donation/threading invariants are exactly what
+    enable_checks' internal assertions exercise."""
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+
+    binner, bins_t, grad = _windowed_inputs()
+    n, f = bins_t.shape[1], bins_t.shape[0]
+    with jax.enable_checks(True):
+        tree, leaf = grow_tree_windowed(
+            bins_t, grad, jnp.ones((n,), jnp.float32),
+            jnp.ones((n,), bool), jnp.ones((n,), jnp.float32),
+            jnp.ones((f,), bool),
+            jnp.asarray(binner.num_bins_per_feature),
+            jnp.asarray(binner.missing_bin_per_feature),
+            num_leaves=15, num_bins=64,
+            params=SplitParams(min_data_in_leaf=5.0),
+            leaf_tile=4, use_pallas=False)
+    nl = int(tree.num_leaves)
+    assert nl > 1
+    assert np.isfinite(np.asarray(tree.leaf_value[:nl])).all()
+    assert not np.isnan(np.asarray(leaf)).any()
+
+
+def test_data_parallel_round_under_enable_checks():
+    """One loopback data-parallel growth round (shard_map + psum over the
+    virtual CPU mesh) under enable_checks: the collective/sharding layer
+    runs with JAX's internal invariant checks on."""
+    from lightgbm_tpu.binning import DatasetBinner
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.parallel.data_parallel import (ShardedData,
+                                                     grow_tree_data_parallel)
+    from lightgbm_tpu.parallel.mesh import make_mesh
+
+    if jax.device_count() < 4:
+        pytest.skip("needs the virtual multi-device CPU mesh")
+    rng = np.random.RandomState(7)
+    n, f = 1200, 8
+    X = rng.randn(n, f)
+    y = X @ rng.randn(f)
+    binner = DatasetBinner.fit(X, max_bin=31)
+    bins = binner.transform(X)
+    mesh = make_mesh(4)
+    sharded = ShardedData(mesh, bins, binner.num_bins_per_feature,
+                          binner.missing_bin_per_feature)
+    with jax.enable_checks(True):
+        tree, leaf = grow_tree_data_parallel(
+            sharded,
+            sharded.pad_rows(np.asarray(0.6 * y, np.float32)),
+            sharded.pad_rows(np.full(n, 0.25, np.float32)),
+            sharded.pad_rows(np.ones(n, bool), fill=False),
+            sharded.pad_rows(np.ones(n, np.float32), fill=1.0),
+            jnp.ones((f,), bool),
+            num_leaves=7, num_bins=binner.max_num_bins,
+            params=SplitParams(min_data_in_leaf=10))
+    nl = int(tree.num_leaves)
+    assert nl > 1
+    assert np.isfinite(np.asarray(tree.leaf_value[:nl])).all()
 
 
 def test_no_nans_in_training_state():
