@@ -248,11 +248,22 @@ def _pallas_smoke():
         tree_ok[tag] = bool(nl > 1 and np.isfinite(lv).all() and corr > 0.3)
     grower_s = time.perf_counter() - gt0
 
+    # traced-op count of the grower round body at the primary config: the
+    # r5 warmup regression (~137 s -> ~240 s fused-step compile) made
+    # trace size a first-class artifact metric — a jump here flags the
+    # next compile-time regression off-chip, before it costs a 4-minute
+    # tunnel warmup (benchmarks/probe_trace_ops.py has the breakdown)
+    from benchmarks.probe_trace_ops import fast_grower_eqns
+
+    trace_eqns = fast_grower_eqns(n=4096, f=f, num_leaves=31,
+                                  num_bins=64, leaf_tile=8)
+
     _STATE["workloads"]["pallas_smoke"] = {
         "ok": ok, "kernel_s": round(elapsed, 1),
         "grower_float_ok": tree_ok["float"],
         "grower_quant_ok": tree_ok["quant"],
         "grower_s": round(grower_s, 1),
+        "trace_eqns": trace_eqns,
         "platform": jax.devices()[0].platform}
     if not (ok and all(tree_ok.values())):
         # surface the miscomputation as a hard error entry too (_guarded

@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R5.
+"""jaxlint built-in rules R1-R6.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -420,3 +420,164 @@ def r5_impure(pkg: PackageIndex) -> Iterator[Finding]:
                         f"{fn}() host RNG inside traced {fi.qualname} "
                         "(one sample baked into the trace)",
                         "use jax.random with an explicitly threaded key")
+
+
+# ---------------------------------------------------------------------------
+# R6 — fusable-round-loop
+# ---------------------------------------------------------------------------
+
+_HOST_CONSUMER_ATTRS = ("item", "tolist")
+
+
+def _call_names(node: ast.AST) -> set:
+    """Simple names mentioned anywhere in `node`."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _statement_branch_contexts(root: ast.AST) -> dict:
+    """Map each statement under `root` to its chain of enclosing if-arms
+    ((id(if_node), arm), ...) — statements in the body vs orelse of the
+    same ``if`` are mutually exclusive within one iteration."""
+    out: dict = {}
+
+    def rec(stmts, ctx) -> None:
+        for st in stmts:
+            out[st] = ctx
+            if isinstance(st, ast.If):
+                rec(st.body, ctx + ((id(st), 0),))
+                rec(st.orelse, ctx + ((id(st), 1),))
+            elif isinstance(st, ast.Match):
+                for arm, case in enumerate(st.cases):
+                    rec(case.body, ctx + ((id(st), arm),))
+            elif isinstance(st, (ast.For, ast.While)):
+                rec(st.body, ctx)
+                rec(st.orelse, ctx)
+            elif isinstance(st, ast.With):
+                rec(st.body, ctx)
+            elif isinstance(st, ast.Try):
+                rec(st.body, ctx)
+                rec(st.orelse, ctx)
+                rec(st.finalbody, ctx)
+                for h in st.handlers:
+                    rec(h.body, ctx)
+
+    rec(getattr(root, "body", []), ())
+    return out
+
+
+def _mutually_exclusive(ctx_a, ctx_b) -> bool:
+    """True when the two branch contexts share an ``if`` with different
+    arms — at most one of the statements runs per iteration."""
+    arms_a = dict(ctx_a)
+    return any(if_id in arms_a and arms_a[if_id] != arm
+               for if_id, arm in ctx_b)
+
+
+@register_rule("R6", "fusable-round-loop")
+def r6_fusable_round_loop(pkg: PackageIndex) -> Iterator[Finding]:
+    """Two consecutive jitted dispatches on the same DONATED state inside
+    a host round loop, with no host consumer of the first call's results
+    between them, are one fused dispatch waiting to happen: each extra
+    dispatch costs a tunnel round-trip (~1-1.5 ms) and splits the round
+    into separately scheduled XLA programs (the windowed grower's round-6
+    admit/pass split — fused in round 7, docs/PERF_NOTES.md).  A host
+    read (``np.asarray``/``.item()``/``float()`` of the first call's
+    output) between the two is a REAL data dependency the host consumes
+    — the loop genuinely needs the sync (or an async-read protocol) and
+    is not flagged."""
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if not pkg.is_host_driver(fi):
+                continue
+            # pair dispatches PER LOOP: two single-dispatch loops in
+            # sequence share nothing per-iteration and must not pair
+            # (nested loops revisit their nodes under the outer loop too
+            # — `seen` dedups the identical finding)
+            loops = [node for node in _own_body(fi)
+                     if isinstance(node, (ast.For, ast.While))]
+            seen = set()
+            for loop in loops:
+                loop_nodes = set(ast.walk(loop)) - {loop}
+                branch_ctx = _statement_branch_contexts(loop)
+                donated_calls = []  # (line, assigned, donated, qualname, ctx)
+                dispatch_nodes = set()  # AST nodes inside dispatch assigns
+                for node in _own_body(fi):
+                    if node not in loop_nodes:
+                        continue
+                    if isinstance(node, ast.Assign) and isinstance(
+                            node.value, ast.Call):
+                        call = node.value
+                        target = pkg.resolve_call(mod, call.func)
+                        callee = pkg.lookup(target) if target else None
+                        if callee is not None and callee.jit is not None and (
+                                callee.jit.donate_argnums
+                                or callee.jit.donate_argnames):
+                            assigned = set()
+                            for t in node.targets:
+                                assigned |= _call_names(t)
+                            donated_calls.append((
+                                node.lineno, assigned,
+                                set(_donated_arg_names(callee, call)),
+                                callee.qualname, branch_ctx.get(node, ())))
+                            dispatch_nodes.update(ast.walk(node))
+                consumers = []  # (lineno, mentioned-names) — sync calls
+                loads = []  # (lineno, name) — bare reads OUTSIDE dispatches
+                for node in _own_body(fi):
+                    if node not in loop_nodes:
+                        continue
+                    if isinstance(node, ast.Call):
+                        is_sync = _is_np_attr(node.func, _NP_SYNC_FUNCS) or (
+                            isinstance(node.func, ast.Attribute)
+                            and node.func.attr in _HOST_CONSUMER_ATTRS) or (
+                            isinstance(node.func, ast.Name)
+                            and node.func.id in _CAST_BUILTINS)
+                        if is_sync:
+                            consumers.append((node.lineno, _call_names(node)))
+                    if (isinstance(node, ast.Name)
+                            and isinstance(getattr(node, "ctx", None), ast.Load)
+                            and node not in dispatch_nodes):
+                        # reads INSIDE a dispatch are device arguments, not
+                        # host consumption (run_pass(state, info) is still
+                        # fusable); a sync call inside a dispatch argument
+                        # (int(np.asarray(info)[0])) is caught above
+                        loads.append((node.lineno, node.id))
+                donated_calls.sort(key=lambda e: e[0])
+                for (la, assigned, _d_a, name_a, ctx_a), (
+                        lb, _as_b, donated_b, name_b, ctx_b) in zip(
+                        donated_calls, donated_calls[1:]):
+                    threaded = assigned & donated_b
+                    if not threaded:
+                        continue
+                    if _mutually_exclusive(ctx_a, ctx_b):
+                        # if/else arms: only one dispatch runs per
+                        # iteration — nothing to fuse
+                        continue
+                    # a host consumer suppresses the finding — either an
+                    # explicit sync call touching the first dispatch's
+                    # outputs (lc <= lb: a consumer ON the second
+                    # dispatch's line still counts), or a bare read of a
+                    # non-threaded output outside any dispatch
+                    # (`if info[0]: break` implies a real host data
+                    # dependency even without a recognizable sync call)
+                    side_outputs = assigned - donated_b
+                    consumed = any(
+                        la < lc <= lb and (names & assigned)
+                        for lc, names in consumers) or any(
+                        la < ll <= lb and nm in side_outputs
+                        for ll, nm in loads)
+                    if consumed:
+                        continue
+                    key = (la, lb, name_a, name_b)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Finding(
+                        str(mod.path), lb, "R6",
+                        f"{name_b} re-dispatches donated state "
+                        f"{sorted(threaded)} produced by {name_a} (line {la}) "
+                        f"in {fi.qualname}'s round loop with no host consumer "
+                        "between them",
+                        "fuse both phases into one jitted round body (one "
+                        "dispatch/round); if the host truly needs a value "
+                        "between them, read it asynchronously one round behind "
+                        "(utils/sanitizer.py async_pull_*)")

@@ -485,9 +485,10 @@ def predict_sparse_output(bst: Booster, indptr_addr: int, indptr_type: int,
     matching the reference's check.  Returns
     (indptr_addr, indices_addr, data_addr, n_indptr, nnz) where the three
     buffers are malloc()'d here (libc) so LGBM_BoosterFreePredictSparse can
-    free() them from C; indptr is written in indptr_type, data in f64 (the
-    reference allocates f32/f64 per data_type — f64 here, enforced by the
-    C entry rejecting f32 requests).  Multiclass contribs are laid out as
+    free() them from C; indptr is written in indptr_type, data in the
+    REQUESTED data_type — f32 or f64, exactly like the reference
+    allocates per data_type (round 7 closed the f64-only deviation
+    PARITY.md carried).  Multiclass contribs are laid out as
     (nrow, num_class*(num_feature+1)), the reference's dense flattening."""
     import ctypes.util
     import scipy.sparse as sp
@@ -505,6 +506,8 @@ def predict_sparse_output(bst: Booster, indptr_addr: int, indptr_type: int,
     contrib = bst.predict(
         x, pred_contrib=True,
         **_predict_kw(start_iteration, num_iteration, parameter))
+    # sparsify in f64 (exact zero detection on the model's own outputs),
+    # then narrow the kept values to the caller's requested dtype
     contrib = np.ascontiguousarray(
         np.asarray(contrib, np.float64).reshape(x.shape[0], -1))
     mat = (sp.csr_matrix(contrib) if matrix_type == 0
@@ -512,7 +515,8 @@ def predict_sparse_output(bst: Booster, indptr_addr: int, indptr_type: int,
     out_indptr = np.asarray(
         mat.indptr, np.int64 if indptr_type == 3 else np.int32)
     out_indices = np.asarray(mat.indices, np.int32)
-    out_data = np.asarray(mat.data, np.float64)
+    out_data = np.asarray(
+        mat.data, np.float32 if data_type == 0 else np.float64)
 
     libc = ctypes.CDLL(None)
     libc.malloc.restype = ctypes.c_void_p

@@ -643,14 +643,17 @@ class GBDT:
 
         The windowed grower shrinks each histogram pass from full-N to the
         round's small-children window (pass ~200 ms -> ~30 ms at Epsilon,
-        400k x 2000 x 255 bins).  The round-4 parity blocker — per-round
-        fixed costs from the old (L, F, B, 3) hist state's 42x-padded
-        tiled layouts — is addressed by the round-5 channel-first
-        (L, 3, F, B) rework (see ops/histogram.py); measured numbers in
-        docs/PERF_NOTES.md round 5.  Still OPT-IN via
-        windowed_growth=true.  Its v1 feature envelope excludes the rarer
-        options below; anything outside falls back to the full-pass
-        rounds grower, which supports everything."""
+        400k x 2000 x 255 bins).  Round 7 fused its two per-round phases
+        into ONE donated dispatch with zero blocking host syncs (the round
+        driver no longer pulls between admit and pass; window sizes are
+        predicted from the device's own bound and verified on device), and
+        moved the row partition to the Pallas segment kernel — targeting
+        the ~0.10-0.14 s/round admit fixed cost that round 6 measured as
+        the parity blocker (docs/NEXT.md lever 1).  Still OPT-IN via
+        windowed_growth=true until the fused round is re-benched on chip
+        (docs/PERF_NOTES.md round 7).  Its v1 feature envelope excludes
+        the rarer options below; anything outside falls back to the
+        full-pass rounds grower, which supports everything."""
         return (
             self._on_tpu
             and bool(self.cfg.extra.get("windowed_growth", False))
@@ -690,26 +693,14 @@ class GBDT:
             if use_efb and getattr(ts, "efb", None) is not None
             else ts.num_feature()
         )
-        # wide data runs one pallas_call per 128-feature chunk
-        # (ops/hist_pallas.py), so the VMEM accumulator — the binding
-        # constraint — is (min(F,128), lanes, B) f32 regardless of total F;
-        # lanes beyond ~64 also measurably slow the dot (probe_b256b/c), so
-        # the wide-data budget is ~60 payload lanes: 10 leaves x 6ch float,
-        # or 20 leaves x 3ch quantized (the int path needs no bf16x2 split
-        # — half the lanes per leaf buys half the admission rounds)
-        ncl = 3 if (quant or self.cfg.hist_precision == "bf16") else 6
-        fb = min(f_eff if f_eff > 0 else 1, 128)
-        fb_pad = max((fb + 7) // 8 * 8, 8)
-        budget = 8_000_000  # bytes of VMEM accumulator headroom
-        bpad = (max(ts.max_num_bins, 8) + 7) // 8 * 8  # kernel pads B to 8
-        per_leaf = fb_pad * bpad * 4 * ncl  # f32/int32 accumulator lanes
-        if f_eff <= 128:
-            # narrow: measured optimum is ~48 payload lanes — 8 leaves for
-            # the 6-channel bf16x2 payload, 16 for 3-channel (int8/bf16)
-            cap = 8 if ncl == 6 else 16
-        else:
-            cap = 20 if quant else 10  # both = ~60 lanes
-        return max(1, min(cap, budget // max(per_leaf, 1), self.cfg.num_leaves))
+        # channel-aware tile selection lives with the kernel cost model
+        # (ops/hist_pallas.py::recommended_leaf_tile): ~60-lane budgets,
+        # narrow tile16-bf16 / tile20-q16, wide 10-f32 / 20-q
+        from ..ops.hist_pallas import recommended_leaf_tile
+
+        return recommended_leaf_tile(
+            ts.max_num_bins, f_eff, self.cfg.num_leaves,
+            hist_precision=self.cfg.hist_precision, quantized=quant)
 
     _last_mask = None
     _nobag_cache = None

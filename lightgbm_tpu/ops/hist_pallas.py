@@ -59,6 +59,52 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def payload_channels(hist_precision: str, quantized: bool) -> int:
+    """Payload lanes per leaf for the multi-leaf kernels: 6 for the
+    bf16x2-split f32 path, 3 for rounded bf16 or int8-quantized."""
+    return 3 if (quantized or hist_precision == "bf16") else 6
+
+
+def recommended_leaf_tile(
+    num_bins: int,
+    n_features_effective: int,
+    num_leaves: int,
+    *,
+    hist_precision: str = "f32",
+    quantized: bool = False,
+) -> int:
+    """Leaves per multi-leaf pass for THIS module's kernels — the
+    channel-aware tile selection, kept next to the VMEM cost model it
+    budgets against (round 7; previously inlined in models/gbdt.py).
+
+    Wide data runs one pallas_call per 128-feature chunk, so the VMEM
+    accumulator — the binding constraint — is (min(F,128), lanes, B) f32
+    regardless of total F; lanes beyond ~64 also measurably slow the dot
+    (benchmarks/probe_b256b/c), so the wide-data budget is ~60 payload
+    lanes: 10 leaves x 6ch float, or 20 leaves x 3ch quantized (the int
+    path needs no bf16x2 split — half the lanes per leaf buys half the
+    admission rounds).
+
+    Narrow data (one feature chunk) is pass-count-bound, not lane-bound:
+    the measured optimum is ~48-60 payload lanes — 8 leaves for the
+    6-channel bf16x2 payload, 16 for 3-channel bf16, 20 for 3-lane int8
+    (the tile16-bf16 / tile20-q16 configurations of
+    benchmarks/probe_narrow255.py; docs/PERF_NOTES.md round 7 has the
+    255-bin floor analysis they probe against).
+    """
+    ncl = payload_channels(hist_precision, quantized)
+    fb = min(n_features_effective if n_features_effective > 0 else 1, 128)
+    fb_pad = max(_round_up(fb, 8), 8)
+    budget = 8_000_000  # bytes of VMEM accumulator headroom
+    bpad = _round_up(max(num_bins, 8), 8)  # kernel pads B to 8
+    per_leaf = fb_pad * bpad * 4 * ncl  # f32/int32 accumulator lanes
+    if n_features_effective <= 128:
+        cap = 8 if ncl == 6 else (20 if quantized else 16)
+    else:
+        cap = 20 if quantized else 10  # both = ~60 lanes
+    return max(1, min(cap, budget // max(per_leaf, 1), num_leaves))
+
+
 _FEAT_BLOCK = 128  # feature-block width for wide datasets (Epsilon-class);
 # Mosaic requires trailing block dims divisible by 128 (or the full array
 # width, which covers every narrow dataset)

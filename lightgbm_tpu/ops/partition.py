@@ -7,24 +7,61 @@ proportional to live rows instead of N (docs/PERF_NOTES.md round-3 plan).
 
 The reference partitions with per-thread index buffers; here a round's
 splits are applied as ONE fixed-shape stable permutation over the full row
-order: within each split leaf's contiguous range, left-child rows keep
-their relative order and move to the front, right-child rows to the back —
-computed with segment-relative cumulative sums and applied with a single
-permutation scatter.  Everything is O(N) elementwise + 2 cumsums + 1
-scatter; no dynamic shapes.
+order.  Two interchangeable implementations sit behind
+:func:`partition_rows`:
 
-Not yet wired into the growers — grow_tree_fast still histograms with
-full-N masked passes.  Measured on a v5e (docs/PERF_NOTES.md): this op
-costs ~41 ms per 1M-row round and an XLA row-gather of the bin matrix
-~909 ms, so the windowed-pass rework must move the rows with an in-kernel
-Pallas DMA rather than XLA gather/scatter; this module keeps the partition
-SEMANTICS and its equivalence tests for that rework.
+* :func:`stable_partition_ranges` (XLA, this module): segment-relative
+  cumulative sums + one permutation scatter.  Exact, shape-stable, runs
+  everywhere — but O(N) per round (measured ~41 ms at 1M rows on a v5e)
+  even when the round only splits a few small segments.
+* ``ops/partition_pallas.py``: a Pallas kernel that touches ONLY the
+  split segments (the in-place ``DataPartition::Split`` analogue), used
+  by the fused windowed round on TPU; its raw output is merged back over
+  the untouched positions here with the ``seg_id`` mask the admit phase
+  already computed.
+
+Both return identical results; tests/test_partition.py pins the Pallas
+kernel (interpret mode) against the XLA path on the same fixtures.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+
+def partition_rows(
+    order: jnp.ndarray,  # (N,) i32 — current row ids, grouped by leaf
+    seg_id: jnp.ndarray,  # (N,) i32 — split-segment id per POSITION, -1 = not split
+    seg_start: jnp.ndarray,  # (S,) i32
+    seg_len: jnp.ndarray,  # (S,) i32
+    go_left: jnp.ndarray,  # (N,) bool per POSITION
+    *,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply a round's stable segment partition; returns
+    ``(new_order, left_counts)``.
+
+    ``use_pallas`` selects the segment-proportional TPU kernel
+    (``interpret=True`` runs the same kernel through the Pallas
+    interpreter for off-chip tests); otherwise the O(N) XLA permutation.
+    The choice is made at trace time — both paths are pure functions of
+    the same inputs with identical outputs.  The v1 kernel stages its
+    buffers whole in VMEM, so rows beyond its VMEM cap drop to the XLA
+    path automatically (see ops/partition_pallas.py).
+    """
+    if use_pallas or interpret:
+        from .partition_pallas import _MAX_VMEM_ROWS, partition_pallas_segments
+
+        if order.shape[0] > _MAX_VMEM_ROWS and not interpret:
+            return stable_partition_ranges(
+                order, seg_id, seg_start, seg_len, go_left)
+
+        raw, left_counts = partition_pallas_segments(
+            order, seg_start, seg_len, go_left, interpret=interpret)
+        return jnp.where(seg_id >= 0, raw, order), left_counts
+    return stable_partition_ranges(order, seg_id, seg_start, seg_len, go_left)
 
 
 @jax.jit

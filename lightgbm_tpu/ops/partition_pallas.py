@@ -1,0 +1,174 @@
+"""Pallas TPU kernel for the leaf-ordered row partition.
+
+The XLA implementation (ops/partition.py::stable_partition_ranges) is
+exact but pays O(N) regardless of how few rows a round actually splits:
+two full-N cumsums plus a full-N permutation scatter measured ~41 ms per
+1M-row round on a v5e — pure fixed cost from the windowed grower's admit
+phase (docs/NEXT.md round-6 lever 1).  A round only *moves* the rows
+inside its split segments (the parents of this round's splits, at most
+2x the round's window), so the data movement should be window-
+proportional, like the reference's in-place ``DataPartition::Split``
+(src/treelearner/data_partition.hpp) which touches only the split leaf's
+``[start, count)`` index range.
+
+This kernel is that in-place split, vectorized over all of a round's
+split segments:
+
+* grid ``(S, 2, C)`` — per segment, a COUNT phase then a MOVE phase,
+  each sweeping fixed-size chunks; TPU grids execute sequentially, so
+  per-segment running counters live in SMEM scratch across chunks.
+* count phase: vectorized masked sum of ``go_left`` over the segment's
+  chunks -> ``n_left`` (needed before any element can be placed).
+* move phase: a chunk-local ``fori_loop`` placing each row id at
+  ``start + left_rank`` / ``start + n_left + right_rank``.  Stability is
+  inherited from the sequential sweep.
+* compute scales with the segments: chunks past ``seg_len`` are
+  ``pl.when``-skipped, so count-phase vector work and move-phase loop
+  trips are proportional to the segment total, not N.  STAGING is still
+  O(N): the v1 kernel keeps order/go/out as whole-array VMEM blocks
+  (~12 bytes/row across the three buffers), which is cheap next to the
+  2 cumsums + permutation scatter it replaces but caps N at the scoped
+  VMEM budget — the dispatcher (ops/partition.py::partition_rows) falls
+  back to the XLA path above ``_MAX_VMEM_ROWS`` rows, and an
+  HBM-resident variant with explicit per-chunk DMA is the documented
+  round-8 refinement (docs/NEXT.md).  Positions outside every segment
+  are left undefined in the raw output — the caller merges them back
+  with the ``seg_id`` mask it already has.
+
+Validation status (honest): equivalence vs ``stable_partition_ranges``
+is pinned in ``tests/test_partition.py`` through Mosaic INTERPRET mode —
+this container has no TPU.  The kernel compiles from constructs the
+toolchain accepts elsewhere in the repo (scalar prefetch, SMEM scratch,
+``pl.when``, dynamic ``pl.ds``), but the scalar-store move loop is
+untuned; on-chip the expected ceiling is SREG-bound element placement
+(~segment_rows scalar stores), which still beats the full-N scatter once
+windows are < ~N/4.  ``LGBMTPU_PARTITION_PALLAS=0`` falls back to the
+XLA path without retracing semantics (ops/treegrow_windowed.py reads it
+at trace time).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_CHUNK = 512  # rows per grid step; VPU-wide for the count phase, and the
+# move phase's fori_loop body stays short enough to unroll per chunk
+
+# v1 stages order/go/out as full-array VMEM blocks: 3 buffers x 4 bytes x
+# n_pad must fit the ~16 MiB scoped-VMEM cap with headroom — above this
+# the dispatcher uses the XLA path (Epsilon's 400k rows fit; 1M does not)
+_MAX_VMEM_ROWS = 650_000
+
+
+def _partition_kernel(seg_start_ref, seg_len_ref, order_ref, go_ref,
+                      out_ref, lc_ref, carry):
+    """Grid (S, 2, C): segment s, phase (0=count, 1=move), chunk c.
+
+    carry (SMEM, i32): [0] n_left of the current segment, [1] left write
+    cursor, [2] right write cursor — valid across chunks because the TPU
+    grid is sequential (phase/chunk iterate fastest)."""
+    s = pl.program_id(0)
+    ph = pl.program_id(1)
+    c = pl.program_id(2)
+    start = seg_start_ref[s]
+    base = start + c * _CHUNK
+    rem = seg_len_ref[s] - c * _CHUNK
+
+    @pl.when((ph == 0) & (c == 0))
+    def _reset_count():
+        carry[0] = 0
+
+    @pl.when((ph == 0) & (rem > 0))
+    def _count():
+        m = jnp.minimum(rem, _CHUNK)
+        vals = go_ref[:, pl.ds(base, _CHUNK)]  # (1, CHUNK) i32 0/1
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, _CHUNK), 1)
+        carry[0] += jnp.sum(jnp.where(iota < m, vals, 0))
+
+    @pl.when((ph == 1) & (c == 0))
+    def _start_move():
+        lc_ref[0, s] = carry[0]
+        carry[1] = 0
+        carry[2] = 0
+
+    @pl.when((ph == 1) & (rem > 0))
+    def _move():
+        m = jnp.minimum(rem, _CHUNK)
+        n_left = carry[0]
+
+        def place(i, cur):
+            left_cur, right_cur = cur
+            g = go_ref[0, base + i]
+            dest = jnp.where(g > 0, start + left_cur,
+                             start + n_left + right_cur)
+            out_ref[0, dest] = order_ref[0, base + i]
+            return (left_cur + g, right_cur + 1 - g)
+
+        left_cur, right_cur = jax.lax.fori_loop(
+            0, m, place, (carry[1], carry[2]))
+        carry[1] = left_cur
+        carry[2] = right_cur
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def partition_pallas_segments(
+    order: jnp.ndarray,  # (N,) i32 — row ids, physically grouped by leaf
+    seg_start: jnp.ndarray,  # (S,) i32 — start POSITION of each segment
+    seg_len: jnp.ndarray,  # (S,) i32 — length (0 = inactive slot)
+    go_left: jnp.ndarray,  # (N,) bool per POSITION
+    *,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Stably partition every segment of ``order`` by ``go_left``.
+
+    Returns ``(raw_order, left_counts)`` where ``raw_order`` holds the
+    partitioned row ids INSIDE segments and undefined values outside —
+    merge with ``jnp.where(seg_id >= 0, raw_order, order)`` (the
+    dispatcher in ops/partition.py does).  Segments must be disjoint.
+    """
+    n = order.shape[0]
+    S = seg_start.shape[0]
+    C = pl.cdiv(n, _CHUNK)
+    # pad so every chunk slice is in range: a segment's last chunk may
+    # slice up to CHUNK-1 past N, and an out-of-range pl.ds start CLAMPS
+    # (silently reading shifted data) — the iota<rem mask then does the
+    # real bounding against the padded tail
+    n_pad = (C + 1) * _CHUNK
+    order_p = jnp.pad(order, (0, n_pad - n))
+    go_p = jnp.pad(go_left.astype(jnp.int32), (0, n_pad - n))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, 2, C),
+        in_specs=[
+            pl.BlockSpec((1, n_pad), lambda s, p, c, *_: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_pad), lambda s, p, c, *_: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_pad), lambda s, p, c, *_: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S), lambda s, p, c, *_: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[pltpu.SMEM((4,), jnp.int32)],
+    )
+    raw, lc = pl.pallas_call(
+        _partition_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n_pad), order.dtype),
+            jax.ShapeDtypeStruct((1, S), jnp.int32),
+        ],
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(seg_start.astype(jnp.int32), seg_len.astype(jnp.int32),
+      order_p[None], go_p[None])
+    return raw[0, :n], lc[0]

@@ -453,9 +453,16 @@ def grow_tree_fast(
                 can = can & ~veto
             budget = L - state.num_leaves_cur  # how many new leaves fit
             # best-gain-first admission within budget, but at most leaf_tile
-            # splits per round (one multi-hist pass)
-            order_rank = jnp.argsort(jnp.argsort(jnp.where(can, -gains, jnp.inf)))
+            # splits per round (one multi-hist pass).  The accepted set is a
+            # PREFIX of the stable sort order (can-leaves sort first), so
+            # the sort doubles as the rank->leaf map below — one argsort
+            # fewer in the trace (round-7 warmup diet,
+            # benchmarks/probe_trace_ops.py)
+            srt = jnp.argsort(jnp.where(can, -gains, jnp.inf))
+            order_rank = jnp.argsort(srt)
             accept = can & (order_rank < jnp.minimum(budget, leaf_tile))
+            inv_rank = srt  # leaf at rank r; ranks >= k_acc are guarded by
+            # accept[] at every use
             s = state.best  # vectorized split info (L,)
         else:
             # forced round (reference: ForceSplits): admit EXACTLY the
@@ -465,6 +472,7 @@ def grow_tree_fast(
             f_leaf, s_f, f_valid = forced
             accept = (jnp.arange(L, dtype=jnp.int32) == f_leaf) & f_valid
             order_rank = jnp.where(accept, 0, L)
+            inv_rank = jnp.argsort(order_rank)  # forced leaf at rank 0
             s = jax.tree.map(lambda b, v: b.at[f_leaf].set(v), state.best, s_f)
         k_acc = jnp.sum(accept.astype(jnp.int32))
 
@@ -479,7 +487,6 @@ def grow_tree_fast(
         # TPU (measured ~30 ms/round), while 16 strided column slices +
         # elementwise selects cost ~0.2 ms.
         lid = state.leaf_id
-        inv_rank = jnp.argsort(jnp.where(accept, order_rank, L))  # leaf at rank r
         leaf_id = lid
         for r in range(leaf_tile):
             leaf_r = inv_rank[r]

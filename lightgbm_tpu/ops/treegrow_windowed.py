@@ -8,23 +8,45 @@ keeps rows PHYSICALLY grouped by leaf (reference: DataPartition's
 [start, count) ranges — src/treelearner/data_partition.hpp) so each round
 gathers ONLY the small-children rows into a power-of-two window and runs
 the pass over that window: total row-touches drop from rounds*N toward
-~N (docs/PERF_NOTES.md round-4 plan; ops/partition.py holds the
-permutation op and its equivalence tests).
+~N (docs/PERF_NOTES.md round-4 plan).
 
-Structure: a HOST round loop (the wide regime is exactly where the fused
-full-tree trace blows up — see _fused_eligible) with two jitted phases:
+Round 7 structure — ONE donated jit dispatch per round, ZERO blocking
+host syncs in steady state.  Rounds 1-6 ran a host loop with two jitted
+phases (admit, then pass at a host-chosen static window size W) and one
+blocking ``np.asarray`` between them: ~0.10-0.14 s/round of fixed admit
+cost, 2 tunnel dispatches and a ~45 ms sync capped the grower at parity
+with the full-pass grower (docs/NEXT.md round-6 lever 1).  Now:
 
-  _round_admit   fixed shapes; gain admission, stable partition of the
-                 row order, leaf-range/tree/aggregate bookkeeping; returns
-                 the round's small-child windows as small arrays (the one
-                 host sync per round, ~23 ms through the tunnel).
-  _round_pass    static window size W (power-of-two quantized to bound
-                 recompiles); gathers window rows feature-major
-                 (bins_t[:, rows] — measured ~43 ms for ALL 400k rows at
-                 2000 features, so a window costs proportionally less),
-                 runs the multi-leaf Pallas pass in feature-major layout,
-                 recovers big siblings by subtraction, searches fresh
-                 leaves.
+* ``_round_fused`` traces admit AND pass in one jitted, donated body.
+  The window size W is still jit-static (power-of-two-laddered to bound
+  remote Mosaic compiles), but the host no longer syncs to learn it —
+  W is PREDICTED, and the round body verifies on device that the real
+  window fits (it always does, see the bound below); a breach skips the
+  round and reports, so a wrong prediction costs a retried dispatch,
+  never a wrong tree.
+* the host pipelines 1 round deep: it dispatches round r+1 before
+  resolving round r-1's 4-scalar info vector, which was copied back with
+  ``copy_to_host_async`` one dispatch earlier — the read overlaps device
+  compute of the in-flight round, so the device queue never drains
+  (utils/sanitizer.py async_pull_* accounting).
+* W prediction: every split's small child holds <= floor(cnt/2) of its
+  leaf, and any leaf split within the next TWO rounds descends from a
+  leaf live now — two same-parent descendants' small children sum to
+  <= floor(parent_cnt/2) — so the sum of the top-(leaf_tile ∧ budget)
+  values of floor(leaf_cnt/2) over live leaves bounds BOTH following
+  rounds' window totals.  The round body emits that bound (``whint``)
+  and the host ladders it two dispatches later: the factor-2 window
+  ladder absorbs the slack.
+* the row partition inside the fused body goes through
+  ops/partition.py::partition_rows: the Pallas segment kernel
+  (ops/partition_pallas.py) on TPU — touching only the split segments —
+  with the O(N) XLA permutation as the CPU/fallback path.
+
+The per-round dispatch/sync budget is an executable invariant: the
+driver counts every dispatch and host pull through utils/sanitizer.py,
+``LGBMTPU_DISPATCH_BUDGET=1`` makes it raise on a breach, and
+tests/test_retrace.py pins "1 dispatch, 0 blocking syncs per round,
+zero retraces" at fixed shape.
 
 Scope (gated in models/gbdt.py): single device; numerical AND (round 5)
 categorical splits + EFB bundles; no forced splits / interaction
@@ -37,16 +59,17 @@ TPU default).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
+from ..utils import sanitizer as _san
 from .hist_pallas import (histogram_pallas_multi,
                           histogram_pallas_multi_quantized)
 from .histogram import histogram, unbundle_hists
-from .partition import stable_partition_ranges
+from .partition import partition_rows
 from .split import BestSplit, SplitParams, leaf_output, KMIN_SCORE
 from .treegrow import TreeArrays, _empty_best, _set_best
 from .treegrow_fast import _batched_best
@@ -68,17 +91,12 @@ class WState(NamedTuple):
     num_leaves_cur: jnp.ndarray
     leaf_out: jnp.ndarray
     tree: TreeArrays
-    fresh: jnp.ndarray  # (L,) bool
-    slot_left: jnp.ndarray  # (tile,) i32 — left-child leaf per slot (-1
-    # inactive); parent hists live in left slots (see treegrow_fast)
-    slot_right: jnp.ndarray  # (tile,) i32
-    slot_small_left: jnp.ndarray  # (tile,) bool
 
 
 def _window_size(x: int, n: int, floor: int = 8192) -> int:
     """Window size quantization.  Factor-4 steps to 128k, then factor-2,
     clamped to round_up(N, floor): each distinct W is a separate remote
-    Mosaic compile of _round_pass (1-5 min on this toolchain), so the
+    Mosaic compile of the fused round (1-5 min on this toolchain), so the
     ladder stays short — but r5 WPROF showed early rounds with ~130-170k
     small-children rows landing on W=524288 (> N=400k itself!) under pure
     factor-4, paying 2.5-4x window overshoot exactly where passes are
@@ -93,51 +111,75 @@ def _window_size(x: int, n: int, floor: int = 8192) -> int:
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "max_depth", "params",
-                     "leaf_tile", "has_cat"),
+                     "leaf_tile", "W", "use_pallas", "quantize_bins",
+                     "hist_precision", "has_cat", "pallas_partition"),
     donate_argnums=(0,),  # the 1.5 GB-at-Epsilon hist state threads
     # linearly through the host round loop; donation lets XLA update it in
     # place instead of alloc+copy per call (benchmarks/probe_r5_fixed.py)
 )
-def _round_admit(
+def _round_fused(
     state: WState,
     bins_t: jnp.ndarray,  # (F, N) int16 — FIXED original row order
-    missing_bin_pf: jnp.ndarray,
+    grad: jnp.ndarray,  # (N,) f32 by ROW id (dequantized under quant)
+    hess: jnp.ndarray,
+    gq: Optional[jnp.ndarray],  # (N,) int8 or None
+    hq: Optional[jnp.ndarray],
+    quant_scale: Optional[jnp.ndarray],  # (3,) or None
     row_mask: jnp.ndarray,  # (N,) bool by ROW id
+    num_bins_pf: jnp.ndarray,
+    missing_bin_pf: jnp.ndarray,
+    feature_mask: jnp.ndarray,
+    rng_key: Optional[jnp.ndarray],
+    feature_contri: Optional[jnp.ndarray],
+    categorical_mask: Optional[jnp.ndarray] = None,
+    efb_bins_t: Optional[jnp.ndarray] = None,  # (F_b, N) bundled matrix
+    efb_gather: Optional[jnp.ndarray] = None,  # (F, B) -> flat (F_b*B)+pad
+    efb_default: Optional[jnp.ndarray] = None,  # (F, B) bool default slots
     *,
     num_leaves: int,
     num_bins: int,
     max_depth: int,
     params: SplitParams,
     leaf_tile: int,
+    W: int,
+    use_pallas: bool,
+    quantize_bins: int,
+    hist_precision: str,
     has_cat: bool = False,
+    pallas_partition: bool = False,
 ):
-    """Phase 1: admit this round's splits and repartition the row order.
+    """One whole boosting round in one traced body: gain admission,
+    segment partition, bookkeeping, window gather, multi-leaf pass,
+    sibling subtraction, fresh-leaf search, next-window bound.
 
-    Returns (state', info) where info = (k_acc, win_start (tile,),
-    win_cnt (tile,), gains_left) — the small arrays the host loop syncs.
+    Returns (state', info) with info = [k_acc, window_total, fits_W,
+    whint] (i32) — the ONLY values that ever reach the host, read
+    asynchronously one round behind.  If the admitted splits' window
+    would not fit the static W (impossible while the whint bound holds;
+    kept as a device-verified safety net), the round applies NOTHING
+    (bitwise-identical state passthrough) and reports fits_W=0 with the
+    needed total so the host retries at a corrected W.
     """
     L = num_leaves
+    f = bins_t.shape[0]
     n = state.order.shape[0]
     eps = KMIN_SCORE / 2
+    idx = jnp.arange(L, dtype=jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
 
+    # ---- admission (identical semantics to treegrow_fast round_body) ----
     gains = state.best.gain
     can = gains > eps
     if max_depth > 0:
         can = can & (state.leaf_depth < max_depth)
     budget = L - state.num_leaves_cur
-    order_rank = jnp.argsort(jnp.argsort(jnp.where(can, -gains, jnp.inf)))
-    accept = can & (order_rank < jnp.minimum(budget, leaf_tile))
+    key = jnp.where(can, -gains, jnp.inf)
+    srt = jnp.argsort(key)  # leaf at rank r (stable); doubles as inv_rank
+    order_rank = jnp.argsort(srt)
+    accept0 = can & (order_rank < jnp.minimum(budget, leaf_tile))
     s = state.best
-    k_acc = jnp.sum(accept.astype(jnp.int32))
 
-    acc_rank = jnp.where(accept, order_rank, L)
-    node_of = state.num_leaves_cur - 1 + acc_rank
-    right_of = state.num_leaves_cur + acc_rank
-    inv_rank = jnp.argsort(jnp.where(accept, order_rank, L))
-    idx = jnp.arange(L, dtype=jnp.int32)
-    pos = jnp.arange(n, dtype=jnp.int32)
-
-    # ---- partition the physical row order at segment boundaries ----
+    # ---- split decisions + segment geometry (pre-partition) ----
     # One fused gather instead of leaf_tile full-N column gathers (measured
     # ~240 ms/round the sequential way at 400k x 2000): slice the <= tile
     # accepted split features into a (tile, N) block (contiguous row reads
@@ -147,8 +189,8 @@ def _round_admit(
     seg_start = jnp.zeros((leaf_tile,), jnp.int32)
     seg_len = jnp.zeros((leaf_tile,), jnp.int32)
     ord_rows = state.order
-    leaf_of_rank = inv_rank[:leaf_tile]
-    live_rk = accept[leaf_of_rank]
+    leaf_of_rank = srt[:leaf_tile]
+    live_rk = accept0[leaf_of_rank]
     feats_rk = jnp.where(live_rk, s.feature[leaf_of_rank], 0)
     cols = bins_t[feats_rk]  # (tile, N) by ROW id
     colv = cols[:, ord_rows].astype(jnp.int32)  # (tile, N) by POSITION
@@ -182,17 +224,44 @@ def _round_admit(
         in_cat = jnp.any(oh & cat_rk, axis=0)
         gc = jnp.any(oh & go_cat_rk, axis=0)
         go_left = jnp.where(in_cat, gc, go_left)
-    new_order, left_counts = stable_partition_ranges(
-        ord_rows, seg_id, seg_start, seg_len, go_left)
+
+    # ---- on-device window verification (the fused round's safety net) ----
+    # per-rank left counts from the one-hot the decisions already built —
+    # O(tile*N) elementwise, no extra cumsums; in-segment positions only
+    in_seg_all = seg_id >= 0
+    left_counts = jnp.sum(
+        (oh & (go_left & in_seg_all)[None, :]).astype(jnp.int32), axis=1)
+    win_cnt_rk = jnp.where(
+        live_rk, jnp.minimum(left_counts, seg_len - left_counts), 0)
+    total = jnp.sum(win_cnt_rk)
+    ok = total <= W  # guaranteed by the whint bound; verified anyway
+
+    # everything applied below is gated on `ok`: a breached prediction
+    # makes the whole round a bitwise no-op (state threads through
+    # unchanged) and the host folds the correction into the next dispatch
+    accept = accept0 & ok
+    live_rk = live_rk & ok
+    k_acc = jnp.sum(accept.astype(jnp.int32))
+    acc_rank = jnp.where(accept, order_rank, L)
+    node_of = state.num_leaves_cur - 1 + acc_rank
+    right_of = state.num_leaves_cur + acc_rank
+    seg_id = jnp.where(ok, seg_id, -1)
+    seg_len_eff = jnp.where(ok, seg_len, 0)
+    n_left_seg = jnp.where(live_rk, left_counts, 0)
+
+    # ---- partition the physical row order at segment boundaries ----
+    new_order, _ = partition_rows(
+        ord_rows, seg_id, seg_start, seg_len_eff, go_left,
+        use_pallas=pallas_partition)
 
     # ---- leaf ranges + per-row leaf ids ----
     leaf_start, leaf_cnt = state.leaf_start, state.leaf_cnt
     lid_pos = state.leaf_id[new_order]  # leaf per POSITION (pre-split)
     for r in range(leaf_tile):
-        leaf_r = inv_rank[r]
+        leaf_r = srt[r]
         live_r = accept[leaf_r]
         st = state.leaf_start[leaf_r]
-        lc = left_counts[r]
+        lc = n_left_seg[r]
         ct = state.leaf_cnt[leaf_r]
         rp = jnp.clip(right_of[leaf_r], 0, L - 1)
         leaf_start = jnp.where(
@@ -253,30 +322,36 @@ def _round_admit(
     out_r = leaf_output(s.right_sum_g, s.right_sum_h, params)
     leaf_out = jnp.where(accept, out_l, state.leaf_out)
     leaf_out = leaf_out.at[right_pos].set(out_r, mode="drop")
+    num_leaves_new = state.num_leaves_cur + k_acc
 
-    # ---- fresh/small bookkeeping + the round's windows ----
-    left_smaller = s.left_count <= s.right_count
+    # ---- fresh/small bookkeeping + this round's windows ----
+    # per-slot child maps stay LOCAL to the fused body (rounds 1-6 carried
+    # them in WState to hand admit's result to the separate pass dispatch;
+    # the fusion is what lets them die here).
+    # The window child is chosen by PHYSICAL row counts — the same
+    # quantity the gather pays for, the `ok` check verified against W,
+    # and the whint bound promises about (rounds 1-6 chose by in-bag
+    # counts, which under bagging can pick the physically BIGGER child
+    # and desynchronize the window sum from the verified total; which
+    # child is histogrammed directly vs recovered by subtraction does
+    # not change the children's histograms)
+    left_smaller_rk = 2 * n_left_seg <= seg_len  # (tile,) per rank
     fresh = jnp.where(accept, True, jnp.zeros((L,), bool))
     fresh = fresh.at[right_pos].set(True, mode="drop")
-    # per-slot child maps (no full-state parent snapshot: the pass gathers
-    # parent hists from the left-child slots and subtracts compactly —
-    # see treegrow_fast round-5 notes / benchmarks/probe_r5_fixed.py)
     pos_r = jnp.where(accept, acc_rank, leaf_tile)
     slot_left = jnp.full((leaf_tile,), -1, jnp.int32).at[pos_r].set(
         idx, mode="drop")
     slot_right = jnp.full((leaf_tile,), -1, jnp.int32).at[pos_r].set(
         right_of, mode="drop")
-    slot_small_left = jnp.zeros((leaf_tile,), bool).at[pos_r].set(
-        left_smaller, mode="drop")
-    hist = state.hist
+    slot_small_left = live_rk & left_smaller_rk  # slot r == rank r
 
     # windows: per admission rank, the SMALL child's [start, cnt)
     win_start = jnp.zeros((leaf_tile,), jnp.int32)
     win_cnt = jnp.zeros((leaf_tile,), jnp.int32)
     for r in range(leaf_tile):
-        leaf_r = inv_rank[r]
+        leaf_r = srt[r]
         live_r = accept[leaf_r]
-        sm = jnp.where(left_smaller[leaf_r], leaf_r,
+        sm = jnp.where(left_smaller_rk[r], leaf_r,
                        jnp.clip(right_of[leaf_r], 0, L - 1))
         win_start = win_start.at[r].set(jnp.where(live_r, leaf_start[sm], 0))
         win_cnt = win_cnt.at[r].set(jnp.where(live_r, leaf_cnt[sm], 0))
@@ -284,77 +359,21 @@ def _round_admit(
     best = state.best._replace(
         gain=jnp.where(fresh, jnp.full((L,), KMIN_SCORE, jnp.float32),
                        state.best.gain))
-    state = WState(
-        order=new_order, leaf_start=leaf_start, leaf_cnt=leaf_cnt,
-        leaf_id=leaf_id, hist=hist, best=best,
-        leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h, leaf_count=leaf_count,
-        leaf_depth=leaf_depth, leaf_parent=leaf_parent, leaf_side=leaf_side,
-        num_leaves_cur=state.num_leaves_cur + k_acc, leaf_out=leaf_out,
-        tree=tree, fresh=fresh,
-        slot_left=slot_left, slot_right=slot_right,
-        slot_small_left=slot_small_left,
-    )
-    # one packed array -> ONE host transfer per round
-    info = jnp.concatenate([
-        k_acc[None], jnp.sum(win_cnt)[None], win_start, win_cnt,
-    ]).astype(jnp.int32)
-    return state, info
 
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_leaves", "num_bins", "params", "leaf_tile", "W",
-                     "use_pallas", "quantize_bins", "hist_precision"),
-    donate_argnums=(0,),  # see _round_admit
-)
-def _round_pass(
-    state: WState,
-    bins_t: jnp.ndarray,  # (F, N) int16
-    grad: jnp.ndarray,  # (N,) f32 by ROW id (dequantized under quant)
-    hess: jnp.ndarray,
-    gq: Optional[jnp.ndarray],  # (N,) int8 or None
-    hq: Optional[jnp.ndarray],
-    quant_scale: Optional[jnp.ndarray],  # (3,) or None
-    row_mask: jnp.ndarray,  # (N,) bool by ROW id
-    win_start: jnp.ndarray,  # (tile,)
-    win_cnt: jnp.ndarray,
-    num_bins_pf: jnp.ndarray,
-    missing_bin_pf: jnp.ndarray,
-    feature_mask: jnp.ndarray,
-    rng_key: Optional[jnp.ndarray],
-    feature_contri: Optional[jnp.ndarray],
-    categorical_mask: Optional[jnp.ndarray] = None,
-    efb_bins_t: Optional[jnp.ndarray] = None,  # (F_b, N) bundled matrix
-    efb_gather: Optional[jnp.ndarray] = None,  # (F, B) -> flat (F_b*B)+pad
-    efb_default: Optional[jnp.ndarray] = None,  # (F, B) bool default slots
-    *,
-    num_leaves: int,
-    num_bins: int,
-    params: SplitParams,
-    leaf_tile: int,
-    W: int,
-    use_pallas: bool,
-    quantize_bins: int,
-    hist_precision: str,
-):
-    """Phase 2: window gather -> one multi-leaf pass -> sibling subtraction
-    -> fresh-leaf split search."""
-    L = num_leaves
-    f = bins_t.shape[0]
-    idx = jnp.arange(L, dtype=jnp.int32)
-
+    # ---- pass: window gather -> one multi-leaf pass -> sibling
+    # subtraction -> fresh-leaf split search (same trace, no dispatch) ----
     offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                             jnp.cumsum(win_cnt).astype(jnp.int32)])
-    total = offs[-1]
+    w_total = offs[-1]
     aw = jnp.arange(W, dtype=jnp.int32)
     # slot per window element: number of window boundaries <= position
     slot_of = jnp.sum((aw[:, None] >= offs[1:][None, :]).astype(jnp.int32),
                       axis=1)
     slot_of = jnp.clip(slot_of, 0, leaf_tile - 1)
     wpos = win_start[slot_of] + (aw - offs[slot_of])
-    valid = aw < total
+    valid = aw < w_total
     wpos = jnp.where(valid, wpos, 0)
-    rows = state.order[wpos]  # (W,) row ids
+    rows = new_order[wpos]  # (W,) row ids
 
     # feature-major window gather (a row gather on the (N, F) layout
     # measured ~909 ms at 1M x 28; column slices of (F, N) are ~20x
@@ -393,12 +412,12 @@ def _round_pass(
     # COMPACT sibling recovery (round 5, mirrors treegrow_fast): gather the
     # <= tile parent hists from the left-child slots, subtract, scatter
     # both children once — O(tile) state traffic instead of full-(L,...)
-    active = state.slot_left >= 0  # (tile,)
-    sl = jnp.clip(state.slot_left, 0, L - 1)
-    sr = jnp.clip(state.slot_right, 0, L - 1)
+    active = slot_left >= 0  # (tile,)
+    sl = jnp.clip(slot_left, 0, L - 1)
+    sr = jnp.clip(slot_right, 0, L - 1)
     parent_hists = state.hist[sl]  # (tile, 3, F, B)
     big_hists = parent_hists - fresh_hists
-    sml = state.slot_small_left[:, None, None, None]
+    sml = slot_small_left[:, None, None, None]
     left_hists = jnp.where(sml, fresh_hists, big_hists)
     right_hists = jnp.where(sml, big_hists, fresh_hists)
     lpos = jnp.where(active, sl, 2 * L)
@@ -407,19 +426,19 @@ def _round_pass(
         right_hists, mode="drop")
 
     # fresh-leaf split search directly on the compact child hists
-    node_ids = jnp.clip(state.leaf_parent, 0, None) * 2 + state.leaf_side + 1
+    node_ids = jnp.clip(leaf_parent, 0, None) * 2 + leaf_side + 1
     cand = jnp.concatenate([sl, sr])
     cand_ok = jnp.concatenate([active, active])
     cand_hists = jnp.concatenate([left_hists, right_hists], axis=0)
     ci = jnp.where(cand_ok, cand, 0)
     bb = _batched_best(
-        cand_hists, state.leaf_sum_g[ci], state.leaf_sum_h[ci],
-        state.leaf_count[ci], num_bins_pf, missing_bin_pf, params,
+        cand_hists, leaf_sum_g[ci], leaf_sum_h[ci],
+        leaf_count[ci], num_bins_pf, missing_bin_pf, params,
         feature_mask, categorical_mask, None, None,
         jnp.full((2 * leaf_tile,), -jnp.inf, jnp.float32),
         jnp.full((2 * leaf_tile,), jnp.inf, jnp.float32),
         None, node_ids[ci], rng_key,
-        depth=state.leaf_depth[ci], parent_out=state.leaf_out[ci],
+        depth=leaf_depth[ci], parent_out=leaf_out[ci],
         feature_contri=feature_contri,
     )
     scatter_pos = jnp.where(cand_ok, cand, 2 * L)
@@ -427,12 +446,38 @@ def _round_pass(
     def merge(old, new):
         return old.at[scatter_pos].set(new, mode="drop")
 
-    best = BestSplit(*[merge(o, nw) for o, nw in zip(state.best, bb)])
-    return state._replace(hist=hist, best=best,
-                          fresh=jnp.zeros((L,), bool),
-                          slot_left=jnp.full((leaf_tile,), -1, jnp.int32),
-                          slot_right=jnp.full((leaf_tile,), -1, jnp.int32),
-                          slot_small_left=jnp.zeros((leaf_tile,), bool))
+    best = BestSplit(*[merge(o, nw) for o, nw in zip(best, bb)])
+
+    # ---- next-window bound for the host's ladder prediction ----
+    # any leaf split within the next two rounds descends from a leaf live
+    # NOW; the small children under one live ancestor sum to
+    # <= floor(ancestor_cnt/2), and distinct split leaves have distinct
+    # live ancestors — so the top-(tile ∧ budget) floor(cnt/2) over live
+    # leaves bounds both following window totals.  Exact enough that the
+    # factor-2 ladder absorbs the slack; always an over- (never under-)
+    # estimate, so the on-device `ok` check cannot trip while the host
+    # ladders this value.
+    live_next = idx < num_leaves_new
+    half_cnt = jnp.where(live_next, leaf_cnt // 2, 0)
+    k_top = min(leaf_tile, L)
+    top_halves = jax.lax.top_k(half_cnt, k_top)[0]
+    budget_next = jnp.maximum(L - num_leaves_new, 0)
+    whint = jnp.sum(jnp.where(
+        jnp.arange(k_top, dtype=jnp.int32) < jnp.minimum(
+            budget_next, leaf_tile),
+        top_halves, 0))
+
+    state = WState(
+        order=new_order, leaf_start=leaf_start, leaf_cnt=leaf_cnt,
+        leaf_id=leaf_id, hist=hist, best=best,
+        leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h, leaf_count=leaf_count,
+        leaf_depth=leaf_depth, leaf_parent=leaf_parent, leaf_side=leaf_side,
+        num_leaves_cur=num_leaves_new, leaf_out=leaf_out, tree=tree,
+    )
+    info = jnp.stack([
+        k_acc, total, ok.astype(jnp.int32), whint.astype(jnp.int32),
+    ]).astype(jnp.int32)
+    return state, info
 
 
 @functools.partial(
@@ -558,10 +603,6 @@ def _w_init(
         num_leaves_cur=jnp.asarray(1, jnp.int32),
         leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(leaf_out0),
         tree=tree0,
-        fresh=jnp.zeros((L,), bool),
-        slot_left=jnp.full((leaf_tile,), -1, jnp.int32),
-        slot_right=jnp.full((leaf_tile,), -1, jnp.int32),
-        slot_small_left=jnp.zeros((leaf_tile,), bool),
     )
     return state, grad, hess, gq, hq, quant_scale, grad_true, hess_true
 
@@ -618,8 +659,15 @@ def grow_tree_windowed(
     quantize_bins: int = 0,
     stochastic_rounding: bool = True,
     quant_renew: bool = False,
+    stats: Optional[dict] = None,
 ) -> tuple[TreeArrays, jnp.ndarray]:
-    """Host-driven windowed growth; returns (tree, leaf_id per row)."""
+    """Host-driven windowed growth; returns (tree, leaf_id per row).
+
+    One donated dispatch per round, zero blocking host syncs in steady
+    state (module docstring).  ``stats``, when given, receives the
+    driver's dispatch/sync ledger: {rounds, dispatches, host_syncs,
+    async_resolves, retries, windows} — what tests/test_retrace.py pins.
+    """
     common = dict(num_leaves=num_leaves, num_bins=num_bins, params=params,
                   leaf_tile=leaf_tile)
     state, g_d, h_d, gq, hq, qs, g_true, h_true = _w_init(
@@ -630,40 +678,98 @@ def grow_tree_windowed(
         hist_precision=hist_precision,
         stochastic_rounding=stochastic_rounding, **common)
 
-    import os
-    import time
+    n = bins_t.shape[1]
     prof = os.environ.get("LGBMTPU_WPROF") == "1"
+    enforce = os.environ.get("LGBMTPU_DISPATCH_BUDGET") == "1"
+    # the Pallas segment partition is the TPU default; LGBMTPU_PARTITION
+    # _PALLAS=0 drops to the O(N) XLA permutation (same results)
+    pallas_partition = use_pallas and (
+        os.environ.get("LGBMTPU_PARTITION_PALLAS", "1") != "0")
 
+    # round 1 needs no feedback: a round's window (the small children)
+    # can never exceed floor(N/2) rows, whatever it admits
+    W = _window_size(max(n // 2, 1), n)
+    pending: list = []  # dispatched rounds whose info is still in flight
     n_leaves = 1
-    while n_leaves < num_leaves:
-        t0 = time.perf_counter() if prof else 0.0
-        state, info_d = _round_admit(
-            state, bins_t, missing_bin_pf, row_mask,
-            max_depth=max_depth,
-            has_cat=categorical_mask is not None, **common)
-        # the one host sync per round (~23 ms through the tunnel)
-        info = np.asarray(info_d)  # jaxlint: disable=R1 (by design: k_acc/total must reach the host to pick the next static window size W)
-        t1 = time.perf_counter() if prof else 0.0
-        k_acc, total = int(info[0]), int(info[1])
-        if k_acc == 0:
-            break
-        n_leaves += k_acc
-        win_start = jnp.asarray(info[2:2 + leaf_tile])
-        win_cnt = jnp.asarray(info[2 + leaf_tile:])
-        W = _window_size(total, bins_t.shape[1])
-        state = _round_pass(
-            state, bins_t, g_d, h_d, gq, hq, qs, row_mask,
-            win_start, win_cnt, num_bins_pf, missing_bin_pf, feature_mask,
-            rng_key, feature_contri, categorical_mask,
-            efb_bins_t, efb_gather, efb_default,
-            W=W, use_pallas=use_pallas, quantize_bins=quantize_bins,
-            hist_precision=hist_precision, **common)
-        if prof:
-            _ = np.asarray(state.best.gain[:4])  # jaxlint: disable=R1 (LGBMTPU_WPROF-gated profiling pull, off by default)
-            t2 = time.perf_counter()
-            print(f"[WPROF] k={k_acc:2d} total={total:7d} W={W:7d} "
-                  f"admit+sync={t1 - t0:6.3f}s pass={t2 - t1:6.3f}s",
-                  flush=True)
+    rounds = 0
+    retries = 0
+    windows: list = []
+    import time as _time
+    t_last = _time.perf_counter() if prof else 0.0
+    # every productive round admits >= 1 split, reads lag 1 round, plus
+    # defensive headroom for retried (skipped) rounds
+    max_rounds = 2 * num_leaves + 4
+    converged = False
+    resolved = 0  # rounds whose info the host has read (lags `rounds` by 1)
+    counter = _san.DispatchCounter()
+    counter.__enter__()
+    try:
+        while rounds < max_rounds:
+            _san.record_dispatch()
+            state, info_d = _round_fused(
+                state, bins_t, g_d, h_d, gq, hq, qs, row_mask,
+                num_bins_pf, missing_bin_pf, feature_mask, rng_key,
+                feature_contri, categorical_mask,
+                efb_bins_t, efb_gather, efb_default,
+                max_depth=max_depth, W=W, use_pallas=use_pallas,
+                quantize_bins=quantize_bins, hist_precision=hist_precision,
+                has_cat=categorical_mask is not None,
+                pallas_partition=pallas_partition, **common)
+            _san.async_pull_start(info_d)
+            pending.append(info_d)
+            rounds += 1
+            windows.append(W)
+            if len(pending) < 2:
+                continue  # pipeline fill: resolve reads one dispatch behind
+            info = _san.async_pull_result(pending.pop(0))
+            k_acc, total, ok, whint = (int(info[0]), int(info[1]),
+                                       int(info[2]), int(info[3]))
+            w_ran = windows[resolved]  # the W THIS round ran with (the loop
+            # variable has moved on to later dispatches)
+            resolved += 1
+            if prof:
+                t_now = _time.perf_counter()
+                print(f"[WPROF] k={k_acc:2d} total={total:7d} W={w_ran:7d} "
+                      f"round={t_now - t_last:6.3f}s", flush=True)
+                t_last = t_now
+            if not ok:
+                # prediction breached (whint bound violated — a bug, not a
+                # workload property): the device skipped the round; fold the
+                # corrected W into the next dispatch instead of syncing
+                retries += 1
+                W = _window_size(max(total, 1), n)
+                continue
+            n_leaves += k_acc
+            if k_acc == 0 or n_leaves >= num_leaves:
+                converged = True
+                break
+            W = _window_size(max(whint, 1), n)
+    finally:
+        pending.clear()
+        counter.__exit__(None, None, None)
+        if stats is not None:
+            stats.update(rounds=rounds, dispatches=counter.dispatches,
+                         host_syncs=counter.host_syncs,
+                         async_resolves=counter.async_resolves,
+                         retries=retries, windows=windows)
+    if not converged:
+        # the safety headroom ran out (repeated window-bound breaches):
+        # growth stopped early with a valid but under-grown tree — make
+        # that LOUD even without the enforce gate armed
+        from ..utils.log import log_warning
+        log_warning(
+            f"windowed growth exhausted its round budget ({max_rounds} "
+            f"dispatches, {retries} window retries) before reaching "
+            f"num_leaves={num_leaves}; the tree is valid but under-grown "
+            "— this indicates a whint bound violation, please report")
+
+    if enforce:
+        counter.assert_round_budget(rounds, what="windowed round loop")
+        if retries:
+            raise _san.BudgetError(
+                f"windowed round loop: {retries} window-prediction "
+                "retries — the whint bound under-predicted (see "
+                "ops/treegrow_windowed.py round-7 notes)")
 
     return _w_finalize(state, g_true, h_true, row_mask, params=params,
                        quant_renew=bool(quant_renew and quantize_bins))

@@ -1,12 +1,24 @@
-"""Runtime retrace/donation sanitizer.
+"""Runtime retrace/donation/dispatch sanitizer.
 
-The static pass (``lightgbm_tpu/analysis``, jaxlint R2) catches recompile
-hazards visible in the AST; *varying* static arguments and shape drift are
+The static pass (``lightgbm_tpu/analysis``, jaxlint R2/R6) catches recompile
+and dispatch-structure hazards visible in the AST; *varying* static
+arguments, shape drift, and the actual per-round dispatch/sync traffic are
 runtime properties.  This module turns them into executable assertions: a
 process-global ``jax.monitoring`` listener counts every jaxpr trace and every
 XLA backend compile, and :class:`CompileCounter` exposes deltas so a test can
 pin "N boosting rounds at fixed shape compile exactly once" (the per-round
 recompile class docs/NEXT.md suspects in the windowed admit phase).
+
+Dispatch side (round 7): host round loops that dispatch jitted work record
+each dispatch through :func:`record_dispatch` and route every host read of
+device data through :func:`sync_pull` (a BLOCKING pull — the ~45 ms tunnel
+round-trip class) or the :func:`async_pull_start`/:func:`async_pull_result`
+pair (a pipelined read that overlaps device compute and never stalls the
+device queue).  :class:`DispatchCounter` snapshots all of it, so "each
+steady-state windowed round is exactly ONE dispatch and ZERO blocking
+syncs" is an executable invariant (tests/test_retrace.py), not benchmark
+archaeology — and :meth:`DispatchCounter.assert_round_budget` is the gate
+the grower itself arms under ``LGBMTPU_DISPATCH_BUDGET=1``.
 
 Counting is cumulative and process-wide — the listener is installed once and
 never removed (``jax.monitoring`` has no unregister; ``clear_event_listeners``
@@ -28,12 +40,14 @@ import threading
 from typing import Iterable, Optional
 
 import jax
+import numpy as np
 
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 
 _lock = threading.Lock()
-_counts = {"compiles": 0, "traces": 0}
+_counts = {"compiles": 0, "traces": 0, "dispatches": 0, "host_syncs": 0,
+           "async_resolves": 0}
 _installed = False
 
 
@@ -139,6 +153,114 @@ class _ExpectCompiles(CompileCounter):
         if exc_type is None:
             self.assert_compiles(self._expected, self._what)
         return None
+
+
+# ---------------------------------------------------------------------------
+# dispatch / host-sync accounting
+# ---------------------------------------------------------------------------
+
+def record_dispatch(n: int = 1) -> None:
+    """Count a device dispatch issued by a host driver loop.  Call sites
+    are the loop's jitted calls (one call == one XLA execution enqueued
+    through the tunnel, ~1-1.5 ms each; docs/NEXT.md round-3 note).
+
+    Honest scope: unlike compiles/traces (measured via jax.monitoring),
+    dispatch counting is INSTRUMENTATION-BASED — jax emits no monitoring
+    event on warm executions (verified on this toolchain), so an
+    uninstrumented second dispatch in a loop is invisible to the runtime
+    budget.  The structural guard for that class is static: jaxlint R6
+    flags consecutive donated dispatches in round loops, and the sync
+    half of the budget (``sync_pull`` vs ``async_pull_*``) covers the
+    expensive regression (~45 ms blocking pulls) by routing EVERY host
+    read in the drivers through this module."""
+    with _lock:
+        _counts["dispatches"] += n
+
+
+def sync_pull(x):
+    """BLOCKING host pull of a device value: the caller stalls until the
+    device queue drains to this value (~45 ms through the tunnel when the
+    pipeline is deep).  Returns the numpy value.  Every counted call in a
+    steady-state round loop is a round-trip the loop failed to pipeline —
+    the class :meth:`DispatchCounter.assert_round_budget` pins to zero."""
+    with _lock:
+        _counts["host_syncs"] += 1
+    return np.asarray(x)
+
+
+def async_pull_start(x) -> None:
+    """Begin a device->host copy WITHOUT waiting (pipelined read).  Pair
+    with :func:`async_pull_result` at least one dispatch later: by then
+    the producing computation has retired behind newer queued work, so
+    resolving the copy does not stall the device pipeline."""
+    getattr(x, "copy_to_host_async", lambda: None)()
+
+
+def async_pull_result(x):
+    """Resolve a read started with :func:`async_pull_start`.  Counted
+    separately from blocking syncs: the host may wait here, but the
+    device queue keeps executing the already-dispatched rounds, so
+    device utilization is unaffected (the property the windowed round
+    protocol is built on)."""
+    with _lock:
+        _counts["async_resolves"] += 1
+    return np.asarray(x)
+
+
+class BudgetError(AssertionError):
+    """A host round loop exceeded its dispatch/sync budget."""
+
+
+class DispatchCounter(CompileCounter):
+    """Context manager counting dispatches and host pulls (plus compiles/
+    traces, inherited) in the enclosed block.
+
+    >>> with DispatchCounter() as d:
+    ...     grow_tree_windowed(...)
+    >>> d.assert_round_budget(rounds, what="windowed growth")
+    """
+
+    def __enter__(self) -> "DispatchCounter":
+        super().__enter__()
+        with _lock:
+            self._d0 = _counts["dispatches"]
+            self._h0 = _counts["host_syncs"]
+            self._a0 = _counts["async_resolves"]
+        return self
+
+    @property
+    def dispatches(self) -> int:
+        with _lock:
+            return _counts["dispatches"] - self._d0
+
+    @property
+    def host_syncs(self) -> int:
+        with _lock:
+            return _counts["host_syncs"] - self._h0
+
+    @property
+    def async_resolves(self) -> int:
+        with _lock:
+            return _counts["async_resolves"] - self._a0
+
+    def assert_round_budget(self, rounds: int, *,
+                            dispatches_per_round: int = 1,
+                            syncs_per_round: int = 0,
+                            what: str = "round loop") -> None:
+        """The steady-state contract of a fused round loop: exactly
+        ``dispatches_per_round`` dispatches and ``syncs_per_round``
+        blocking pulls per round across the block."""
+        got_d, got_s = self.dispatches, self.host_syncs
+        want_d = rounds * dispatches_per_round
+        want_s = rounds * syncs_per_round
+        if got_d != want_d or got_s != want_s:
+            raise BudgetError(
+                f"{what}: {rounds} round(s) budgeted "
+                f"{want_d} dispatch(es) + {want_s} blocking sync(s), "
+                f"observed {got_d} + {got_s} "
+                f"(async resolves: {self.async_resolves}) — a phase was "
+                "dispatched separately or a host pull crept into the loop; "
+                "see docs/ANALYSIS.md (R6)")
 
 
 # ---------------------------------------------------------------------------

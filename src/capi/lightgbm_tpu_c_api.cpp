@@ -572,11 +572,10 @@ int LGBM_BoosterPredictSparseOutput(BoosterHandle handle, const void* indptr,
                                     const char* parameter, int matrix_type,
                                     int64_t* out_len, void** out_indptr,
                                     int32_t** out_indices, void** out_data) {
-  if (data_type != C_API_DTYPE_FLOAT64) {
-    /* enumerated deviation (docs/BINDINGS.md): output data is f64-only */
+  if (data_type != C_API_DTYPE_FLOAT32 && data_type != C_API_DTYPE_FLOAT64) {
     set_last_error(
         "LGBM_BoosterPredictSparseOutput: data_type must be "
-        "C_API_DTYPE_FLOAT64 (f32 output buffers are not supported)");
+        "C_API_DTYPE_FLOAT32 or C_API_DTYPE_FLOAT64");
     return -1;
   }
   GilGuard gil;

@@ -203,9 +203,9 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle,
  * predict_type must be C_API_PREDICT_CONTRIB; matrix_type 0 = CSR input
  * and output, 1 = CSC (num_col_or_row = #cols for CSR, #rows for CSC).
  * The library malloc()s *out_indptr/*out_indices/*out_data; release them
- * with LGBM_BoosterFreePredictSparse.  data_type must be
- * C_API_DTYPE_FLOAT64 (deviation: the reference also allocates f32;
- * enumerated in docs/BINDINGS.md).  out_len[0] = indptr length,
+ * with LGBM_BoosterFreePredictSparse.  Output data is written in the
+ * requested data_type (C_API_DTYPE_FLOAT32 or _FLOAT64, matching the
+ * reference's per-type allocation).  out_len[0] = indptr length,
  * out_len[1] = nnz. */
 #define C_API_MATRIX_TYPE_CSR 0
 #define C_API_MATRIX_TYPE_CSC 1

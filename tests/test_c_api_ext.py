@@ -751,3 +751,59 @@ def test_dataset_get_feature_num_bin(lib):
     _check(lib.LGBM_DatasetGetFeatureNumBin(h, 0, ctypes.byref(nb)), lib)
     assert 2 <= nb.value <= 16
     assert lib.LGBM_DatasetGetFeatureNumBin(h, 99, ctypes.byref(nb)) == -1
+
+
+def test_predict_sparse_output_contrib_f32(lib):
+    """Round-7 parity fix: LGBM_BoosterPredictSparseOutput honors the
+    requested data_type — an f32 request gets f32 output buffers (the
+    reference allocates per data_type; this surface was f64-only)."""
+    rng = np.random.RandomState(33)
+    X = rng.randn(250, 4)
+    y = (X @ rng.randn(4) > 0).astype(np.float64)
+    h = _dense_handle(lib, X, y)
+    bh = _train(lib, h, iters=3)
+
+    Xs = sp.csr_matrix(np.asarray(X, np.float32))
+    indptr = np.ascontiguousarray(Xs.indptr, np.int32)
+    indices = np.ascontiguousarray(Xs.indices, np.int32)
+    data = np.ascontiguousarray(Xs.data, np.float32)
+    out_len = (ctypes.c_int64 * 2)()
+    o_indptr = ctypes.c_void_p()
+    o_indices = ctypes.POINTER(ctypes.c_int32)()
+    o_data = ctypes.c_void_p()
+    _check(lib.LGBM_BoosterPredictSparseOutput(
+        bh, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 0,  # C_API_DTYPE_FLOAT32
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(X.shape[1]),
+        3,  # C_API_PREDICT_CONTRIB
+        0, -1, b"", 0,  # matrix_type CSR
+        out_len, ctypes.byref(o_indptr), ctypes.byref(o_indices),
+        ctypes.byref(o_data)), lib)
+    n_indptr, nnz = out_len[0], out_len[1]
+    assert n_indptr == X.shape[0] + 1
+    got_indptr = np.ctypeslib.as_array(
+        ctypes.cast(o_indptr, ctypes.POINTER(ctypes.c_int32)), (n_indptr,))
+    got_indices = np.ctypeslib.as_array(o_indices, (nnz,))
+    # the data buffer is FLOAT32-typed — reading it as f32 must reproduce
+    # the dense contrib path within f32 rounding
+    got_data = np.ctypeslib.as_array(
+        ctypes.cast(o_data, ctypes.POINTER(ctypes.c_float)), (nnz,))
+    got = sp.csr_matrix((got_data.astype(np.float64), got_indices.copy(),
+                         got_indptr.copy()),
+                        shape=(X.shape[0], X.shape[1] + 1)).toarray()
+    bst = lgb.Booster(model_str=_model_string(lib, bh))
+    expect = bst.predict(X, pred_contrib=True)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+    _check(lib.LGBM_BoosterFreePredictSparse(o_indptr, o_indices, o_data,
+                                             2, 0), lib)
+    # an integer data_type is still rejected
+    assert lib.LGBM_BoosterPredictSparseOutput(
+        bh, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 2,  # C_API_DTYPE_INT32
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(X.shape[1]), 3, 0, -1, b"", 0,
+        out_len, ctypes.byref(o_indptr), ctypes.byref(o_indices),
+        ctypes.byref(o_data)) == -1

@@ -573,3 +573,274 @@ def test_unknown_rule_selection_raises(tmp_path):
 def test_syntax_error_is_reported_not_fatal(tmp_path):
     rep = _scan(tmp_path, {"mod.py": "def broken(:\n"})
     assert any(f.rule == "E0" for f in rep.findings)
+
+
+# ---------------------------------------------------------------------------
+# R6 fusable-round-loop
+# ---------------------------------------------------------------------------
+
+_R6_TWO_PHASE = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def admit(state):
+        return state + 1, state * 2
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_pass(state, w):
+        return state - w
+
+    def drive(state, w):
+        for _ in range(5):
+            state, info = admit(state)
+            state = run_pass(state, w)
+        return state
+"""
+
+
+def test_r6_positive_two_phase_round_loop(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": _R6_TWO_PHASE}, rules=["R6"])
+    assert len(rep.findings) == 1, rep.findings
+    f = rep.findings[0]
+    assert f.rule == "R6" and f.line == 16  # the second dispatch
+    assert "run_pass" in f.message and "admit" in f.message
+
+
+def test_r6_negative_host_consumer_between(tmp_path):
+    """A host read of the first phase's output between the dispatches is a
+    real data dependency — the loop cannot be fused blindly (that sync is
+    R1's business, and the async-read protocol the hint points at)."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+        import numpy as np
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def admit(state):
+            return state + 1, state * 2
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_pass(state, w):
+            return state - w
+
+        def drive(state, w):
+            for _ in range(5):
+                state, info = admit(state)
+                k = int(np.asarray(info)[0])
+                state = run_pass(state, k)
+            return state
+    """}, rules=["R6"])
+    assert rep.findings == []
+
+
+def test_r6_negative_undonated_calls(tmp_path):
+    """Without donation the two dispatches do not thread an in-place
+    state buffer — nothing forces them into one round body."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        @jax.jit
+        def admit(state):
+            return state + 1
+
+        @jax.jit
+        def run_pass(state, w):
+            return state - w
+
+        def drive(state, w):
+            for _ in range(5):
+                state = admit(state)
+                state = run_pass(state, w)
+            return state
+    """}, rules=["R6"])
+    assert rep.findings == []
+
+
+def test_r6_negative_outside_loop(tmp_path):
+    """Back-to-back donated dispatches NOT in a loop are a one-off cost,
+    not the per-round dispatch class."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def admit(state):
+            return state + 1
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_pass(state):
+            return state * 2
+
+        def setup(state):
+            state = admit(state)
+            state = run_pass(state)
+            return state
+    """}, rules=["R6"])
+    assert rep.findings == []
+
+
+def test_r6_pragma_suppressed(tmp_path):
+    src = _R6_TWO_PHASE.replace(
+        "state = run_pass(state, w)",
+        "state = run_pass(state, w)  "
+        "# jaxlint: disable=R6 (phases keep separate Mosaic budgets)")
+    rep = _scan(tmp_path, {"mod.py": src}, rules=["R6"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+def test_r6_negative_sequential_single_dispatch_loops(tmp_path):
+    """Two SEPARATE loops, each already one dispatch per iteration, must
+    not pair across loop boundaries (they cannot be fused per-round)."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def admit(state):
+            return state + 1
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_pass(state):
+            return state * 2
+
+        def drive(state):
+            for _ in range(5):
+                state = admit(state)
+            for _ in range(5):
+                state = run_pass(state)
+            return state
+    """}, rules=["R6"])
+    assert rep.findings == []
+
+
+def test_r6_negative_consumer_on_second_dispatch_line(tmp_path):
+    """A host read of the first phase's output INSIDE the second call's
+    argument list is still a real data dependency — not fusable."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+        import numpy as np
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def admit(state):
+            return state + 1, state * 2
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_pass(state, w):
+            return state - w
+
+        def drive(state):
+            for _ in range(5):
+                state, info = admit(state)
+                state = run_pass(state, int(np.asarray(info)[0]))
+            return state
+    """}, rules=["R6"])
+    assert rep.findings == []
+
+
+def test_r6_negative_bare_read_of_first_dispatch_output(tmp_path):
+    """A bare read of the first dispatch's side output between the calls
+    (`if info[0]: break` — no recognizable sync call) still implies a
+    host data dependency; R6 suppresses conservatively."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def admit(state):
+            return state + 1, state * 2
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_pass(state, w):
+            return state - w
+
+        def drive(state, w):
+            for _ in range(5):
+                state, info = admit(state)
+                if info[0] == 0:
+                    break
+                state = run_pass(state, w)
+            return state
+    """}, rules=["R6"])
+    assert rep.findings == []
+
+
+def test_r6_positive_side_output_as_device_argument(tmp_path):
+    """Passing the first dispatch's side output straight into the second
+    jitted call is device-to-device data flow — the flagship fusable
+    shape, NOT a host consumer."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def admit(state):
+            return state + 1, state * 2
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_pass(state, w):
+            return state - w
+
+        def drive(state):
+            for _ in range(5):
+                state, info = admit(state)
+                state = run_pass(state, info)
+            return state
+    """}, rules=["R6"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "R6"
+
+
+def test_r6_negative_mutually_exclusive_branches(tmp_path):
+    """Dispatches in if/else arms of the same conditional: only one runs
+    per iteration — nothing to fuse."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fast(state):
+            return state + 1
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def slow(state):
+            return state * 2
+
+        def drive(state, big):
+            for _ in range(5):
+                if big:
+                    state = fast(state)
+                else:
+                    state = slow(state)
+            return state
+    """}, rules=["R6"])
+    assert rep.findings == []
+
+
+def test_r6_negative_match_case_arms(tmp_path):
+    """match/case arms are mutually exclusive per iteration, exactly like
+    if/else."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fast(state):
+            return state + 1
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def slow(state):
+            return state * 2
+
+        def drive(state, phase):
+            for _ in range(5):
+                match phase:
+                    case 0:
+                        state = fast(state)
+                    case _:
+                        state = slow(state)
+            return state
+    """}, rules=["R6"])
+    assert rep.findings == []

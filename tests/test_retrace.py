@@ -108,3 +108,81 @@ def test_booster_steady_state_does_not_retrace():
             bst.update()
         np.asarray(bst._gbdt._score)
     c.assert_no_recompile("Booster.update steady state")
+
+
+def _windowed_inputs(n=900, f=8, seed=5):
+    from lightgbm_tpu.binning import DatasetBinner
+    from lightgbm_tpu.ops.split import SplitParams
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X @ rng.randn(f) + 0.2 * rng.randn(n)
+    binner = DatasetBinner.fit(X, max_bin=31)
+    bins_t = jnp.asarray(binner.transform(X).T, jnp.int16)
+    grads = [jnp.asarray(0.6 * y + 0.05 * k, jnp.float32) for k in range(3)]
+    kw = dict(
+        row_mask=jnp.ones((n,), bool),
+        sample_weight=jnp.ones((n,), jnp.float32),
+        feature_mask=jnp.ones((f,), bool),
+        num_bins_pf=jnp.asarray(binner.num_bins_per_feature),
+        missing_bin_pf=jnp.asarray(binner.missing_bin_per_feature),
+    )
+    static = dict(num_leaves=15, num_bins=32, params=SplitParams(
+        min_data_in_leaf=5.0), leaf_tile=4, use_pallas=False)
+    return bins_t, grads, jnp.ones((n,), jnp.float32), kw, static
+
+
+def test_windowed_steady_state_one_dispatch_zero_syncs_no_retrace():
+    """The round-7 fused-round contract (ISSUE acceptance): after warmup,
+    windowed rounds at fixed shape trace ZERO times and cost exactly ONE
+    device dispatch and ZERO blocking host pulls per round — pinned by
+    the DispatchCounter, not inferred from benchmarks."""
+    from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+    from lightgbm_tpu.utils.sanitizer import DispatchCounter
+
+    bins_t, grads, hess, kw, static = _windowed_inputs()
+    # warmup: compiles _w_init, the fused round at this shape's single
+    # window-ladder rung, and _w_finalize
+    tree, leaf = grow_tree_windowed(bins_t, grads[0], hess, **kw, **static)
+    jax.block_until_ready(leaf)
+
+    stats = {}
+    with DispatchCounter() as d:
+        tree, leaf = grow_tree_windowed(bins_t, grads[1], hess, **kw,
+                                        **static, stats=stats)
+        jax.block_until_ready(leaf)
+    # steady state: 1 dispatch per round, 0 blocking syncs, 0 mispredicted
+    # windows, and the whole tree was warm-cache (zero traces/compiles)
+    assert stats["rounds"] >= 3, stats  # a 15-leaf tree takes several rounds
+    d.assert_round_budget(stats["rounds"], what="windowed steady state")
+    assert stats["dispatches"] == stats["rounds"], stats
+    assert stats["host_syncs"] == 0, stats
+    assert stats["retries"] == 0, stats
+    # info reads resolve one round behind and never block the device queue
+    assert stats["async_resolves"] <= stats["rounds"], stats
+    d.assert_no_recompile("3+ windowed rounds at fixed shape")
+
+
+def test_windowed_budget_gate_enforces(monkeypatch):
+    """LGBMTPU_DISPATCH_BUDGET=1 arms the in-driver gate; a blocking pull
+    smuggled into the loop breaks the budget and raises."""
+    from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+    from lightgbm_tpu.utils import sanitizer as san
+
+    bins_t, grads, hess, kw, static = _windowed_inputs(seed=6)
+    monkeypatch.setenv("LGBMTPU_DISPATCH_BUDGET", "1")
+    # clean run passes the gate
+    tree, leaf = grow_tree_windowed(bins_t, grads[0], hess, **kw, **static)
+    assert int(tree.num_leaves) > 1
+
+    # a sync_pull inside the loop (e.g. a re-introduced per-round host
+    # read) must trip the gate
+    orig = san.async_pull_result
+
+    def leaky(x):
+        san.sync_pull(x)  # the regression class: a blocking pull per round
+        return orig(x)
+
+    monkeypatch.setattr(san, "async_pull_result", leaky)
+    with pytest.raises(san.BudgetError):
+        grow_tree_windowed(bins_t, grads[1], hess, **kw, **static)

@@ -142,3 +142,40 @@ def test_no_nans_in_training_state():
         assert not np.isnan(np.asarray(bst._gbdt._cur_grad)).any()
     for t in bst._gbdt.models:
         assert np.isfinite(t.leaf_value[: t.num_leaves]).all()
+
+
+def test_dispatch_counter_accounting():
+    """DispatchCounter deltas: dispatches, blocking pulls and pipelined
+    resolves are counted independently and snapshot-scoped."""
+    from lightgbm_tpu.utils import sanitizer as san
+
+    x = jnp.arange(8.0)
+    with san.DispatchCounter() as d:
+        san.record_dispatch()
+        san.record_dispatch(2)
+        v = san.sync_pull(x)
+        san.async_pull_start(x)
+        w = san.async_pull_result(x)
+    assert (d.dispatches, d.host_syncs, d.async_resolves) == (3, 1, 1)
+    assert np.asarray(v).shape == (8,) and np.asarray(w).shape == (8,)
+    # a fresh counter starts from the new baseline
+    with san.DispatchCounter() as d2:
+        pass
+    assert (d2.dispatches, d2.host_syncs, d2.async_resolves) == (0, 0, 0)
+
+
+def test_dispatch_counter_round_budget():
+    from lightgbm_tpu.utils import sanitizer as san
+
+    with san.DispatchCounter() as d:
+        for _ in range(4):
+            san.record_dispatch()
+    d.assert_round_budget(4, what="clean loop")
+    with pytest.raises(san.BudgetError):
+        d.assert_round_budget(4, dispatches_per_round=2, what="two-phase")
+
+    with san.DispatchCounter() as d2:
+        san.record_dispatch()
+        san.sync_pull(jnp.zeros(()))
+    with pytest.raises(san.BudgetError):
+        d2.assert_round_budget(1, what="loop with a blocking pull")

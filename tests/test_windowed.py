@@ -99,7 +99,8 @@ def test_windowed_quantized_matches_fast_grower_quantized():
 
 def test_windowed_categorical_matches_fast_grower():
     """Round-5 envelope widening: categorical splits in the windowed grower
-    (bitset partition in _round_admit + cat search in _round_pass) must
+    (bitset partition + categorical search, both in the fused round body
+    _round_fused since round 7) must
     reproduce the fast grower tree-for-tree."""
     rng = np.random.RandomState(5)
     n, f, n_cat = 3000, 10, 8
