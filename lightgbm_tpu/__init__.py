@@ -10,9 +10,10 @@ socket/MPI Network layer.
 Public API mirrors python-package/lightgbm/__init__.py.
 """
 
-from .basic import Booster, Dataset, LightGBMError, Sequence_ as Sequence
+from .basic import Booster, CorruptModelError, Dataset, LightGBMError, Sequence_ as Sequence
 from .callback import EarlyStopException, early_stopping, log_evaluation, record_evaluation, reset_parameter
 from .engine import CVBooster, cv, train
+from .utils.guards import NonFiniteError
 from .utils.log import register_logger
 
 __all__ = [
@@ -21,6 +22,8 @@ __all__ = [
     "Booster",
     "CVBooster",
     "LightGBMError",
+    "CorruptModelError",
+    "NonFiniteError",
     "register_logger",
     "train",
     "cv",

@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R6.
+"""jaxlint built-in rules R1-R7.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -581,3 +581,91 @@ def r6_fusable_round_loop(pkg: PackageIndex) -> Iterator[Finding]:
                         "dispatch/round); if the host truly needs a value "
                         "between them, read it asynchronously one round behind "
                         "(utils/sanitizer.py async_pull_*)")
+
+
+# ---------------------------------------------------------------------------
+# R7 — host-nonfinite-guard
+# ---------------------------------------------------------------------------
+
+_NONFINITE_FUNCS = ("isnan", "isfinite", "isinf")
+_NONFINITE_HOST_MODULES = _NUMPY_ALIASES + ("math",)
+_DEVICE_NP_ALIASES = ("jnp", "jax")
+
+
+@register_rule("R7", "host-nonfinite-guard")
+def r7_host_nonfinite_guard(pkg: PackageIndex) -> Iterator[Finding]:
+    """The NaN-guard anti-pattern: checking per-round tensors for
+    non-finite values FROM THE HOST inside a grower/boosting loop.  A
+    ``np.isnan(...)``/``math.isnan(...)`` on a device value forces a
+    blocking device pull every round (the ~45 ms tunnel sync class R1
+    hunts), and ``float()``/``bool()``/``int()`` wrapped around a
+    device-side ``jnp.isnan(...)``/``jnp.isfinite(...)`` result is the
+    same sync wearing a jnp costume.  The supported pattern costs
+    nothing: fold the finite flag into the round's device info vector and
+    read it asynchronously one round behind (the windowed grower's guard,
+    utils/guards.py + utils/sanitizer.py async_pull_*), or accumulate a
+    device-side first-bad-iteration scalar checked at existing sync
+    points (models/gbdt.py _guard_accumulate/_guard_check)."""
+    hint = ("keep the finite check ON DEVICE: fold it into the round's "
+            "info vector and resolve it one round behind "
+            "(utils/sanitizer.py async_pull_*), or accumulate a device "
+            "flag checked at existing sync points — see "
+            "docs/ROBUSTNESS.md and models/gbdt.py::_guard_accumulate")
+    def _device_nonfinite_call(node: ast.AST) -> Optional[str]:
+        """Dotted name of a jnp/jax is{nan,finite,inf} call inside node."""
+        for inner in ast.walk(node):
+            if not isinstance(inner, ast.Call):
+                continue
+            ifn = dotted_name(inner.func)
+            if ifn is None:
+                continue
+            iparts = ifn.split(".")
+            if (iparts[-1] in _NONFINITE_FUNCS
+                    and iparts[0] in _DEVICE_NP_ALIASES):
+                return ifn
+        return None
+
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if not pkg.is_host_driver(fi):
+                continue
+            loop_nodes = PackageIndex._loop_body_walk(fi)
+            flagged = set()  # nodes already reported via an if/while test
+            for node in _own_body(fi):
+                if node not in loop_nodes:
+                    continue
+                # if/while/assert on a jnp.is* result: __bool__ on a
+                # device array — the implicit form of the same sync
+                if isinstance(node, (ast.If, ast.While, ast.Assert)):
+                    cond = node.test
+                    ifn = _device_nonfinite_call(cond)
+                    if ifn is not None:
+                        flagged.update(ast.walk(cond))
+                        yield _finding(
+                            fi, cond, "R7",
+                            f"branching on {ifn}(...) forces a blocking "
+                            f"device pull (implicit bool) in "
+                            f"{fi.qualname}'s round loop", hint)
+                    continue
+                if not isinstance(node, ast.Call) or node in flagged:
+                    continue
+                fn = dotted_name(node.func)
+                if fn is not None:
+                    parts = fn.split(".")
+                    if (len(parts) >= 2 and parts[-1] in _NONFINITE_FUNCS
+                            and parts[0] in _NONFINITE_HOST_MODULES):
+                        yield _finding(
+                            fi, node, "R7",
+                            f"host-side {fn}() non-finite check on a "
+                            f"per-round tensor in {fi.qualname}'s round loop "
+                            "(one blocking device pull per round)", hint)
+                        continue
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id in _CAST_BUILTINS and node.args):
+                    ifn = _device_nonfinite_call(node.args[0])
+                    if ifn is not None:
+                        yield _finding(
+                            fi, node, "R7",
+                            f"{node.func.id}({ifn}(...)) pulls a "
+                            f"device-side finite flag synchronously in "
+                            f"{fi.qualname}'s round loop", hint)

@@ -25,10 +25,18 @@ from .config import Config
 from .models.gbdt import GBDT, create_boosting
 from .models.tree import Tree
 from .ops import predict as predict_ops
+from .utils import checkpoint as _checkpoint
+from .utils.guards import validate_finite
 
 
 class LightGBMError(Exception):
     """reference: LightGBMError in python-package/lightgbm/basic.py."""
+
+
+class CorruptModelError(LightGBMError):
+    """A model/snapshot file failed integrity verification (torn write,
+    truncation, bit rot).  engine.train catches this to fall back to the
+    newest valid snapshot; see utils/checkpoint.py."""
 
 
 def _is_scipy_sparse(data) -> bool:
@@ -371,6 +379,14 @@ class Dataset:
                 self.position = np.asarray(loaded["position"], np.int64).ravel()
             if self.feature_name == "auto":
                 self.feature_name = list(loaded["feature_names"])
+        # non-finite guard rail layer 1 (docs/ROBUSTNESS.md): a NaN/inf
+        # target silently corrupts every boosting round downstream — reject
+        # it here, once, host-side, with the offending row in the message
+        # (features are exempt: non-finite feature values take the
+        # missing-value path in binning)
+        validate_finite("label", self.label)
+        validate_finite("weight", self.weight)
+        validate_finite("init_score", self.init_score)
         # sparse inputs are binned straight from CSC (reference:
         # src/io/sparse_bin.hpp — stored nonzeros + implicit zeros); only the
         # compact binned matrix is materialized, never dense raw floats
@@ -568,12 +584,15 @@ class Dataset:
     def set_field(self, field_name: str, data) -> "Dataset":
         if field_name == "label":
             self.label = None if data is None else np.asarray(data, np.float64).ravel()
+            validate_finite("label", self.label)
         elif field_name == "weight":
             self.weight = None if data is None else np.asarray(data, np.float64).ravel()
+            validate_finite("weight", self.weight)
         elif field_name == "group" or field_name == "query":
             self.group = None if data is None else np.asarray(data, np.int64).ravel()
         elif field_name == "init_score":
             self.init_score = None if data is None else np.asarray(data, np.float64)
+            validate_finite("init_score", self.init_score)
         elif field_name == "position":
             self.position = None if data is None else np.asarray(data, np.int64).ravel()
         else:
@@ -833,7 +852,28 @@ class Booster:
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._train_set = train_set
         if model_file is not None:
-            model_str = Path(model_file).read_text()
+            # snapshots carry an integrity trailer (utils/checkpoint.py):
+            # verify-and-strip so a torn file raises instead of parsing into
+            # a half-model; plain model files (no trailer) load as before
+            try:
+                text = Path(model_file).read_text(encoding="utf-8")
+            except UnicodeDecodeError as e:
+                # bit rot / binary garbage: torn, not a crash — so the
+                # engine's snapshot fallback can still run
+                raise CorruptModelError(
+                    f"{model_file} is not valid UTF-8 ({e}); the file is "
+                    "corrupted") from None
+            model_str, ok = _checkpoint.verify_text(text)
+            if ok is False or (
+                    ok is None and _checkpoint.is_snapshot_path(model_file)):
+                # snapshots are always written WITH a trailer, so a
+                # snapshot whose trailer is missing was truncated before
+                # the trailer line — every bit as torn as a bad digest
+                raise CorruptModelError(
+                    f"{model_file} failed integrity verification (torn or "
+                    "truncated checkpoint); resume from an older snapshot — "
+                    "utils/checkpoint.py latest_valid_snapshot scans the "
+                    "family, and engine.train falls back automatically")
         if model_str is not None:
             self._gbdt = GBDT.load_model_from_string(model_str)
             self.cfg = self._gbdt.cfg
@@ -1101,7 +1141,11 @@ class Booster:
 
     def save_model(self, filename, num_iteration: int = -1, start_iteration: int = 0,
                    importance_type: str = None) -> "Booster":
-        Path(filename).write_text(self.model_to_string(num_iteration, start_iteration, importance_type))
+        # atomic (temp + os.replace): a crash mid-write leaves the previous
+        # file intact instead of a torn model (docs/ROBUSTNESS.md)
+        _checkpoint.atomic_write_text(
+            filename,
+            self.model_to_string(num_iteration, start_iteration, importance_type))
         return self
 
     @classmethod

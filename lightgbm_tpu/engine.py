@@ -11,10 +11,63 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
 
-from .basic import Booster, Dataset, LightGBMError
+from .basic import Booster, CorruptModelError, Dataset, LightGBMError
 from .callback import CallbackEnv, EarlyStopException
 from .config import Config, choose_param_value
+from .utils import checkpoint as _checkpoint
+from .utils import faults as _faults
 from .utils.log import log_info, log_warning, set_verbosity
+
+
+def _load_init_booster(init_model) -> Booster:
+    """Booster from init_model; a snapshot that fails integrity
+    verification falls back to the newest VALID snapshot in its family
+    instead of dying on (or worse, silently half-loading) a torn file
+    (docs/ROBUSTNESS.md)."""
+    if isinstance(init_model, Booster):
+        return init_model
+    try:
+        return Booster(model_file=init_model)
+    except CorruptModelError as corrupt:
+        # scan strictly OLDER siblings: a stale NEWER snapshot (from a
+        # previous, longer run sharing the prefix) would resume with the
+        # wrong trees — older-than-requested is the only safe direction
+        below = _checkpoint.snapshot_iteration(init_model)
+        fb = _checkpoint.latest_valid_snapshot(init_model, below_iter=below)
+        if fb is not None:
+            it, snap = fb
+            log_warning(
+                f"init_model {init_model} failed integrity verification; "
+                f"falling back to the newest valid older snapshot {snap} "
+                f"(iteration {it})")
+            return Booster(model_file=snap)
+        # last resort: a PRE-TRAILER-ERA snapshot (no trailer at all but
+        # otherwise intact) — load unverified rather than abandoning the
+        # whole checkpoint family.  A truncated file usually loses its
+        # trailer too and looks identical, and the parser tolerates
+        # missing tail blocks — so demand the format's own structural
+        # completeness markers ("end of trees" + every tree block the
+        # tree_sizes header promises) before the benefit of the doubt.
+        text, ok = _checkpoint.read_and_verify(init_model)
+        if ok is None and "\nend of trees" in text:
+            import re as _re
+
+            m = _re.search(r"^tree_sizes=(.*)$", text, _re.M)
+            expected_trees = len(m.group(1).split()) if m else -1
+            try:
+                booster = Booster(model_str=text)
+            except Exception:  # noqa: BLE001 — torn after all
+                raise corrupt from None
+            if booster.num_trees() != expected_trees:
+                raise corrupt from None
+            log_warning(
+                f"init_model {init_model} is a snapshot with no integrity "
+                "trailer (pre-trailer format); no verified fallback exists "
+                "— loading it UNVERIFIED as a last resort. Re-snapshot "
+                "after this run to upgrade the family "
+                "(docs/ROBUSTNESS.md)")
+            return booster
+        raise
 
 
 def train(
@@ -46,7 +99,7 @@ def train(
 
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
-        init_booster = init_model if isinstance(init_model, Booster) else Booster(model_file=init_model)
+        init_booster = _load_init_booster(init_model)
         # continued training (reference: GBDT continued training via
         # input_model): seed with the SAVED form of the model — init scores
         # folded into the trees — then replay scores from the trees alone, so
@@ -100,9 +153,17 @@ def train(
     train_in_valids = any(vs is train_set for vs in (valid_sets or []))
 
     snapshot_freq = int(cfg_probe.snapshot_freq)
+    # snapshot names carry GLOBAL iteration numbers: a resumed run (this
+    # call's round i continues init_model's iterations) must not overwrite
+    # snapshot_iter_2 with a 6-tree model — the fallback scan and the
+    # "train (total - k) more rounds" resume recipe both trust the name
+    snapshot_base = booster.current_iteration()
 
     try:
         for i in range(num_boost_round):
+            # fault-injection site: preemption at the start of 1-based
+            # iteration i+1 (utils/faults.py; recovery = snapshot resume)
+            _faults.maybe_crash("host_crash", i + 1)
             for cb in callbacks_before:
                 cb(CallbackEnv(booster, params, i, 0, num_boost_round, []))
             finished = booster.update(fobj=fobj)
@@ -112,12 +173,17 @@ def train(
             evaluation_result_list.extend(booster.eval_valid(feval))
             for cb in callbacks_after:
                 cb(CallbackEnv(booster, params, i, 0, num_boost_round, evaluation_result_list))
-            if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+            global_iter = snapshot_base + i + 1
+            if snapshot_freq > 0 and global_iter % snapshot_freq == 0:
                 # periodic failure-recovery snapshot (reference: CLI
                 # snapshot_freq / save_period — GBDT::Train saves
                 # model_output_path.snapshot_iter_<n> every freq iterations)
-                snap = f"{cfg_probe.output_model}.snapshot_iter_{i + 1}"
-                booster.save_model(snap)
+                snap = f"{cfg_probe.output_model}.snapshot_iter_{global_iter}"
+                # atomic + integrity-trailed (utils/checkpoint.py): a crash
+                # mid-write can no longer leave a torn snapshot that a
+                # restart would load
+                _checkpoint.save_snapshot(snap, booster.model_to_string(),
+                                          global_iter)
                 log_info(f"Saved snapshot to {snap}")
             if finished:
                 log_info("Stopped training because there are no more leaves that meet the split requirements")

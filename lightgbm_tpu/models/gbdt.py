@@ -28,6 +28,7 @@ from ..objectives import Objective, create_objective
 from ..ops.split import SplitParams
 from ..ops.treegrow import grow_tree
 from ..ops import predict as predict_ops
+from ..utils import faults as _faults
 from .tree import Tree, tree_from_device
 
 _MODEL_VERSION = "v4"
@@ -99,6 +100,11 @@ class GBDT:
         self._pred_cache = None
         self.binner = None
         self.rng = np.random.RandomState(cfg.seed)
+        # non-finite guard rail (docs/ROBUSTNESS.md): first boosting
+        # iteration (1-based) whose tree carried NaN/inf, 0 = clean.
+        # Accumulated ON DEVICE per iteration (O(num_leaves), no syncs)
+        # and pulled only at points that already sync (_guard_check)
+        self._guard_bad_iter = jnp.asarray(0, jnp.int32)
         if train_set is not None:
             self.reset_training_data(train_set)
 
@@ -118,11 +124,41 @@ class GBDT:
 
     def _flush_pending(self) -> None:
         if self._pending:
+            self._guard_check()
             pending, self._pending = self._pending, []
             for arrays, shrink, linear_fit in pending:
                 tree = tree_from_device(arrays, self.binner, linear=linear_fit)
                 tree.apply_shrinkage(shrink)
                 self._models.append(tree)
+
+    # -- non-finite guard rail (docs/ROBUSTNESS.md) --------------------
+    def _guard_accumulate(self, arrays) -> None:
+        """Fold this iteration's tree stats into the device-side guard
+        flag: O(num_leaves) reductions, no host pull.  Mirrors the
+        windowed grower's in-round info-vector guard on the full-pass and
+        fast growers, which have no per-round host read to ride."""
+        ok = (jnp.isfinite(arrays.leaf_value).all()
+              & ~jnp.isnan(arrays.split_gain).any())
+        self._guard_bad_iter = jnp.where(
+            (self._guard_bad_iter == 0) & ~ok,
+            jnp.asarray(self.iter_ + 1, jnp.int32), self._guard_bad_iter)
+
+    def _guard_check(self) -> None:
+        """Pull and test the guard flag — callers are points that sync
+        anyway (eval, flush, save, the %32 finish probe), so detection
+        lags corruption by at most the sync cadence while the error stays
+        stamped with the iteration the corruption ENTERED."""
+        bad = int(np.asarray(self._guard_bad_iter))
+        if bad:
+            from ..utils.guards import NonFiniteError
+
+            raise NonFiniteError(
+                f"non-finite leaf values/split gains entered the model at "
+                f"boosting iteration {bad}: the gradients or hessians went "
+                "NaN/inf (custom objective output? fp overflow?) and every "
+                "tree from that iteration on is invalid. Detection is "
+                "deferred to sync points by design — the device-side guard "
+                "costs no extra dispatches; see docs/ROBUSTNESS.md")
 
     # ------------------------------------------------------------------
     def reset_training_data(self, train_set) -> None:
@@ -984,6 +1020,7 @@ class GBDT:
             self.objective.set_fused_state(obj_state)
             self._cur_grad, self._cur_hess = g, h
             for c, arrays in enumerate(arrays_all):
+                self._guard_accumulate(arrays)
                 self._pending.append((arrays, shrinkage, None))
                 for vi, vs in enumerate(self.valid_sets):
                     from ..ops.treegrow_fast import predict_leaf_arrays
@@ -1019,7 +1056,9 @@ class GBDT:
             if (self.iter_ % 32) == 0:
                 # library path: syncing every iteration is too expensive (see
                 # above); a finished model only accretes constant trees, so a
-                # deferred check is safe — it is documented in engine.train
+                # deferred check is safe — it is documented in engine.train.
+                # The non-finite guard piggybacks on the same sync cadence.
+                self._guard_check()
                 return all(bool(a.num_leaves <= 1) for a in arrays_all)
             return False
         if grad is None:
@@ -1027,6 +1066,11 @@ class GBDT:
         else:
             g = jnp.asarray(grad, jnp.float32).reshape(self._score.shape)
             h = jnp.asarray(hess, jnp.float32).reshape(self._score.shape)
+        # fault-injection sites: poison one gradient/hessian element at a
+        # chosen iteration to drive the non-finite guard-rail tests
+        # (utils/faults.py; no-ops unless LGBMTPU_FAULT arms them)
+        g = _faults.corrupt_nonfinite("nonfinite_grad", self.iter_ + 1, g)
+        h = _faults.corrupt_nonfinite("nonfinite_hess", self.iter_ + 1, h)
         self._cur_grad, self._cur_hess = g, h
         row_mask, sample_weight = self._bagging_mask()
         feature_mask = self._feature_mask()
@@ -1161,6 +1205,7 @@ class GBDT:
                     quantize_bins=(self.cfg.num_grad_quant_bins if quant else 0),
                     stochastic_rounding=bool(self.cfg.stochastic_rounding),
                     quant_renew=bool(self.cfg.quant_train_renew_leaf),
+                    guard_label=f" (boosting iteration {self.iter_ + 1})",
                 )
             elif self._use_fast:
                 from ..ops.treegrow_fast import grow_tree_fast
@@ -1254,6 +1299,7 @@ class GBDT:
                     arrays, leaf_id, self._cegb_lazy_used = grow_out
                 else:
                     arrays, leaf_id = grow_out
+            self._guard_accumulate(arrays)
             linear_fit = None
             if self._linear and arrays.path_features is not None:
                 from ..ops.linear import fit_linear_leaves
@@ -1382,6 +1428,7 @@ class GBDT:
             # iteration is constant the score stops changing, so every later
             # iteration is constant too and the next check catches it).
             if (self.iter_ % 32) == 0:
+                self._guard_check()
                 return bool(all_const)
             return False
         return all_const
@@ -1543,6 +1590,9 @@ class GBDT:
     def eval_at(self, data_idx: int) -> List[Tuple[str, str, float, bool]]:
         """data_idx 0 = training, 1.. = valid sets (reference: GBDT::GetEvalAt).
         Returns (dataset_name, metric_name, value, is_higher_better)."""
+        # eval pulls metric scalars anyway — piggyback the non-finite
+        # guard so runs with valid sets detect corruption within a round
+        self._guard_check()
         if self._pre_partition and jax.process_count() > 1:
             return self._eval_at_synced(data_idx)
         ds, score, name = self._eval_target(data_idx)
@@ -1894,6 +1944,9 @@ class GBDT:
 
     def save_model_to_string(self, num_iteration: int = -1, start_iteration: int = 0,
                              importance_type: str = None) -> str:
+        # never serialize (or snapshot) a model poisoned by non-finite
+        # training values — the deferred guard is settled here at the latest
+        self._guard_check()
         if importance_type is None:
             # reference: config saved_feature_importance_type selects the
             # importance written into the model file (0=split, 1=gain)

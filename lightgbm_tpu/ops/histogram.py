@@ -221,6 +221,74 @@ def histogram_onehot_multi_quantized(
         num_leaves_tile, ncl, f, num_bins)  # (L_tile, 3, F, B)
 
 
+def histogram_multi(
+    bins: jnp.ndarray,  # (N, F) int
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    mask: jnp.ndarray,
+    leaf_id: jnp.ndarray,
+    leaf_base: int,
+    num_leaves_tile: int,
+    num_bins: int,
+    *,
+    precision: str = "f32",
+) -> jnp.ndarray:
+    """Multi-leaf histogram DISPATCHER for the Pallas-eligible growers ->
+    (L_tile, 3, F, B).
+
+    Tries the Pallas kernel; a kernel failure (or an armed
+    ``pallas_hist`` fault-injection site) is caught ONCE, logged, and
+    permanently degrades this process to the XLA one-hot path — identical
+    contract, no manual env var needed (utils/degrade.py).  The decision
+    runs at trace time: callers fold ``utils.degrade.available`` into
+    their ``use_pallas`` static so post-failure traces compile without
+    the broken kernel."""
+    from ..utils import degrade as _degrade
+
+    def _pallas():
+        from .hist_pallas import histogram_pallas_multi
+
+        return histogram_pallas_multi(
+            bins, grad, hess, mask, leaf_id, leaf_base, num_leaves_tile,
+            num_bins, precision=precision)
+
+    return _degrade.run_with_fallback(
+        _degrade.HIST, _pallas,
+        lambda: histogram_onehot_multi(
+            bins, grad, hess, mask, leaf_id, leaf_base, num_leaves_tile,
+            num_bins, precision=precision),
+        fault_site="pallas_hist")
+
+
+def histogram_multi_quantized(
+    bins: jnp.ndarray,  # (N, F) int
+    grad_q: jnp.ndarray,
+    hess_q: jnp.ndarray,
+    mask: jnp.ndarray,
+    leaf_id: jnp.ndarray,
+    leaf_base: int,
+    num_leaves_tile: int,
+    num_bins: int,
+) -> jnp.ndarray:
+    """Quantized sibling of :func:`histogram_multi` — same
+    catch-once/degrade-forever dispatch over the int8 kernels."""
+    from ..utils import degrade as _degrade
+
+    def _pallas():
+        from .hist_pallas import histogram_pallas_multi_quantized
+
+        return histogram_pallas_multi_quantized(
+            bins, grad_q, hess_q, mask, leaf_id, leaf_base,
+            num_leaves_tile, num_bins)
+
+    return _degrade.run_with_fallback(
+        _degrade.HIST, _pallas,
+        lambda: histogram_onehot_multi_quantized(
+            bins, grad_q, hess_q, mask, leaf_id, leaf_base, num_leaves_tile,
+            num_bins),
+        fault_site="pallas_hist")
+
+
 def histogram(
     bins: jnp.ndarray,
     grad: jnp.ndarray,

@@ -52,15 +52,35 @@ def partition_rows(
     path automatically (see ops/partition_pallas.py).
     """
     if use_pallas or interpret:
+        from ..utils import degrade as _degrade
         from .partition_pallas import _MAX_VMEM_ROWS, partition_pallas_segments
 
-        if order.shape[0] > _MAX_VMEM_ROWS and not interpret:
+        if not interpret and order.shape[0] > _MAX_VMEM_ROWS:
             return stable_partition_ranges(
                 order, seg_id, seg_start, seg_len, go_left)
 
-        raw, left_counts = partition_pallas_segments(
-            order, seg_start, seg_len, go_left, interpret=interpret)
-        return jnp.where(seg_id >= 0, raw, order), left_counts
+        def _pallas():
+            raw, left_counts = partition_pallas_segments(
+                order, seg_start, seg_len, go_left, interpret=interpret)
+            return jnp.where(seg_id >= 0, raw, order), left_counts
+
+        if interpret:
+            # correctness harness: always run the kernel (ignore the
+            # degradation registry) and surface every failure — a silent
+            # fallback here would quietly test XLA against XLA
+            from ..utils import faults as _faults
+
+            _faults.maybe_fail("pallas_partition")
+            return _pallas()
+
+        # a kernel failure is caught ONCE, logged, and permanently degrades
+        # this process to the XLA permutation — same results, O(N) instead
+        # of segment-proportional (utils/degrade.py)
+        return _degrade.run_with_fallback(
+            _degrade.PARTITION, _pallas,
+            lambda: stable_partition_ranges(
+                order, seg_id, seg_start, seg_len, go_left),
+            fault_site="pallas_partition")
     return stable_partition_ranges(order, seg_id, seg_start, seg_len, go_left)
 
 

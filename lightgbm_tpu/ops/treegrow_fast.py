@@ -40,8 +40,9 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from .hist_pallas import histogram_pallas_multi, histogram_pallas_multi_quantized
-from .histogram import (histogram, histogram_onehot_multi,
+from ..utils import degrade as _degrade
+from .histogram import (histogram, histogram_multi, histogram_multi_quantized,
+                        histogram_onehot_multi,
                         histogram_onehot_multi_quantized, unbundle_hists)
 from .split import (
     BestSplit, SplitParams, find_best_split, forced_split_candidate,
@@ -171,7 +172,7 @@ def _batched_best(
         "monotone_method",
     ),
 )
-def grow_tree_fast(
+def _grow_fast_impl(
     bins: jnp.ndarray,  # (N, F) int
     grad: jnp.ndarray,
     hess: jnp.ndarray,
@@ -283,7 +284,7 @@ def grow_tree_fast(
                     jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
                 )
             else:
-                hi = histogram_pallas_multi_quantized(
+                hi = histogram_multi_quantized(
                     hist_bins, gq, hq, row_mask & (leaf_slot >= 0),
                     jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
                 )
@@ -298,7 +299,7 @@ def grow_tree_fast(
             )
             h = unbundle(h)
         elif use_pallas:
-            h = histogram_pallas_multi(
+            h = histogram_multi(
                 hist_bins, grad, hess, row_mask & (leaf_slot >= 0),
                 jnp.maximum(leaf_slot, 0), 0, tile, num_bins,
                 precision=hist_precision,
@@ -926,3 +927,26 @@ def grow_tree_fast(
         # feature_used_in_data bitset persists across trees)
         return tree, state.leaf_id, state.lazy_used
     return tree, state.leaf_id
+
+
+def grow_tree_fast(*args, use_pallas: bool = True, **kwargs):
+    """Public entry: :func:`_grow_fast_impl` behind the graceful
+    kernel-degradation net (utils/degrade.py, mirrored from
+    ops/treegrow_windowed.py::grow_tree_windowed).  ``use_pallas`` folds
+    in the degradation registry before becoming a jit static; a Pallas
+    failure surfacing at trace or backend-COMPILE time is caught once,
+    logged, and the tree regrown on the XLA histogram path.
+
+    Honest scope: unlike the windowed grower (whose driver resolves
+    device reads inside the impl, so execute-time kernel failures surface
+    here too), this impl returns un-materialized device arrays — an
+    ASYNC execute-time kernel failure surfaces at the caller's next
+    blocking pull, outside this net.  Compile-time rejection is the
+    dominant real-world Mosaic failure class; the env escape hatches
+    remain for the rest."""
+    if not (use_pallas and _degrade.available(_degrade.HIST)):
+        return _grow_fast_impl(*args, use_pallas=False, **kwargs)
+    return _degrade.run_with_fallback(
+        _degrade.HIST,
+        lambda: _grow_fast_impl(*args, use_pallas=True, **kwargs),
+        lambda: _grow_fast_impl(*args, use_pallas=False, **kwargs))

@@ -65,10 +65,11 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ..utils import degrade as _degrade
 from ..utils import sanitizer as _san
-from .hist_pallas import (histogram_pallas_multi,
-                          histogram_pallas_multi_quantized)
-from .histogram import histogram, unbundle_hists
+from ..utils.guards import NonFiniteError
+from .histogram import (histogram, histogram_multi,
+                        histogram_multi_quantized, unbundle_hists)
 from .partition import partition_rows
 from .split import BestSplit, SplitParams, leaf_output, KMIN_SCORE
 from .treegrow import TreeArrays, _empty_best, _set_best
@@ -153,7 +154,7 @@ def _round_fused(
     sibling subtraction, fresh-leaf search, next-window bound.
 
     Returns (state', info) with info = [k_acc, window_total, fits_W,
-    whint] (i32) — the ONLY values that ever reach the host, read
+    whint, finite] (i32) — the ONLY values that ever reach the host, read
     asynchronously one round behind.  If the admitted splits' window
     would not fit the static W (impossible while the whint bound holds;
     kept as a device-verified safety net), the round applies NOTHING
@@ -390,12 +391,12 @@ def _round_fused(
         return unbundle_hists(h, efb_gather, efb_default, f, num_bins)
 
     if quantize_bins and use_pallas:
-        hi = histogram_pallas_multi_quantized(
+        hi = histogram_multi_quantized(
             sub_bins, gq[rows], hq[rows], mask_w, slot_of, 0, leaf_tile,
             num_bins)
         fresh_hists = unbundle(hi).astype(jnp.float32) * quant_scale[:, None, None]
     elif use_pallas:
-        fresh_hists = unbundle(histogram_pallas_multi(
+        fresh_hists = unbundle(histogram_multi(
             sub_bins, grad[rows], hess[rows], mask_w, slot_of, 0, leaf_tile,
             num_bins, precision=hist_precision))
     else:
@@ -474,8 +475,19 @@ def _round_fused(
         leaf_depth=leaf_depth, leaf_parent=leaf_parent, leaf_side=leaf_side,
         num_leaves_cur=num_leaves_new, leaf_out=leaf_out, tree=tree,
     )
+    # ---- non-finite guard rail (docs/ROBUSTNESS.md layer 2) ----
+    # O(L) reductions over stats this round already produced, folded into
+    # the SAME info vector the host reads one round behind: the guard
+    # costs zero extra dispatches and zero blocking syncs.  Dead slots
+    # hold zeros / KMIN, so any non-finite value is corruption that
+    # entered through the gradients/hessians or split accumulation.
+    finite = (jnp.isfinite(leaf_sum_g).all()
+              & jnp.isfinite(leaf_sum_h).all()
+              & jnp.isfinite(leaf_out).all()
+              & ~jnp.isnan(best.gain).any())
     info = jnp.stack([
         k_acc, total, ok.astype(jnp.int32), whint.astype(jnp.int32),
+        finite.astype(jnp.int32),
     ]).astype(jnp.int32)
     return state, info
 
@@ -536,11 +548,11 @@ def _w_init(
         return unbundle_hists(h, efb_gather, efb_default, f, num_bins)[0]
 
     if quantize_bins and use_pallas:
-        hist0 = unbundle1(histogram_pallas_multi_quantized(
+        hist0 = unbundle1(histogram_multi_quantized(
             hist_src, gq, hq, row_mask, jnp.zeros((n,), jnp.int32), 0, 1,
             num_bins)).astype(jnp.float32) * quant_scale[:, None, None]
     elif use_pallas:
-        hist0 = unbundle1(histogram_pallas_multi(
+        hist0 = unbundle1(histogram_multi(
             hist_src, grad, hess, row_mask, jnp.zeros((n,), jnp.int32), 0, 1,
             num_bins, precision=hist_precision))
     else:
@@ -632,7 +644,7 @@ def _w_finalize(state: WState, grad_true, hess_true, row_mask,
     return tree, state.leaf_id
 
 
-def grow_tree_windowed(
+def _grow_windowed_impl(
     bins_t: jnp.ndarray,  # (F, N) int16 feature-major
     grad: jnp.ndarray,
     hess: jnp.ndarray,
@@ -660,6 +672,7 @@ def grow_tree_windowed(
     stochastic_rounding: bool = True,
     quant_renew: bool = False,
     stats: Optional[dict] = None,
+    guard_label: str = "",
 ) -> tuple[TreeArrays, jnp.ndarray]:
     """Host-driven windowed growth; returns (tree, leaf_id per row).
 
@@ -682,9 +695,12 @@ def grow_tree_windowed(
     prof = os.environ.get("LGBMTPU_WPROF") == "1"
     enforce = os.environ.get("LGBMTPU_DISPATCH_BUDGET") == "1"
     # the Pallas segment partition is the TPU default; LGBMTPU_PARTITION
-    # _PALLAS=0 drops to the O(N) XLA permutation (same results)
+    # _PALLAS=0 drops to the O(N) XLA permutation (same results), as does
+    # a prior kernel failure recorded in the degradation registry (folded
+    # into the jit static here so post-failure traces skip the kernel)
     pallas_partition = use_pallas and (
-        os.environ.get("LGBMTPU_PARTITION_PALLAS", "1") != "0")
+        os.environ.get("LGBMTPU_PARTITION_PALLAS", "1") != "0") and (
+        _degrade.available(_degrade.PARTITION))
 
     # round 1 needs no feedback: a round's window (the small children)
     # can never exceed floor(N/2) rows, whatever it admits
@@ -722,11 +738,20 @@ def grow_tree_windowed(
             if len(pending) < 2:
                 continue  # pipeline fill: resolve reads one dispatch behind
             info = _san.async_pull_result(pending.pop(0))
-            k_acc, total, ok, whint = (int(info[0]), int(info[1]),
-                                       int(info[2]), int(info[3]))
+            k_acc, total, ok, whint, finite = (int(info[0]), int(info[1]),
+                                               int(info[2]), int(info[3]),
+                                               int(info[4]))
             w_ran = windows[resolved]  # the W THIS round ran with (the loop
             # variable has moved on to later dispatches)
             resolved += 1
+            if not finite:
+                raise NonFiniteError(
+                    f"non-finite gradients/hessians/split stats on device "
+                    f"at windowed round {resolved}{guard_label}: refusing "
+                    "to keep boosting on NaNs. The guard rode the round's "
+                    "async info vector (read one round behind, zero extra "
+                    "dispatches/syncs) — check labels/weights/custom "
+                    "objective outputs; see docs/ROBUSTNESS.md")
             if prof:
                 t_now = _time.perf_counter()
                 print(f"[WPROF] k={k_acc:2d} total={total:7d} W={w_ran:7d} "
@@ -744,6 +769,20 @@ def grow_tree_windowed(
                 converged = True
                 break
             W = _window_size(max(whint, 1), n)
+        # drain the in-flight round's info so its finite flag is checked
+        # too (the pipeline runs one dispatch ahead of the resolve point;
+        # without the drain, corruption in the final rounds would slip
+        # past the in-loop guard and only be caught by the deferred
+        # booster-level check)
+        while pending:
+            info = _san.async_pull_result(pending.pop(0))
+            resolved += 1
+            if not int(info[4]):
+                raise NonFiniteError(
+                    f"non-finite gradients/hessians/split stats on device "
+                    f"at windowed round {resolved}{guard_label} (drained "
+                    "in-flight round): refusing to finalize a tree grown "
+                    "on NaNs; see docs/ROBUSTNESS.md")
     finally:
         pending.clear()
         counter.__exit__(None, None, None)
@@ -773,3 +812,23 @@ def grow_tree_windowed(
 
     return _w_finalize(state, g_true, h_true, row_mask, params=params,
                        quant_renew=bool(quant_renew and quantize_bins))
+
+
+def grow_tree_windowed(*args, use_pallas: bool = True, **kwargs):
+    """Public entry: :func:`_grow_windowed_impl` behind the graceful
+    kernel-degradation net (utils/degrade.py).
+
+    ``use_pallas`` is folded with the degradation registry BEFORE it
+    becomes a jit static, so a process that already lost its Pallas
+    kernels traces straight to the XLA paths.  A Pallas/Mosaic failure
+    that only surfaces at backend-compile or execute time escapes the
+    trace-time dispatchers — it is caught here once, logged, recorded,
+    and the whole tree is regrown from the ORIGINAL inputs on the XLA
+    path (only internal WState buffers were donated to the failed
+    dispatch; the grower inputs are intact)."""
+    if not (use_pallas and _degrade.available(_degrade.HIST)):
+        return _grow_windowed_impl(*args, use_pallas=False, **kwargs)
+    return _degrade.run_with_fallback(
+        _degrade.HIST,
+        lambda: _grow_windowed_impl(*args, use_pallas=True, **kwargs),
+        lambda: _grow_windowed_impl(*args, use_pallas=False, **kwargs))

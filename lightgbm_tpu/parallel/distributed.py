@@ -28,9 +28,10 @@ from __future__ import annotations
 
 import os
 import socket
+import time
 from typing import List, Tuple
 
-from ..utils.log import log_info
+from ..utils.log import log_info, log_warning
 
 _initialized = False
 
@@ -115,12 +116,42 @@ def init_distributed(cfg) -> bool:
         f"Initializing distributed runtime: rank {rank}/{cfg.num_machines}, "
         f"coordinator {coordinator}"
     )
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=cfg.num_machines,
-        process_id=rank,
-        initialization_timeout=max(cfg.time_out, 1) * 60,
-    )
+    # bounded retry-with-backoff for the rendezvous phase: coordinator
+    # bring-up races (rank 0 not listening yet, stale TIME_WAIT sockets,
+    # transient DNS) are the dominant init failure class on real fleets
+    # and are safe to retry — jax.distributed.initialize is all-or-nothing
+    # before it succeeds (docs/ROBUSTNESS.md).  LGBMTPU_INIT_RETRIES=1
+    # disables retries.
+    attempts = max(int(os.environ.get("LGBMTPU_INIT_RETRIES", "3")), 1)
+    init_timeout = max(cfg.time_out, 1) * 60
+    for attempt in range(attempts):
+        t0 = time.monotonic()
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=cfg.num_machines,
+                process_id=rank,
+                initialization_timeout=init_timeout,
+            )
+            break
+        except (ValueError, TypeError):
+            # bad address / bad config: deterministic, never retryable
+            raise
+        except Exception as e:  # noqa: BLE001 — last attempt re-raises
+            # only FAST failures are the transient class worth retrying
+            # (coordinator not listening yet, connection refused).  An
+            # attempt that burned a large share of the rendezvous timeout
+            # means every peer waited it out too — retrying would multiply
+            # a multi-hour worst case instead of failing fast.
+            elapsed = time.monotonic() - t0
+            if attempt == attempts - 1 or elapsed >= 0.5 * init_timeout:
+                raise
+            delay = min(1.0 * (2 ** attempt), 15.0)
+            log_warning(
+                f"distributed init attempt {attempt + 1}/{attempts} failed "
+                f"after {elapsed:.1f}s ({type(e).__name__}: {str(e)[:200]}); "
+                f"retrying rendezvous in {delay:.1f}s")
+            time.sleep(delay)
     _initialized = True
     log_info(
         f"Distributed runtime up: {jax.process_count()} processes, "

@@ -30,13 +30,17 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
 import tempfile
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..utils.log import log_warning
 
 _WORKER_SRC = r"""
 import os, sys, json
@@ -81,6 +85,17 @@ if es_rounds > 0 and valid_sets:
     callbacks.append(lgb.early_stopping(es_rounds, verbose=False))
 if valid_sets:
     callbacks.append(lgb.record_evaluation(evals_result))
+if os.environ.get("LGBMTPU_FAULT"):
+    # worker_death injection site (utils/faults.py): rank-gated hard exit
+    # at the start of a chosen iteration — the scenario the launcher
+    # watchdog exists to catch
+    from lightgbm_tpu.utils import faults as _faults
+
+    def _fault_cb(env):
+        _faults.maybe_crash("worker_death", env.iteration + 1)
+    _fault_cb.before_iteration = True
+    _fault_cb.order = -100
+    callbacks.append(_fault_cb)
 bst = lgb.train(params, ds, int(os.environ["LGBM_TPU_ROUNDS"]),
                 valid_sets=valid_sets or None,
                 valid_names=valid_names or None,
@@ -98,6 +113,93 @@ if rank == "0":
         json.dump(meta, fh)
 print("LAUNCHER_RANK_OK", rank, flush=True)
 """
+
+
+class WorkerFailure(RuntimeError):
+    """A launcher worker died (non-zero exit) or the launch timed out.
+    Carries the failing rank (or None for timeouts) so retry logic and
+    tests can tell the cases apart."""
+
+    def __init__(self, msg: str, rank: Optional[int] = None,
+                 timed_out: bool = False):
+        super().__init__(msg)
+        self.rank = rank
+        self.timed_out = timed_out
+
+
+def _kill_worker_group(proc: subprocess.Popen) -> None:
+    """Kill a worker AND everything it spawned (each worker is started in
+    its own session, so its process group is exactly its subtree) — no
+    zombies may outlive a failed launch."""
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        pass
+
+
+def _log_tail(log_path: str, nbytes: int = 2000) -> str:
+    try:
+        with open(log_path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - nbytes))
+            return fh.read().decode(errors="replace")
+    except OSError as e:
+        return f"<log unreadable: {e}>"
+
+
+def _watch_workers(workers, timeout_s: float,
+                   poll_interval: float = 0.1) -> None:
+    """Per-worker liveness watchdog: poll + exit-code harvest.
+
+    ``workers`` is a list of (rank, Popen, log_path).  Returns when every
+    worker exits 0.  A worker exiting non-zero fails the run within
+    ~poll_interval seconds — not after a ``communicate(timeout=600)``
+    hang waiting on the survivors, which block forever on the dead
+    rank's collectives — with that worker's log tail in the error.  On
+    failure or timeout the WHOLE process group of every worker is killed
+    and every tail is harvested (docs/ROBUSTNESS.md)."""
+    deadline = time.monotonic() + timeout_s
+    done = set()
+    try:
+        while len(done) < len(workers):
+            for rank, proc, log_path in workers:
+                if rank in done:
+                    continue
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    done.add(rank)
+                    continue
+                raise WorkerFailure(
+                    f"launcher worker rank {rank} died with exit code {rc}; "
+                    f"remaining workers killed. Tail of rank {rank}'s log "
+                    f"({log_path}):\n{_log_tail(log_path)}",
+                    rank=rank)
+            if time.monotonic() > deadline:
+                tails = "\n".join(
+                    f"--- rank {r} ({lp}) ---\n{_log_tail(lp)}"
+                    for r, _, lp in workers)
+                raise WorkerFailure(
+                    f"launcher timed out after {timeout_s:.0f}s; all worker "
+                    f"process groups killed. Worker log tails:\n{tails}",
+                    timed_out=True)
+            time.sleep(poll_interval)
+    except BaseException:
+        # single cleanup path for death, timeout, and anything else:
+        # no code path may leak live workers
+        for _, p2, _ in workers:
+            if p2.poll() is None:
+                _kill_worker_group(p2)
+        raise
 
 
 def _free_ports(k: int) -> list:
@@ -188,12 +290,21 @@ def train_distributed(
     devices_per_machine: int = 1,
     timeout_s: int = 600,
     env_extra: Optional[Dict[str, str]] = None,
+    max_restarts: int = 0,
+    restart_backoff_s: float = 1.0,
 ):
     """Shard rows over `num_machines` local worker processes, train with
     tree_learner=data under pre_partition, and return (rank 0's Booster,
     per-rank model paths).  With eval_set, each eval set is row-sharded the
     same way; metrics sync across ranks (GlobalSyncUpBySum analogue) and
-    early stopping fires identically on every rank."""
+    early stopping fires identically on every rank.
+
+    Worker liveness is supervised by :func:`_watch_workers`: a dead rank
+    fails the launch in seconds with its log tail, and every failure path
+    kills the full worker process groups (no zombies).  ``max_restarts``
+    relaunches the whole fleet after a failure (fresh ports, re-written
+    shards) with exponential backoff — workers are stateless between
+    launches, so a full relaunch is the correct recovery unit."""
     import lightgbm_tpu as lgb
 
     n = X.shape[0]
@@ -226,9 +337,6 @@ def train_distributed(
         eval_plans.append((np.asarray(Xe), np.asarray(ye).ravel(), we,
                            sl, gr, pe, name))
 
-    ports = _free_ports(num_machines)
-    machines = ",".join(f"127.0.0.1:{p}" for p in ports)
-
     tmp = tempfile.mkdtemp(prefix="lgbm_tpu_launch_")
     params_path = os.path.join(tmp, "params.npz")
     np.savez(params_path, params=np.asarray(dict(params), dtype=object))
@@ -236,49 +344,86 @@ def train_distributed(
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 
-    procs = []
-    for rank in range(num_machines):
-        Xs, ys, ws, gs = _rank_arrays(shard_slices, shard_groups, per,
-                                      rank, X, y, weight)
-        shard_arrays = dict(
-            X=Xs, y=ys, w=ws,
-            g=(gs if gs is not None else np.asarray(())),
-            num_machines=num_machines, machines=machines,
-            local_listen_port=ports[rank], time_out=2,
-            n_eval=len(eval_plans),
-        )
-        for i, (Xe, ye, we, sl, gr, pe, name) in enumerate(eval_plans):
-            Xv, yv, wv, gv = _rank_arrays(sl, gr, pe, rank, Xe, ye, we)
-            shard_arrays[f"ev{i}_X"] = Xv
-            shard_arrays[f"ev{i}_y"] = yv
-            shard_arrays[f"ev{i}_w"] = wv
-            shard_arrays[f"ev{i}_g"] = (gv if gv is not None
-                                        else np.asarray(()))
-            shard_arrays[f"ev{i}_name"] = name
-        shard_path = os.path.join(tmp, f"shard{rank}.npz")
-        np.savez(shard_path, **shard_arrays)
-        env = dict(os.environ)
-        env.update(env_extra or {})
-        env["LIGHTGBM_TPU_RANK"] = str(rank)
-        env["LGBM_TPU_REPO"] = repo
-        env["LGBM_TPU_SHARD"] = shard_path
-        env["LGBM_TPU_PARAMS"] = params_path
-        env["LGBM_TPU_ROUNDS"] = str(num_boost_round)
-        env["LGBM_TPU_MODEL_OUT"] = model_out
-        env["LGBM_TPU_ES_ROUNDS"] = str(early_stopping_rounds or 0)
-        env.pop("PYTEST_CURRENT_TEST", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _WORKER_SRC], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        ))
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=timeout_s)
-        outs.append(out.decode())
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        if p.returncode != 0:
-            raise RuntimeError(
-                f"launcher worker rank {rank} failed:\n{out[-4000:]}")
+    def _launch_once() -> None:
+        # fresh ports per attempt: the previous fleet's listen sockets may
+        # sit in TIME_WAIT, and the machines list is baked into the shards
+        ports = _free_ports(num_machines)
+        machines = ",".join(f"127.0.0.1:{p}" for p in ports)
+        workers = []  # (rank, Popen, log_path)
+        try:
+            _spawn_all(workers, ports, machines)
+        except BaseException:
+            # a failure while SPAWNING (disk full, fork failure on a later
+            # rank) must not leak the ranks already started — the watchdog
+            # cleanup only covers workers it was handed
+            for _, p, _ in workers:
+                if p.poll() is None:
+                    _kill_worker_group(p)
+            raise
+        _watch_workers(workers, timeout_s)
+
+    def _spawn_all(workers, ports, machines) -> None:
+        for rank in range(num_machines):
+            Xs, ys, ws, gs = _rank_arrays(shard_slices, shard_groups, per,
+                                          rank, X, y, weight)
+            shard_arrays = dict(
+                X=Xs, y=ys, w=ws,
+                g=(gs if gs is not None else np.asarray(())),
+                num_machines=num_machines, machines=machines,
+                local_listen_port=ports[rank], time_out=2,
+                n_eval=len(eval_plans),
+            )
+            for i, (Xe, ye, we, sl, gr, pe, name) in enumerate(eval_plans):
+                Xv, yv, wv, gv = _rank_arrays(sl, gr, pe, rank, Xe, ye, we)
+                shard_arrays[f"ev{i}_X"] = Xv
+                shard_arrays[f"ev{i}_y"] = yv
+                shard_arrays[f"ev{i}_w"] = wv
+                shard_arrays[f"ev{i}_g"] = (gv if gv is not None
+                                            else np.asarray(()))
+                shard_arrays[f"ev{i}_name"] = name
+            shard_path = os.path.join(tmp, f"shard{rank}.npz")
+            np.savez(shard_path, **shard_arrays)
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env["LIGHTGBM_TPU_RANK"] = str(rank)
+            env["LGBM_TPU_REPO"] = repo
+            env["LGBM_TPU_SHARD"] = shard_path
+            env["LGBM_TPU_PARAMS"] = params_path
+            env["LGBM_TPU_ROUNDS"] = str(num_boost_round)
+            env["LGBM_TPU_MODEL_OUT"] = model_out
+            env["LGBM_TPU_ES_ROUNDS"] = str(early_stopping_rounds or 0)
+            env.pop("PYTEST_CURRENT_TEST", None)
+            if env.get("LGBMTPU_FAULT"):
+                # make injected faults once-only ACROSS restarts, so a
+                # relaunched fleet runs clean (utils/faults.py)
+                env.setdefault("LGBMTPU_FAULT_ONCE_DIR", tmp)
+            # log file instead of a PIPE: a chatty worker cannot deadlock
+            # on a full pipe buffer, and the watchdog can harvest tails
+            # after the process is gone
+            log_path = os.path.join(tmp, f"worker{rank}.log")
+            with open(log_path, "wb") as log_fh:
+                workers.append((rank, subprocess.Popen(
+                    [sys.executable, "-c", _WORKER_SRC], env=env,
+                    stdout=log_fh, stderr=subprocess.STDOUT,
+                    start_new_session=True,  # own process group: killable
+                    # as a unit, no zombies past a timeout
+                ), log_path))
+
+    attempt = 0
+    while True:
+        try:
+            _launch_once()
+            break
+        except WorkerFailure as e:
+            if attempt >= max_restarts:
+                raise
+            delay = restart_backoff_s * (2 ** attempt)
+            attempt += 1
+            log_warning(
+                f"launcher attempt {attempt}/{max_restarts + 1} failed "
+                f"({str(e)[:200]}); relaunching all workers in "
+                f"{delay:.1f}s")
+            time.sleep(delay)
     booster = lgb.Booster(model_file=model_out + ".rank0")
     meta_path = model_out + ".meta.json"
     if os.path.exists(meta_path):

@@ -1,0 +1,202 @@
+"""Durable checkpoints: atomic model writes + integrity trailers + torn-
+snapshot fallback (docs/ROBUSTNESS.md).
+
+The reference's entire fault model is ``snapshot_freq``: GBDT::Train
+writes ``<output_model>.snapshot_iter_<n>`` every freq iterations and a
+restart loads it via ``input_model``.  A crash MID-WRITE, however, leaves
+a torn file that a restart happily parses into a half-model — the exact
+silent-corruption class a recovery story must exclude.  Three properties
+fix it:
+
+* **Atomicity** — every model file is written to a same-directory temp
+  file, fsync'd, and ``os.replace``d into place.  A crash at any point
+  leaves either the old file or the new file, never a hybrid; stray
+  ``*.tmp.*`` files are garbage, not checkpoints.
+* **Integrity trailer** — snapshots carry a final comment line
+  ``# lgbm-tpu-checkpoint v1 sha256=<hex> bytes=<n>`` over the payload.
+  The model-text parser never sees it (loads strip it), and a resume can
+  distinguish "valid snapshot" from "torn/bit-rotted file" instead of
+  trusting mtime.
+* **Fallback scan** — :func:`latest_valid_snapshot` walks the snapshot
+  family of an output model, newest first, and returns the first one
+  whose trailer verifies; engine.train resumes from it when the
+  requested snapshot fails verification.
+
+Kept import-light (stdlib + utils only): basic.py and engine.py both use
+it, and the launcher's thin worker processes must not pay a jax import
+to write a model atomically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import tempfile
+from typing import List, Optional, Tuple
+
+from . import faults
+
+TRAILER_VERSION = "v1"
+_TRAILER_RE = re.compile(
+    r"^# lgbm-tpu-checkpoint (?P<ver>v\d+) sha256=(?P<digest>[0-9a-f]{64}) "
+    r"bytes=(?P<nbytes>\d+)\s*$")
+_SNAPSHOT_RE = re.compile(r"^(?P<prefix>.*)\.snapshot_iter_(?P<it>\d+)$")
+
+
+def _digest(payload: str) -> str:
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+
+def add_trailer(payload: str) -> str:
+    """Append the integrity trailer line to a model text."""
+    if not payload.endswith("\n"):
+        payload += "\n"
+    return (f"{payload}# lgbm-tpu-checkpoint {TRAILER_VERSION} "
+            f"sha256={_digest(payload)} bytes={len(payload.encode('utf-8'))}\n")
+
+
+def verify_text(text: str) -> Tuple[str, Optional[bool]]:
+    """Split a model text into (payload, verdict).
+
+    verdict is True (trailer present and verifies), False (trailer
+    present but digest/length mismatch — a torn or corrupted file), or
+    None (no trailer: a plain model file, nothing to verify)."""
+    lines = text.splitlines(keepends=True)
+    for i in range(len(lines) - 1, -1, -1):
+        if lines[i].strip():
+            m = _TRAILER_RE.match(lines[i].strip())
+            if m is None:
+                return text, None
+            payload = "".join(lines[:i])
+            ok = (m.group("ver") == TRAILER_VERSION
+                  and len(payload.encode("utf-8")) == int(m.group("nbytes"))
+                  and _digest(payload) == m.group("digest"))
+            return payload, ok
+    return text, None
+
+
+def atomic_write_text(path: str, text: str,
+                      fault_round: Optional[int] = None) -> None:
+    """Write ``text`` to ``path`` atomically (same-dir temp + fsync +
+    ``os.replace``).  ``fault_round`` arms the ``snapshot_write``
+    injection site mid-write (utils/faults.py): the crash lands after a
+    partial payload is flushed to the TEMP file, proving no torn file can
+    reach the final path."""
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".tmp.", dir=d)
+    try:
+        # mkstemp creates 0600; restore umask-based permissions so the
+        # final file is readable exactly as a plain open()-write would be
+        # (shared model dirs, serving processes under another uid)
+        umask = os.umask(0)
+        os.umask(umask)
+        os.fchmod(fd, 0o666 & ~umask)
+        # utf-8 everywhere: the trailer digest and the verify readers
+        # hash/decode utf-8 — the write must not follow the locale
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            if fault_round is not None and faults.armed("snapshot_write"):
+                # injection scaffolding only when armed: the extra
+                # flush+fsync of the split write must not tax every
+                # production snapshot
+                half = text[: len(text) // 2]
+                fh.write(half)
+                fh.flush()
+                os.fsync(fh.fileno())
+                faults.maybe_crash("snapshot_write", fault_round)
+                fh.write(text[len(half):])
+            else:
+                fh.write(text)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def save_snapshot(path: str, model_text: str, iteration: int) -> None:
+    """Atomic, trailer-stamped snapshot write (engine.py snapshot_freq)."""
+    atomic_write_text(path, add_trailer(model_text), fault_round=iteration)
+
+
+def verify_file(path: str) -> Optional[bool]:
+    """Trailer verdict for a file on disk (see :func:`verify_text`).
+    Unreadable files count as torn (False), and so does a SNAPSHOT-named
+    file with no trailer at all — snapshots are always written with one,
+    so truncation that ate the trailer line must not read as 'legacy
+    file, nothing to verify'."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except (OSError, UnicodeDecodeError):
+        return False
+    ok = verify_text(text)[1]
+    if ok is None and is_snapshot_path(path):
+        return False
+    return ok
+
+
+def snapshot_iteration(path: str) -> Optional[int]:
+    """The <k> of a ``*.snapshot_iter_<k>`` path, None for other paths."""
+    m = _SNAPSHOT_RE.match(os.fspath(path))
+    return int(m.group("it")) if m else None
+
+
+def is_snapshot_path(path: str) -> bool:
+    """True for ``*.snapshot_iter_<k>`` paths.  Snapshots are ALWAYS
+    written with a trailer, so a snapshot-named file without a valid one
+    is torn by definition — truncation that chops the trailer off must
+    not demote a snapshot to an unverifiable 'legacy' file."""
+    return _SNAPSHOT_RE.match(os.fspath(path)) is not None
+
+
+def read_and_verify(path: str) -> Tuple[str, Optional[bool]]:
+    """(payload, raw trailer verdict) for a file on disk — unlike
+    :func:`verify_file` this reports the TEXT verdict (None = no trailer)
+    so callers can distinguish a pre-trailer-era file from a torn one.
+    An undecodable file reports ("", False): corrupted, not a crash."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return verify_text(fh.read())
+    except UnicodeDecodeError:
+        return "", False
+
+
+def snapshot_family(path: str) -> List[Tuple[int, str]]:
+    """All ``<prefix>.snapshot_iter_<k>`` siblings of ``path`` (itself a
+    snapshot path or the bare output-model prefix), sorted newest first."""
+    m = _SNAPSHOT_RE.match(os.fspath(path))
+    prefix = m.group("prefix") if m else os.fspath(path)
+    base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
+    base_name = os.path.basename(prefix)
+    out = []
+    try:
+        entries = os.listdir(base_dir)
+    except OSError:
+        return []
+    for name in entries:
+        sm = _SNAPSHOT_RE.match(name)
+        if sm is not None and sm.group("prefix") == base_name:
+            out.append((int(sm.group("it")), os.path.join(base_dir, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def latest_valid_snapshot(path: str,
+                          below_iter: Optional[int] = None
+                          ) -> Optional[Tuple[int, str]]:
+    """Newest snapshot in ``path``'s family whose trailer VERIFIES
+    (trailerless files are skipped — they cannot be vouched for).
+    ``below_iter`` restricts the scan to strictly older snapshots (the
+    fallback case: the iter-k snapshot is torn, look before k)."""
+    for it, snap in snapshot_family(path):
+        if below_iter is not None and it >= below_iter:
+            continue
+        if verify_file(snap) is True:
+            return it, snap
+    return None

@@ -844,3 +844,138 @@ def test_r6_negative_match_case_arms(tmp_path):
             return state
     """}, rules=["R6"])
     assert rep.findings == []
+
+
+# ---------------------------------------------------------------------------
+# R7 host-nonfinite-guard
+# ---------------------------------------------------------------------------
+
+def test_r7_positive_np_isnan_in_driver_loop(tmp_path):
+    """Host np.isnan on a per-round tensor inside a grower loop — one
+    blocking device pull per round, the guard anti-pattern."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(s):
+            return s + 1
+
+        def drive(s):
+            for _ in range(5):
+                s = step(s)
+                if np.isnan(s).any():
+                    raise ValueError("nan")
+            return s
+    """}, rules=["R7"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "R7"
+    assert "np.isnan" in rep.findings[0].message
+
+
+def test_r7_positive_math_isnan_and_float_jnp_pull(tmp_path):
+    """math.isnan(...) and bool(jnp.isfinite(...)) in the loop are the
+    same sync wearing different costumes."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import math
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(s):
+            return s + 1
+
+        def drive(s, g):
+            for _ in range(5):
+                s = step(s)
+                if math.isnan(g):
+                    break
+                if bool(jnp.isfinite(s).all()):
+                    continue
+            return s
+    """}, rules=["R7"])
+    assert len(rep.findings) == 2, rep.findings
+    assert all(f.rule == "R7" for f in rep.findings)
+
+
+def test_r7_negative_outside_loop_and_device_side(tmp_path):
+    """np.isfinite BEFORE the loop is a once-per-call boundary check, and
+    jnp.isfinite folded into the dispatched step is the supported
+    device-side guard — neither is flagged."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def step(s):
+            return s + 1, jnp.isfinite(s).all()
+
+        def drive(s, label):
+            if not np.isfinite(label).all():
+                raise ValueError("bad label")
+            for _ in range(5):
+                s, flag = step(s)
+            return s, flag
+    """}, rules=["R7"])
+    assert rep.findings == []
+
+
+def test_r7_negative_non_driver_function(tmp_path):
+    """A plain host function (no jit dispatch in its loops) may isnan all
+    it likes — numpy-on-numpy is not a device pull."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        def clean(rows):
+            for r in rows:
+                if np.isnan(r).any():
+                    raise ValueError("nan row")
+            return rows
+    """}, rules=["R7"])
+    assert rep.findings == []
+
+
+def test_r7_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(s):
+            return s + 1
+
+        def drive(s):
+            for _ in range(5):
+                s = step(s)
+                if np.isnan(s).any():  # jaxlint: disable=R7 (debug harness, not a hot loop)
+                    raise ValueError("nan")
+            return s
+    """}, rules=["R7"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+def test_r7_positive_implicit_bool_branch(tmp_path):
+    """`if jnp.isnan(x).any():` in a driver loop triggers __bool__ on a
+    device array — the implicit form of the sync, flagged exactly once
+    (no double count with the explicit-cast check)."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(s):
+            return s + 1
+
+        def drive(s):
+            for _ in range(5):
+                s = step(s)
+                if jnp.isnan(s).any():
+                    raise ValueError("nan")
+                while jnp.isfinite(s).all():
+                    break
+            return s
+    """}, rules=["R7"])
+    assert len(rep.findings) == 2, rep.findings
+    assert all("implicit bool" in f.message for f in rep.findings)

@@ -1,15 +1,237 @@
 """Failure-recovery round trip (SURVEY §6.3): snapshot + restart from
 init_model must reproduce uninterrupted training (the reference's recovery
-story is exactly snapshot_freq + task=train input_model=...)."""
+story is exactly snapshot_freq + task=train input_model=...).
+
+Round 8 additions run in TIER-1 (unmarked): a tiny 2+2 round trip, the
+atomic/trailered snapshot format, torn-snapshot fallback, and the
+crash-injection scenarios (host crash / snapshot-write crash at round k
+via LGBMTPU_FAULT in a subprocess, then resume and match the
+uninterrupted run)."""
+
+import os
+import subprocess
+import sys
 
 import pytest
 import numpy as np
 
 import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import CorruptModelError
+from lightgbm_tpu.utils import checkpoint
 
-pytestmark = pytest.mark.slow
+
+def _data(n=200, f=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(float)
+    return X, y
 
 
+PARAMS = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+          "learning_rate": 0.2}
+
+
+# ---------------------------------------------------------------------------
+# tier-1: fast snapshot/resume round trip + checkpoint format
+# ---------------------------------------------------------------------------
+
+def test_fast_snapshot_resume_roundtrip(tmp_path):
+    """2+2 rounds through a snapshot == 4 uninterrupted rounds — the
+    smallest possible recovery equivalence, cheap enough for tier-1."""
+    X, y = _data()
+    full = lgb.train(PARAMS, lgb.Dataset(X, label=y), 4)
+
+    out = str(tmp_path / "model.txt")
+    lgb.train({**PARAMS, "snapshot_freq": 2, "output_model": out},
+              lgb.Dataset(X, label=y), 2)
+    snap = f"{out}.snapshot_iter_2"
+    assert os.path.exists(snap)
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2, init_model=snap)
+
+    assert resumed.num_trees() == 4
+    np.testing.assert_allclose(
+        resumed.predict(X), full.predict(X), rtol=1e-5, atol=1e-6)
+
+
+def test_snapshot_carries_verifiable_trailer(tmp_path):
+    X, y = _data(seed=1)
+    out = str(tmp_path / "m.txt")
+    lgb.train({**PARAMS, "snapshot_freq": 2, "output_model": out},
+              lgb.Dataset(X, label=y), 2)
+    snap = f"{out}.snapshot_iter_2"
+    assert checkpoint.verify_file(snap) is True
+    # the trailer is stripped on load: the snapshot parses into a booster
+    assert lgb.Booster(model_file=snap).num_trees() == 2
+    text = open(snap).read()
+    # payload corruption under an intact trailer: digest mismatch
+    corrupt = str(tmp_path / "corrupt.txt.snapshot_iter_9")
+    open(corrupt, "w").write(text.replace("num_leaves", "num_leavez", 1))
+    assert checkpoint.verify_file(corrupt) is False
+    with pytest.raises(CorruptModelError):
+        lgb.Booster(model_file=corrupt)
+    # plain truncation chops the trailer off — for a snapshot-named file
+    # that is equally torn (snapshots are always written with a trailer)
+    torn = str(tmp_path / "torn.txt.snapshot_iter_9")
+    open(torn, "w").write(text[: int(len(text) * 0.7)])
+    with pytest.raises(CorruptModelError):
+        lgb.Booster(model_file=torn)
+
+
+def test_trailerless_model_files_still_load(tmp_path):
+    """Plain save_model output has no trailer (legacy format) and must
+    keep loading unchanged."""
+    X, y = _data(seed=2)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2)
+    p = str(tmp_path / "plain.txt")
+    bst.save_model(p)
+    assert checkpoint.verify_file(p) is None
+    assert lgb.Booster(model_file=p).num_trees() == 2
+
+
+def test_resume_falls_back_to_newest_valid_snapshot(tmp_path):
+    """A torn newest snapshot must not kill the resume: engine.train
+    falls back to the newest snapshot whose trailer verifies."""
+    X, y = _data(seed=3)
+    out = str(tmp_path / "m.txt")
+    lgb.train({**PARAMS, "snapshot_freq": 2, "output_model": out},
+              lgb.Dataset(X, label=y), 4)
+    snap2, snap4 = (f"{out}.snapshot_iter_{k}" for k in (2, 4))
+    assert checkpoint.verify_file(snap4) is True
+    # tear the newest snapshot
+    text = open(snap4).read()
+    open(snap4, "w").write(text[: len(text) // 2])
+    assert checkpoint.verify_file(snap4) is False
+    assert checkpoint.latest_valid_snapshot(snap4) == (2, snap2)
+
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2,
+                        init_model=snap4)
+    # fell back to iter 2 and trained 2 more: 4 trees
+    assert resumed.num_trees() == 4
+    ref = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2, init_model=snap2)
+    np.testing.assert_allclose(resumed.predict(X), ref.predict(X),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_resume_with_no_valid_fallback_raises(tmp_path):
+    X, y = _data(seed=4)
+    out = str(tmp_path / "m.txt")
+    lgb.train({**PARAMS, "snapshot_freq": 2, "output_model": out},
+              lgb.Dataset(X, label=y), 2)
+    snap = f"{out}.snapshot_iter_2"
+    text = open(snap).read()
+    open(snap, "w").write(text[: len(text) // 2])
+    with pytest.raises(CorruptModelError):
+        lgb.train(PARAMS, lgb.Dataset(X, label=y), 2, init_model=snap)
+
+
+def test_atomic_write_never_tears_on_exception(tmp_path):
+    """atomic_write_text: a failure mid-write leaves the previous file
+    byte-identical and no temp debris behind."""
+    p = str(tmp_path / "f.txt")
+    checkpoint.atomic_write_text(p, "generation one\n")
+
+    class Boom(RuntimeError):
+        pass
+
+    real_replace = os.replace
+
+    def exploding_replace(src, dst):
+        raise Boom("crash between temp write and rename")
+
+    os.replace = exploding_replace
+    try:
+        with pytest.raises(Boom):
+            checkpoint.atomic_write_text(p, "generation two\n")
+    finally:
+        os.replace = real_replace
+    assert open(p).read() == "generation one\n"
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+
+# ---------------------------------------------------------------------------
+# tier-1: crash injection in a subprocess, resume in-process
+# ---------------------------------------------------------------------------
+
+_CRASH_SCRIPT = """
+import os, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(200, 4)
+y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(float)
+lgb.train({{"objective": "binary", "num_leaves": 7, "verbosity": -1,
+           "learning_rate": 0.2, "snapshot_freq": 2,
+           "output_model": {out!r}}},
+          lgb.Dataset(X, label=y), 6)
+print("COMPLETED_WITHOUT_FAULT", flush=True)
+"""
+
+
+def _run_crashing_train(tmp_path, fault: str):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "m.txt")
+    env = dict(os.environ)
+    env["LGBMTPU_FAULT"] = fault
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTEST_CURRENT_TEST", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _CRASH_SCRIPT.format(repo=repo, out=out)],
+        env=env, capture_output=True, text=True, timeout=300)
+    return out, r
+
+
+def test_host_crash_at_round_k_resumes_and_matches(tmp_path):
+    """The acceptance scenario: kill the host at round 4 (after snapshot
+    iter 2), resume from the newest valid snapshot, and reproduce the
+    uninterrupted 6-round model bit-for-bit in predictions."""
+    from lightgbm_tpu.utils.faults import CRASH_EXIT_CODE
+
+    out, r = _run_crashing_train(tmp_path, "host_crash:4")
+    assert r.returncode == CRASH_EXIT_CODE, (r.stdout, r.stderr)
+    assert "COMPLETED_WITHOUT_FAULT" not in r.stdout
+
+    found = checkpoint.latest_valid_snapshot(out)
+    assert found is not None
+    it, snap = found
+    assert it == 2  # crash at the start of round 4: snapshots 1..2 survive
+
+    X, y = _data()  # same data/seed as the crashed run
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), 6 - it,
+                        init_model=snap)
+    full = lgb.train(PARAMS, lgb.Dataset(X, label=y), 6)
+    assert resumed.num_trees() == 6
+    np.testing.assert_allclose(
+        resumed.predict(X), full.predict(X), rtol=1e-5, atol=1e-6)
+
+
+def test_snapshot_write_crash_leaves_no_torn_snapshot(tmp_path):
+    """Kill the process MID-SNAPSHOT-WRITE (iteration 4's snapshot).  The
+    old direct-write code left a torn snapshot_iter_4 that resume loaded;
+    the atomic writer must leave either no iter-4 snapshot or a fully
+    valid one — and resume must work from the newest valid snapshot."""
+    from lightgbm_tpu.utils.faults import CRASH_EXIT_CODE
+
+    out, r = _run_crashing_train(tmp_path, "snapshot_write:4")
+    assert r.returncode == CRASH_EXIT_CODE, (r.stdout, r.stderr)
+
+    for it, snap in checkpoint.snapshot_family(out):
+        assert checkpoint.verify_file(snap) is True, (
+            f"torn snapshot survived the crash: {snap}")
+    found = checkpoint.latest_valid_snapshot(out)
+    assert found is not None and found[0] == 2
+    X, y = _data()
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2,
+                        init_model=found[1])
+    assert resumed.num_trees() == 4
+
+
+# ---------------------------------------------------------------------------
+# slow: the original wider round trips
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
 def test_snapshot_resume_matches_uninterrupted(tmp_path):
     rng = np.random.RandomState(0)
     X = rng.randn(500, 4)
@@ -35,11 +257,9 @@ def test_snapshot_resume_matches_uninterrupted(tmp_path):
         resumed.predict(X), full.predict(X), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_cli_resume_via_input_model(tmp_path):
     """CLI restart: task=train input_model=snapshot continues training."""
-    import subprocess
-    import sys
-
     rng = np.random.RandomState(1)
     X = rng.randn(300, 3)
     y = (X[:, 0] > 0).astype(float)
@@ -61,3 +281,85 @@ def test_cli_resume_via_input_model(tmp_path):
     assert r.returncode == 0, r.stderr
     bst = lgb.Booster(model_file=m2)
     assert bst.num_trees() == 5
+
+
+def test_fallback_never_resumes_from_a_newer_stale_snapshot(tmp_path):
+    """A stale NEWER snapshot (left by a previous longer run on the same
+    prefix) must not win the fallback scan: resuming 'forward' of the
+    requested iteration would silently produce a model with the wrong
+    trees.  The scan is bounded to strictly OLDER siblings."""
+    X, y = _data(seed=5)
+    out = str(tmp_path / "m.txt")
+    # previous, longer run: leaves snapshots 2..6
+    lgb.train({**PARAMS, "snapshot_freq": 2, "output_model": out},
+              lgb.Dataset(X, label=y), 6)
+    snap2 = f"{out}.snapshot_iter_2"
+    snap4 = f"{out}.snapshot_iter_4"
+    assert checkpoint.verify_file(f"{out}.snapshot_iter_6") is True
+    # current run's newest usable snapshot is iter 4 — tear it
+    text = open(snap4).read()
+    open(snap4, "w").write(text[: len(text) // 2])
+
+    assert checkpoint.latest_valid_snapshot(snap4, below_iter=4) == (2, snap2)
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2,
+                        init_model=snap4)
+    ref = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2, init_model=snap2)
+    # fell back to iter 2 (2 + 2 trees), NOT forward to iter 6 (6 + 2)
+    assert resumed.num_trees() == 4
+    np.testing.assert_allclose(resumed.predict(X), ref.predict(X),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_pre_trailer_snapshot_loads_as_last_resort(tmp_path):
+    """A snapshot written by the pre-trailer release (intact, just no
+    trailer) must still be resumable when no verified fallback exists —
+    rejecting the whole family would throw away real progress."""
+    X, y = _data(seed=6)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2)
+    legacy = str(tmp_path / "old.txt.snapshot_iter_2")
+    # simulate the old direct-write path: raw model text, no trailer
+    open(legacy, "w").write(bst.model_to_string())
+
+    # direct Booster load stays strict (cannot vouch for the file)...
+    with pytest.raises(CorruptModelError):
+        lgb.Booster(model_file=legacy)
+    # ...but engine resume accepts it as a loud last resort
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2,
+                        init_model=legacy)
+    assert resumed.num_trees() == 4
+
+
+def test_resumed_run_snapshots_use_global_iteration_numbers(tmp_path):
+    """A resumed run's snapshots continue the GLOBAL iteration numbering:
+    round 1 of a resume-from-iter-4 run writes snapshot_iter_6, never an
+    overwrite of snapshot_iter_2 with a 6-tree model (which would poison
+    the fallback scan's iteration arithmetic)."""
+    X, y = _data(seed=7)
+    out = str(tmp_path / "m.txt")
+    lgb.train({**PARAMS, "snapshot_freq": 2, "output_model": out},
+              lgb.Dataset(X, label=y), 4)  # writes snapshots 2 and 4
+    resumed = lgb.train(
+        {**PARAMS, "snapshot_freq": 2, "output_model": out},
+        lgb.Dataset(X, label=y), 2, init_model=f"{out}.snapshot_iter_4")
+    assert resumed.num_trees() == 6
+    # old snapshots untouched, new one numbered globally
+    assert lgb.Booster(model_file=f"{out}.snapshot_iter_2").num_trees() == 2
+    assert lgb.Booster(model_file=f"{out}.snapshot_iter_6").num_trees() == 6
+    assert checkpoint.latest_valid_snapshot(out) == (
+        6, f"{out}.snapshot_iter_6")
+
+
+def test_bitrotted_snapshot_falls_back_not_crashes(tmp_path):
+    """Binary garbage in the newest snapshot (invalid UTF-8) is 'torn',
+    not an uncaught UnicodeDecodeError: resume falls back to the valid
+    older sibling."""
+    X, y = _data(seed=8)
+    out = str(tmp_path / "m.txt")
+    lgb.train({**PARAMS, "snapshot_freq": 2, "output_model": out},
+              lgb.Dataset(X, label=y), 4)
+    snap4 = f"{out}.snapshot_iter_4"
+    open(snap4, "wb").write(b"\xff\xfe\x00garbage" * 100)
+    assert checkpoint.verify_file(snap4) is False
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2,
+                        init_model=snap4)
+    assert resumed.num_trees() == 4  # fell back to iter 2, +2 rounds

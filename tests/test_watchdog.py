@@ -1,0 +1,161 @@
+"""Launcher watchdog unit tests (parallel/launcher.py::_watch_workers):
+per-worker liveness via poll + exit-code harvest, fast failure with the
+dead worker's log tail, process-group zombie cleanup on timeout — all
+against thin dummy subprocesses (no jax import), so they run in tier-1.
+Also: the bounded retry-with-backoff around the distributed rendezvous
+(parallel/distributed.py)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from lightgbm_tpu.parallel.launcher import (WorkerFailure, _log_tail,
+                                            _watch_workers)
+
+
+def _worker(tmp_path, rank, code):
+    log_path = str(tmp_path / f"w{rank}.log")
+    log_fh = open(log_path, "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], stdout=log_fh,
+        stderr=subprocess.STDOUT, start_new_session=True)
+    log_fh.close()
+    return rank, proc, log_path
+
+
+def test_all_workers_exit_zero(tmp_path):
+    workers = [_worker(tmp_path, r, "print('ok rank', %d)" % r)
+               for r in range(3)]
+    _watch_workers(workers, timeout_s=30)
+    assert all(p.returncode == 0 for _, p, _ in workers)
+
+
+def test_dead_worker_fails_in_seconds_with_log_excerpt(tmp_path):
+    """One rank dies (exit 7) while the others would happily sleep out a
+    600 s communicate() timeout: the watchdog must fail the run in
+    seconds, name the rank, include its log tail, and leave no survivor
+    running."""
+    workers = [
+        _worker(tmp_path, 0, "import time; time.sleep(600)"),
+        _worker(tmp_path, 1,
+                "import sys; print('rendezvous exploded'); sys.exit(7)"),
+        _worker(tmp_path, 2, "import time; time.sleep(600)"),
+    ]
+    t0 = time.monotonic()
+    with pytest.raises(WorkerFailure) as ei:
+        _watch_workers(workers, timeout_s=600)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 30, f"watchdog took {elapsed:.1f}s — it hung"
+    assert ei.value.rank == 1 and not ei.value.timed_out
+    msg = str(ei.value)
+    assert "rank 1" in msg and "exit code 7" in msg
+    assert "rendezvous exploded" in msg  # the log tail made it into the error
+    for _, p, _ in workers:
+        assert p.poll() is not None, "watchdog leaked a live worker"
+
+
+def test_timeout_kills_process_groups_and_dumps_tails(tmp_path):
+    """The zombie-cleanup satellite: on timeout, the whole process GROUP
+    dies (including children the workers spawned) and every worker's log
+    tail lands in the error."""
+    spawn_child = (
+        "import subprocess, sys, time\n"
+        "print('worker with child', flush=True)\n"
+        "c = subprocess.Popen([sys.executable, '-c', "
+        "'import time; time.sleep(600)'])\n"
+        "print('CHILD_PID', c.pid, flush=True)\n"
+        "time.sleep(600)\n")
+    workers = [_worker(tmp_path, 0, spawn_child)]
+    # let the worker print its child pid
+    deadline = time.monotonic() + 20
+    child_pid = None
+    while time.monotonic() < deadline and child_pid is None:
+        tail = _log_tail(workers[0][2])
+        for line in tail.splitlines():
+            if line.startswith("CHILD_PID"):
+                child_pid = int(line.split()[1])
+        time.sleep(0.1)
+    assert child_pid is not None
+
+    with pytest.raises(WorkerFailure) as ei:
+        _watch_workers(workers, timeout_s=1)
+    assert ei.value.timed_out
+    assert "worker with child" in str(ei.value)
+    # the worker AND its child are gone (process-group kill)
+    assert workers[0][1].poll() is not None
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            os.kill(child_pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        os.kill(child_pid, 9)
+        pytest.fail("worker's child survived the process-group kill")
+
+
+def test_log_tail_truncates_and_survives_missing_files(tmp_path):
+    p = tmp_path / "big.log"
+    p.write_bytes(b"x" * 10000 + b"THE-END")
+    tail = _log_tail(str(p), nbytes=100)
+    assert tail.endswith("THE-END") and len(tail) <= 107
+    assert "unreadable" in _log_tail(str(tmp_path / "nope.log"))
+
+
+def test_distributed_init_retries_with_backoff(monkeypatch):
+    """parallel/distributed.py: transient rendezvous failures are retried
+    with exponential backoff, bounded by LGBMTPU_INIT_RETRIES; success on
+    a later attempt initializes normally."""
+    import jax
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel import distributed
+
+    attempts = []
+    sleeps = []
+
+    def flaky_init(**kwargs):
+        attempts.append(kwargs)
+        if len(attempts) < 3:
+            raise RuntimeError("coordination service unavailable (transient)")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+    monkeypatch.setattr(distributed.time, "sleep", sleeps.append)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    monkeypatch.setenv("LIGHTGBM_TPU_RANK", "0")
+    monkeypatch.setenv("LGBMTPU_INIT_RETRIES", "3")
+
+    cfg = Config.from_dict({
+        "num_machines": 2, "machines": "127.0.0.1:9999,127.0.0.1:9998",
+        "local_listen_port": 9999, "time_out": 1})
+    assert distributed.init_distributed(cfg) is True
+    assert len(attempts) == 3
+    assert sleeps == [1.0, 2.0]  # exponential backoff between attempts
+    monkeypatch.setattr(distributed, "_initialized", False)
+
+
+def test_distributed_init_exhausts_retries(monkeypatch):
+    import jax
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel import distributed
+
+    def always_fail(**kwargs):
+        raise RuntimeError("coordinator never came up")
+
+    monkeypatch.setattr(jax.distributed, "initialize", always_fail)
+    monkeypatch.setattr(distributed.time, "sleep", lambda s: None)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    monkeypatch.setenv("LIGHTGBM_TPU_RANK", "1")
+    monkeypatch.setenv("LGBMTPU_INIT_RETRIES", "2")
+
+    cfg = Config.from_dict({
+        "num_machines": 2, "machines": "127.0.0.1:9999,127.0.0.1:9998",
+        "local_listen_port": 9998, "time_out": 1})
+    with pytest.raises(RuntimeError, match="never came up"):
+        distributed.init_distributed(cfg)
+    assert distributed._initialized is False
