@@ -37,6 +37,12 @@ reference number carry vs_baseline: null.
 
 Env knobs: BENCH_ROWS, BENCH_ITERS, BENCH_MAX_BIN (primary workload),
 BENCH_FAST=1 (smoke + primary only), BENCH_BUDGET_S (global budget).
+
+Predict mode (round 9): BENCH_MODE=predict runs the serving benchmark
+instead (benchmarks/predict_bench.py — cold compile, warm rows/sec,
+p50/p99 batch latency over batch sizes x ensemble sizes) and emits a
+{"metric": "predict_rows_per_sec*", ...} artifact row with the same
+incremental un-losable contract; its knobs are PREDICT_BENCH_*.
 """
 
 import json
@@ -274,6 +280,14 @@ def _pallas_smoke():
 
 
 def main():
+    if os.environ.get("BENCH_MODE") == "predict":
+        # serving benchmark: inference throughput/latency instead of
+        # training iters/sec (BENCH_predict_* artifact row)
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.predict_bench import main as predict_main
+
+        return predict_main()
     # persistent XLA compilation cache (measured r5: cuts warmups ~2.4x on
     # the second process — kernel smoke 31->21 s, primary compile
     # 104->43 s — the warmups were the reason Epsilon kept falling off the

@@ -1,12 +1,17 @@
 """Throughput smoke for the non-binary baseline workloads (BASELINE.md):
-LambdaRank (MSLR-like) and multiclass (Airline-like).  Prints iters/sec
-for each on the current backend."""
+LambdaRank (MSLR-like) and multiclass (Airline-like) — plus, round 9, a
+SERVING smoke that asserts the warm-predict dispatch budget and parity
+against the host ``Tree.predict_batch`` walk, so CI catches serving
+regressions without the chip.  Prints iters/sec (train) and rows/sec
+(predict) for each on the current backend."""
 
 import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def bench_rank(n, q_len, iters):
@@ -58,16 +63,61 @@ def bench_multiclass(n, k, iters):
     return iters / (time.perf_counter() - t0)
 
 
+def bench_predict(n_rows=2000, n_trees=24, iters=20):
+    """Fast serving smoke (small T/N, runs off-chip in seconds): trains a
+    tiny model, ASSERTS the warm-call serving budget (1 dispatch + 1 sync,
+    no retrace — the tests/test_predict_budget.py contract, re-checked here
+    in the artifact path) and raw-prediction parity against the host
+    ``Tree.predict_batch`` f64 walk, then reports warm rows/sec."""
+    import time
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.sanitizer import DispatchCounter
+
+    rng = np.random.RandomState(2)
+    X = rng.randn(n_rows, 16)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(float)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                              "max_bin": 63, "verbosity": -1},
+                      train_set=lgb.Dataset(X, label=y))
+    for _ in range(n_trees):
+        bst.update()
+    raw = bst.predict(X, raw_score=True)  # warm: pack + bucket compile
+
+    host = np.zeros(n_rows)
+    for t in bst._gbdt._trees_for_export(0, -1):
+        host += t.predict_batch(np.asarray(X, np.float64))
+    err = float(np.abs(raw - host).max())
+    assert err < 1e-4, f"device serving path diverged from host walk: {err}"
+
+    with DispatchCounter() as d:
+        bst.predict(X, raw_score=True)
+    assert d.dispatches == 1, f"warm predict cost {d.dispatches} dispatches"
+    assert d.host_syncs == 1, f"warm predict cost {d.host_syncs} syncs"
+    d.assert_no_recompile("warm predict smoke")
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        bst.predict(X, raw_score=True)
+    return n_rows * iters / (time.perf_counter() - t0), err
+
+
 def main():
     n = int(os.environ.get("SMOKE_ROWS", 1_000_000))
     iters = int(os.environ.get("SMOKE_ITERS", 10))
-    which = sys.argv[1].split(",") if len(sys.argv) > 1 else ["rank", "multiclass"]
+    which = (sys.argv[1].split(",") if len(sys.argv) > 1
+             else ["rank", "multiclass", "predict"])
     if "rank" in which:
         ips = bench_rank(n, q_len=128, iters=iters)
         print(f"lambdarank {n//1000}k rows x64f q128 63bins: {ips:.2f} iters/sec", flush=True)
     if "multiclass" in which:
         ips = bench_multiclass(n, k=5, iters=iters)
         print(f"multiclass5 {n//1000}k rows x28f 63bins: {ips:.2f} iters/sec (5 trees/iter)", flush=True)
+    if "predict" in which:
+        rps, err = bench_predict()
+        print(f"predict 2k rows x16f T24: {rps:.0f} rows/sec warm "
+              f"(1 dispatch/call, host-walk parity {err:.1e})", flush=True)
 
 
 if __name__ == "__main__":

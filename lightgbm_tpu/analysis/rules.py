@@ -669,3 +669,93 @@ def r7_host_nonfinite_guard(pkg: PackageIndex) -> Iterator[Finding]:
                             f"{node.func.id}({ifn}(...)) pulls a "
                             f"device-side finite flag synchronously in "
                             f"{fi.qualname}'s round loop", hint)
+
+
+# ---------------------------------------------------------------------------
+# R8 — unbucketed-predict-entry
+# ---------------------------------------------------------------------------
+
+_MASK_PRODUCING_FNS = ("nonzero", "flatnonzero", "where", "isnan",
+                       "isfinite", "isinf")
+
+
+def _masklike_names(fi: FuncInfo) -> set:
+    """Names assigned (anywhere in ``fi``) from a boolean-mask-shaped
+    expression — a comparison, a bitwise mask combination (&, |, ~), or a
+    ``np.nonzero``/``np.where``/``np.isnan``-class call.  Subscripting a
+    batch with one of these produces a DATA-dependent row count, the shape
+    class that defeats jit caching."""
+    def masky(expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Compare):
+                return True
+            if isinstance(node, ast.BinOp) and isinstance(
+                    node.op, (ast.BitAnd, ast.BitOr)):
+                return True
+            if isinstance(node, ast.UnaryOp) and isinstance(
+                    node.op, ast.Invert):
+                return True
+            if isinstance(node, ast.Call):
+                fn = dotted_name(node.func)
+                if fn is not None and fn.split(".")[-1] in _MASK_PRODUCING_FNS:
+                    return True
+        return False
+
+    out = set()
+    for node in _own_body(fi):
+        if isinstance(node, ast.Assign) and masky(node.value):
+            for t in node.targets:
+                out |= _call_names(t)
+        elif isinstance(node, ast.AugAssign) and (
+                masky(node.value)
+                or isinstance(node.op, (ast.BitAnd, ast.BitOr))):
+            out |= _call_names(node.target)
+    return out
+
+
+@register_rule("R8", "unbucketed-predict-entry")
+def r8_unbucketed_predict_entry(pkg: PackageIndex) -> Iterator[Finding]:
+    """A jitted entry point dispatched in a host loop with a DATA-dependent
+    leading dimension — the ``X[active]`` anti-pattern the round-9 serving
+    rework removed from prediction early-stopping: every distinct mask
+    count is a new shape, so the entry RETRACES AND RECOMPILES once per
+    distinct active-set size (O(chunks) compiles for one predict call).
+    The supported pattern keeps every row in a bucket-padded batch and
+    masks inactive rows ON DEVICE (ops/predict.py ``active=`` +
+    models/gbdt.py ``_predict_bucket``), so the loop reuses one compiled
+    executable."""
+    hint = ("pad the batch to a shape bucket and pass the mask to the "
+            "device (ops/predict.py active=); shrinking the array "
+            "host-side recompiles per distinct mask count — see "
+            "docs/ANALYSIS.md (R8) and models/gbdt.py "
+            "_predict_raw_early_stop")
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if not pkg.is_host_driver(fi):
+                continue
+            loop_nodes = PackageIndex._loop_body_walk(fi)
+            masky = _masklike_names(fi)
+            for node in _own_body(fi):
+                if node not in loop_nodes or not isinstance(node, ast.Call):
+                    continue
+                target = pkg.resolve_call(mod, node.func)
+                callee = pkg.lookup(target) if target else None
+                if callee is None or callee.jit is None:
+                    continue
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                for arg in args:
+                    if not isinstance(arg, ast.Subscript):
+                        continue
+                    idx = arg.slice
+                    if isinstance(idx, ast.Name) and idx.id in masky:
+                        why = f"boolean-mask subscript [{idx.id}]"
+                    elif isinstance(idx, ast.Compare):
+                        why = "inline comparison-mask subscript"
+                    else:
+                        continue
+                    yield _finding(
+                        fi, node, "R8",
+                        f"{callee.qualname} dispatched in {fi.qualname}'s "
+                        f"loop with a data-dependent leading dimension "
+                        f"({why}): one retrace + compile per distinct mask "
+                        "count", hint)

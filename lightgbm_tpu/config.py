@@ -410,6 +410,10 @@ class Config:
     output_model: str = "LightGBM_model.txt"
     saved_feature_importance_type: int = 0
     snapshot_freq: int = -1
+    # resume=auto (ours; docs/ROBUSTNESS.md): engine.train resumes from the
+    # newest VALID snapshot in output_model's family without naming a file,
+    # and trains only the remaining rounds toward num_iterations
+    resume: str = ""
 
     # unknown/passthrough params preserved here
     extra: Dict[str, Any] = field(default_factory=dict)

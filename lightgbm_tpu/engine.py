@@ -80,8 +80,17 @@ def train(
     init_model: Optional[Union[str, Booster]] = None,
     keep_training_booster: bool = False,
     callbacks: Optional[List[Callable]] = None,
+    resume: Optional[str] = None,
 ) -> Booster:
-    """reference: engine.py train()."""
+    """reference: engine.py train().
+
+    ``resume="auto"`` (ours; also reachable as the ``resume=auto`` config/CLI
+    param): pick up the newest VALID snapshot in ``output_model``'s family
+    (utils/checkpoint.py latest_valid_snapshot) without naming a file, and
+    train only the REMAINING rounds toward ``num_boost_round`` — crash
+    recovery becomes re-running the original command (docs/ROBUSTNESS.md;
+    the round-8 fallback handled a torn *named* snapshot, this closes the
+    queued round-9 follow-up of not having to name one at all)."""
     params = dict(params or {})
     params = choose_param_value("num_iterations", params, None)
     if params.get("num_iterations") is not None:
@@ -91,6 +100,33 @@ def train(
     early_stopping_round = params.get("early_stopping_round")
     cfg_probe = Config.from_dict(params)
     set_verbosity(cfg_probe.verbosity)
+
+    resume = resume if resume is not None else (cfg_probe.resume or None)
+    if resume is not None:
+        if resume != "auto":
+            raise LightGBMError(
+                f"resume={resume!r} is not supported (only 'auto'; pass "
+                "init_model=<snapshot> to resume from a specific file)")
+        if init_model is not None:
+            log_warning("resume='auto' ignored: an explicit init_model was "
+                        "given and takes precedence")
+        else:
+            # restrict to snapshots AT OR BELOW the target iteration: a
+            # newer snapshot from a previous, longer run sharing the prefix
+            # would overshoot the requested model (the same stale-newer
+            # hazard the torn-snapshot fallback guards against)
+            fb = _checkpoint.latest_valid_snapshot(
+                cfg_probe.output_model, below_iter=num_boost_round + 1)
+            if fb is not None:
+                it, snap = fb
+                init_model = snap
+                num_boost_round = max(num_boost_round - it, 0)
+                log_info(
+                    f"resume=auto: resuming from {snap} (iteration {it}); "
+                    f"training {num_boost_round} remaining round(s)")
+            else:
+                log_info("resume=auto: no valid snapshot found for "
+                         f"{cfg_probe.output_model}; starting fresh")
 
     fobj = None
     if callable(params.get("objective")):

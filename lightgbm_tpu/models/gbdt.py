@@ -16,6 +16,7 @@ path of ScoreUpdater::AddScore).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -29,9 +30,46 @@ from ..ops.split import SplitParams
 from ..ops.treegrow import grow_tree
 from ..ops import predict as predict_ops
 from ..utils import faults as _faults
+from ..utils import sanitizer as _san
 from .tree import Tree, tree_from_device
 
 _MODEL_VERSION = "v4"
+
+# serving bucket ladder: predict batches pad N up to the next power of two
+# (floor 8) so the jitted traversal compiles once per bucket instead of once
+# per distinct batch size — the predict-side analogue of the windowed
+# grower's W ladder.  Padding rows are masked on device; the padded result
+# is bit-identical to the unpadded one (rows traverse independently).
+_PREDICT_BUCKET_MIN = 8
+
+
+def _predict_bucket(n: int) -> int:
+    """Row-bucket for a batch of n rows; LGBMTPU_PREDICT_BUCKETS=0 disables
+    (exact shapes — one compile per distinct N, the pre-round-9 behavior)."""
+    if os.environ.get("LGBMTPU_PREDICT_BUCKETS", "1") == "0":
+        return n
+    b = _PREDICT_BUCKET_MIN
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _dummy_tree() -> Tree:
+    """Single-leaf zero-value tree: pads the tree axis of a packed ensemble
+    so every early-stop window has the same static size (contributes exactly
+    0.0 to every row — leaf 0 of a num_leaves=1 tree)."""
+    z32 = np.zeros(0, np.int32)
+    return Tree(
+        num_leaves=1, split_feature=z32, threshold=np.zeros(0, np.float64),
+        threshold_bin=None, decision_type=np.zeros(0, np.uint8),
+        split_gain=np.zeros(0, np.float32), left_child=z32, right_child=z32,
+        internal_value=np.zeros(0, np.float64),
+        internal_weight=np.zeros(0, np.float64),
+        internal_count=np.zeros(0, np.int64),
+        leaf_value=np.zeros(1, np.float64),
+        leaf_weight=np.zeros(1, np.float64),
+        leaf_count=np.zeros(1, np.int64),
+    )
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -121,6 +159,7 @@ class GBDT:
     def models(self, value) -> None:
         self._pending = []
         self._models = value
+        self._pred_cache = None  # packed-ensemble serving cache is stale
 
     def _flush_pending(self) -> None:
         if self._pending:
@@ -1696,22 +1735,90 @@ class GBDT:
                 np.concatenate(words) if off else np.zeros(1, np.uint32))
         return out
 
+    # -- packed-ensemble serving cache (round 9) -----------------------
+    _PACKED_CACHE_CAP = 32  # bounds early-stop chunk windows etc.
+
+    def _packed(self, start: int = 0, num_iteration: int = -1, *,
+                pad_trees_to: int = 0):
+        """Device-resident packed ensemble for serving: the `_stacked` SoA
+        arrays built once per (tree range, model state) and cached, so a
+        warm ``predict`` performs ZERO host-side re-pack and re-upload.
+
+        The cache lives in ``self._pred_cache`` (None = empty), which every
+        model mutation already nulls (train_one_iter, rollback_one_iter,
+        the ``models`` setter, Booster.refit/shuffle_models) — and the key
+        carries ``len(self.models)`` so even an unnulled stale entry can
+        never be served after training grows the ensemble.
+
+        ``pad_trees_to`` pads the tree axis with single-leaf zero-value
+        trees to a multiple of that window so the early-stop chunk op runs
+        every chunk through one executable.  Packed entries also carry:
+
+        * ``_trees``: the export-form host trees (linear path, scale)
+        * ``_linear``: True when any tree has linear leaves (host walk)
+        """
+        k = self.num_tree_per_iteration
+        n_models = len(self.models)  # property: flushes pending device trees
+        lo = start * k
+        hi = n_models if num_iteration < 0 else min(
+            (start + num_iteration) * k, n_models)
+        key = (lo, hi, n_models, pad_trees_to)
+        if self._pred_cache is None:
+            self._pred_cache = {}
+        hit = self._pred_cache.get(key)
+        if hit is not None:
+            return hit
+        trees = self._trees_for_export(start, num_iteration)
+        pack_trees = trees
+        if pad_trees_to and trees:
+            pad = (-len(trees)) % pad_trees_to
+            pack_trees = trees + [_dummy_tree()] * pad
+        s = self._stacked(trees=pack_trees) if pack_trees else None
+        if s is not None:
+            s["_trees"] = trees
+            s["_linear"] = any(t.is_linear for t in trees)
+        if len(self._pred_cache) >= self._PACKED_CACHE_CAP:
+            self._pred_cache.pop(next(iter(self._pred_cache)))
+        self._pred_cache[key] = s
+        return s
+
+    def _pad_rows(self, X: np.ndarray, n_bucket: int) -> jnp.ndarray:
+        """(N, F) host batch -> (n_bucket, F) f32 device array, zero-padded
+        tail (padding rows are masked on device by the serving ops)."""
+        xh = np.zeros((n_bucket, X.shape[1]), dtype=np.float32)
+        xh[: X.shape[0]] = X
+        return jnp.asarray(xh)
+
+    def _active_mask(self, n: int, n_bucket: int) -> Optional[jnp.ndarray]:
+        if n_bucket == n:
+            return None
+        m = np.zeros(n_bucket, dtype=bool)
+        m[:n] = True
+        return jnp.asarray(m)
+
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0, num_iteration: int = -1) -> np.ndarray:
         """Raw margin prediction on raw feature values (device traversal).
 
         Uses the export representation — init score folded into the first
         tree(s) per class — so an in-memory model and its .txt save/load
         round-trip predict BIT-IDENTICALLY (the reference also folds:
-        Tree::AddBias)."""
-        trees = self._trees_for_export(start_iteration, num_iteration)
-        s = self._stacked(trees=trees)
+        Tree::AddBias).
+
+        Serving contract (round 9, pinned by tests/test_predict_budget.py):
+        a warm call is ONE device dispatch and ONE blocking pull — the
+        packed ensemble comes from the `_packed` cache, the batch is padded
+        to the `_predict_bucket` ladder so the traversal compiles once per
+        bucket, and multiclass reduces all k classes in that same single
+        dispatch (predict_ops.predict_raw_multiclass)."""
+        s = self._packed(start_iteration, num_iteration)
         n = X.shape[0]
         k = self.num_tree_per_iteration
         if s is None:
             init = np.asarray(self.init_scores, dtype=np.float64)
             base = np.zeros((n, k), dtype=np.float64) + init[None, :]
             return base[:, 0] if k == 1 else base
-        if any(t.is_linear for t in trees):
+        trees = s["_trees"]
+        if s["_linear"]:
             # linear leaves evaluate per-leaf ridge models on raw features:
             # vectorized host walk
             Xh = np.asarray(X, dtype=np.float64)
@@ -1726,43 +1833,39 @@ class GBDT:
         cat_kw = {}
         if "is_cat" in s:
             cat_kw = dict(cat_words=s["cat_words"])
-        x = jnp.asarray(np.asarray(X, dtype=np.float32))
+        nb = _predict_bucket(n)
+        x = self._pad_rows(X, nb)
+        active = self._active_mask(n, nb)
         n_per_class = max(s["T"] // k, 1)
         scale = (1.0 / n_per_class) if self.average_output else 1.0
+        _san.record_dispatch()
         if k == 1:
             out = predict_ops.predict_raw_values(
                 x, s["split_feature"], s["threshold"], s["default_left"],
                 s["missing_type"], s["left_child"], s["right_child"],
                 s["num_leaves"], s["leaf_value"],
                 is_cat=s.get("is_cat"), cat_base=s.get("cat_base"),
-                cat_nwords=s.get("cat_nwords"), **cat_kw,
+                cat_nwords=s.get("cat_nwords"), active=active, **cat_kw,
             )
-            return np.asarray(out, dtype=np.float64) * scale
-        # multiclass: per-class sum over its trees.  Accumulate ON DEVICE and
-        # pull once — a per-class np.asarray made this k syncs per predict
-        # call (jaxlint R1)
-        parts = []
-        for c in range(k):
-            sel = slice(c, s["T"], k)
-            parts.append(predict_ops.predict_raw_values(
-                x, s["split_feature"][sel], s["threshold"][sel], s["default_left"][sel],
-                s["missing_type"][sel], s["left_child"][sel], s["right_child"][sel],
-                s["num_leaves"][sel], s["leaf_value"][sel],
-                is_cat=(s["is_cat"][sel] if "is_cat" in s else None),
-                cat_base=(s["cat_base"][sel] if "is_cat" in s else None),
-                cat_nwords=(s["cat_nwords"][sel] if "is_cat" in s else None),
-                **cat_kw,
-            ))
-        return np.asarray(jnp.stack(parts, axis=1), dtype=np.float64) * scale
+            return np.asarray(
+                _san.sync_pull(out)[:n], dtype=np.float64) * scale
+        # multiclass: ONE class-reshaped dispatch (predict_raw_multiclass)
+        # replaced the k-dispatch per-class host loop; outputs are
+        # bit-identical (same per-class summation order)
+        out = predict_ops.predict_raw_multiclass(
+            x, s["split_feature"], s["threshold"], s["default_left"],
+            s["missing_type"], s["left_child"], s["right_child"],
+            s["num_leaves"], s["leaf_value"],
+            is_cat=s.get("is_cat"), cat_base=s.get("cat_base"),
+            cat_nwords=s.get("cat_nwords"), active=active, k=k, **cat_kw,
+        )
+        return np.asarray(_san.sync_pull(out)[:n], dtype=np.float64) * scale
 
     def predict(self, X, raw_score=False, start_iteration=0, num_iteration=-1,
                 pred_leaf=False, pred_contrib=False) -> np.ndarray:
         X = np.asarray(X, dtype=np.float64)
         if pred_leaf:
-            k = self.num_tree_per_iteration
-            lo = start_iteration * k
-            hi = len(self.models) if num_iteration < 0 else min((start_iteration + num_iteration) * k, len(self.models))
-            return np.stack([t.predict_leaf(X) for t in self.models[lo:hi]], axis=1)
+            return self._predict_leaf(X, start_iteration, num_iteration)
         if pred_contrib:
             return self.predict_contrib(X, start_iteration, num_iteration)
         if (
@@ -1776,13 +1879,61 @@ class GBDT:
             raw = self.predict_raw(X, start_iteration, num_iteration)
         if raw_score or self.objective is None:
             return raw
-        return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+        # output conversion rides the same row-bucket ladder: convert_output
+        # is jitted per shape, so padding keeps it at one compile per bucket
+        # (conversions are rowwise — sigmoid/exp/softmax — so padded rows
+        # cannot leak into real ones)
+        n = raw.shape[0]
+        nb = _predict_bucket(n)
+        if nb != n:
+            pad = np.zeros((nb,) + raw.shape[1:], raw.dtype)
+            pad[:n] = raw
+            _san.record_dispatch()
+            return _san.sync_pull(self.objective.convert_output(
+                jnp.asarray(pad)))[:n]
+        _san.record_dispatch()
+        return _san.sync_pull(self.objective.convert_output(jnp.asarray(raw)))
+
+    def _predict_leaf(self, X: np.ndarray, start_iteration: int = 0,
+                      num_iteration: int = -1) -> np.ndarray:
+        """``pred_leaf``: leaf index per (row, tree) — (N, T) i32.
+
+        Round 9 routes this through the stacked device traversal
+        (ops/predict.py predict_leaf_values) instead of the per-tree host
+        walk: one dispatch over the cached packed ensemble, f32 decision
+        semantics identical to predict_raw (leaf structure is shared with
+        the value path — `_f32_threshold_upper` keeps left rows left)."""
+        n = X.shape[0]
+        s = self._packed(start_iteration, num_iteration)
+        if s is None:
+            return np.zeros((n, 0), dtype=np.int32)
+        nb = _predict_bucket(n)
+        x = self._pad_rows(X, nb)
+        cat_kw = {}
+        if "is_cat" in s:
+            cat_kw = dict(
+                is_cat=s["is_cat"], cat_base=s["cat_base"],
+                cat_nwords=s["cat_nwords"], cat_words=s["cat_words"])
+        _san.record_dispatch()
+        out = predict_ops.predict_leaf_values(
+            x, s["split_feature"], s["threshold"], s["default_left"],
+            s["missing_type"], s["left_child"], s["right_child"],
+            s["num_leaves"], **cat_kw,
+        )
+        return np.asarray(_san.sync_pull(out)[:n], dtype=np.int32)
 
     def _predict_raw_early_stop(self, X, start_iteration=0, num_iteration=-1):
         """Prediction early stopping (reference: include/LightGBM/
         prediction_early_stop.h + predictor.hpp): every pred_early_stop_freq
         trees, rows whose margin (|raw| for binary, top1-top2 for multiclass)
-        exceeds pred_early_stop_margin stop accumulating further trees."""
+        exceeds pred_early_stop_margin stop accumulating further trees.
+
+        Round 9: every chunk keeps ALL rows in the padded batch and masks
+        early-stopped rows ON DEVICE (predict_ops.predict_raw_window with a
+        traced tree offset over the window-padded packed ensemble), so each
+        chunk reuses ONE compiled executable — the old path shrank the
+        active set host-side (``X[active]``, jaxlint R8) and compiled
+        O(chunks) times per distinct active-set size."""
         k = self.num_tree_per_iteration
         total = len(self.models) // k
         if num_iteration is not None and num_iteration >= 0:
@@ -1791,27 +1942,69 @@ class GBDT:
         margin = float(self.cfg.pred_early_stop_margin)
         X = np.asarray(X)
         n = X.shape[0]
-        raw = None
-        active = np.ones(n, dtype=bool)
-        it = start_iteration
-        while it < total:
-            chunk = min(freq, total - it)
-            if raw is None:
-                raw = self.predict_raw(X, it, chunk)
-            else:
-                # only still-active rows traverse further trees (the point of
-                # prediction early stopping)
-                raw[active] += self.predict_raw(X[active], it, chunk)
-            it += chunk
-            if raw.ndim == 1:
-                m = np.abs(raw)
-            else:
-                top2 = np.partition(raw, -2, axis=1)[:, -2:]
-                m = top2[:, 1] - top2[:, 0]
-            active &= m < margin
-            if not active.any():
+        n_iters = total - start_iteration
+        if n_iters <= 0:
+            return self.predict_raw(X, start_iteration, 0)
+        # a freq beyond the model is one all-trees chunk, not a dummy-tree
+        # pad blowup (the old chunked path's min(freq, total - it))
+        freq = min(freq, n_iters)
+        window = freq * k
+        s = self._packed(start_iteration, n_iters, pad_trees_to=window)
+        if s is None:
+            return self.predict_raw(X, start_iteration, 0)
+        if s["_linear"]:
+            # linear leaves walk on host — chunk over full rows (no device
+            # executable to protect; masked accumulation keeps semantics)
+            raw = None
+            active = np.ones(n, dtype=bool)
+            it = start_iteration
+            while it < total:
+                chunk = min(freq, total - it)
+                part = self.predict_raw(X, it, chunk)
+                raw = part if raw is None else raw + np.where(
+                    (active if part.ndim == 1 else active[:, None]), part, 0.0)
+                it += chunk
+                active &= self._early_stop_active(raw, margin)
+                if not active.any():
+                    break
+            return raw
+        cat_kw = {}
+        if "is_cat" in s:
+            cat_kw = dict(cat_words=s["cat_words"])
+        nb = _predict_bucket(n)
+        x = self._pad_rows(X, nb)
+        active = np.zeros(nb, dtype=bool)
+        active[:n] = True
+        shape = (n,) if k == 1 else (n, k)
+        raw = np.zeros(shape, dtype=np.float64)
+        for ci in range(s["T"] // window):
+            _san.record_dispatch()
+            out = predict_ops.predict_raw_window(
+                x, jnp.int32(ci * window),
+                s["split_feature"], s["threshold"], s["default_left"],
+                s["missing_type"], s["left_child"], s["right_child"],
+                s["num_leaves"], s["leaf_value"],
+                is_cat=s.get("is_cat"), cat_base=s.get("cat_base"),
+                cat_nwords=s.get("cat_nwords"),
+                active=jnp.asarray(active), k=k, window=window, **cat_kw,
+            )
+            # the margin test is a REAL host data dependency (the loop's
+            # exit condition) — one accounted blocking pull per chunk
+            raw += _san.sync_pull(out)[:n].astype(np.float64)
+            active[:n] &= self._early_stop_active(raw, margin)
+            if not active[:n].any():
                 break
-        return raw if raw is not None else self.predict_raw(X, start_iteration, 0)
+        return raw
+
+    @staticmethod
+    def _early_stop_active(raw: np.ndarray, margin: float) -> np.ndarray:
+        """Rows whose margin has NOT yet cleared pred_early_stop_margin."""
+        if raw.ndim == 1:
+            m = np.abs(raw)
+        else:
+            top2 = np.partition(raw, -2, axis=1)[:, -2:]
+            m = top2[:, 1] - top2[:, 0]
+        return m < margin
 
     def predict_contrib(self, X, start_iteration=0, num_iteration=-1) -> np.ndarray:
         """SHAP values via the per-tree path algorithm (reference:

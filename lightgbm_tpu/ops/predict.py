@@ -11,6 +11,20 @@ node arrays, no data-dependent Python control flow.
 
 Trees are stacked: ensembles predict via one vmapped traversal over the tree
 axis then a sum reduction, keeping the MXU/VPU busy across trees.
+
+Serving entry points (round 9) are shape-stable and one-dispatch:
+
+* every op takes an optional ``active`` row mask so callers can pad the row
+  axis to a bucket ladder (models/gbdt.py ``_predict_bucket``) and mask the
+  padding / early-stopped rows ON DEVICE — the executable is reused across
+  batch sizes and early-stop chunks instead of recompiling per distinct N;
+* :func:`predict_raw_multiclass` folds the per-class host loop (k separate
+  dispatches) into one class-reshaped reduction — one dispatch per call;
+* :func:`predict_raw_window` traverses a fixed-size window of trees starting
+  at a TRACED offset (``lax.dynamic_slice_in_dim``), so prediction
+  early-stopping runs every chunk through the SAME compiled executable;
+* :func:`predict_leaf_values` is the stacked device traversal behind
+  ``pred_leaf`` (previously a per-tree host walk).
 """
 
 from __future__ import annotations
@@ -111,6 +125,43 @@ def predict_leaf_binned(
     return fn(split_feature, threshold_bin, default_left, left_child, right_child, num_leaves)
 
 
+def _per_tree_values(
+    x: jnp.ndarray,  # (N, F) raw features (NaN = missing)
+    split_feature, threshold, default_left, missing_type, left_child,
+    right_child, num_leaves,
+    leaf_value=None,  # (T, L) — None returns leaf INDICES instead of values
+    is_cat=None, cat_base=None, cat_nwords=None, cat_words=None,
+) -> jnp.ndarray:
+    """Vmapped traversal over the stacked tree axis: (T, N) leaf values
+    (or leaf indices when ``leaf_value`` is None)."""
+    x = x.astype(jnp.float32)
+    miss = jnp.isnan(x)
+    vals = jnp.where(miss, 0.0, x)
+
+    if is_cat is None:
+        def one(sf, th, dl, mt, lc, rc, nl):
+            return _traverse_one_tree(
+                vals, miss, sf, th.astype(jnp.float32), dl, mt, lc, rc, nl)
+
+        leaf = jax.vmap(one)(
+            split_feature, threshold, default_left, missing_type, left_child,
+            right_child, num_leaves,
+        )  # (T, N)
+    else:
+        def one_cat(sf, th, dl, mt, lc, rc, nl, ic, cb, cw):
+            return _traverse_one_tree(
+                vals, miss, sf, th.astype(jnp.float32), dl, mt, lc, rc, nl,
+                is_cat=ic, cat_base=cb, cat_nwords=cw, cat_words=cat_words)
+
+        leaf = jax.vmap(one_cat)(
+            split_feature, threshold, default_left, missing_type, left_child,
+            right_child, num_leaves, is_cat, cat_base, cat_nwords,
+        )
+    if leaf_value is None:
+        return leaf
+    return jnp.take_along_axis(leaf_value, leaf, axis=1)  # (T, N)
+
+
 @functools.partial(jax.jit, static_argnames=())
 def predict_raw_values(
     x: jnp.ndarray,  # (N, F) f32/f64 raw features (NaN = missing)
@@ -126,30 +177,134 @@ def predict_raw_values(
     cat_base: jnp.ndarray = None,  # (T, M) i32 into cat_words
     cat_nwords: jnp.ndarray = None,  # (T, M) i32
     cat_words: jnp.ndarray = None,  # (W,) uint32
+    active: jnp.ndarray = None,  # (N,) bool — inactive/padding rows emit 0
 ) -> jnp.ndarray:
     """Raw ensemble margin per row: sum over trees of leaf values (N,)."""
-    x = x.astype(jnp.float32)
-    miss = jnp.isnan(x)
-    vals = jnp.where(miss, 0.0, x)
+    per_tree = _per_tree_values(
+        x, split_feature, threshold, default_left, missing_type, left_child,
+        right_child, num_leaves, leaf_value,
+        is_cat=is_cat, cat_base=cat_base, cat_nwords=cat_nwords,
+        cat_words=cat_words,
+    )
+    out = jnp.sum(per_tree, axis=0)
+    if active is not None:
+        out = jnp.where(active, out, 0.0)
+    return out
 
-    if is_cat is None:
-        def one(sf, th, dl, mt, lc, rc, nl, lv):
-            leaf = _traverse_one_tree(vals, miss, sf, th.astype(jnp.float32), dl, mt, lc, rc, nl)
-            return lv[leaf]
 
-        per_tree = jax.vmap(one)(
-            split_feature, threshold, default_left, missing_type, left_child,
-            right_child, num_leaves, leaf_value,
-        )  # (T, N)
-    else:
-        def one_cat(sf, th, dl, mt, lc, rc, nl, lv, ic, cb, cw):
-            leaf = _traverse_one_tree(
-                vals, miss, sf, th.astype(jnp.float32), dl, mt, lc, rc, nl,
-                is_cat=ic, cat_base=cb, cat_nwords=cw, cat_words=cat_words)
-            return lv[leaf]
+@functools.partial(jax.jit, static_argnames=("k",))
+def predict_raw_multiclass(
+    x: jnp.ndarray,  # (N, F)
+    split_feature: jnp.ndarray,  # (T, M) — T trees, iter-major class-minor
+    threshold: jnp.ndarray,
+    default_left: jnp.ndarray,
+    missing_type: jnp.ndarray,
+    left_child: jnp.ndarray,
+    right_child: jnp.ndarray,
+    num_leaves: jnp.ndarray,
+    leaf_value: jnp.ndarray,  # (T, L)
+    is_cat: jnp.ndarray = None,
+    cat_base: jnp.ndarray = None,
+    cat_nwords: jnp.ndarray = None,
+    cat_words: jnp.ndarray = None,
+    active: jnp.ndarray = None,  # (N,) bool
+    *,
+    k: int,
+) -> jnp.ndarray:
+    """Multiclass raw margins in ONE dispatch: (N, k).
 
-        per_tree = jax.vmap(one_cat)(
-            split_feature, threshold, default_left, missing_type, left_child,
-            right_child, num_leaves, leaf_value, is_cat, cat_base, cat_nwords,
-        )
-    return jnp.sum(per_tree, axis=0)
+    Tree i belongs to class ``i % k`` (the flat iter-major layout), so the
+    per-tree values reshape to (T//k, k, N) and reduce over the iteration
+    axis — each class sums its own trees in the same order as a per-class
+    slice, which keeps the result bit-identical to the k-dispatch host loop
+    this op replaced (gbdt.py round-6 predict_raw)."""
+    per_tree = _per_tree_values(
+        x, split_feature, threshold, default_left, missing_type, left_child,
+        right_child, num_leaves, leaf_value,
+        is_cat=is_cat, cat_base=cat_base, cat_nwords=cat_nwords,
+        cat_words=cat_words,
+    )  # (T, N)
+    t, n = per_tree.shape
+    out = jnp.sum(per_tree.reshape(t // k, k, n), axis=0)  # (k, N)
+    if active is not None:
+        out = jnp.where(active[None, :], out, 0.0)
+    return out.T  # (N, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "window"))
+def predict_raw_window(
+    x: jnp.ndarray,  # (N, F)
+    tree_lo: jnp.ndarray,  # i32 scalar, TRACED — first tree of the window
+    split_feature: jnp.ndarray,  # (Tp, M) — Tp padded to a multiple of window
+    threshold: jnp.ndarray,
+    default_left: jnp.ndarray,
+    missing_type: jnp.ndarray,
+    left_child: jnp.ndarray,
+    right_child: jnp.ndarray,
+    num_leaves: jnp.ndarray,
+    leaf_value: jnp.ndarray,  # (Tp, L)
+    is_cat: jnp.ndarray = None,
+    cat_base: jnp.ndarray = None,
+    cat_nwords: jnp.ndarray = None,
+    cat_words: jnp.ndarray = None,  # (W,) flat — NOT sliced (global offsets)
+    active: jnp.ndarray = None,  # (N,) bool — early-stopped rows emit 0
+    *,
+    k: int,
+    window: int,
+) -> jnp.ndarray:
+    """Raw margins of ``window`` consecutive trees starting at ``tree_lo``:
+    (N,) for k == 1, else (N, k).
+
+    The window size is static but the offset is traced, so prediction
+    early-stopping dispatches every chunk through ONE compiled executable —
+    the caller pads the tree axis with single-leaf zero-value trees
+    (gbdt.py ``_packed(pad_trees_to=...)``) so the slice is always in
+    range."""
+    def win(a):
+        return (None if a is None
+                else jax.lax.dynamic_slice_in_dim(a, tree_lo, window, axis=0))
+
+    per_tree = _per_tree_values(
+        x, win(split_feature), win(threshold), win(default_left),
+        win(missing_type), win(left_child), win(right_child),
+        win(num_leaves), win(leaf_value),
+        is_cat=win(is_cat), cat_base=win(cat_base), cat_nwords=win(cat_nwords),
+        cat_words=cat_words,
+    )  # (window, N)
+    n = per_tree.shape[1]
+    if k == 1:
+        out = jnp.sum(per_tree, axis=0)  # (N,)
+        if active is not None:
+            out = jnp.where(active, out, 0.0)
+        return out
+    out = jnp.sum(per_tree.reshape(window // k, k, n), axis=0)  # (k, N)
+    if active is not None:
+        out = jnp.where(active[None, :], out, 0.0)
+    return out.T
+
+
+@functools.partial(jax.jit, static_argnames=())
+def predict_leaf_values(
+    x: jnp.ndarray,  # (N, F) raw features (NaN = missing)
+    split_feature: jnp.ndarray,  # (T, M)
+    threshold: jnp.ndarray,
+    default_left: jnp.ndarray,
+    missing_type: jnp.ndarray,
+    left_child: jnp.ndarray,
+    right_child: jnp.ndarray,
+    num_leaves: jnp.ndarray,
+    is_cat: jnp.ndarray = None,
+    cat_base: jnp.ndarray = None,
+    cat_nwords: jnp.ndarray = None,
+    cat_words: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """Leaf index per (row, tree) on RAW values: (N, T) i32 — the stacked
+    device traversal behind ``pred_leaf`` (reference: Predictor's leaf-index
+    mode; previously a per-tree host walk)."""
+    leaf = _per_tree_values(
+        x, split_feature, threshold, default_left, missing_type, left_child,
+        right_child, num_leaves, None,
+        is_cat=is_cat, cat_base=cat_base, cat_nwords=cat_nwords,
+        cat_words=cat_words,
+    )  # (T, N)
+    return leaf.T

@@ -979,3 +979,113 @@ def test_r7_positive_implicit_bool_branch(tmp_path):
     """}, rules=["R7"])
     assert len(rep.findings) == 2, rep.findings
     assert all("implicit bool" in f.message for f in rep.findings)
+
+
+# ---------------------------------------------------------------------------
+# R8 unbucketed-predict-entry
+# ---------------------------------------------------------------------------
+
+def test_r8_positive_boolean_mask_subscript_in_loop(tmp_path):
+    """The exact pre-round-9 early-stop anti-pattern: the active set
+    shrinks host-side and a jitted entry sees a new leading dim per
+    chunk."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def predict_chunk(x):
+            return x.sum(axis=1)
+
+        def predict_early_stop(X, margin):
+            raw = np.zeros(X.shape[0])
+            active = np.ones(X.shape[0], dtype=bool)
+            for _ in range(10):
+                raw[active] += predict_chunk(X[active])
+                active &= np.abs(raw) < margin
+            return raw
+    """}, rules=["R8"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "R8"
+    assert "active" in rep.findings[0].message
+
+
+def test_r8_positive_inline_comparison_mask(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def score(x):
+            return x * 2
+
+        def drive(X, raw):
+            for _ in range(4):
+                raw = raw + score(X[raw < 0.5])
+            return raw
+    """}, rules=["R8"])
+    assert len(rep.findings) == 1, rep.findings
+
+
+def test_r8_negative_padded_bucket_with_device_mask(tmp_path):
+    """The supported serving pattern: full padded batch + mask ARGUMENT
+    (not subscript) — nothing to flag."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def predict_chunk(x, active):
+            import jax.numpy as jnp
+            return jnp.where(active, x.sum(axis=1), 0.0)
+
+        def predict_early_stop(X, margin):
+            raw = np.zeros(X.shape[0])
+            active = np.ones(X.shape[0], dtype=bool)
+            for _ in range(10):
+                raw = raw + predict_chunk(X, active)
+                active &= np.abs(raw) < margin
+            return raw
+    """}, rules=["R8"])
+    assert not rep.findings, rep.findings
+
+
+def test_r8_negative_static_subscripts_and_outside_loop(tmp_path):
+    """Constant/slice subscripts and one-off calls before the loop keep a
+    stable shape — not the recompile class R8 hunts."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def drive(X, mask):
+            warm = step(X[mask])  # once per call, outside the loop
+            s = X[:128]
+            for i in range(4):
+                s = step(s)
+                s = step(X[0:128])
+            return warm + s
+    """}, rules=["R8"])
+    assert not rep.findings, rep.findings
+
+
+def test_r8_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x
+
+        def drive(X):
+            m = np.ones(4, bool)
+            for _ in range(3):
+                m &= np.abs(step(X[m])) < 1.0  # jaxlint: disable=R8 (tiny fixed cap, measured cheaper than padding)
+            return m
+    """}, rules=["R8"])
+    assert not rep.findings
+    assert len(rep.suppressed) == 1

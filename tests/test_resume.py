@@ -363,3 +363,61 @@ def test_bitrotted_snapshot_falls_back_not_crashes(tmp_path):
     resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2,
                         init_model=snap4)
     assert resumed.num_trees() == 4  # fell back to iter 2, +2 rounds
+
+
+# ---------------------------------------------------------------------------
+# tier-1: resume="auto" — recovery without naming a snapshot (round 9)
+# ---------------------------------------------------------------------------
+
+def test_auto_resume_picks_latest_valid_and_trains_remainder(tmp_path):
+    """Re-running the ORIGINAL command with resume=auto after a crash at
+    round 4 continues from snapshot_iter_4 and trains only the remaining
+    2 rounds — equivalent to the uninterrupted 6-round run."""
+    X, y = _data(seed=11)
+    full = lgb.train(PARAMS, lgb.Dataset(X, label=y), 6)
+
+    out = str(tmp_path / "m.txt")
+    run_params = {**PARAMS, "snapshot_freq": 2, "output_model": out}
+    lgb.train(run_params, lgb.Dataset(X, label=y), 4)  # "crashed" at 4
+    resumed = lgb.train(run_params, lgb.Dataset(X, label=y), 6,
+                        resume="auto")
+    assert resumed.num_trees() == 6
+    np.testing.assert_allclose(
+        resumed.predict(X), full.predict(X), rtol=1e-5, atol=1e-6)
+
+    # target already reached: zero further rounds, model unchanged
+    again = lgb.train(run_params, lgb.Dataset(X, label=y), 4, resume="auto")
+    assert again.num_trees() == 4
+
+
+def test_auto_resume_skips_torn_newest_snapshot(tmp_path):
+    X, y = _data(seed=12)
+    out = str(tmp_path / "m.txt")
+    run_params = {**PARAMS, "snapshot_freq": 2, "output_model": out}
+    lgb.train(run_params, lgb.Dataset(X, label=y), 4)
+    snap4 = f"{out}.snapshot_iter_4"
+    text = open(snap4).read()
+    open(snap4, "w").write(text[: int(len(text) * 0.6)])  # torn
+    resumed = lgb.train(run_params, lgb.Dataset(X, label=y), 6,
+                        resume="auto")
+    # fell back to the valid iter-2 snapshot, trained 4 more
+    assert resumed.num_trees() == 6
+
+
+def test_auto_resume_fresh_start_and_param_form(tmp_path):
+    """No snapshots yet: resume=auto starts fresh; the config-param form
+    (resume=auto in params, the CLI spelling) behaves identically."""
+    X, y = _data(seed=13)
+    out = str(tmp_path / "m.txt")
+    run_params = {**PARAMS, "snapshot_freq": 2, "output_model": out,
+                  "resume": "auto"}
+    first = lgb.train(run_params, lgb.Dataset(X, label=y), 4)
+    assert first.num_trees() == 4
+    resumed = lgb.train(run_params, lgb.Dataset(X, label=y), 6)
+    assert resumed.num_trees() == 6
+
+
+def test_auto_resume_rejects_unknown_mode(tmp_path):
+    X, y = _data(seed=14)
+    with pytest.raises(lgb.basic.LightGBMError):
+        lgb.train(PARAMS, lgb.Dataset(X, label=y), 2, resume="latest")
