@@ -76,7 +76,16 @@ _STATE = {
 
 
 def _emit():
-    line = json.dumps(_STATE) + "\n"
+    try:
+        # embed the telemetry snapshot (docs/OBSERVABILITY.md) so on-chip
+        # rows land with dispatch/compile/W-ladder context attached; obs is
+        # stdlib-only, so this never forces a jax import
+        from lightgbm_tpu.obs import metrics as _obs
+
+        _STATE["metrics"] = _obs.snapshot()
+    except Exception:  # noqa: BLE001 — artifact robustness first
+        pass
+    line = json.dumps(_STATE, default=str) + "\n"
     sys.stdout.write(line)
     sys.stdout.flush()
 

@@ -50,7 +50,16 @@ _STATE = {
 
 
 def _emit():
-    line = json.dumps(_STATE) + "\n"
+    try:
+        # telemetry snapshot embedded in the artifact (docs/OBSERVABILITY.md):
+        # warm-latency reservoirs + bucket hit/miss + dispatch ledger travel
+        # with every emitted row
+        from lightgbm_tpu.obs import metrics as _obs
+
+        _STATE["metrics"] = _obs.snapshot()
+    except Exception:  # noqa: BLE001 — artifact robustness first
+        pass
+    line = json.dumps(_STATE, default=str) + "\n"
     sys.stdout.write(line)
     sys.stdout.flush()
     out = os.environ.get("PREDICT_BENCH_OUT")

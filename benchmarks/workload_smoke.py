@@ -97,6 +97,18 @@ def bench_predict(n_rows=2000, n_trees=24, iters=20):
     assert d.host_syncs == 1, f"warm predict cost {d.host_syncs} syncs"
     d.assert_no_recompile("warm predict smoke")
 
+    # the metrics snapshot bench.py / predict_bench.py embed in their
+    # artifacts must be schema-valid and cover the serving keys here too
+    from lightgbm_tpu.obs import metrics as _obs
+
+    snap = _obs.snapshot()
+    _obs.validate_snapshot(snap)
+    for key in ("predict_requests_total", "predict_bucket_hits_total",
+                "train_boost_rounds_total", "device_dispatches_total"):
+        assert key in snap["counters"], f"metrics snapshot missing {key}"
+    assert snap["histograms"]["predict_warm_latency_ms"]["count"] >= 1, (
+        "warm predict left no latency reservoir samples")
+
     t0 = time.perf_counter()
     for _ in range(iters):
         bst.predict(X, raw_score=True)

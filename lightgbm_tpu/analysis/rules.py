@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R7.
+"""jaxlint built-in rules R1-R9.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -759,3 +759,130 @@ def r8_unbucketed_predict_entry(pkg: PackageIndex) -> Iterator[Finding]:
                         f"loop with a data-dependent leading dimension "
                         f"({why}): one retrace + compile per distinct mask "
                         "count", hint)
+
+
+# ---------------------------------------------------------------------------
+# R9 — untimed-device-section
+# ---------------------------------------------------------------------------
+
+_TIMER_ATTRS = ("perf_counter", "monotonic", "perf_counter_ns",
+                "monotonic_ns")
+# calls that prove the device queue drained (or a host pull resolved)
+# between a dispatch and the timer read: the wall-clock delta then covers
+# the device work it claims to measure
+_R9_SYNC_ATTRS = ("asarray", "array", "item", "tolist", "block_until_ready",
+                  "sync_pull", "async_pull_result")
+
+
+def _is_timer_call(node: ast.AST) -> bool:
+    """``time.perf_counter()`` / ``time.time()`` / ``time.monotonic()``
+    (any module alias whose name contains "time"; bare ``perf_counter``
+    from a ``from time import`` also counts)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = dotted_name(node.func)
+    if fn is None:
+        return False
+    parts = fn.split(".")
+    if parts[-1] in _TIMER_ATTRS:
+        return True
+    return len(parts) >= 2 and parts[-1] == "time" and "time" in parts[0]
+
+
+def _r9_sync_lines(fi: FuncInfo) -> list:
+    out = []
+    for node in _own_body(fi):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = dotted_name(node.func)
+        if fn is not None and fn.split(".")[-1] in _R9_SYNC_ATTRS:
+            out.append(node.lineno)
+        elif (isinstance(node.func, ast.Name)
+                and node.func.id in _CAST_BUILTINS and node.args):
+            # int()/float()/bool() of a device value is itself a blocking
+            # pull — as a SUPPRESSOR, over-matching is the safe direction
+            out.append(node.lineno)
+    return out
+
+
+@register_rule("R9", "untimed-device-section")
+def r9_untimed_device_section(pkg: PackageIndex) -> Iterator[Finding]:
+    """The async-dispatch mistiming anti-pattern: a ``time.perf_counter()``
+    / ``time.time()`` delta taken around a jitted dispatch with no
+    accounted sync between the dispatch and the second timer read.  JAX
+    dispatch is ASYNCHRONOUS — the jitted call returns as soon as the
+    work is enqueued (~1-1.5 ms through the tunnel), so the delta measures
+    enqueue time, not device compute, and every benchmark built on it is
+    fiction (the round-4 ``block_until_ready``-returns-early episode in
+    docs/PERF_NOTES.md is the companion failure on the sync side).  A host
+    pull (``np.asarray``/``.item()``/``sync_pull``) or an
+    ``async_pull_result`` between the dispatch and the read makes the
+    delta honest and suppresses the finding — as does routing the section
+    through ``utils/profiling.py::timed_section(sync=True)``, which drains
+    the queue with the documented host-pull sync."""
+    hint = ("resolve a host pull of the dispatched work before reading the "
+            "timer (np.asarray of a tiny slice, utils/sanitizer.py "
+            "sync_pull/async_pull_result), or use utils/profiling.py "
+            "timed_section(sync=True) — raw perf_counter around an async "
+            "dispatch times the enqueue, not the device")
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if pkg.is_hot(fi):
+                continue  # time.* under trace is R5's business
+            timer_starts: dict = {}  # var -> [assignment lines]
+            subs = []  # (line, names in the Sub expr, has inline timer call)
+            dispatch_lines = []
+            for node in _own_body(fi):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and _is_timer_call(node.value)):
+                    timer_starts.setdefault(
+                        node.targets[0].id, []).append(node.lineno)
+                    continue
+                if isinstance(node, ast.BinOp) and isinstance(
+                        node.op, ast.Sub):
+                    has_timer_call = any(_is_timer_call(x)
+                                         for x in ast.walk(node))
+                    names = {x.id for x in ast.walk(node)
+                             if isinstance(x, ast.Name)}
+                    if names:
+                        subs.append((node.lineno, names, has_timer_call))
+                if isinstance(node, ast.Call):
+                    target = pkg.resolve_call(mod, node.func)
+                    callee = pkg.lookup(target) if target else None
+                    if callee is not None and callee.jit is not None:
+                        dispatch_lines.append(node.lineno)
+            # a delta reads a timer var against a second timer value —
+            # either an inline timer call (perf_counter() - t0) or another
+            # timer var (t1 - t0, the stored-second-read spelling); decided
+            # after the walk, when timer_starts is complete
+            deltas = [(ln, names) for ln, names, inline in subs
+                      if (names & set(timer_starts))
+                      and (inline
+                           or len(names & set(timer_starts)) >= 2)]
+            if not dispatch_lines or not deltas:
+                continue
+            sync_lines = _r9_sync_lines(fi)
+            for dline, names in deltas:
+                for var in names & set(timer_starts):
+                    starts = [ln for ln in timer_starts[var] if ln < dline]
+                    if not starts:
+                        continue
+                    s = max(starts)  # the binding this delta reads
+                    disp = [d for d in dispatch_lines if s < d < dline]
+                    if not disp:
+                        continue
+                    last_d = max(disp)
+                    # a blocking pull at-or-after the last dispatch drains
+                    # the queue — earlier dispatches retired with it.
+                    # `<=` on the left: np.asarray(step(x)) puts the pull
+                    # on the dispatch's own line, and over-matching is the
+                    # safe direction for a suppressor
+                    if any(last_d <= sl <= dline for sl in sync_lines):
+                        continue
+                    yield Finding(
+                        str(mod.path), dline, "R9",
+                        f"wall-clock delta (started line {s}) read around "
+                        f"a jitted dispatch (line {last_d}) with no "
+                        f"accounted sync before the read in {fi.qualname}",
+                        hint)

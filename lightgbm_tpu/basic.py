@@ -933,7 +933,7 @@ class Booster:
         seg = models[start_iteration:end]
         np.random.shuffle(seg)
         self._gbdt.models[start_iteration:end] = seg
-        self._gbdt._pred_cache = None  # tree order changed under the cache
+        self._gbdt._invalidate_pred_cache("shuffle_models")  # order changed
         return self
 
     def _init_score_offset(self) -> float:
@@ -1132,7 +1132,7 @@ class Booster:
                 sum_h > 0, new_vals, tree.leaf_value
             )
             score += tree.predict(X)
-        gbdt._pred_cache = None  # leaf values renewed in place
+        gbdt._invalidate_pred_cache("refit")  # leaf values renewed in place
         return new_booster
 
     # -- serialization ----------------------------------------------------
@@ -1229,7 +1229,7 @@ class Booster:
 
     def set_leaf_output(self, tree_id: int, leaf_id: int, value: float) -> "Booster":
         self._gbdt.models[tree_id].leaf_value[leaf_id] = value
-        self._gbdt._pred_cache = None  # in-place tree edit: packed cache stale
+        self._gbdt._invalidate_pred_cache("set_leaf_output")  # in-place edit
         return self
 
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:

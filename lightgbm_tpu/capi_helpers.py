@@ -1000,7 +1000,7 @@ def booster_refit_leaf_preds(bst: Booster, leaf_addr: int, nrow: int,
             score[:, c] += pred
         else:
             score += pred
-    gbdt._pred_cache = None  # leaf values renewed in place
+    gbdt._invalidate_pred_cache("capi_refit_leaf")  # renewed in place
     return True
 
 

@@ -415,6 +415,17 @@ class Config:
     # and trains only the remaining rounds toward num_iterations
     resume: str = ""
 
+    # --- observability (ours; docs/OBSERVABILITY.md) ---
+    # telemetry: the process-wide metrics/event registry (lightgbm_tpu/obs)
+    # is DEFAULT-ON — it adds zero device dispatches and zero blocking
+    # syncs (every device-derived metric rides an existing sync point);
+    # telemetry=false flips the registry off for the process.
+    telemetry: bool = True
+    # metrics_file: engine.train writes the end-of-run metrics snapshot
+    # (JSON, schema lgbmtpu-metrics-v1) here atomically; render it with
+    # `python -m lightgbm_tpu.obs <file>`.
+    metrics_file: str = ""
+
     # unknown/passthrough params preserved here
     extra: Dict[str, Any] = field(default_factory=dict)
     # names the user explicitly set (vs defaults) — lets device-specific

@@ -14,9 +14,10 @@ import numpy as np
 from .basic import Booster, CorruptModelError, Dataset, LightGBMError
 from .callback import CallbackEnv, EarlyStopException
 from .config import Config, choose_param_value
+from .obs import metrics as _obs
 from .utils import checkpoint as _checkpoint
 from .utils import faults as _faults
-from .utils.log import log_info, log_warning, set_verbosity
+from .utils.log import log_debug, log_info, log_warning, set_verbosity
 
 
 def _load_init_booster(init_model) -> Booster:
@@ -36,6 +37,9 @@ def _load_init_booster(init_model) -> Booster:
         fb = _checkpoint.latest_valid_snapshot(init_model, below_iter=below)
         if fb is not None:
             it, snap = fb
+            _obs.counter("checkpoint_fallbacks_total").inc()
+            _obs.event("checkpoint_fallback", requested=str(init_model),
+                       used=snap, iteration=it)
             log_warning(
                 f"init_model {init_model} failed integrity verification; "
                 f"falling back to the newest valid older snapshot {snap} "
@@ -230,7 +234,35 @@ def train(
             booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
+    _finish_run_report(cfg_probe)
     return booster
+
+
+def _finish_run_report(cfg: Config) -> None:
+    """End-of-run observability (docs/OBSERVABILITY.md): the reference-style
+    "Time for X / counter = v" report through the logger (debug verbosity —
+    the TIMETAG analogue, quiet by default), and the machine-readable
+    snapshot to ``metrics_file=`` when configured (atomic JSON; render with
+    ``python -m lightgbm_tpu.obs <file>``)."""
+    if not _obs.enabled():
+        if cfg.metrics_file:
+            log_warning(f"metrics_file={cfg.metrics_file} ignored: "
+                        "telemetry is disabled (telemetry=false / "
+                        "LGBMTPU_TELEMETRY=0)")
+        return
+    snap = _obs.snapshot()
+    for line in _obs.render_lightgbm(snap):
+        log_debug(line)
+    if cfg.metrics_file:
+        # best-effort: an unwritable metrics path must never cost the
+        # caller a fully trained booster
+        try:
+            _obs.write_snapshot(cfg.metrics_file, snap)
+        except OSError as e:
+            log_warning(f"could not write metrics snapshot to "
+                        f"{cfg.metrics_file}: {e}")
+        else:
+            log_info(f"Metrics snapshot written to {cfg.metrics_file}")
 
 
 def _replay_scores(gbdt) -> None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -26,6 +27,7 @@ import numpy as np
 from ..config import Config
 from ..metrics import Metric, create_metrics
 from ..objectives import Objective, create_objective
+from ..obs import metrics as _obs
 from ..ops.split import SplitParams
 from ..ops.treegrow import grow_tree
 from ..ops import predict as predict_ops
@@ -143,6 +145,13 @@ class GBDT:
         # Accumulated ON DEVICE per iteration (O(num_leaves), no syncs)
         # and pulled only at points that already sync (_guard_check)
         self._guard_bad_iter = jnp.asarray(0, jnp.int32)
+        # telemetry is default-on and process-wide (docs/OBSERVABILITY.md);
+        # an explicit telemetry= param applies for this model's lifetime,
+        # and a model WITHOUT one restores the process default — so one
+        # model's telemetry=false cannot silently swallow a later model's
+        # metrics_file= snapshot
+        _obs.set_enabled(bool(cfg.telemetry) if cfg.is_set("telemetry")
+                         else _obs.DEFAULT_ENABLED)
         if train_set is not None:
             self.reset_training_data(train_set)
 
@@ -159,7 +168,17 @@ class GBDT:
     def models(self, value) -> None:
         self._pending = []
         self._models = value
-        self._pred_cache = None  # packed-ensemble serving cache is stale
+        self._invalidate_pred_cache("models_setter")
+
+    def _invalidate_pred_cache(self, reason: str) -> None:
+        """Null the packed-ensemble serving cache, counting REAL
+        invalidations (a populated cache dropped) so serving dashboards can
+        see churn — training every round vs an occasional leaf edit look
+        very different here."""
+        if getattr(self, "_pred_cache", None):
+            _obs.counter("predict_cache_invalidations_total").inc()
+            _obs.event("pred_cache_invalidate", reason=reason)
+        self._pred_cache = None
 
     def _flush_pending(self) -> None:
         if self._pending:
@@ -191,6 +210,8 @@ class GBDT:
         if bad:
             from ..utils.guards import NonFiniteError
 
+            _obs.counter("train_nonfinite_errors_total").inc()
+            _obs.event("nonfinite", phase="guard_check", iteration=bad)
             raise NonFiniteError(
                 f"non-finite leaf values/split gains entered the model at "
                 f"boosting iteration {bad}: the gradients or hessians went "
@@ -1005,7 +1026,29 @@ class GBDT:
     # ------------------------------------------------------------------
     def train_one_iter(self, grad: Optional[np.ndarray] = None, hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (reference: GBDT::TrainOneIter).  Returns
-        True when training cannot continue (all trees constant)."""
+        True when training cannot continue (all trees constant).
+
+        The telemetry wrapper around :meth:`_train_one_iter_impl` emits the
+        per-round training summary (docs/OBSERVABILITY.md): one
+        ``boost_round`` event carrying the round's dispatch/sync/compile
+        deltas read from the sanitizer's host-side ledger — deliberately NO
+        wall-clock delta, because the fast path dispatches asynchronously
+        and an unsynced timer would be the jaxlint-R9 mistiming
+        anti-pattern."""
+        if not _obs.enabled():
+            return self._train_one_iter_impl(grad, hess)
+        c0 = _san.compile_totals()
+        finished = self._train_one_iter_impl(grad, hess)
+        c1 = _san.compile_totals()
+        _obs.counter("train_boost_rounds_total").inc()
+        _obs.event("boost_round", iteration=self.iter_,
+                   dispatches=c1["dispatches"] - c0["dispatches"],
+                   host_syncs=c1["host_syncs"] - c0["host_syncs"],
+                   compiles=c1["compiles"] - c0["compiles"],
+                   traces=c1["traces"] - c0["traces"])
+        return finished
+
+    def _train_one_iter_impl(self, grad: Optional[np.ndarray] = None, hess: Optional[np.ndarray] = None) -> bool:
         ts = self.train_set
         k = self.num_tree_per_iteration
         if self._fused_eligible(grad):
@@ -1055,7 +1098,9 @@ class GBDT:
                     )
                     self._fused_disabled = True
                     self._fused_step = None
-                    return self.train_one_iter(grad, hess)
+                    # recurse into the impl: the telemetry wrapper already
+                    # opened this round's ledger window (one event per round)
+                    return self._train_one_iter_impl(grad, hess)
             self.objective.set_fused_state(obj_state)
             self._cur_grad, self._cur_hess = g, h
             for c, arrays in enumerate(arrays_all):
@@ -1073,7 +1118,7 @@ class GBDT:
                     else:
                         self._valid_scores[vi] = self._valid_scores[vi].at[:, c].add(vals)
             self.iter_ += 1
-            self._pred_cache = None
+            self._invalidate_pred_cache("train_one_iter")
             if self._report_finish_every_iter:
                 # C API path: the reference reports is_finished immediately.
                 # Reading THIS iteration's num_leaves would sync the tunnel
@@ -1458,7 +1503,7 @@ class GBDT:
                 else:
                     self._valid_scores[vi] = self._valid_scores[vi].at[:, c].add(vals)
         self.iter_ += 1
-        self._pred_cache = None
+        self._invalidate_pred_cache("train_one_iter")
         if not isinstance(all_const, bool):
             # fast path: only force the cannot-split flag to host every 32
             # iterations, so callers doing `if train_one_iter(): break` don't
@@ -1499,7 +1544,7 @@ class GBDT:
                 else:
                     self._valid_scores[vi] = self._valid_scores[vi].at[:, c].add(-vv)
         self.iter_ -= 1
-        self._pred_cache = None
+        self._invalidate_pred_cache("rollback_one_iter")
 
     # ------------------------------------------------------------------
     def _converted(self, score: jnp.ndarray) -> np.ndarray:
@@ -1767,7 +1812,9 @@ class GBDT:
             self._pred_cache = {}
         hit = self._pred_cache.get(key)
         if hit is not None:
+            _obs.counter("predict_packed_cache_hits_total").inc()
             return hit
+        _obs.counter("predict_packed_cache_misses_total").inc()
         trees = self._trees_for_export(start, num_iteration)
         pack_trees = trees
         if pad_trees_to and trees:
@@ -1781,6 +1828,34 @@ class GBDT:
             self._pred_cache.pop(next(iter(self._pred_cache)))
         self._pred_cache[key] = s
         return s
+
+    # -- serving telemetry (docs/OBSERVABILITY.md) ---------------------
+    @staticmethod
+    def _serve_t0() -> Tuple[float, int]:
+        """(wall clock, compile count) opening a serving entry's telemetry
+        window — closed by :meth:`_serve_note` AFTER the entry's accounted
+        ``sync_pull``, so the latency reservoir measures the real
+        end-to-end call (dispatch + device compute + pull), never the
+        async-enqueue time (the jaxlint-R9 mistiming class)."""
+        return time.perf_counter(), _san.compile_totals()["compiles"]
+
+    def _serve_note(self, entry: str, n: int, t0c0: Tuple[float, int]) -> None:
+        """Record one serving call.  Bucket hit/miss is decided by whether
+        the call compiled anything (a miss = a new bucket/shape opened);
+        only hits feed the warm-latency reservoirs, so cold compiles never
+        pollute the p50/p99 the serving round cares about."""
+        if not _obs.enabled():
+            return
+        t0, c0 = t0c0
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        _obs.counter("predict_requests_total").inc()
+        _obs.counter("predict_rows_total").inc(n)
+        if _san.compile_totals()["compiles"] == c0:
+            _obs.counter("predict_bucket_hits_total").inc()
+            _obs.histogram("predict_warm_latency_ms").observe(dt_ms)
+            _obs.histogram(f"predict_warm_latency_ms.{entry}").observe(dt_ms)
+        else:
+            _obs.counter("predict_bucket_misses_total").inc()
 
     def _pad_rows(self, X: np.ndarray, n_bucket: int) -> jnp.ndarray:
         """(N, F) host batch -> (n_bucket, F) f32 device array, zero-padded
@@ -1833,6 +1908,7 @@ class GBDT:
         cat_kw = {}
         if "is_cat" in s:
             cat_kw = dict(cat_words=s["cat_words"])
+        t0c0 = self._serve_t0()
         nb = _predict_bucket(n)
         x = self._pad_rows(X, nb)
         active = self._active_mask(n, nb)
@@ -1847,8 +1923,10 @@ class GBDT:
                 is_cat=s.get("is_cat"), cat_base=s.get("cat_base"),
                 cat_nwords=s.get("cat_nwords"), active=active, **cat_kw,
             )
-            return np.asarray(
+            res = np.asarray(
                 _san.sync_pull(out)[:n], dtype=np.float64) * scale
+            self._serve_note("raw", n, t0c0)
+            return res
         # multiclass: ONE class-reshaped dispatch (predict_raw_multiclass)
         # replaced the k-dispatch per-class host loop; outputs are
         # bit-identical (same per-class summation order)
@@ -1859,7 +1937,9 @@ class GBDT:
             is_cat=s.get("is_cat"), cat_base=s.get("cat_base"),
             cat_nwords=s.get("cat_nwords"), active=active, k=k, **cat_kw,
         )
-        return np.asarray(_san.sync_pull(out)[:n], dtype=np.float64) * scale
+        res = np.asarray(_san.sync_pull(out)[:n], dtype=np.float64) * scale
+        self._serve_note("raw_multiclass", n, t0c0)
+        return res
 
     def predict(self, X, raw_score=False, start_iteration=0, num_iteration=-1,
                 pred_leaf=False, pred_contrib=False) -> np.ndarray:
@@ -1907,6 +1987,7 @@ class GBDT:
         s = self._packed(start_iteration, num_iteration)
         if s is None:
             return np.zeros((n, 0), dtype=np.int32)
+        t0c0 = self._serve_t0()
         nb = _predict_bucket(n)
         x = self._pad_rows(X, nb)
         cat_kw = {}
@@ -1920,7 +2001,9 @@ class GBDT:
             s["missing_type"], s["left_child"], s["right_child"],
             s["num_leaves"], **cat_kw,
         )
-        return np.asarray(_san.sync_pull(out)[:n], dtype=np.int32)
+        res = np.asarray(_san.sync_pull(out)[:n], dtype=np.int32)
+        self._serve_note("leaf", n, t0c0)
+        return res
 
     def _predict_raw_early_stop(self, X, start_iteration=0, num_iteration=-1):
         """Prediction early stopping (reference: include/LightGBM/
@@ -1971,6 +2054,7 @@ class GBDT:
         cat_kw = {}
         if "is_cat" in s:
             cat_kw = dict(cat_words=s["cat_words"])
+        t0c0 = self._serve_t0()
         nb = _predict_bucket(n)
         x = self._pad_rows(X, nb)
         active = np.zeros(nb, dtype=bool)
@@ -1994,6 +2078,10 @@ class GBDT:
             active[:n] &= self._early_stop_active(raw, margin)
             if not active[:n].any():
                 break
+        # the last chunk's sync_pull already drained the device queue, so
+        # the whole-call latency is honestly attributed (every chunk ends
+        # in an accounted blocking pull)
+        self._serve_note("raw_early_stop", n, t0c0)
         return raw
 
     @staticmethod

@@ -1,0 +1,25 @@
+"""Runtime observability: metrics registry, event sink, run reports.
+
+See docs/OBSERVABILITY.md for the metric catalog, the event schema, and the
+zero-dispatch rule this subsystem is built around.  ``python -m
+lightgbm_tpu.obs`` dumps the live registry (or a saved snapshot file) as
+Prometheus text exposition.
+"""
+
+from .metrics import (  # noqa: F401
+    REGISTRY, RESERVOIR_CAP, SCHEMA, SECTION_PREFIX, Counter, Gauge,
+    Histogram, Registry, clear_prefix, counter, enabled, event, events,
+    gauge, histogram, histogram_items, load_snapshot, merge_event_files,
+    register_collector, render_lightgbm, render_prometheus, reset,
+    set_enabled, set_events_file, snapshot, validate_snapshot,
+    write_snapshot,
+)
+
+__all__ = [
+    "REGISTRY", "RESERVOIR_CAP", "SCHEMA", "SECTION_PREFIX", "Counter",
+    "Gauge", "Histogram", "Registry", "clear_prefix", "counter", "enabled",
+    "event", "events", "gauge", "histogram", "histogram_items",
+    "load_snapshot", "merge_event_files", "register_collector",
+    "render_lightgbm", "render_prometheus", "reset", "set_enabled",
+    "set_events_file", "snapshot", "validate_snapshot", "write_snapshot",
+]

@@ -1,0 +1,52 @@
+"""CLI: ``python -m lightgbm_tpu.obs [snapshot.json] [--format ...]``.
+
+With a path, renders a snapshot previously written via ``metrics_file=``
+(Config/CLI param) or :func:`lightgbm_tpu.obs.write_snapshot`; with no
+path, dumps the live in-process registry (empty in a fresh interpreter —
+the path form is the operational one).  Formats: ``prometheus`` (default),
+``lightgbm`` (reference "Time for X" report lines), ``json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .metrics import (load_snapshot, render_lightgbm, render_prometheus,
+                      snapshot)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.obs",
+        description="dump a lightgbm_tpu metrics snapshot")
+    parser.add_argument("path", nargs="?", default=None,
+                        help="snapshot JSON written by metrics_file= / "
+                             "write_snapshot (default: the live registry)")
+    parser.add_argument("--format", choices=("prometheus", "lightgbm",
+                                             "json"),
+                        default="prometheus")
+    args = parser.parse_args(argv)
+
+    if args.path is not None:
+        try:
+            snap = load_snapshot(args.path)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    else:
+        snap = snapshot()
+
+    if args.format == "json":
+        print(json.dumps(snap, indent=1, default=str))
+    elif args.format == "lightgbm":
+        for line in render_lightgbm(snap):
+            print(line)
+    else:
+        sys.stdout.write(render_prometheus(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

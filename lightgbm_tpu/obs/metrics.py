@@ -1,0 +1,521 @@
+"""Process-wide metrics registry + structured event sink (docs/OBSERVABILITY.md).
+
+The reference ships TIMETAG per-phase timers and "Time for X: Y s" summaries
+(SURVEY §6.1/§6.2); a production serving/training system additionally needs
+counters, latency percentiles, and machine-readable run artifacts.  This
+module is that layer, with one hard design rule inherited from the round-7/8
+budget protocol:
+
+**Telemetry adds ZERO device dispatches and ZERO blocking syncs.**  Nothing
+in this module imports jax or touches a device value.  Every device-derived
+metric is recorded by a caller that already holds the value on the host —
+the windowed grower's one-round-behind async info vector, the accounted
+``sync_pull`` at a predict entry, the sanitizer's ``jax.monitoring``
+listener — so enabling telemetry (it is default-on) cannot change the
+dispatch/sync budgets that ``tests/test_retrace.py`` and
+``tests/test_predict_budget.py`` pin.
+
+Three primitives plus an event stream:
+
+* :class:`Counter` — monotonic ``inc(n)``;
+* :class:`Gauge` — last-write-wins ``set(v)``;
+* :class:`Histogram` — bounded reservoir (cap 512, deterministic
+  per-name-seeded sampling) with exact ``count``/``sum``/``min``/``max``
+  and reservoir-estimated percentiles (p50/p90/p99);
+* :func:`event` — a structured record appended to an in-memory ring
+  (cap 4096) and, when a sink file is configured
+  (``LGBMTPU_EVENTS_FILE`` env or :func:`set_events_file`), to a JSONL
+  file — one JSON object per line, schema below.
+
+Event schema (every record)::
+
+    {"ts": <unix float>, "kind": <str>, "rank": <int|None>, ...fields}
+
+``rank`` is read from ``LIGHTGBM_TPU_RANK`` so launcher workers stamp their
+own records; ``parallel/launcher.py`` aggregates per-rank files into one
+fleet-level JSONL.
+
+Collectors bridge subsystems that keep their own authoritative counters
+(``utils/sanitizer.py``'s dispatch/sync/compile ledger): a registered
+collector is called at :func:`snapshot` time and its values merge into the
+snapshot — zero per-event overhead, one read per snapshot.
+
+Snapshots are plain JSON (schema ``lgbmtpu-metrics-v1``); render them as
+Prometheus text exposition (:func:`render_prometheus`) or reference-style
+log lines (:func:`render_lightgbm`), or via ``python -m lightgbm_tpu.obs``.
+
+Kept import-light (stdlib only) on purpose: utils/faults.py, the launcher's
+thin worker processes, and checkpoint writers all record here without
+paying a jax import.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import random
+import re
+import tempfile
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+SCHEMA = "lgbmtpu-metrics-v1"
+RESERVOIR_CAP = 512
+EVENT_RING_CAP = 4096
+_PROM_PREFIX = "lgbmtpu_"
+
+_lock = threading.RLock()
+# the process default (env-derived); Config application restores it for
+# models that do not set telemetry= explicitly, so one model's
+# telemetry=false cannot silently disable a later model's metrics_file=
+DEFAULT_ENABLED: bool = os.environ.get("LGBMTPU_TELEMETRY", "1") != "0"
+_enabled: bool = DEFAULT_ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    """Process-wide switch (``telemetry=false`` Config param routes here).
+    Disabling makes every record call a cheap no-op; existing values stay
+    readable."""
+    global _enabled
+    with _lock:
+        _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if not _enabled:
+            return
+        with _lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with _lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        if not _enabled:
+            return
+        with _lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with _lock:
+            return self._value
+
+
+class Histogram:
+    """Bounded-reservoir distribution: exact count/sum/min/max, percentiles
+    estimated from a RESERVOIR_CAP-sample reservoir (classic algorithm-R,
+    seeded per name so runs are reproducible)."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_samples", "_rng")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._samples: List[float] = []
+        # stable per-name seed (str hash() is salted per process — crc32
+        # keeps the "identical runs keep identical reservoirs" promise)
+        self._rng = random.Random(zlib.crc32(name.encode("utf-8")))
+
+    def observe(self, v: float, always: bool = False) -> None:
+        """``always=True`` records even while telemetry is disabled — for
+        explicitly invoked profiling APIs (utils/profiling.py
+        timed_section), where the call itself is the opt-in."""
+        if not (_enabled or always):
+            return
+        v = float(v)
+        with _lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self._samples) < RESERVOIR_CAP:
+                self._samples.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < RESERVOIR_CAP:
+                    self._samples[j] = v
+
+    def percentile(self, p: float) -> Optional[float]:
+        with _lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+        k = min(int(round((p / 100.0) * (len(s) - 1))), len(s) - 1)
+        return s[k]
+
+    def summary(self) -> Dict[str, Any]:
+        with _lock:
+            n, tot, lo, hi = self.count, self.total, self.min, self.max
+        return {
+            "count": n, "sum": tot, "min": lo, "max": hi,
+            "p50": self.percentile(50), "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+class Registry:
+    """One process-wide instance (:data:`REGISTRY`); separate instances
+    exist only for tests."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._collectors: Dict[str, Callable[[], Dict[str, Dict[str, float]]]] = {}
+        self._events: "collections.deque" = collections.deque(
+            maxlen=EVENT_RING_CAP)
+        self._events_total = 0
+        self._events_path: Optional[str] = None
+        self._events_fh = None
+        # sink resolution happens ONCE (explicit path, else the env var);
+        # a failed open stays failed — no per-event retry, no silent
+        # fallback from an explicit path to the env-configured one
+        self._events_resolved = False
+        self._rank = _rank_from_env()
+
+    # -- metric accessors (create-on-first-use) -------------------------
+    def counter(self, name: str) -> Counter:
+        with _lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with _lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name: str) -> Histogram:
+        with _lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            return h
+
+    def histogram_items(self, prefix: str = "") -> Dict[str, Histogram]:
+        with _lock:
+            return {n: h for n, h in self._histograms.items()
+                    if n.startswith(prefix)}
+
+    def clear_prefix(self, prefix: str) -> None:
+        """Drop metrics whose name starts with ``prefix`` (the profiling
+        module's ``log_timings(reset=True)`` semantics)."""
+        with _lock:
+            for table in (self._counters, self._gauges, self._histograms):
+                for name in [n for n in table if n.startswith(prefix)]:
+                    del table[name]
+
+    # -- collectors ------------------------------------------------------
+    def register_collector(
+            self, name: str,
+            fn: Callable[[], Dict[str, Dict[str, float]]]) -> None:
+        """``fn`` returns ``{"counters": {...}, "gauges": {...}}`` merged at
+        snapshot time — for subsystems keeping their own ledgers
+        (utils/sanitizer.py).  Re-registration under the same name
+        replaces (idempotent module reloads)."""
+        with _lock:
+            self._collectors[name] = fn
+
+    # -- events ----------------------------------------------------------
+    def set_events_file(self, path: Optional[str]) -> None:
+        """Explicit sink path; ``None`` reverts to env-var resolution
+        (``LGBMTPU_EVENTS_FILE``) at the next event."""
+        with _lock:
+            if self._events_fh is not None:
+                try:
+                    self._events_fh.close()
+                except OSError:
+                    pass
+            self._events_fh = None
+            self._events_path = path
+            self._events_resolved = False
+
+    def event(self, kind: str, **fields: Any) -> None:
+        if not _enabled:
+            return
+        rec = {"ts": time.time(), "kind": kind, "rank": self._rank}
+        rec.update(fields)
+        with _lock:
+            self._events.append(rec)
+            self._events_total += 1
+            if not self._events_resolved:
+                self._events_resolved = True
+                path = self._events_path or os.environ.get(
+                    "LGBMTPU_EVENTS_FILE")
+                if path:
+                    try:
+                        self._events_fh = open(path, "a", encoding="utf-8")
+                        self._events_path = path
+                    except OSError:
+                        self._events_fh = None  # stays failed: no
+                        # per-event retry, no fallback to another path
+            if self._events_fh is not None:
+                try:
+                    self._events_fh.write(json.dumps(rec, default=str) + "\n")
+                    self._events_fh.flush()
+                except (OSError, ValueError):
+                    self._events_fh = None
+
+    def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        with _lock:
+            out = list(self._events)
+        if kind is not None:
+            out = [e for e in out if e.get("kind") == kind]
+        return out
+
+    # -- snapshot --------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with _lock:
+            counters = {n: c.value for n, c in self._counters.items()}
+            gauges = {n: g.value for n, g in self._gauges.items()}
+            # capture the Histogram OBJECTS under the lock: a concurrent
+            # clear_prefix()/reset() may drop map entries, but captured
+            # objects stay summarizable
+            hist_objs = dict(self._histograms)
+            collectors = list(self._collectors.items())
+            events_total = self._events_total
+        hists = {n: h.summary() for n, h in hist_objs.items()}
+        for cname, fn in collectors:
+            try:
+                extra = fn() or {}
+            except Exception:  # noqa: BLE001 — a broken collector must
+                continue  # never take the snapshot (or a run report) down
+            for n, v in (extra.get("counters") or {}).items():
+                counters[n] = int(v)
+            for n, v in (extra.get("gauges") or {}).items():
+                gauges[n] = float(v)
+        return {
+            "schema": SCHEMA,
+            "ts": time.time(),
+            "enabled": _enabled,
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+            "events_total": events_total,
+        }
+
+    def reset(self) -> None:
+        """Clear metrics and events (tests only).  Registered collectors
+        survive — their backing ledgers are process-cumulative and owned
+        elsewhere (utils/sanitizer.py)."""
+        with _lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._events.clear()
+            self._events_total = 0
+            self._rank = _rank_from_env()
+
+
+def _rank_from_env() -> Optional[int]:
+    r = os.environ.get("LIGHTGBM_TPU_RANK")
+    try:
+        return int(r) if r is not None else None
+    except ValueError:
+        return None
+
+
+REGISTRY = Registry()
+
+# module-level conveniences bound to the process registry
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+event = REGISTRY.event
+events = REGISTRY.events
+snapshot = REGISTRY.snapshot
+reset = REGISTRY.reset
+register_collector = REGISTRY.register_collector
+set_events_file = REGISTRY.set_events_file
+histogram_items = REGISTRY.histogram_items
+clear_prefix = REGISTRY.clear_prefix
+
+
+# ---------------------------------------------------------------------------
+# snapshot persistence + validation
+# ---------------------------------------------------------------------------
+
+def write_snapshot(path: str, snap: Optional[Dict[str, Any]] = None) -> None:
+    """Write a snapshot as JSON, atomically (same-dir temp + ``os.replace``).
+    Deliberately NOT routed through utils/checkpoint.py: metrics writes must
+    not count as model checkpoint writes nor arm the snapshot_write fault
+    site."""
+    if snap is None:
+        snap = snapshot()
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".tmp.", dir=d)
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            json.dump(snap, fh, indent=1, default=str)
+            fh.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_snapshot(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as fh:
+        snap = json.load(fh)
+    validate_snapshot(snap)
+    return snap
+
+
+def validate_snapshot(snap: Dict[str, Any]) -> None:
+    """Raise ValueError unless ``snap`` is a schema-valid metrics snapshot
+    (the contract bench artifacts and tests assert)."""
+    if not isinstance(snap, dict) or snap.get("schema") != SCHEMA:
+        raise ValueError(
+            f"not a {SCHEMA} snapshot: schema={snap.get('schema')!r}"
+            if isinstance(snap, dict) else "snapshot is not a JSON object")
+    for key, typ in (("counters", dict), ("gauges", dict),
+                     ("histograms", dict), ("events_total", int),
+                     ("ts", (int, float))):
+        if not isinstance(snap.get(key), typ):
+            raise ValueError(f"snapshot field {key!r} missing or mistyped")
+    for name, h in snap["histograms"].items():
+        if not isinstance(h, dict) or "count" not in h or "sum" not in h:
+            raise ValueError(f"histogram {name!r} missing count/sum")
+
+
+# ---------------------------------------------------------------------------
+# rendering: Prometheus text exposition + reference-style log lines
+# ---------------------------------------------------------------------------
+
+def _prom_name(name: str) -> str:
+    return _PROM_PREFIX + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Prometheus text exposition (counters/gauges plus summary-style
+    quantiles for histograms)."""
+    if snap is None:
+        snap = snapshot()
+    lines = [f"# lightgbm_tpu metrics ({snap.get('schema')})"]
+    for name in sorted(snap.get("counters", {})):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {snap['counters'][name]}")
+    for name in sorted(snap.get("gauges", {})):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {snap['gauges'][name]}")
+    for name in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][name]
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} summary")
+        for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+            v = h.get(key)
+            if v is not None:
+                lines.append(f'{pn}{{quantile="{q}"}} {v}')
+        lines.append(f"{pn}_sum {h.get('sum', 0.0)}")
+        lines.append(f"{pn}_count {h.get('count', 0)}")
+    ev = snap.get("events_total")
+    if ev is not None:
+        pn = _prom_name("events_total")
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {ev}")
+    return "\n".join(lines) + "\n"
+
+
+SECTION_PREFIX = "section_seconds."
+
+
+def render_lightgbm(snap: Optional[Dict[str, Any]] = None) -> List[str]:
+    """Reference-log-style end-of-run report lines: the TIMETAG "Time for
+    X: Y s" section tallies first, then one line per counter/gauge."""
+    if snap is None:
+        snap = snapshot()
+    lines: List[str] = []
+    hists = snap.get("histograms", {})
+    sections = {n[len(SECTION_PREFIX):]: h for n, h in hists.items()
+                if n.startswith(SECTION_PREFIX)}
+    for name in sorted(sections, key=lambda n: -sections[n].get("sum", 0.0)):
+        h = sections[name]
+        lines.append(
+            f"Time for {name}: {h.get('sum', 0.0):.6f} s "
+            f"({h.get('count', 0)} calls)")
+    for name in sorted(snap.get("counters", {})):
+        lines.append(f"{name} = {snap['counters'][name]}")
+    for name in sorted(snap.get("gauges", {})):
+        lines.append(f"{name} = {snap['gauges'][name]:g}")
+    for name in sorted(hists):
+        if name.startswith(SECTION_PREFIX):
+            continue
+        h = hists[name]
+        if not h.get("count"):
+            continue
+        lines.append(
+            f"{name}: count={h['count']} p50={h.get('p50')} "
+            f"p99={h.get('p99')} max={h.get('max')}")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# fleet event aggregation (parallel/launcher.py)
+# ---------------------------------------------------------------------------
+
+def merge_event_files(paths: List[str], out_path: str) -> int:
+    """Merge per-rank JSONL event files into one fleet-level JSONL sorted by
+    timestamp; malformed lines are skipped (a crashed worker may have torn
+    its last record).  Returns the number of merged records."""
+    records: List[Dict[str, Any]] = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        records.append(rec)
+        except OSError:
+            continue
+    records.sort(key=lambda r: r.get("ts", 0.0))
+    with open(out_path, "w", encoding="utf-8") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec, default=str) + "\n")
+    return len(records)

@@ -25,6 +25,15 @@ Serving entry points (round 9) are shape-stable and one-dispatch:
   early-stopping runs every chunk through the SAME compiled executable;
 * :func:`predict_leaf_values` is the stacked device traversal behind
   ``pred_leaf`` (previously a per-tree host walk).
+
+Telemetry contract (round 10, docs/OBSERVABILITY.md): these ops are pure
+traced programs and carry NO instrumentation — the serving layer
+(models/gbdt.py ``_serve_t0``/``_serve_note``) times each entry point at
+its accounted ``sync_pull``, where the device queue has provably drained,
+and feeds the ``predict_warm_latency_ms`` reservoirs.  Adding host-side
+counters or timers INSIDE these jitted bodies would either break the trace
+or run once at trace time (jaxlint R5); timing around them without the
+sync is the jaxlint-R9 mistiming class.
 """
 
 from __future__ import annotations

@@ -65,6 +65,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ..obs import metrics as _obs
 from ..utils import degrade as _degrade
 from ..utils import sanitizer as _san
 from ..utils.guards import NonFiniteError
@@ -744,7 +745,16 @@ def _grow_windowed_impl(
             w_ran = windows[resolved]  # the W THIS round ran with (the loop
             # variable has moved on to later dispatches)
             resolved += 1
+            # telemetry rides the values the async protocol ALREADY pulled —
+            # host dict updates only, zero extra dispatches/syncs (the
+            # DispatchCounter budget pin runs with this enabled)
+            if _obs.enabled():
+                _obs.histogram("train_window_rows").observe(total)
+                _obs.histogram("train_window_fill").observe(
+                    total / max(w_ran, 1))
             if not finite:
+                _obs.counter("train_nonfinite_errors_total").inc()
+                _obs.event("nonfinite", phase="windowed", round=resolved)
                 raise NonFiniteError(
                     f"non-finite gradients/hessians/split stats on device "
                     f"at windowed round {resolved}{guard_label}: refusing "
@@ -778,6 +788,9 @@ def _grow_windowed_impl(
             info = _san.async_pull_result(pending.pop(0))
             resolved += 1
             if not int(info[4]):
+                _obs.counter("train_nonfinite_errors_total").inc()
+                _obs.event("nonfinite", phase="windowed_drain",
+                           round=resolved)
                 raise NonFiniteError(
                     f"non-finite gradients/hessians/split stats on device "
                     f"at windowed round {resolved}{guard_label} (drained "
@@ -791,6 +804,14 @@ def _grow_windowed_impl(
                          host_syncs=counter.host_syncs,
                          async_resolves=counter.async_resolves,
                          retries=retries, windows=windows)
+        if _obs.enabled():
+            # per-tree summary from the driver's own host-side ledger
+            _obs.counter("train_windowed_rounds_total").inc(rounds)
+            _obs.counter("train_windowed_retries_total").inc(retries)
+            _obs.event("windowed_tree", rounds=rounds, retries=retries,
+                       dispatches=counter.dispatches,
+                       host_syncs=counter.host_syncs,
+                       async_resolves=counter.async_resolves)
     if not converged:
         # the safety headroom ran out (repeated window-bound breaches):
         # growth stopped early with a valid but under-grown tree — make

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import metrics as _obs
 from ..utils.log import log_warning
 
 _WORKER_SRC = r"""
@@ -178,13 +179,19 @@ def _watch_workers(workers, timeout_s: float,
                     continue
                 if rc == 0:
                     done.add(rank)
+                    _obs.event("worker_exit", worker_rank=rank, exit_code=0)
                     continue
+                _obs.counter("launcher_worker_deaths_total").inc()
+                _obs.event("worker_death", worker_rank=rank, exit_code=rc,
+                           log=log_path)
                 raise WorkerFailure(
                     f"launcher worker rank {rank} died with exit code {rc}; "
                     f"remaining workers killed. Tail of rank {rank}'s log "
                     f"({log_path}):\n{_log_tail(log_path)}",
                     rank=rank)
             if time.monotonic() > deadline:
+                _obs.counter("launcher_timeouts_total").inc()
+                _obs.event("launch_timeout", timeout_s=timeout_s)
                 tails = "\n".join(
                     f"--- rank {r} ({lp}) ---\n{_log_tail(lp)}"
                     for r, _, lp in workers)
@@ -200,6 +207,35 @@ def _watch_workers(workers, timeout_s: float,
             if p2.poll() is None:
                 _kill_worker_group(p2)
         raise
+
+
+def aggregate_fleet_events(tmp: str, num_machines: int,
+                           since: float = 0.0) -> str:
+    """Merge per-rank worker event JSONLs with the launcher's own
+    lifecycle events (worker_spawn/worker_death/fleet_relaunch/
+    launch_timeout, stamped rank=None) into ``<tmp>/fleet_events.jsonl``,
+    sorted by timestamp.  ``since`` scopes the launcher's process-wide
+    event ring to THIS run — a second train_distributed in the same
+    process must not replay the previous fleet's deaths into its flight
+    recorder.  Torn last lines from crashed workers are skipped, not
+    fatal — the file is written on every exit path."""
+    own = os.path.join(tmp, "launcher.events.jsonl")
+    try:
+        with open(own, "w", encoding="utf-8") as fh:
+            for rec in _obs.events():
+                if rec.get("ts", 0.0) >= since and str(
+                        rec.get("kind", "")).startswith(
+                        ("worker_", "fleet_", "launch_")):
+                    fh.write(json.dumps(rec, default=str) + "\n")
+    except OSError:
+        own = None
+    paths = [os.path.join(tmp, f"worker{r}.events.jsonl")
+             for r in range(num_machines)]
+    if own is not None:
+        paths.append(own)
+    out = os.path.join(tmp, "fleet_events.jsonl")
+    _obs.merge_event_files(paths, out)
+    return out
 
 
 def _free_ports(k: int) -> list:
@@ -393,6 +429,11 @@ def train_distributed(
             env["LGBM_TPU_MODEL_OUT"] = model_out
             env["LGBM_TPU_ES_ROUNDS"] = str(early_stopping_rounds or 0)
             env.pop("PYTEST_CURRENT_TEST", None)
+            # per-rank structured event sink (docs/OBSERVABILITY.md): each
+            # worker's obs layer appends rank-stamped JSONL records here;
+            # the launcher merges them into one fleet-level file afterwards
+            env["LGBMTPU_EVENTS_FILE"] = os.path.join(
+                tmp, f"worker{rank}.events.jsonl")
             if env.get("LGBMTPU_FAULT"):
                 # make injected faults once-only ACROSS restarts, so a
                 # relaunched fleet runs clean (utils/faults.py)
@@ -408,23 +449,45 @@ def train_distributed(
                     start_new_session=True,  # own process group: killable
                     # as a unit, no zombies past a timeout
                 ), log_path))
+            _obs.counter("launcher_worker_spawns_total").inc()
+            _obs.event("worker_spawn", worker_rank=rank,
+                       pid=workers[-1][1].pid)
 
     attempt = 0
-    while True:
+    run_started = time.time()  # scopes the event ring to this run's fleet
+    try:
+        while True:
+            try:
+                _launch_once()
+                break
+            except WorkerFailure as e:
+                if attempt >= max_restarts:
+                    raise
+                delay = restart_backoff_s * (2 ** attempt)
+                attempt += 1
+                _obs.counter("launcher_relaunches_total").inc()
+                _obs.event("fleet_relaunch", attempt=attempt,
+                           backoff_s=delay, cause=str(e)[:200])
+                log_warning(
+                    f"launcher attempt {attempt}/{max_restarts + 1} failed "
+                    f"({str(e)[:200]}); relaunching all workers in "
+                    f"{delay:.1f}s")
+                time.sleep(delay)
+    finally:
+        # fleet-level observability artifact: merge every rank's JSONL
+        # event stream (plus the launcher's own lifecycle events) into one
+        # time-sorted file — written on success AND on failure, so a dead
+        # fleet still leaves its flight recorder behind.  Best-effort: a
+        # full disk here must not cost a trained model (nor mask the real
+        # WorkerFailure on the failure path)
         try:
-            _launch_once()
-            break
-        except WorkerFailure as e:
-            if attempt >= max_restarts:
-                raise
-            delay = restart_backoff_s * (2 ** attempt)
-            attempt += 1
-            log_warning(
-                f"launcher attempt {attempt}/{max_restarts + 1} failed "
-                f"({str(e)[:200]}); relaunching all workers in "
-                f"{delay:.1f}s")
-            time.sleep(delay)
+            fleet_events = aggregate_fleet_events(tmp, num_machines,
+                                                  since=run_started)
+        except OSError as e:
+            log_warning(f"could not write fleet_events.jsonl: {e}")
+            fleet_events = None
     booster = lgb.Booster(model_file=model_out + ".rank0")
+    booster._fleet_events = fleet_events
     meta_path = model_out + ".meta.json"
     if os.path.exists(meta_path):
         with open(meta_path) as fh:

@@ -117,11 +117,21 @@ def atomic_write_text(path: str, text: str,
         except OSError:
             pass
         raise
+    # telemetry AFTER the replace: only durable writes count (lazy import —
+    # thin launcher workers write models without extra import cost)
+    from ..obs import metrics as _obs
+
+    _obs.counter("checkpoint_writes_total").inc()
 
 
 def save_snapshot(path: str, model_text: str, iteration: int) -> None:
     """Atomic, trailer-stamped snapshot write (engine.py snapshot_freq)."""
     atomic_write_text(path, add_trailer(model_text), fault_round=iteration)
+    from ..obs import metrics as _obs
+
+    _obs.counter("checkpoint_snapshots_total").inc()
+    _obs.event("checkpoint_snapshot", path=os.fspath(path),
+               iteration=iteration)
 
 
 def verify_file(path: str) -> Optional[bool]:
@@ -134,10 +144,16 @@ def verify_file(path: str) -> Optional[bool]:
         with open(path, encoding="utf-8") as fh:
             text = fh.read()
     except (OSError, UnicodeDecodeError):
-        return False
-    ok = verify_text(text)[1]
-    if ok is None and is_snapshot_path(path):
-        return False
+        ok = False
+    else:
+        ok = verify_text(text)[1]
+        if ok is None and is_snapshot_path(path):
+            ok = False
+    if ok is False:
+        from ..obs import metrics as _obs
+
+        _obs.counter("checkpoint_torn_total").inc()
+        _obs.event("checkpoint_torn", path=os.fspath(path))
     return ok
 
 

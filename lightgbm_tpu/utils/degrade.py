@@ -58,6 +58,10 @@ def disable(feature: str, reason: str) -> None:
         if feature in _disabled:
             return
         _disabled[feature] = reason
+    from ..obs import metrics as _obs  # lazy: keep import graph unchanged
+
+    _obs.counter("degrade_disabled_total").inc()
+    _obs.event("degrade", feature=feature, reason=reason[:200])
     log_warning(
         f"Pallas kernel {feature!r} failed and is disabled for this "
         f"process; falling back to the XLA path permanently ({reason}). "

@@ -162,6 +162,14 @@ def fire(site: str, round_i: Optional[int] = None) -> bool:
                 fh.write(f"{os.getpid()}\n")
         except OSError:
             pass  # marker is best-effort; in-process registry still holds
+    # telemetry (lazy import: this module must stay importable without the
+    # package's jax-importing __init__ cost mattering — obs is stdlib-only).
+    # Crash sites record BEFORE dying, so the event reaches the JSONL sink
+    # (the in-memory ring dies with the process, the file line survives).
+    from ..obs import metrics as _obs
+
+    _obs.counter("faults_injected_total").inc()
+    _obs.event("fault", site=site, round=round_i)
     return True
 
 

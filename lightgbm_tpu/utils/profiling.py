@@ -4,21 +4,37 @@ Reference: the TIMETAG-gated wall-clock tallies in src/treelearner/*.cpp
 (global_timer) and the CLI's per-phase timing logs.  TPU-native analogue:
 `jax.profiler` device traces (viewable in TensorBoard/Perfetto) plus a
 host-side section timer with the reference's "Time for X: Y s" log style.
+
+Section tallies live in the process-wide metrics registry
+(``lightgbm_tpu/obs``) as ``section_seconds.<name>`` histograms — one
+thread-safe store shared with the rest of the telemetry layer, replacing
+the module-global dicts this module carried before round 10 (they raced
+under concurrent sections and were invisible to metrics snapshots).
+``log_timings`` reads and (optionally) clears them; they also appear in
+every ``metrics_file=`` snapshot and the ``python -m lightgbm_tpu.obs``
+dump.
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
-from collections import defaultdict
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator
 
 import jax
+import numpy as np
 
+from ..obs import metrics as _obs
 from .log import log_info
 
-_section_totals: Dict[str, float] = defaultdict(float)
-_section_counts: Dict[str, int] = defaultdict(int)
+
+def _drain_device_queue() -> None:
+    """Honest wait for outstanding device work: a HOST PULL of a tiny fresh
+    value, which cannot resolve until the device queue drains to it.
+    ``block_until_ready()`` is NOT used — PERF_NOTES/NEXT.md record it
+    returning EARLY through the axon tunnel before the async pipeline
+    drains, which silently mis-attributed every sync=True section."""
+    np.asarray(jax.device_put(0.0) + 0)
 
 
 @contextlib.contextmanager
@@ -41,30 +57,38 @@ def annotate(name: str) -> Iterator[None]:
 @contextlib.contextmanager
 def timed_section(name: str, sync: bool = False) -> Iterator[None]:
     """Host wall-clock tally per section (reference: global_timer's
-    start/stop pairs).  With sync=True the section waits for outstanding
-    device work first, attributing async dispatch correctly."""
+    start/stop pairs).  With sync=True the section first drains outstanding
+    device work through the documented host-pull sync, attributing async
+    dispatch correctly.  Without sync, the tally measures HOST time only —
+    async device work dispatched inside the section may still be in flight
+    when it closes (jaxlint R9 flags the raw-perf_counter form of that
+    mistake)."""
     if sync:
-        (jax.device_put(0.0) + 0).block_until_ready()
+        _drain_device_queue()
     t0 = time.perf_counter()
     try:
         with annotate(name):
             yield
     finally:
         dt = time.perf_counter() - t0
-        _section_totals[name] += dt
-        _section_counts[name] += 1
+        # always=True: entering a timed_section IS the opt-in — the tally
+        # must not go silent under telemetry=false (the pre-round-10
+        # module-global tallies recorded unconditionally too)
+        _obs.histogram(f"{_obs.SECTION_PREFIX}{name}").observe(
+            dt, always=True)
 
 
 def log_timings(reset: bool = True) -> Dict[str, float]:
     """Emit the accumulated section tallies (reference: the TIMETAG summary
-    printed at the end of training)."""
-    out = dict(_section_totals)
-    for name in sorted(_section_totals, key=_section_totals.get, reverse=True):
-        log_info(
-            f"Time for {name}: {_section_totals[name]:.6f} s "
-            f"({_section_counts[name]} calls)"
-        )
+    printed at the end of training).  Returns {section: total_seconds}."""
+    sections = _obs.histogram_items(_obs.SECTION_PREFIX)
+    out = {}
+    for full_name, h in sections.items():
+        name = full_name[len(_obs.SECTION_PREFIX):]
+        out[name] = h.total
+    for name in sorted(out, key=out.get, reverse=True):
+        h = sections[_obs.SECTION_PREFIX + name]
+        log_info(f"Time for {name}: {h.total:.6f} s ({h.count} calls)")
     if reset:
-        _section_totals.clear()
-        _section_counts.clear()
+        _obs.clear_prefix(_obs.SECTION_PREFIX)
     return out

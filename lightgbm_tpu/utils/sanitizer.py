@@ -42,6 +42,8 @@ from typing import Iterable, Optional
 import jax
 import numpy as np
 
+from ..obs import metrics as _obs
+
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 
@@ -49,6 +51,25 @@ _lock = threading.Lock()
 _counts = {"compiles": 0, "traces": 0, "dispatches": 0, "host_syncs": 0,
            "async_resolves": 0}
 _installed = False
+
+
+def _obs_collect() -> dict:
+    """Snapshot-time bridge into the metrics registry (docs/OBSERVABILITY.md):
+    this module stays the single authoritative ledger — counting here twice
+    per dispatch would tax the hot path for nothing — and every metrics
+    snapshot reads it once through this collector.  Process-cumulative."""
+    with _lock:
+        c = dict(_counts)
+    return {"counters": {
+        "device_compiles_total": c["compiles"],
+        "device_traces_total": c["traces"],
+        "device_dispatches_total": c["dispatches"],
+        "device_host_syncs_total": c["host_syncs"],
+        "device_async_resolves_total": c["async_resolves"],
+    }}
+
+
+_obs.register_collector("sanitizer", _obs_collect)
 
 
 def _listener(event: str, duration: float, **_kw) -> None:  # noqa: ARG001

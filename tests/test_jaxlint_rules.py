@@ -1089,3 +1089,167 @@ def test_r8_pragma_suppression(tmp_path):
     """}, rules=["R8"])
     assert not rep.findings
     assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R9 untimed-device-section
+# ---------------------------------------------------------------------------
+
+def test_r9_positive_perf_counter_around_dispatch(tmp_path):
+    """The async-dispatch mistiming anti-pattern: the delta reads before
+    any host pull, so it measures the ~1 ms enqueue, not the device."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def bench(x):
+            t0 = time.perf_counter()
+            x = step(x)
+            dt = time.perf_counter() - t0
+            return x, dt
+    """}, rules=["R9"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "R9"
+    assert rep.findings[0].line == 12
+
+
+def test_r9_positive_time_time_in_loop(tmp_path):
+    """time.time() deltas around a loop of dispatches are the same class
+    (the ISSUE names both timer spellings)."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def bench(x):
+            t0 = time.time()
+            for _ in range(5):
+                x = step(x)
+            print(time.time() - t0)
+            return x
+    """}, rules=["R9"])
+    assert len(rep.findings) == 1, rep.findings
+
+
+def test_r9_negative_host_pull_between(tmp_path):
+    """An np.asarray of the dispatched value before the read drains the
+    queue — the delta is honest, nothing to flag."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def bench(x):
+            t0 = time.perf_counter()
+            x = step(x)
+            _ = np.asarray(x)
+            dt = time.perf_counter() - t0
+            return x, dt
+    """}, rules=["R9"])
+    assert not rep.findings, rep.findings
+
+
+def test_r9_positive_two_var_delta(tmp_path):
+    """The stored-second-read spelling — t1 = perf_counter(); dt = t1 - t0
+    — is the same mistiming with no inline timer call in the Sub."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def bench(x):
+            t0 = time.perf_counter()
+            x = step(x)
+            t1 = time.perf_counter()
+            dt = t1 - t0
+            return x, dt
+    """}, rules=["R9"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].line == 13
+
+
+def test_r9_negative_same_line_pull(tmp_path):
+    """np.asarray(step(x)) — the one-line pull-the-dispatch idiom the
+    hint itself recommends — syncs on the dispatch's own line and must
+    not be flagged."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def bench(x):
+            t0 = time.perf_counter()
+            r = np.asarray(step(x))
+            dt = time.perf_counter() - t0
+            return r, dt
+    """}, rules=["R9"])
+    assert not rep.findings, rep.findings
+
+
+def test_r9_negative_async_pull_protocol_and_no_dispatch(tmp_path):
+    """The windowed driver's shape: an async_pull_result between dispatch
+    and read accounts the section; a delta with no dispatch inside its
+    window is plain host timing."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def round_fused(s):
+            return s, s
+
+        def drive(s, san):
+            t_last = time.perf_counter()
+            pend = []
+            for _ in range(4):
+                s, info = round_fused(s)
+                pend.append(info)
+                got = san.async_pull_result(pend.pop(0))
+                t_now = time.perf_counter()
+                print(t_now - t_last, got)
+                t_last = t_now
+            return s
+
+        def host_only(a, b):
+            t0 = time.perf_counter()
+            c = a + b
+            return c, time.perf_counter() - t0
+    """}, rules=["R9"])
+    assert not rep.findings, rep.findings
+
+
+def test_r9_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x
+
+        def bench(x):
+            t0 = time.perf_counter()
+            x = step(x)
+            dt = time.perf_counter() - t0  # jaxlint: disable=R9 (fixture: enqueue latency is the quantity under test)
+            return x, dt
+    """}, rules=["R9"])
+    assert not rep.findings
+    assert len(rep.suppressed) == 1

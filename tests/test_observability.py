@@ -1,19 +1,38 @@
-"""Tracing/profiling harness + NaN-sanitizer analog (SURVEY §6.1/§6.2:
-the reference's TIMETAG timers and its sanitizer CI jobs)."""
+"""Observability subsystem (round 10, docs/OBSERVABILITY.md): registry
+semantics, event schema, snapshot round-trip, fleet aggregation — plus THE
+acceptance pin: with telemetry default-on, the round-7 windowed budget
+(1 dispatch / 0 blocking syncs / 0 retraces per steady-state round) and the
+round-9 serving budget (warm predict = 1 dispatch + 1 pull) hold unchanged
+while the run leaves a non-empty, schema-valid metrics snapshot covering
+train, predict, and a robustness event.
 
-import pytest
+The legacy profiling-harness tests (device trace capture, debug_nans train)
+stay ``slow``; everything else here is tier-1.
+"""
+
 import glob
+import json
 import os
 
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
-from lightgbm_tpu.utils.profiling import device_trace, log_timings, timed_section
+from lightgbm_tpu.obs import metrics as obs
+from lightgbm_tpu.utils.profiling import (device_trace, log_timings,
+                                          timed_section)
 
-pytestmark = pytest.mark.slow
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs.reset()
+    obs.set_events_file(None)
+    yield
+    obs.reset()
+    obs.set_events_file(None)
 
 
-def _tiny_train(extra=None):
+def _tiny_train(extra=None, rounds=3):
     rng = np.random.RandomState(0)
     X = rng.randn(800, 5).astype(np.float32)
     y = ((X @ rng.randn(5)) > 0).astype(np.float64)
@@ -21,11 +40,310 @@ def _tiny_train(extra=None):
     params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
     params.update(extra or {})
     bst = lgb.Booster(params=params, train_set=ds)
-    for _ in range(3):
+    for _ in range(rounds):
         bst.update()
     return bst, X, y
 
 
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_semantics():
+    c = obs.counter("t_total")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert obs.counter("t_total") is c  # create-on-first-use, then shared
+    g = obs.gauge("t_gauge")
+    g.set(2.5)
+    g.set(-1.0)
+    assert g.value == -1.0
+
+
+def test_histogram_reservoir_bounded_and_percentiles():
+    h = obs.histogram("t_hist")
+    for v in range(10_000):
+        h.observe(float(v))
+    assert h.count == 10_000
+    assert h.total == sum(range(10_000))
+    assert h.min == 0.0 and h.max == 9999.0
+    assert len(h._samples) == obs.RESERVOIR_CAP  # hard memory bound
+    p50, p99 = h.percentile(50), h.percentile(99)
+    # reservoir estimate: generous tolerance, exact rank not required
+    assert 3500 < p50 < 6500, p50
+    assert p99 > 9000, p99
+    s = h.summary()
+    assert s["count"] == 10_000 and s["p50"] == p50
+
+
+def test_disabled_registry_is_noop():
+    obs.set_enabled(False)
+    try:
+        obs.counter("t_off").inc()
+        obs.histogram("t_off_h").observe(1.0)
+        obs.event("t_off_event")
+        assert obs.counter("t_off").value == 0
+        assert obs.histogram("t_off_h").count == 0
+        assert not obs.events("t_off_event")
+    finally:
+        obs.set_enabled(True)
+
+
+def test_collector_merges_into_snapshot():
+    obs.register_collector(
+        "t_coll", lambda: {"counters": {"t_coll_total": 7},
+                           "gauges": {"t_coll_gauge": 1.5}})
+    try:
+        snap = obs.snapshot()
+        assert snap["counters"]["t_coll_total"] == 7
+        assert snap["gauges"]["t_coll_gauge"] == 1.5
+        # the sanitizer collector is registered at import and always present
+        assert "device_dispatches_total" in snap["counters"]
+        assert "device_compiles_total" in snap["counters"]
+    finally:
+        obs.REGISTRY._collectors.pop("t_coll", None)
+
+
+# ---------------------------------------------------------------------------
+# events: schema + JSONL sink
+# ---------------------------------------------------------------------------
+
+def test_event_schema_and_jsonl_sink(tmp_path):
+    sink = str(tmp_path / "events.jsonl")
+    obs.set_events_file(sink)
+    obs.event("unit_test", detail="abc", n=3)
+    obs.event("unit_test", n=4)
+    recs = [json.loads(line) for line in
+            open(sink, encoding="utf-8").read().splitlines()]
+    assert len(recs) == 2
+    for rec in recs:
+        # the schema every record carries (docs/OBSERVABILITY.md)
+        assert isinstance(rec["ts"], float)
+        assert rec["kind"] == "unit_test"
+        assert "rank" in rec  # None outside launcher workers
+    assert recs[0]["detail"] == "abc" and recs[1]["n"] == 4
+    # the in-memory ring saw the same records
+    assert len(obs.events("unit_test")) == 2
+
+
+def test_event_sink_failure_is_silent_and_final(tmp_path, monkeypatch):
+    """A sink that cannot open fails ONCE: events keep flowing to the
+    ring, nothing raises, and the registry neither retries per event nor
+    falls back to the env-configured path."""
+    env_sink = tmp_path / "env.jsonl"
+    monkeypatch.setenv("LGBMTPU_EVENTS_FILE", str(env_sink))
+    obs.set_events_file(str(tmp_path / "no_such_dir" / "x.jsonl"))
+    obs.event("sink_fail", n=1)
+    obs.event("sink_fail", n=2)
+    assert len(obs.events("sink_fail")) == 2  # ring unaffected
+    assert not env_sink.exists()  # no silent fallback to the env path
+    # reverting to env resolution picks the env sink up again
+    obs.set_events_file(None)
+    obs.event("sink_fail", n=3)
+    assert env_sink.exists()
+
+
+def test_event_rank_stamped_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_RANK", "3")
+    reg = obs.Registry()
+    reg.event("ranked")
+    assert reg.events("ranked")[0]["rank"] == 3
+
+
+def test_fleet_event_aggregation(tmp_path):
+    """parallel/launcher.py merges per-rank JSONLs time-sorted, skipping a
+    crashed worker's torn last line."""
+    a = tmp_path / "worker0.events.jsonl"
+    b = tmp_path / "worker1.events.jsonl"
+    a.write_text(json.dumps({"ts": 2.0, "kind": "boost_round", "rank": 0})
+                 + "\n")
+    b.write_text(json.dumps({"ts": 1.0, "kind": "boost_round", "rank": 1})
+                 + "\n" + '{"ts": 3.0, "kind": "torn')  # mid-crash tail
+    out = tmp_path / "fleet.jsonl"
+    n = obs.merge_event_files([str(a), str(b), str(tmp_path / "gone")],
+                              str(out))
+    assert n == 2
+    recs = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [r["rank"] for r in recs] == [1, 0]  # time-sorted across ranks
+
+
+# ---------------------------------------------------------------------------
+# snapshot round-trip + rendering
+# ---------------------------------------------------------------------------
+
+def test_snapshot_roundtrip_and_renderers(tmp_path):
+    obs.counter("t_rt_total").inc(3)
+    obs.gauge("t_rt_gauge").set(0.5)
+    obs.histogram("t_rt_ms").observe(1.5)
+    obs.histogram(obs.SECTION_PREFIX + "train").observe(2.0)
+    obs.event("t_rt")
+    path = str(tmp_path / "metrics.json")
+    obs.write_snapshot(path)
+    snap = obs.load_snapshot(path)  # validates the schema on load
+    assert snap["schema"] == obs.SCHEMA
+    assert snap["counters"]["t_rt_total"] == 3
+    assert snap["histograms"]["t_rt_ms"]["count"] == 1
+    assert snap["events_total"] == 1
+    prom = obs.render_prometheus(snap)
+    assert "# TYPE lgbmtpu_t_rt_total counter" in prom
+    assert "lgbmtpu_t_rt_total 3" in prom
+    assert 'lgbmtpu_t_rt_ms{quantile="0.5"} 1.5' in prom
+    report = obs.render_lightgbm(snap)
+    assert "Time for train: 2.000000 s (1 calls)" in report
+    assert any(line.startswith("t_rt_total = 3") for line in report)
+    with pytest.raises(ValueError):
+        obs.validate_snapshot({"schema": "bogus"})
+
+
+def test_obs_cli_dumps_snapshot(tmp_path, capsys):
+    from lightgbm_tpu.obs.__main__ import main as obs_main
+
+    obs.counter("t_cli_total").inc()
+    path = str(tmp_path / "snap.json")
+    obs.write_snapshot(path)
+    assert obs_main([path]) == 0
+    assert "lgbmtpu_t_cli_total 1" in capsys.readouterr().out
+    assert obs_main([path, "--format", "lightgbm"]) == 0
+    assert "t_cli_total = 1" in capsys.readouterr().out
+    assert obs_main([str(tmp_path / "missing.json")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# profiling satellite: registry-backed sections + honest sync
+# ---------------------------------------------------------------------------
+
+def test_timed_section_routes_through_registry():
+    with timed_section("unit_section"):
+        pass
+    with timed_section("unit_section", sync=True):  # host-pull sync path
+        pass
+    h = obs.histogram(obs.SECTION_PREFIX + "unit_section")
+    assert h.count == 2
+    totals = log_timings(reset=True)
+    assert totals["unit_section"] > 0
+    assert not obs.histogram_items(obs.SECTION_PREFIX)  # reset cleared them
+
+
+# ---------------------------------------------------------------------------
+# config plumbing: metrics_file= + telemetry=
+# ---------------------------------------------------------------------------
+
+def test_train_writes_metrics_file(tmp_path):
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(float)
+    mfile = str(tmp_path / "run_metrics.json")
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+               "metrics_file": mfile},
+              lgb.Dataset(X, label=y), num_boost_round=3)
+    snap = obs.load_snapshot(mfile)
+    assert snap["counters"]["train_boost_rounds_total"] == 3
+
+
+def test_telemetry_param_disables_registry():
+    try:
+        _tiny_train({"telemetry": False}, rounds=2)
+        assert not obs.enabled()
+        assert obs.counter("train_boost_rounds_total").value == 0
+    finally:
+        obs.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: telemetry default-on, budgets unchanged, snapshot non-empty
+# ---------------------------------------------------------------------------
+
+def test_budgets_hold_with_telemetry_on_and_snapshot_covers_run(tmp_path):
+    """ISSUE 5 acceptance: train (windowed steady-state round budget) +
+    predict (warm serving budget) with the registry active, then assert a
+    schema-valid snapshot covering train, predict, and a robustness event
+    (an injected kernel degrade)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.binning import DatasetBinner
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+    from lightgbm_tpu.utils import degrade
+    from lightgbm_tpu.utils.sanitizer import DispatchCounter
+
+    assert obs.enabled()  # default-on is the contract under test
+
+    # -- train side: the round-7 budget pin with telemetry recording -----
+    n, f = 900, 8
+    rng = np.random.RandomState(5)
+    X = rng.randn(n, f)
+    yv = X @ rng.randn(f) + 0.2 * rng.randn(n)
+    binner = DatasetBinner.fit(X, max_bin=31)
+    bins_t = jnp.asarray(binner.transform(X).T, jnp.int16)
+    kw = dict(
+        row_mask=jnp.ones((n,), bool),
+        sample_weight=jnp.ones((n,), jnp.float32),
+        feature_mask=jnp.ones((f,), bool),
+        num_bins_pf=jnp.asarray(binner.num_bins_per_feature),
+        missing_bin_pf=jnp.asarray(binner.missing_bin_per_feature),
+    )
+    static = dict(num_leaves=15, num_bins=32,
+                  params=SplitParams(min_data_in_leaf=5.0), leaf_tile=4,
+                  use_pallas=False)
+    g0 = jnp.asarray(0.6 * yv, jnp.float32)
+    g1 = jnp.asarray(0.6 * yv + 0.05, jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    tree, leaf = grow_tree_windowed(bins_t, g0, hess, **kw, **static)
+    jax.block_until_ready(leaf)  # warmup compiles
+
+    stats = {}
+    with DispatchCounter() as d:
+        tree, leaf = grow_tree_windowed(bins_t, g1, hess, **kw, **static,
+                                        stats=stats)
+        jax.block_until_ready(leaf)
+    d.assert_round_budget(stats["rounds"], what="windowed + telemetry")
+    assert stats["host_syncs"] == 0 and stats["retries"] == 0, stats
+    d.assert_no_recompile("windowed steady state with telemetry on")
+
+    # -- predict side: the round-9 warm budget with telemetry recording --
+    bst, Xb, _ = _tiny_train(rounds=4)
+    bst.predict(Xb, raw_score=True)  # warm the bucket
+    with DispatchCounter() as dp:
+        bst.predict(Xb, raw_score=True)
+    assert dp.dispatches == 1, dp.dispatches
+    assert dp.host_syncs == 1, dp.host_syncs
+    dp.assert_no_recompile("warm predict with telemetry on")
+
+    # -- robustness event: an injected kernel degrade -------------------
+    degrade.reset()
+    try:
+        degrade.disable(degrade.HIST, "injected by test_observability")
+    finally:
+        degrade.reset()
+
+    # -- the run left a non-empty, schema-valid snapshot -----------------
+    snap = obs.snapshot()
+    obs.validate_snapshot(snap)
+    c = snap["counters"]
+    assert c["train_windowed_rounds_total"] >= stats["rounds"]  # train
+    assert c["train_boost_rounds_total"] == 4
+    assert c["predict_requests_total"] >= 2  # predict
+    assert c["predict_bucket_hits_total"] >= 1
+    assert snap["histograms"]["predict_warm_latency_ms"]["count"] >= 1
+    assert snap["histograms"]["train_window_rows"]["count"] >= 1
+    assert c["degrade_disabled_total"] == 1  # robustness
+    assert c["device_dispatches_total"] >= 1  # sanitizer collector merged
+    kinds = {e["kind"] for e in obs.events()}
+    assert {"boost_round", "windowed_tree", "degrade"} <= kinds
+    # and the snapshot round-trips to a readable artifact
+    path = str(tmp_path / "acceptance.json")
+    obs.write_snapshot(path, snap)
+    assert "lgbmtpu_train_windowed_rounds_total" in obs.render_prometheus(
+        obs.load_snapshot(path))
+
+
+# ---------------------------------------------------------------------------
+# legacy profiling harness (slow: full device trace + debug_nans trains)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
 def test_device_trace_writes_profile(tmp_path):
     logdir = str(tmp_path / "trace")
     with device_trace(logdir):
@@ -37,6 +355,7 @@ def test_device_trace_writes_profile(tmp_path):
     assert totals["train"] > 0
 
 
+@pytest.mark.slow
 def test_training_is_nan_clean_under_debug_nans():
     """jax debug_nans is the sanitizer-CI analog: any NaN produced inside a
     jitted training op raises immediately."""
