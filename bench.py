@@ -133,28 +133,59 @@ def _run(params, X, y, group=None, iters=30, repeats=1):
     tunnel's block_until_ready returns before the async pipeline drains
     (docs/PERF_NOTES.md round-4 methodology note), so these numbers are
     slightly lower but honest vs the r1-r4 artifacts.  `repeats` re-times
-    the same booster to expose run-to-run variance (VERDICT r4 weak #7)."""
-    import lightgbm_tpu as lgb
+    the same booster to expose run-to-run variance (VERDICT r4 weak #7).
 
-    ds = lgb.Dataset(X, label=y, group=group)
+    Phases run under timed_section so every artifact row carries the
+    per-section split (binning vs warmup-compile vs steady-state) via
+    _sections(), not just the embedded whole-process snapshot — the
+    round-10 follow-up from docs/NEXT.md.  The section close is honest:
+    each phase ends in the host pull above, and timed_section's tally is
+    host wall clock around it."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.profiling import timed_section
+
+    with timed_section("bench_dataset_bin"):
+        ds = lgb.Dataset(X, label=y, group=group)
+        ds.construct()
     t0 = time.perf_counter()
-    bst = lgb.Booster(params=params, train_set=ds)
-    bst.update()
-    _ = np.asarray(bst._gbdt._score[:8])
+    with timed_section("bench_warmup"):
+        bst = lgb.Booster(params=params, train_set=ds)
+        bst.update()
+        _ = np.asarray(bst._gbdt._score[:8])
     warmup = time.perf_counter() - t0
     rates = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            bst.update()
-        _ = np.asarray(bst._gbdt._score[:8])
+        with timed_section("bench_train_iters"):
+            for _ in range(iters):
+                bst.update()
+            _ = np.asarray(bst._gbdt._score[:8])
         rates.append(iters / (time.perf_counter() - t0))
     return float(np.median(rates)), warmup, rates
 
 
+def _sections():
+    """Drain the section_seconds tallies accumulated since the last call
+    into a {section: {sum_s, count}} dict for the workload's artifact row
+    (per-workload attribution needs the reset; the cumulative view stays
+    in the embedded metrics snapshot's history)."""
+    try:
+        from lightgbm_tpu.obs import metrics as _obs
+
+        out = {}
+        for name, h in _obs.histogram_items(_obs.SECTION_PREFIX).items():
+            out[name[len(_obs.SECTION_PREFIX):]] = {
+                "sum_s": round(h.total, 4), "count": h.count}
+        _obs.clear_prefix(_obs.SECTION_PREFIX)
+        return out
+    except Exception:  # noqa: BLE001 — artifact robustness first
+        return {}
+
+
 def _record(name, ips, warmup, vs=None, extra=None):
     entry = {"iters_per_sec": round(ips, 3), "warmup_s": round(warmup, 1),
-             "vs_baseline": vs if vs is None else round(vs, 3)}
+             "vs_baseline": vs if vs is None else round(vs, 3),
+             "sections": _sections()}
     if extra:
         entry.update(extra)
     _STATE["workloads"][name] = entry
@@ -362,34 +393,38 @@ def main():
 
         def weps():
             import lightgbm_tpu as lgb
+            from lightgbm_tpu.utils.profiling import timed_section
             cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  ".bench_cache", "epsilon_255.bin")
             eparams = dict(base_params, objective="binary", max_bin=255,
                            num_leaves=255)
-            if os.path.exists(cache) and fe == 2000:
-                ds = lgb.Dataset(cache, params={"max_bin": 255})
-                from_cache = True
-            elif _remaining() > (420 if fe == 2000 else 30):
-                rng_e = np.random.RandomState(1)
-                Xe = rng_e.randn(ne, fe).astype(np.float32)
-                ye = ((Xe[:, :64] @ rng_e.randn(64) + rng_e.randn(ne))
-                      > 0).astype(np.float64)
-                ds = lgb.Dataset(Xe, label=ye, params={"max_bin": 255})
-                from_cache = False
-            else:
-                _STATE["workloads"][name_e] = {
-                    "skipped": "no cache and insufficient budget to bin"}
-                return
+            with timed_section("bench_dataset_bin"):
+                if os.path.exists(cache) and fe == 2000:
+                    ds = lgb.Dataset(cache, params={"max_bin": 255})
+                    from_cache = True
+                elif _remaining() > (420 if fe == 2000 else 30):
+                    rng_e = np.random.RandomState(1)
+                    Xe = rng_e.randn(ne, fe).astype(np.float32)
+                    ye = ((Xe[:, :64] @ rng_e.randn(64) + rng_e.randn(ne))
+                          > 0).astype(np.float64)
+                    ds = lgb.Dataset(Xe, label=ye, params={"max_bin": 255})
+                    from_cache = False
+                else:
+                    _STATE["workloads"][name_e] = {
+                        "skipped": "no cache and insufficient budget to bin"}
+                    return
             t0 = time.perf_counter()
-            bst = lgb.Booster(params=eparams, train_set=ds)
-            bst.update()
-            _ = np.asarray(bst._gbdt._score[:8])  # true drain (tunnel)
+            with timed_section("bench_warmup"):
+                bst = lgb.Booster(params=eparams, train_set=ds)
+                bst.update()
+                _ = np.asarray(bst._gbdt._score[:8])  # true drain (tunnel)
             warme = time.perf_counter() - t0
             t0 = time.perf_counter()
             e_iters = 5
-            for _i in range(e_iters):
-                bst.update()
-            _ = np.asarray(bst._gbdt._score[:8])
+            with timed_section("bench_train_iters"):
+                for _i in range(e_iters):
+                    bst.update()
+                _ = np.asarray(bst._gbdt._score[:8])
             dte = time.perf_counter() - t0
             ipse = e_iters / dte
             _record(name_e, ipse, warme, None,

@@ -136,11 +136,15 @@ def synthetic_gbdt(num_trees, depth=6, num_features=28, k=1, seed=0):
 
 def bench_one(g, X, repeats):
     """(cold_s, rows_per_sec, p50_ms, p99_ms, warm_dispatches) for
-    raw-score prediction of X on gbdt g (fresh cache assumed for cold)."""
+    raw-score prediction of X on gbdt g (fresh cache assumed for cold).
+    Phases run under timed_section so the artifact rows carry the
+    cold-vs-warm section split alongside the embedded snapshot."""
+    from lightgbm_tpu.utils.profiling import timed_section
     from lightgbm_tpu.utils.sanitizer import DispatchCounter
 
     t0 = time.perf_counter()
-    first = g.predict(X, raw_score=True)
+    with timed_section("predict_cold"):
+        first = g.predict(X, raw_score=True)
     cold = time.perf_counter() - t0
     assert np.isfinite(first).all()
 
@@ -148,10 +152,11 @@ def bench_one(g, X, repeats):
     with DispatchCounter() as d:
         g.predict(X, raw_score=True)
     warm_dispatches = d.dispatches
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        g.predict(X, raw_score=True)
-        lat.append(time.perf_counter() - t0)
+    with timed_section("predict_warm"):
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            g.predict(X, raw_score=True)
+            lat.append(time.perf_counter() - t0)
     lat = np.asarray(lat)
     rows_per_sec = X.shape[0] / float(np.median(lat))
     return (cold, rows_per_sec,

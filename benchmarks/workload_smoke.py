@@ -108,6 +108,14 @@ def bench_predict(n_rows=2000, n_trees=24, iters=20):
         assert key in snap["counters"], f"metrics snapshot missing {key}"
     assert snap["histograms"]["predict_warm_latency_ms"]["count"] >= 1, (
         "warm predict left no latency reservoir samples")
+    # round 11: per-bucket latency labels + span tracing ride the same run
+    assert any(k.startswith('predict_warm_latency_ms{bucket="')
+               for k in snap["histograms"]), (
+        "per-bucket warm-latency labels missing from the snapshot")
+    from lightgbm_tpu.obs import trace as _tr
+
+    assert _tr.spans("boost_round") and _tr.spans("predict.raw"), (
+        "span tracing left no boost_round/predict spans")
 
     t0 = time.perf_counter()
     for _ in range(iters):

@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R9.
+"""jaxlint built-in rules R1-R10.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -886,3 +886,80 @@ def r9_untimed_device_section(pkg: PackageIndex) -> Iterator[Finding]:
                         f"a jitted dispatch (line {last_d}) with no "
                         f"accounted sync before the read in {fi.qualname}",
                         hint)
+
+
+# ---------------------------------------------------------------------------
+# R10 — sync-in-span-close
+# ---------------------------------------------------------------------------
+
+# calls that PULL a device value to the host (fresh blocking syncs when the
+# value lives on device).  Narrower than R9's suppressor list on purpose:
+# here matching is a POSITIVE finding, so the sanitizer-routed accounted
+# reads (sync_pull / async_pull_result) are explicitly allowed — closing a
+# span AT an accounted sync is the correct pattern, adding a fresh pull to
+# "drain for the timer" is the bug.
+_R10_FRESH_PULL_ATTRS = ("asarray", "array", "item", "tolist",
+                         "block_until_ready", "device_get")
+_R10_ACCOUNTED = ("sync_pull", "async_pull_result")
+_R10_CLOSE_NAMES = ("__exit__", "close", "end", "finish")
+
+
+def _is_contextmanager(node: ast.FunctionDef) -> bool:
+    return any((dotted_name(d) or "").split(".")[-1] == "contextmanager"
+               for d in node.decorator_list)
+
+
+def _r10_close_paths(mod) -> Iterator:
+    """(FuncInfo, first_line) pairs whose body (from first_line on, or all
+    of it for None) is a span CLOSE path: the ``__exit__``/``close`` of a
+    *Span-named* class, or the after-``yield`` tail of a
+    ``@contextmanager`` generator named like a span."""
+    for fi in mod.functions.values():
+        parts = fi.qualname.split(".")
+        if (len(parts) >= 2 and parts[-1] in _R10_CLOSE_NAMES
+                and any("span" in p.lower() for p in parts[:-1])):
+            yield fi, None
+            continue
+        if "span" in parts[-1].lower() and _is_contextmanager(fi.node):
+            ylines = [n.lineno for n in ast.walk(fi.node)
+                      if isinstance(n, (ast.Yield, ast.YieldFrom))]
+            if ylines:
+                yield fi, min(ylines)
+
+
+@register_rule("R10", "sync-in-span-close")
+def r10_sync_in_span_close(pkg: PackageIndex) -> Iterator[Finding]:
+    """The tracing twin of R9's mistiming class: a span ``__exit__`` /
+    ``close`` (or the after-yield tail of a ``@contextmanager`` span) that
+    performs a FRESH device pull (``np.asarray``/``.item()``/
+    ``block_until_ready``/a host cast) to make its duration "honest".
+    Spans are opened around device work everywhere the round loops run, so
+    a pull in the close path reintroduces exactly the per-round blocking
+    sync the round-7 protocol removed — one hidden ~45 ms tunnel
+    round-trip per span, and the DispatchCounter budget pins fail with
+    tracing on.  The correct pattern is the inverse: close the span AT an
+    existing accounted sync (the async info resolve, the predict entry's
+    ``sync_pull``) via ``obs.trace.record_span`` — the accounted readers
+    (``sync_pull``/``async_pull_result``) are therefore allowed here."""
+    hint = ("span closes must not pull: record device-inclusive intervals "
+            "retroactively at an existing accounted sync "
+            "(obs/trace.py record_span after the async info resolve or the "
+            "entry's sync_pull) and let context-manager spans stay "
+            "host-causal — see docs/OBSERVABILITY.md 'Span tracing'")
+    for mod in pkg.modules.values():
+        for fi, after_line in _r10_close_paths(mod):
+            for node in _own_body(fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                if after_line is not None and node.lineno <= after_line:
+                    continue
+                fn = dotted_name(node.func)
+                last = fn.split(".")[-1] if fn else None
+                if last in _R10_ACCOUNTED:
+                    continue
+                if last in _R10_FRESH_PULL_ATTRS:
+                    yield _finding(
+                        fi, node, "R10",
+                        f"span close path {fi.qualname} performs a fresh "
+                        f"device pull ({last}) — a hidden blocking sync "
+                        "per span", hint)

@@ -425,6 +425,16 @@ class Config:
     # (JSON, schema lgbmtpu-metrics-v1) here atomically; render it with
     # `python -m lightgbm_tpu.obs <file>`.
     metrics_file: str = ""
+    # metrics_port: opt-in live HTTP endpoint (lightgbm_tpu/obs/server.py:
+    # /metrics /healthz /snapshot /events) started on engine.train entry.
+    # -1 = off (default), 0 = ephemeral port, >0 = that port (falling back
+    # to ephemeral if busy).  LGBMTPU_METRICS_PORT is the env spelling;
+    # binds 127.0.0.1 unless LGBMTPU_METRICS_HOST overrides.
+    metrics_port: int = -1
+    # trace_file: engine.train writes the span ring as Chrome-trace/
+    # Perfetto JSON here at end of run (lightgbm_tpu/obs/trace.py; also
+    # `python -m lightgbm_tpu.obs trace`).
+    trace_file: str = ""
 
     # unknown/passthrough params preserved here
     extra: Dict[str, Any] = field(default_factory=dict)

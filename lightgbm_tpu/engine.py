@@ -15,6 +15,8 @@ from .basic import Booster, CorruptModelError, Dataset, LightGBMError
 from .callback import CallbackEnv, EarlyStopException
 from .config import Config, choose_param_value
 from .obs import metrics as _obs
+from .obs import server as _obs_server
+from .obs import trace as _trace
 from .utils import checkpoint as _checkpoint
 from .utils import faults as _faults
 from .utils.log import log_debug, log_info, log_warning, set_verbosity
@@ -104,6 +106,23 @@ def train(
     early_stopping_round = params.get("early_stopping_round")
     cfg_probe = Config.from_dict(params)
     set_verbosity(cfg_probe.verbosity)
+    # live introspection opt-in (docs/OBSERVABILITY.md): metrics_port= (or
+    # LGBMTPU_METRICS_PORT) starts the process-wide /metrics + /healthz
+    # endpoint before the first round, so the whole run is scrapeable.
+    # Port conflicts fall back to an ephemeral port; nothing here may
+    # cost the caller a model.
+    telemetry_on = (bool(cfg_probe.telemetry) if cfg_probe.is_set("telemetry")
+                    else _obs.DEFAULT_ENABLED)
+    if telemetry_on:
+        try:
+            _obs_server.maybe_start(
+                cfg_probe.metrics_port if cfg_probe.is_set("metrics_port")
+                else None)
+        except OSError as e:
+            # an unbindable endpoint (fd exhaustion, no loopback in a
+            # sandbox) must never cost the caller a model — the fallback
+            # inside start() covers busy ports; this covers everything else
+            log_warning(f"metrics endpoint could not start: {e}")
 
     resume = resume if resume is not None else (cfg_probe.resume or None)
     if resume is not None:
@@ -199,6 +218,11 @@ def train(
     # "train (total - k) more rounds" resume recipe both trust the name
     snapshot_base = booster.current_iteration()
 
+    # the run-level span is HOST-CAUSAL wall clock (docs/OBSERVABILITY.md
+    # "Span tracing"): per-round device-inclusive spans are the windowed
+    # grower's, anchored at its accounted async-info resolves
+    train_span = _trace.span("train", num_boost_round=num_boost_round)
+    train_span.__enter__()
     try:
         for i in range(num_boost_round):
             # fault-injection site: preemption at the start of 1-based
@@ -232,6 +256,10 @@ def train(
         booster.best_iteration = e.best_iteration + 1
         for item in e.best_score:
             booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+        train_span.set(early_stopped=True)
+    finally:
+        train_span.set(trained_iterations=booster.current_iteration())
+        train_span.__exit__(None, None, None)
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
     _finish_run_report(cfg_probe)
@@ -245,10 +273,11 @@ def _finish_run_report(cfg: Config) -> None:
     snapshot to ``metrics_file=`` when configured (atomic JSON; render with
     ``python -m lightgbm_tpu.obs <file>``)."""
     if not _obs.enabled():
-        if cfg.metrics_file:
-            log_warning(f"metrics_file={cfg.metrics_file} ignored: "
-                        "telemetry is disabled (telemetry=false / "
-                        "LGBMTPU_TELEMETRY=0)")
+        for name, val in (("metrics_file", cfg.metrics_file),
+                          ("trace_file", cfg.trace_file)):
+            if val:
+                log_warning(f"{name}={val} ignored: telemetry is disabled "
+                            "(telemetry=false / LGBMTPU_TELEMETRY=0)")
         return
     snap = _obs.snapshot()
     for line in _obs.render_lightgbm(snap):
@@ -263,6 +292,15 @@ def _finish_run_report(cfg: Config) -> None:
                         f"{cfg.metrics_file}: {e}")
         else:
             log_info(f"Metrics snapshot written to {cfg.metrics_file}")
+    if cfg.trace_file:
+        # Chrome-trace/Perfetto span export (obs/trace.py); same
+        # best-effort contract as metrics_file
+        try:
+            n_spans = _trace.write_trace(cfg.trace_file)
+        except OSError as e:
+            log_warning(f"could not write trace to {cfg.trace_file}: {e}")
+        else:
+            log_info(f"Trace ({n_spans} spans) written to {cfg.trace_file}")
 
 
 def _replay_scores(gbdt) -> None:

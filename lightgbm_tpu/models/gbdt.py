@@ -28,10 +28,14 @@ from ..config import Config
 from ..metrics import Metric, create_metrics
 from ..objectives import Objective, create_objective
 from ..obs import metrics as _obs
+from ..obs import trace as _trace
 from ..ops.split import SplitParams
 from ..ops.treegrow import grow_tree
 from ..ops import predict as predict_ops
 from ..utils import faults as _faults
+from ..utils import profiling as _profiling  # noqa: F401 — importing
+# installs the jax.profiler span-annotation bridge when
+# LGBMTPU_JAX_PROFILER=1 (obs/ itself must stay jax-free)
 from ..utils import sanitizer as _san
 from .tree import Tree, tree_from_device
 
@@ -1034,12 +1038,20 @@ class GBDT:
         deltas read from the sanitizer's host-side ledger — deliberately NO
         wall-clock delta, because the fast path dispatches asynchronously
         and an unsynced timer would be the jaxlint-R9 mistiming
-        anti-pattern."""
+        anti-pattern.  The ``boost_round`` SPAN around the impl carries the
+        same ledger deltas; its duration is host-causal by design (spans
+        never add a sync — jaxlint R10), and with LGBMTPU_JAX_PROFILER=1
+        it mirrors into jax.profiler.StepTraceAnnotation so profiler steps
+        line up with boosting iterations."""
         if not _obs.enabled():
             return self._train_one_iter_impl(grad, hess)
         c0 = _san.compile_totals()
-        finished = self._train_one_iter_impl(grad, hess)
-        c1 = _san.compile_totals()
+        with _trace.span("boost_round", iteration=self.iter_) as sp:
+            finished = self._train_one_iter_impl(grad, hess)
+            c1 = _san.compile_totals()
+            sp.set(dispatches=c1["dispatches"] - c0["dispatches"],
+                   host_syncs=c1["host_syncs"] - c0["host_syncs"],
+                   compiles=c1["compiles"] - c0["compiles"])
         _obs.counter("train_boost_rounds_total").inc()
         _obs.event("boost_round", iteration=self.iter_,
                    dispatches=c1["dispatches"] - c0["dispatches"],
@@ -1839,23 +1851,36 @@ class GBDT:
         async-enqueue time (the jaxlint-R9 mistiming class)."""
         return time.perf_counter(), _san.compile_totals()["compiles"]
 
-    def _serve_note(self, entry: str, n: int, t0c0: Tuple[float, int]) -> None:
+    def _serve_note(self, entry: str, n: int, t0c0: Tuple[float, int],
+                    bucket: Optional[int] = None) -> None:
         """Record one serving call.  Bucket hit/miss is decided by whether
         the call compiled anything (a miss = a new bucket/shape opened);
         only hits feed the warm-latency reservoirs, so cold compiles never
-        pollute the p50/p99 the serving round cares about."""
+        pollute the p50/p99 the serving round cares about.  ``bucket``
+        (the pow-2 ladder rung the batch padded to) additionally labels a
+        per-bucket reservoir — ``predict_warm_latency_ms{bucket="128"}``
+        in the Prometheus output — so multi-bucket request mixes stay
+        attributable.  The closing timer read is honest by construction:
+        every entry calls this AFTER its accounted ``sync_pull``, and the
+        retroactive span records the same interval (jaxlint R9/R10)."""
         if not _obs.enabled():
             return
         t0, c0 = t0c0
         dt_ms = (time.perf_counter() - t0) * 1e3
+        warm = _san.compile_totals()["compiles"] == c0
         _obs.counter("predict_requests_total").inc()
         _obs.counter("predict_rows_total").inc(n)
-        if _san.compile_totals()["compiles"] == c0:
+        if warm:
             _obs.counter("predict_bucket_hits_total").inc()
             _obs.histogram("predict_warm_latency_ms").observe(dt_ms)
             _obs.histogram(f"predict_warm_latency_ms.{entry}").observe(dt_ms)
+            if bucket is not None:
+                _obs.histogram(_obs.labeled(
+                    "predict_warm_latency_ms", bucket=bucket)).observe(dt_ms)
         else:
             _obs.counter("predict_bucket_misses_total").inc()
+        _trace.record_span(f"predict.{entry}", dt_ms / 1e3, rows=n,
+                           bucket=bucket, warm=warm)
 
     def _pad_rows(self, X: np.ndarray, n_bucket: int) -> jnp.ndarray:
         """(N, F) host batch -> (n_bucket, F) f32 device array, zero-padded
@@ -1925,7 +1950,7 @@ class GBDT:
             )
             res = np.asarray(
                 _san.sync_pull(out)[:n], dtype=np.float64) * scale
-            self._serve_note("raw", n, t0c0)
+            self._serve_note("raw", n, t0c0, bucket=nb)
             return res
         # multiclass: ONE class-reshaped dispatch (predict_raw_multiclass)
         # replaced the k-dispatch per-class host loop; outputs are
@@ -1938,7 +1963,7 @@ class GBDT:
             cat_nwords=s.get("cat_nwords"), active=active, k=k, **cat_kw,
         )
         res = np.asarray(_san.sync_pull(out)[:n], dtype=np.float64) * scale
-        self._serve_note("raw_multiclass", n, t0c0)
+        self._serve_note("raw_multiclass", n, t0c0, bucket=nb)
         return res
 
     def predict(self, X, raw_score=False, start_iteration=0, num_iteration=-1,
@@ -2002,7 +2027,7 @@ class GBDT:
             s["num_leaves"], **cat_kw,
         )
         res = np.asarray(_san.sync_pull(out)[:n], dtype=np.int32)
-        self._serve_note("leaf", n, t0c0)
+        self._serve_note("leaf", n, t0c0, bucket=nb)
         return res
 
     def _predict_raw_early_stop(self, X, start_iteration=0, num_iteration=-1):
@@ -2081,7 +2106,7 @@ class GBDT:
         # the last chunk's sync_pull already drained the device queue, so
         # the whole-call latency is honestly attributed (every chunk ends
         # in an accounted blocking pull)
-        self._serve_note("raw_early_stop", n, t0c0)
+        self._serve_note("raw_early_stop", n, t0c0, bucket=nb)
         return raw
 
     @staticmethod

@@ -169,20 +169,25 @@ class Histogram:
 
     def percentile(self, p: float) -> Optional[float]:
         with _lock:
-            if not self._samples:
-                return None
             s = sorted(self._samples)
-        k = min(int(round((p / 100.0) * (len(s) - 1))), len(s) - 1)
-        return s[k]
+        return _percentile_of(s, p)
 
-    def summary(self) -> Dict[str, Any]:
+    def summary(self, include_samples: bool = False) -> Dict[str, Any]:
+        """``include_samples=True`` attaches the raw reservoir — the form
+        per-rank snapshot files carry so the launcher's fleet merge can
+        recompute exact combined percentiles instead of averaging
+        per-rank estimates."""
         with _lock:
             n, tot, lo, hi = self.count, self.total, self.min, self.max
-        return {
+            samples = list(self._samples) if include_samples else None
+        out = {
             "count": n, "sum": tot, "min": lo, "max": hi,
             "p50": self.percentile(50), "p90": self.percentile(90),
             "p99": self.percentile(99),
         }
+        if samples is not None:
+            out["samples"] = samples
+        return out
 
 
 class Registry:
@@ -299,7 +304,7 @@ class Registry:
         return out
 
     # -- snapshot --------------------------------------------------------
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self, include_samples: bool = False) -> Dict[str, Any]:
         with _lock:
             counters = {n: c.value for n, c in self._counters.items()}
             gauges = {n: g.value for n, g in self._gauges.items()}
@@ -309,7 +314,8 @@ class Registry:
             hist_objs = dict(self._histograms)
             collectors = list(self._collectors.items())
             events_total = self._events_total
-        hists = {n: h.summary() for n, h in hist_objs.items()}
+        hists = {n: h.summary(include_samples=include_samples)
+                 for n, h in hist_objs.items()}
         for cname, fn in collectors:
             try:
                 extra = fn() or {}
@@ -323,6 +329,7 @@ class Registry:
             "schema": SCHEMA,
             "ts": time.time(),
             "enabled": _enabled,
+            "rank": self._rank,
             "counters": counters,
             "gauges": gauges,
             "histograms": hists,
@@ -340,6 +347,14 @@ class Registry:
             self._events.clear()
             self._events_total = 0
             self._rank = _rank_from_env()
+
+
+def _percentile_of(sorted_samples: List[float], p: float) -> Optional[float]:
+    if not sorted_samples:
+        return None
+    k = min(int(round((p / 100.0) * (len(sorted_samples) - 1))),
+            len(sorted_samples) - 1)
+    return sorted_samples[k]
 
 
 def _rank_from_env() -> Optional[int]:
@@ -370,19 +385,16 @@ clear_prefix = REGISTRY.clear_prefix
 # snapshot persistence + validation
 # ---------------------------------------------------------------------------
 
-def write_snapshot(path: str, snap: Optional[Dict[str, Any]] = None) -> None:
-    """Write a snapshot as JSON, atomically (same-dir temp + ``os.replace``).
-    Deliberately NOT routed through utils/checkpoint.py: metrics writes must
-    not count as model checkpoint writes nor arm the snapshot_write fault
-    site."""
-    if snap is None:
-        snap = snapshot()
+def _atomic_write_json(path: str, obj: Any) -> None:
+    """Same-dir temp + ``os.replace``.  Deliberately NOT routed through
+    utils/checkpoint.py: metrics/trace writes must not count as model
+    checkpoint writes nor arm the snapshot_write fault site."""
     path = os.fspath(path)
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".tmp.", dir=d)
     try:
         with os.fdopen(fd, "w", encoding="utf-8") as fh:
-            json.dump(snap, fh, indent=1, default=str)
+            json.dump(obj, fh, indent=1, default=str)
             fh.write("\n")
         os.replace(tmp, path)
     except BaseException:
@@ -391,6 +403,16 @@ def write_snapshot(path: str, snap: Optional[Dict[str, Any]] = None) -> None:
         except OSError:
             pass
         raise
+
+
+def write_snapshot(path: str, snap: Optional[Dict[str, Any]] = None,
+                   include_samples: bool = False) -> None:
+    """Write a snapshot as JSON, atomically.  ``include_samples`` (used by
+    the per-rank periodic writer) attaches raw reservoirs so a fleet merge
+    can recompute exact combined percentiles."""
+    if snap is None:
+        snap = snapshot(include_samples=include_samples)
+    _atomic_write_json(path, snap)
 
 
 def load_snapshot(path: str) -> Dict[str, Any]:
@@ -412,6 +434,11 @@ def validate_snapshot(snap: Dict[str, Any]) -> None:
                      ("ts", (int, float))):
         if not isinstance(snap.get(key), typ):
             raise ValueError(f"snapshot field {key!r} missing or mistyped")
+    for table in ("counters", "gauges"):
+        for name, v in snap[table].items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise ValueError(
+                    f"{table} entry {name!r} is not numeric: {v!r}")
     for name, h in snap["histograms"].items():
         if not isinstance(h, dict) or "count" not in h or "sum" not in h:
             raise ValueError(f"histogram {name!r} missing count/sum")
@@ -421,34 +448,74 @@ def validate_snapshot(snap: Dict[str, Any]) -> None:
 # rendering: Prometheus text exposition + reference-style log lines
 # ---------------------------------------------------------------------------
 
+def labeled(name: str, **labels: Any) -> str:
+    """A metric name carrying Prometheus labels: ``labeled("x", bucket=128)``
+    -> ``x{bucket="128"}``.  The registry treats the result as an opaque
+    name; :func:`render_prometheus` splits it back so the exposition gets a
+    real label set (and merges quantile labels for histograms).  Labels on
+    an already-labeled name merge (sorted by key)."""
+    base, existing = _split_labels(name)
+    merged = dict(_parse_labels(existing))
+    merged.update({k: str(v) for k, v in labels.items()})
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(merged.items()))
+    return f"{base}{{{inner}}}" if inner else base
+
+
+def _split_labels(name: str) -> tuple:
+    """``x{bucket="128"}`` -> ("x", 'bucket="128"'); plain names pass
+    through with an empty label string."""
+    if name.endswith("}") and "{" in name:
+        base, _, rest = name.partition("{")
+        return base, rest[:-1]
+    return name, ""
+
+
+def _parse_labels(label_str: str) -> List[tuple]:
+    return [(m.group(1), m.group(2)) for m in
+            re.finditer(r'(\w+)="([^"]*)"', label_str)]
+
+
 def _prom_name(name: str) -> str:
     return _PROM_PREFIX + re.sub(r"[^a-zA-Z0-9_]", "_", name)
 
 
 def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
     """Prometheus text exposition (counters/gauges plus summary-style
-    quantiles for histograms)."""
+    quantiles for histograms).  Names written via :func:`labeled` render
+    with real label sets; a ``# TYPE`` line is emitted once per base
+    family."""
     if snap is None:
         snap = snapshot()
     lines = [f"# lightgbm_tpu metrics ({snap.get('schema')})"]
+    typed = set()
+
+    def emit(name, typ):
+        base, labels = _split_labels(name)
+        pn = _prom_name(base)
+        if pn not in typed:
+            typed.add(pn)
+            lines.append(f"# TYPE {pn} {typ}")
+        return pn, labels
+
     for name in sorted(snap.get("counters", {})):
-        pn = _prom_name(name)
-        lines.append(f"# TYPE {pn} counter")
-        lines.append(f"{pn} {snap['counters'][name]}")
+        pn, labels = emit(name, "counter")
+        sfx = f"{{{labels}}}" if labels else ""
+        lines.append(f"{pn}{sfx} {snap['counters'][name]}")
     for name in sorted(snap.get("gauges", {})):
-        pn = _prom_name(name)
-        lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {snap['gauges'][name]}")
+        pn, labels = emit(name, "gauge")
+        sfx = f"{{{labels}}}" if labels else ""
+        lines.append(f"{pn}{sfx} {snap['gauges'][name]}")
     for name in sorted(snap.get("histograms", {})):
         h = snap["histograms"][name]
-        pn = _prom_name(name)
-        lines.append(f"# TYPE {pn} summary")
+        pn, labels = emit(name, "summary")
+        sfx = f"{{{labels}}}" if labels else ""
+        pre = labels + "," if labels else ""
         for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
             v = h.get(key)
             if v is not None:
-                lines.append(f'{pn}{{quantile="{q}"}} {v}')
-        lines.append(f"{pn}_sum {h.get('sum', 0.0)}")
-        lines.append(f"{pn}_count {h.get('count', 0)}")
+                lines.append(f'{pn}{{{pre}quantile="{q}"}} {v}')
+        lines.append(f"{pn}_sum{sfx} {h.get('sum', 0.0)}")
+        lines.append(f"{pn}_count{sfx} {h.get('count', 0)}")
     ev = snap.get("events_total")
     if ev is not None:
         pn = _prom_name("events_total")
@@ -519,3 +586,201 @@ def merge_event_files(paths: List[str], out_path: str) -> int:
         for rec in records:
             fh.write(json.dumps(rec, default=str) + "\n")
     return len(records)
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics aggregation (parallel/launcher.py)
+# ---------------------------------------------------------------------------
+
+FLEET_SCHEMA = "lgbmtpu-fleet-metrics-v1"
+
+
+def _merge_hist_summaries(summaries: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-rank histogram summaries: count/sum/min/max combine
+    exactly; percentiles recompute from the concatenated reservoirs when
+    the snapshots carry samples (``include_samples=True``, the per-rank
+    writer default), else fall back to a count-weighted average of the
+    per-rank estimates (approximate, better than dropping them)."""
+    count = sum(int(s.get("count") or 0) for s in summaries)
+    total = sum(float(s.get("sum") or 0.0) for s in summaries)
+    mins = [s["min"] for s in summaries if s.get("min") is not None]
+    maxs = [s["max"] for s in summaries if s.get("max") is not None]
+    out: Dict[str, Any] = {
+        "count": count, "sum": total,
+        "min": min(mins) if mins else None,
+        "max": max(maxs) if maxs else None,
+    }
+    samples: List[float] = []
+    for s in summaries:
+        samples.extend(s.get("samples") or [])
+    if samples:
+        samples.sort()
+        for key, p in (("p50", 50), ("p90", 90), ("p99", 99)):
+            out[key] = _percentile_of(samples, p)
+        return out
+    for key in ("p50", "p90", "p99"):
+        num = den = 0.0
+        for s in summaries:
+            v, c = s.get(key), int(s.get("count") or 0)
+            if v is not None and c > 0:
+                num += v * c
+                den += c
+        out[key] = (num / den) if den else None
+    return out
+
+
+def merge_snapshot_files(paths: List[str],
+                         out_path: Optional[str] = None) -> Dict[str, Any]:
+    """Merge per-rank snapshot files into one fleet-level document (schema
+    ``lgbmtpu-fleet-metrics-v1``): counters SUM, gauges MAX, histogram
+    reservoirs merge (:func:`_merge_hist_summaries`), ``events_total``
+    sums.  Missing or invalid rank files are skipped, not fatal — a
+    crashed worker leaves whatever its periodic writer got out, possibly
+    nothing, and the fleet artifact must still be written on kill paths.
+    ``out_path`` additionally writes the document atomically."""
+    ranks: Dict[str, Dict[str, Any]] = {}
+    skipped: List[str] = []
+    for i, p in enumerate(paths):
+        try:
+            snap = load_snapshot(p)
+        except (OSError, ValueError):
+            skipped.append(os.path.basename(os.fspath(p)))
+            continue
+        rank = snap.get("rank")
+        ranks[str(rank if rank is not None else i)] = snap
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
+    hist_parts: Dict[str, List[Dict[str, Any]]] = {}
+    events_total = 0
+    for snap in ranks.values():
+        for n, v in snap["counters"].items():
+            counters[n] = counters.get(n, 0) + int(v)
+        for n, v in snap["gauges"].items():
+            gauges[n] = max(gauges.get(n, float("-inf")), float(v))
+        for n, h in snap["histograms"].items():
+            hist_parts.setdefault(n, []).append(h)
+        events_total += int(snap.get("events_total") or 0)
+    fleet = {
+        "schema": FLEET_SCHEMA,
+        "ts": time.time(),
+        "num_ranks": len(ranks),
+        "skipped": skipped,
+        "ranks": ranks,
+        "aggregate": {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {n: _merge_hist_summaries(parts)
+                           for n, parts in hist_parts.items()},
+            "events_total": events_total,
+        },
+    }
+    if out_path is not None:
+        _atomic_write_json(out_path, fleet)
+    return fleet
+
+
+def load_fleet_metrics(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as fh:
+        fleet = json.load(fh)
+    validate_fleet_metrics(fleet)
+    return fleet
+
+
+def validate_fleet_metrics(fleet: Any) -> None:
+    """Raise ValueError unless ``fleet`` is a schema-valid fleet metrics
+    document (one entry per rank plus the aggregate)."""
+    if not isinstance(fleet, dict) or fleet.get("schema") != FLEET_SCHEMA:
+        raise ValueError(
+            f"not a {FLEET_SCHEMA} document: schema={fleet.get('schema')!r}"
+            if isinstance(fleet, dict) else "fleet metrics not a JSON object")
+    if not isinstance(fleet.get("ranks"), dict):
+        raise ValueError("fleet field 'ranks' missing or mistyped")
+    for rank, snap in fleet["ranks"].items():
+        try:
+            validate_snapshot(snap)
+        except ValueError as e:
+            raise ValueError(f"rank {rank}: {e}") from None
+    agg = fleet.get("aggregate")
+    if not isinstance(agg, dict):
+        raise ValueError("fleet field 'aggregate' missing or mistyped")
+    for key in ("counters", "gauges", "histograms"):
+        if not isinstance(agg.get(key), dict):
+            raise ValueError(f"aggregate field {key!r} missing or mistyped")
+
+
+def render_prometheus_fleet(fleet: Dict[str, Any]) -> str:
+    """Prometheus exposition for a fleet document: the aggregate unlabeled
+    plus every per-rank series re-labeled ``{rank="<r>"}``."""
+    agg = fleet["aggregate"]
+    counters = dict(agg.get("counters", {}))
+    gauges = dict(agg.get("gauges", {}))
+    hists = dict(agg.get("histograms", {}))
+    for rank, snap in sorted(fleet.get("ranks", {}).items()):
+        for n, v in snap.get("counters", {}).items():
+            counters[labeled(n, rank=rank)] = v
+        for n, v in snap.get("gauges", {}).items():
+            gauges[labeled(n, rank=rank)] = v
+        for n, h in snap.get("histograms", {}).items():
+            hists[labeled(n, rank=rank)] = h
+    pseudo = {
+        "schema": fleet.get("schema"),
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": hists,
+        "events_total": agg.get("events_total"),
+    }
+    return render_prometheus(pseudo)
+
+
+# ---------------------------------------------------------------------------
+# periodic snapshot writer (per-rank flight recorder for the fleet merge)
+# ---------------------------------------------------------------------------
+
+_snap_writer_lock = threading.Lock()
+_snap_writer: Optional[tuple] = None  # (thread, stop_event, path)
+
+
+def start_periodic_snapshots(path: str, period_s: float = 1.0,
+                             include_samples: bool = True) -> None:
+    """Write the registry snapshot to ``path`` atomically NOW and then
+    every ``period_s`` seconds from a daemon thread — the per-rank flight
+    recorder the launcher merges into ``fleet_metrics.json``.  Writing
+    first (not after the first sleep) means even a worker that dies in
+    its first iteration leaves a mergeable file.  One writer per process;
+    restarting moves it to the new path."""
+    stop_periodic_snapshots()
+    stop = threading.Event()
+
+    def _loop() -> None:
+        while True:
+            try:
+                write_snapshot(path, include_samples=include_samples)
+            except OSError:
+                pass  # a full disk must not kill the worker
+            if stop.wait(max(period_s, 0.05)):
+                return
+
+    t = threading.Thread(target=_loop, daemon=True,
+                         name="lgbmtpu-metrics-snapshots")
+    global _snap_writer
+    with _snap_writer_lock:
+        _snap_writer = (t, stop, path)
+    t.start()
+
+
+def stop_periodic_snapshots(final_write: bool = True) -> None:
+    """Stop the periodic writer; by default flush one last exact snapshot
+    so a clean exit's file is not one period stale."""
+    global _snap_writer
+    with _snap_writer_lock:
+        writer, _snap_writer = _snap_writer, None
+    if writer is None:
+        return
+    t, stop, path = writer
+    stop.set()
+    t.join(timeout=5)
+    if final_write:
+        try:
+            write_snapshot(path, include_samples=True)
+        except OSError:
+            pass

@@ -1,0 +1,289 @@
+"""Lightweight span tracing (docs/OBSERVABILITY.md "Span tracing").
+
+Round 10's registry answers "how many / how fast on aggregate"; this module
+answers "WHAT was the process doing when round 412 took 3x its neighbors".
+Spans are named, attributed, nesting host-side intervals:
+
+    with trace.span("boost_round", iteration=i) as sp:
+        ...
+        sp.set(dispatches=3)
+
+plus :func:`record_span` for the retroactive form — an interval whose end
+the caller anchors at an **accounted sync point** it already paid for (the
+windowed grower's one-round-behind async info resolve, the predict entry's
+``sync_pull``).  That split embodies the zero-dispatch rule:
+
+* opening/closing a span NEVER touches a device value.  A span close that
+  performs a fresh host pull to "drain" the queue would add the blocking
+  sync the round-7 protocol removed — jaxlint R10 ``sync-in-span-close``
+  statically bans exactly that, the tracing twin of R9's mistiming class.
+* consequently a context-manager span measures HOST-CAUSAL wall clock
+  (async device work dispatched inside it may still be in flight at
+  close).  Spans that must cover device time are recorded retroactively
+  at the next accounted sync (``windowed_round``, ``predict.*``) — the
+  instrumented layers own that anchoring, not this module.
+
+Finished spans land in a bounded ring (cap :data:`TRACE_RING_CAP`) and
+export as Chrome-trace / Perfetto-loadable JSON (:func:`to_chrome_trace`,
+:func:`write_trace`; ``python -m lightgbm_tpu.obs trace`` is the CLI form,
+``trace_file=`` the Config param).  The exported file keeps the raw span
+records under a ``"lgbmtpu"`` key (schema :data:`SCHEMA_TRACE`) so it
+round-trips through the CLI while chrome://tracing and ui.perfetto.dev
+read the standard ``traceEvents`` list.
+
+On-chip correlation: :func:`set_annotation_factory` accepts a callable
+``(name, attrs) -> context manager`` entered for the body of every
+context-manager span.  ``utils/profiling.py`` installs a
+``jax.profiler.TraceAnnotation``/``StepTraceAnnotation`` factory when
+``LGBMTPU_JAX_PROFILER=1``, lining host spans up with XLA device traces —
+the jax bridge lives in that (jax-importing) layer, never here: this
+module stays stdlib-only like the rest of ``lightgbm_tpu/obs``.
+
+Enablement follows the metrics registry (``telemetry=false`` /
+``LGBMTPU_TELEMETRY=0`` silences spans too); a disabled span is a cheap
+no-op object.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, ContextManager, Dict, List, Optional
+
+from . import metrics as _metrics
+
+SCHEMA_TRACE = "lgbmtpu-trace-v1"
+TRACE_RING_CAP = 8192
+
+_lock = threading.RLock()
+_ring: "collections.deque" = collections.deque(maxlen=TRACE_RING_CAP)
+_ids = itertools.count(1)
+_tls = threading.local()
+_annotation_factory: Optional[
+    Callable[[str, Dict[str, Any]], ContextManager]] = None
+
+
+def set_annotation_factory(
+        fn: Optional[Callable[[str, Dict[str, Any]], ContextManager]]
+) -> None:
+    """Install (or clear, with None) the device-annotation mirror used by
+    context-manager spans.  The factory must be cheap and must not raise;
+    utils/profiling.py installs the jax.profiler one behind
+    ``LGBMTPU_JAX_PROFILER=1``."""
+    global _annotation_factory
+    _annotation_factory = fn
+
+
+def _stack() -> List["Span"]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class Span:
+    """One open span.  Use via :func:`span`; ``set(**attrs)`` attaches
+    attributes any time before close."""
+
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "depth",
+                 "_ts", "_t0", "_annotation", "_recorded")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]) -> None:
+        self.name = name
+        self.attrs = attrs
+        self.span_id = next(_ids)
+        self.parent_id: Optional[int] = None
+        self.depth = 0
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        self._annotation: Optional[ContextManager] = None
+        self._recorded = False
+
+    def set(self, **attrs: Any) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    # -- context protocol ------------------------------------------------
+    def __enter__(self) -> "Span":
+        st = _stack()
+        if st:
+            self.parent_id = st[-1].span_id
+            self.depth = st[-1].depth + 1
+        st.append(self)
+        fac = _annotation_factory
+        if fac is not None:
+            try:
+                self._annotation = fac(self.name, self.attrs)
+                self._annotation.__enter__()
+            except Exception:  # noqa: BLE001 — a broken profiler bridge
+                self._annotation = None  # must never take training down
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # close = read the host clock and append to the ring.  NOTHING
+        # else belongs here — in particular no device pull (jaxlint R10):
+        # a span that must cover device time is recorded retroactively at
+        # an accounted sync via record_span().
+        dur = time.perf_counter() - self._t0
+        if self._annotation is not None:
+            try:
+                self._annotation.__exit__(exc_type, exc, tb)
+            except Exception:  # noqa: BLE001
+                pass
+            self._annotation = None
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        elif self in st:  # mis-nested close: drop self + anything above
+            del st[st.index(self):]
+        if not self._recorded:
+            self._recorded = True
+            if exc_type is not None:
+                self.attrs.setdefault("error", exc_type.__name__)
+            _append(self.name, self._ts, dur, self.attrs,
+                    span_id=self.span_id, parent_id=self.parent_id,
+                    depth=self.depth)
+        return None
+
+
+class _NoopSpan:
+    """Returned while telemetry is disabled: absorbs the protocol."""
+
+    __slots__ = ()
+
+    def set(self, **attrs: Any) -> "_NoopSpan":
+        return self
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str, **attrs: Any):
+    """Open a nesting span around a host-side section.  Records a ring
+    entry on close; mirrors into the installed device-annotation factory
+    (jax.profiler) when one is set."""
+    if not _metrics.enabled():
+        return _NOOP
+    return Span(name, attrs)
+
+
+def record_span(name: str, duration_s: float, **attrs: Any) -> None:
+    """Record a span that ENDS NOW and lasted ``duration_s`` — the
+    retroactive form for intervals anchored at an accounted sync point the
+    caller just passed (async info resolve, ``sync_pull``).  Does not
+    nest (no stack interaction) and never touches a device value."""
+    if not _metrics.enabled():
+        return
+    dur = max(float(duration_s), 0.0)
+    _append(name, time.time() - dur, dur, attrs)
+
+
+def _append(name: str, ts: float, dur: float, attrs: Dict[str, Any],
+            span_id: Optional[int] = None, parent_id: Optional[int] = None,
+            depth: int = 0) -> None:
+    rec = {
+        "name": name,
+        "ts": ts,
+        "dur": dur,
+        "tid": threading.get_ident(),
+        "depth": depth,
+        "attrs": dict(attrs),
+    }
+    if span_id is not None:
+        rec["id"] = span_id
+    if parent_id is not None:
+        rec["parent"] = parent_id
+    with _lock:
+        _ring.append(rec)
+
+
+def spans(name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Finished spans currently in the ring (oldest first)."""
+    with _lock:
+        out = list(_ring)
+    if name is not None:
+        out = [s for s in out if s["name"] == name]
+    return out
+
+
+def reset_trace() -> None:
+    """Clear the span ring (tests)."""
+    with _lock:
+        _ring.clear()
+    _tls.stack = []
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace / Perfetto export
+# ---------------------------------------------------------------------------
+
+def to_chrome_trace(
+        span_list: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+    """Chrome Trace Event Format dict (complete "X" events, microsecond
+    timestamps) that chrome://tracing and ui.perfetto.dev load directly.
+    The raw span records ride along under ``"lgbmtpu"`` so the file
+    round-trips through :func:`load_trace` / the obs CLI."""
+    if span_list is None:
+        span_list = spans()
+    pid = os.getpid()
+    events = []
+    for s in span_list:
+        ev = {
+            "name": s["name"],
+            "cat": "lgbmtpu",
+            "ph": "X",
+            "ts": s["ts"] * 1e6,
+            "dur": s["dur"] * 1e6,
+            "pid": pid,
+            "tid": s.get("tid", 0),
+            "args": s.get("attrs", {}),
+        }
+        events.append(ev)
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "lgbmtpu": {"schema": SCHEMA_TRACE, "spans": span_list},
+    }
+
+
+def write_trace(path: str,
+                span_list: Optional[List[Dict[str, Any]]] = None) -> int:
+    """Atomically write the Chrome-trace JSON for ``span_list`` (default:
+    the live ring).  Returns the number of spans written."""
+    doc = to_chrome_trace(span_list)
+    _metrics._atomic_write_json(path, doc)
+    return len(doc["traceEvents"])
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    """Load + validate a trace file written by :func:`write_trace`.
+    Raises ValueError on anything that is not a schema-valid trace."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    validate_trace(doc)
+    return doc
+
+
+def validate_trace(doc: Any) -> None:
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise ValueError("not a Chrome-trace JSON document "
+                         "(missing traceEvents list)")
+    meta = doc.get("lgbmtpu")
+    if not isinstance(meta, dict) or meta.get("schema") != SCHEMA_TRACE:
+        raise ValueError(
+            f"not a {SCHEMA_TRACE} trace: lgbmtpu.schema="
+            f"{meta.get('schema')!r}" if isinstance(meta, dict)
+            else "missing lgbmtpu trace metadata")
+    if not isinstance(meta.get("spans"), list):
+        raise ValueError("lgbmtpu.spans missing or mistyped")

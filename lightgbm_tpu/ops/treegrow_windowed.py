@@ -66,6 +66,7 @@ import jax
 import jax.numpy as jnp
 
 from ..obs import metrics as _obs
+from ..obs import trace as _trace
 from ..utils import degrade as _degrade
 from ..utils import sanitizer as _san
 from ..utils.guards import NonFiniteError
@@ -712,6 +713,12 @@ def _grow_windowed_impl(
     retries = 0
     windows: list = []
     import time as _time
+    t_open = _time.perf_counter()
+    # span anchor: per-round spans close ONLY at the accounted async-info
+    # resolves below (the round-7 protocol's existing sync points), so the
+    # intervals are device-inclusive without adding a single pull — the
+    # pattern jaxlint R10 pins for span closes
+    t_resolve_prev: Optional[float] = None
     t_last = _time.perf_counter() if prof else 0.0
     # every productive round admits >= 1 split, reads lag 1 round, plus
     # defensive headroom for retried (skipped) rounds
@@ -752,6 +759,18 @@ def _grow_windowed_impl(
                 _obs.histogram("train_window_rows").observe(total)
                 _obs.histogram("train_window_fill").observe(
                     total / max(w_ran, 1))
+                # the resolve we just did IS an accounted sync: the
+                # resolve-to-resolve interval is the honest wall clock of
+                # the round that retired between them (the first one also
+                # carries init + pipeline fill, flagged in the attrs)
+                t_now = _time.perf_counter()
+                _trace.record_span(
+                    "windowed_round",
+                    t_now - (t_resolve_prev if t_resolve_prev is not None
+                             else t_open),
+                    round=resolved, k_acc=k_acc, rows=total, W=w_ran,
+                    first=t_resolve_prev is None)
+                t_resolve_prev = t_now
             if not finite:
                 _obs.counter("train_nonfinite_errors_total").inc()
                 _obs.event("nonfinite", phase="windowed", round=resolved)
@@ -787,6 +806,20 @@ def _grow_windowed_impl(
         while pending:
             info = _san.async_pull_result(pending.pop(0))
             resolved += 1
+            if _obs.enabled():
+                # drained rounds get their span too — the trace must hold
+                # exactly `rounds` windowed_round spans per tree (the last
+                # round of a tree resolves HERE, one dispatch behind), and
+                # this resolve is just as accounted as the in-loop one
+                t_now = _time.perf_counter()
+                _trace.record_span(
+                    "windowed_round",
+                    t_now - (t_resolve_prev if t_resolve_prev is not None
+                             else t_open),
+                    round=resolved, k_acc=int(info[0]), rows=int(info[1]),
+                    W=windows[resolved - 1],
+                    first=t_resolve_prev is None, drained=True)
+                t_resolve_prev = t_now
             if not int(info[4]):
                 _obs.counter("train_nonfinite_errors_total").inc()
                 _obs.event("nonfinite", phase="windowed_drain",
@@ -812,6 +845,14 @@ def _grow_windowed_impl(
                        dispatches=counter.dispatches,
                        host_syncs=counter.host_syncs,
                        async_resolves=counter.async_resolves)
+            # tree-level span closing here, right after the drain loop's
+            # final accounted resolve emptied `pending` — every dispatched
+            # round's info has been read, so the interval covers the whole
+            # tree's device work without adding a sync
+            _trace.record_span("windowed_tree",
+                               _time.perf_counter() - t_open,
+                               rounds=rounds, retries=retries,
+                               dispatches=counter.dispatches)
     if not converged:
         # the safety headroom ran out (repeated window-bound breaches):
         # growth stopped early with a valid but under-grown tree — make

@@ -53,6 +53,19 @@ from lightgbm_tpu.parallel.distributed import init_distributed
 shard = np.load(os.environ["LGBM_TPU_SHARD"], allow_pickle=True)
 net = {k: shard[k].item() for k in ("num_machines", "machines",
                                     "local_listen_port", "time_out")}
+
+# per-rank metrics flight recorder (docs/OBSERVABILITY.md "Fleet
+# metrics"): atomic snapshot writes start BEFORE the rendezvous and
+# repeat every period, so even a rank that dies mid-round leaves a
+# mergeable file for the launcher's fleet_metrics.json
+from lightgbm_tpu.obs import metrics as _obs_metrics
+
+_snap_path = os.environ.get("LGBMTPU_METRICS_SNAPSHOT_FILE")
+if _snap_path:
+    _obs_metrics.start_periodic_snapshots(
+        _snap_path,
+        float(os.environ.get("LGBMTPU_METRICS_SNAPSHOT_PERIOD_S", "1.0")))
+
 assert init_distributed(Config.from_dict(net))
 
 import lightgbm_tpu as lgb
@@ -112,8 +125,18 @@ if rank == "0":
                              for d, m in evals_result.items()}}
     with open(out + ".meta.json", "w") as fh:
         json.dump(meta, fh)
+if _snap_path:
+    # stop the writer and flush one exact final snapshot — a clean exit's
+    # fleet entry must not be a period stale
+    _obs_metrics.stop_periodic_snapshots()
 print("LAUNCHER_RANK_OK", rank, flush=True)
 """
+
+
+# the most recent train_distributed launch directory — lets callers and
+# tests locate fleet_events.jsonl / fleet_metrics.json after a FAILED
+# launch too (the success path exposes them on the returned booster)
+_LAST_LAUNCH_DIR: Optional[str] = None
 
 
 class WorkerFailure(RuntimeError):
@@ -235,6 +258,22 @@ def aggregate_fleet_events(tmp: str, num_machines: int,
         paths.append(own)
     out = os.path.join(tmp, "fleet_events.jsonl")
     _obs.merge_event_files(paths, out)
+    return out
+
+
+def aggregate_fleet_metrics(tmp: str, num_machines: int) -> str:
+    """Merge per-rank metrics snapshot files (the periodic atomic writes
+    each worker's obs layer keeps under ``<tmp>/worker<rank>.metrics.json``)
+    into ``<tmp>/fleet_metrics.json`` — schema ``lgbmtpu-fleet-metrics-v1``,
+    one entry per rank plus the aggregate (counters SUM, gauges MAX,
+    latency reservoirs merged).  Missing rank files (a worker killed
+    before its first write) are skipped, not fatal: this runs on success
+    AND on every kill/crash exit path, and a partial fleet artifact still
+    answers "which rank was behind / who died with what counters"."""
+    paths = [os.path.join(tmp, f"worker{r}.metrics.json")
+             for r in range(num_machines)]
+    out = os.path.join(tmp, "fleet_metrics.json")
+    _obs.merge_snapshot_files(paths, out)
     return out
 
 
@@ -373,7 +412,8 @@ def train_distributed(
         eval_plans.append((np.asarray(Xe), np.asarray(ye).ravel(), we,
                            sl, gr, pe, name))
 
-    tmp = tempfile.mkdtemp(prefix="lgbm_tpu_launch_")
+    global _LAST_LAUNCH_DIR
+    tmp = _LAST_LAUNCH_DIR = tempfile.mkdtemp(prefix="lgbm_tpu_launch_")
     params_path = os.path.join(tmp, "params.npz")
     np.savez(params_path, params=np.asarray(dict(params), dtype=object))
     model_out = os.path.join(tmp, "model.txt")
@@ -434,6 +474,12 @@ def train_distributed(
             # the launcher merges them into one fleet-level file afterwards
             env["LGBMTPU_EVENTS_FILE"] = os.path.join(
                 tmp, f"worker{rank}.events.jsonl")
+            # per-rank metrics flight recorder: the worker body writes
+            # atomic snapshots here periodically (and one exact final
+            # write on clean exit); aggregate_fleet_metrics merges them
+            # into fleet_metrics.json on every exit path
+            env["LGBMTPU_METRICS_SNAPSHOT_FILE"] = os.path.join(
+                tmp, f"worker{rank}.metrics.json")
             if env.get("LGBMTPU_FAULT"):
                 # make injected faults once-only ACROSS restarts, so a
                 # relaunched fleet runs clean (utils/faults.py)
@@ -486,8 +532,16 @@ def train_distributed(
         except OSError as e:
             log_warning(f"could not write fleet_events.jsonl: {e}")
             fleet_events = None
+        # the metrics twin: merge whatever per-rank snapshot files exist
+        # (periodic atomic writes survive kills) — success AND kill paths
+        try:
+            fleet_metrics = aggregate_fleet_metrics(tmp, num_machines)
+        except OSError as e:
+            log_warning(f"could not write fleet_metrics.json: {e}")
+            fleet_metrics = None
     booster = lgb.Booster(model_file=model_out + ".rank0")
     booster._fleet_events = fleet_events
+    booster._fleet_metrics = fleet_metrics
     meta_path = model_out + ".meta.json"
     if os.path.exists(meta_path):
         with open(meta_path) as fh:

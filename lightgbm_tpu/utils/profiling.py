@@ -18,6 +18,7 @@ dump.
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Dict, Iterator
 
@@ -25,6 +26,7 @@ import jax
 import numpy as np
 
 from ..obs import metrics as _obs
+from ..obs import trace as _trace
 from .log import log_info
 
 
@@ -52,6 +54,32 @@ def annotate(name: str) -> Iterator[None]:
     (jax.profiler.TraceAnnotation)."""
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def _jax_annotation_factory(name: str, attrs: dict):
+    """obs/trace.py annotation factory: spans carrying a ``step``/
+    ``iteration`` attribute mirror into StepTraceAnnotation (so the
+    profiler's step view lines up with boosting iterations), everything
+    else into TraceAnnotation."""
+    step = attrs.get("step", attrs.get("iteration"))
+    if step is not None:
+        return jax.profiler.StepTraceAnnotation(name, step_num=int(step))
+    return jax.profiler.TraceAnnotation(name)
+
+
+def install_jax_annotations() -> None:
+    """Mirror every context-manager span (obs/trace.py) into jax.profiler
+    annotations, lining host spans up with on-chip XLA traces captured via
+    :func:`device_trace`.  The obs package itself stays stdlib-only: THIS
+    module (which already imports jax) owns the bridge, and it is
+    installed automatically when ``LGBMTPU_JAX_PROFILER=1`` — the layers
+    that open spans (models/gbdt.py, engine) import this module, so the
+    env opt-in needs no further wiring."""
+    _trace.set_annotation_factory(_jax_annotation_factory)
+
+
+if os.environ.get("LGBMTPU_JAX_PROFILER") == "1":
+    install_jax_annotations()
 
 
 @contextlib.contextmanager

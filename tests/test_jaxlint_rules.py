@@ -1253,3 +1253,111 @@ def test_r9_pragma_suppression(tmp_path):
     """}, rules=["R9"])
     assert not rep.findings
     assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R10 sync-in-span-close
+# ---------------------------------------------------------------------------
+
+def test_r10_positive_pull_in_span_exit(tmp_path):
+    """A Span __exit__ that pulls the device value to 'drain for the
+    timer' — one hidden blocking sync per span, the class R10 exists
+    for."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import numpy as np
+
+        class TraceSpan:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                _ = np.asarray(self.result)
+                self.dur = time.perf_counter() - self.t0
+    """}, rules=["R10"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "R10"
+    assert rep.findings[0].line == 11
+
+
+def test_r10_positive_block_until_ready_in_span_close(tmp_path):
+    """close()-spelled span finalizers are the same close path, and
+    block_until_ready is the same fresh drain."""
+    rep = _scan(tmp_path, {"mod.py": """
+        class SpanRecorder:
+            def close(self):
+                self.out.block_until_ready()
+                self.done = True
+    """}, rules=["R10"])
+    assert len(rep.findings) == 1, rep.findings
+
+
+def test_r10_positive_contextmanager_span_tail(tmp_path):
+    """A @contextmanager generator named like a span: the code after the
+    yield IS the close path."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import contextlib
+        import numpy as np
+
+        @contextlib.contextmanager
+        def device_span(name, x):
+            yield
+            _ = np.asarray(x)
+    """}, rules=["R10"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].line == 8
+
+
+def test_r10_negative_clean_close_and_accounted_sync(tmp_path):
+    """A close that only reads the host clock is the designed pattern;
+    sanitizer-routed accounted reads (sync_pull/async_pull_result) are
+    closing AT an accounted sync — allowed, not flagged.  Pulls before
+    the yield (the OPEN path of a cm span) are not close-path either."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import contextlib
+        import time
+        import numpy as np
+
+        class Span:
+            def __exit__(self, *exc):
+                self.dur = time.perf_counter() - self.t0
+                self.ring.append(self.dur)
+
+        class ResolveSpan:
+            def __exit__(self, *exc):
+                info = self.san.async_pull_result(self.pending)
+                self.attrs["k"] = int(info[0])
+
+        @contextlib.contextmanager
+        def warmup_span(x):
+            _ = np.asarray(x)
+            yield
+    """}, rules=["R10"])
+    assert not rep.findings, rep.findings
+
+
+def test_r10_negative_non_span_close_not_matched(tmp_path):
+    """Ordinary resource closes pull-at-will — R10 is scoped to span
+    closes, not every __exit__ in the tree."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        class FileSink:
+            def __exit__(self, *exc):
+                self.fh.write(str(np.asarray(self.buf)))
+                self.fh.close()
+    """}, rules=["R10"])
+    assert not rep.findings, rep.findings
+
+
+def test_r10_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        class DebugSpan:
+            def __exit__(self, *exc):
+                _ = np.asarray(self.x)  # jaxlint: disable=R10 (fixture: debug span, sync cost accepted)
+    """}, rules=["R10"])
+    assert not rep.findings
+    assert len(rep.suppressed) == 1

@@ -25,11 +25,18 @@ from lightgbm_tpu.utils.profiling import (device_trace, log_timings,
 
 @pytest.fixture(autouse=True)
 def _fresh_registry():
+    from lightgbm_tpu.obs import server as _srv
+    from lightgbm_tpu.obs import trace as _trc
+
     obs.reset()
     obs.set_events_file(None)
+    _trc.reset_trace()
     yield
+    _srv.stop_server()
+    obs.stop_periodic_snapshots(final_write=False)
     obs.reset()
     obs.set_events_file(None)
+    _trc.reset_trace()
 
 
 def _tiny_train(extra=None, rounds=3):
@@ -255,20 +262,30 @@ def test_telemetry_param_disables_registry():
 # ---------------------------------------------------------------------------
 
 def test_budgets_hold_with_telemetry_on_and_snapshot_covers_run(tmp_path):
-    """ISSUE 5 acceptance: train (windowed steady-state round budget) +
-    predict (warm serving budget) with the registry active, then assert a
-    schema-valid snapshot covering train, predict, and a robustness event
-    (an injected kernel degrade)."""
+    """ISSUE 5 acceptance, extended by ISSUE 6: train (windowed
+    steady-state round budget) + predict (warm serving budget) with the
+    registry active, SPAN TRACING recording, and the HTTP endpoint
+    serving live — then assert a schema-valid snapshot covering train,
+    predict, and a robustness event (an injected kernel degrade).  The
+    round-11 contract is that live introspection adds zero dispatches,
+    zero blocking syncs, and zero retraces to both budgets."""
+    import json as _json
+    import urllib.request
+
     import jax
     import jax.numpy as jnp
 
     from lightgbm_tpu.binning import DatasetBinner
+    from lightgbm_tpu.obs import server as obs_server
+    from lightgbm_tpu.obs import trace as obs_trace
     from lightgbm_tpu.ops.split import SplitParams
     from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
     from lightgbm_tpu.utils import degrade
     from lightgbm_tpu.utils.sanitizer import DispatchCounter
 
     assert obs.enabled()  # default-on is the contract under test
+    obs_trace.reset_trace()
+    srv = obs_server.MetricsServer(port=0).start()  # live while we train
 
     # -- train side: the round-7 budget pin with telemetry recording -----
     n, f = 900, 8
@@ -298,9 +315,22 @@ def test_budgets_hold_with_telemetry_on_and_snapshot_covers_run(tmp_path):
         tree, leaf = grow_tree_windowed(bins_t, g1, hess, **kw, **static,
                                         stats=stats)
         jax.block_until_ready(leaf)
-    d.assert_round_budget(stats["rounds"], what="windowed + telemetry")
+    d.assert_round_budget(stats["rounds"],
+                          what="windowed + telemetry + tracing + server")
     assert stats["host_syncs"] == 0 and stats["retries"] == 0, stats
     d.assert_no_recompile("windowed steady state with telemetry on")
+    # the grower left per-round + per-tree spans, all closed at the
+    # accounted async-info resolves (ZERO extra syncs, pinned just above)
+    assert obs_trace.spans("windowed_round"), "no windowed_round spans"
+    assert obs_trace.spans("windowed_tree"), "no windowed_tree spans"
+    # reconciliation: every dispatched round has its span — the pipeline's
+    # final in-flight round resolves in the drain loop and must be traced
+    # there too (its spans carry drained=True)
+    total_rounds = sum(s["attrs"]["rounds"]
+                       for s in obs_trace.spans("windowed_tree"))
+    assert len(obs_trace.spans("windowed_round")) == total_rounds
+    assert any(s["attrs"].get("drained")
+               for s in obs_trace.spans("windowed_round"))
 
     # -- predict side: the round-9 warm budget with telemetry recording --
     bst, Xb, _ = _tiny_train(rounds=4)
@@ -310,6 +340,25 @@ def test_budgets_hold_with_telemetry_on_and_snapshot_covers_run(tmp_path):
     assert dp.dispatches == 1, dp.dispatches
     assert dp.host_syncs == 1, dp.host_syncs
     dp.assert_no_recompile("warm predict with telemetry on")
+    assert obs_trace.spans("predict.raw"), "no predict spans"
+    assert obs_trace.spans("boost_round"), "no boost_round spans"
+
+    # -- the HTTP endpoint served the whole run and sees both families --
+    prom_live = urllib.request.urlopen(
+        srv.url("/metrics"), timeout=10).read().decode()
+    assert "lgbmtpu_train_windowed_rounds_total" in prom_live
+    assert "lgbmtpu_predict_requests_total" in prom_live
+    assert 'lgbmtpu_predict_warm_latency_ms{bucket="' in prom_live
+    hz = urllib.request.urlopen(srv.url("/healthz"), timeout=10)
+    assert _json.load(hz)["status"] == "ok"
+    srv.stop()
+
+    # -- trace export round-trips as Chrome-trace JSON -------------------
+    tpath = str(tmp_path / "run_trace.json")
+    from lightgbm_tpu.obs import trace as _t
+    assert _t.write_trace(tpath) > 0
+    doc = _t.load_trace(tpath)
+    assert all(ev["ph"] == "X" for ev in doc["traceEvents"])
 
     # -- robustness event: an injected kernel degrade -------------------
     degrade.reset()
