@@ -43,6 +43,12 @@ instead (benchmarks/predict_bench.py — cold compile, warm rows/sec,
 p50/p99 batch latency over batch sizes x ensemble sizes) and emits a
 {"metric": "predict_rows_per_sec*", ...} artifact row with the same
 incremental un-losable contract; its knobs are PREDICT_BENCH_*.
+
+Out-of-core mode (round 12): BENCH_MODE=ooc runs the data-path levers
+(benchmarks/ooc_bench.py — stream-ingest rows/s vs chunk size,
+spill-training rows/s with bitwise parity asserted, and the partition
+move-phase timing at segment fractions that the HBM-resident DMA kernel
+must flatten on chip); knobs OOC_BENCH_*.
 """
 
 import json
@@ -328,6 +334,13 @@ def main():
         from benchmarks.predict_bench import main as predict_main
 
         return predict_main()
+    if os.environ.get("BENCH_MODE") == "ooc":
+        # out-of-core/partition data-path levers (BENCH_ooc_* artifact)
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.ooc_bench import main as ooc_main
+
+        return ooc_main()
     # persistent XLA compilation cache (measured r5: cuts warmups ~2.4x on
     # the second process — kernel smoke 31->21 s, primary compile
     # 104->43 s — the warmups were the reason Epsilon kept falling off the

@@ -123,11 +123,70 @@ def bench_predict(n_rows=2000, n_trees=24, iters=20):
     return n_rows * iters / (time.perf_counter() - t0), err
 
 
+def bench_ooc(n_rows=3000, n_feat=8, rounds=3):
+    """Out-of-core smoke (round 12, runs off-chip in seconds): trains
+    from a ``save_binary`` cache in BOTH out-of-core regimes — resident
+    (stream-assembled device matrix) and spill (chunked-histogram
+    grower) — ASSERTS bitwise model parity against plain in-memory
+    training, checks the snapshot carries the OOC keys, and reports
+    streamed rows/sec for the spill run."""
+    import tempfile
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import metrics as _obs
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(n_rows, n_feat)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 255,
+              "verbosity": -1, "feature_pre_filter": False}
+
+    def train(ds):
+        bst = lgb.Booster(params=params, train_set=ds)
+        for _ in range(rounds):
+            bst.update()
+        return bst.model_to_string()
+
+    want = train(lgb.Dataset(X, label=y, params=dict(params)))
+    with tempfile.TemporaryDirectory() as td:
+        cache = os.path.join(td, "smoke.bin")
+        base = lgb.Dataset(X, label=y, params=dict(params))
+        base.construct()
+        base.save_binary(cache)
+
+        resident = lgb.Dataset(cache, params=dict(
+            params, out_of_core=True, out_of_core_chunk_rows=257))
+        got = train(resident)
+        assert got == want, "resident OOC diverged from in-memory"
+        assert resident.bins is None, "resident OOC materialized host bins"
+
+        spill = lgb.Dataset(cache, params=dict(
+            params, out_of_core=True, max_rows_in_hbm=n_rows // 4,
+            out_of_core_chunk_rows=512))
+        # delta, not the cumulative process-global counter: earlier OOC
+        # work in this process must not inflate rows/sec (the pattern
+        # ooc_bench.bench_spill_train uses)
+        passes0 = _obs.counter("train_ooc_passes_total").value
+        t0 = time.perf_counter()
+        got = train(spill)
+        dt = time.perf_counter() - t0
+        assert got == want, "spill OOC diverged from in-memory"
+        assert spill.ooc_spill and spill.bins_device is None
+
+    snap = _obs.snapshot()
+    _obs.validate_snapshot(snap)
+    for key in ("train_ooc_passes_total", "train_ooc_chunks_total"):
+        assert key in snap["counters"], f"metrics snapshot missing {key}"
+    passes = snap["counters"]["train_ooc_passes_total"] - passes0
+    return n_rows * passes / dt, passes
+
+
 def main():
     n = int(os.environ.get("SMOKE_ROWS", 1_000_000))
     iters = int(os.environ.get("SMOKE_ITERS", 10))
     which = (sys.argv[1].split(",") if len(sys.argv) > 1
-             else ["rank", "multiclass", "predict"])
+             else ["rank", "multiclass", "predict", "ooc"])
     if "rank" in which:
         ips = bench_rank(n, q_len=128, iters=iters)
         print(f"lambdarank {n//1000}k rows x64f q128 63bins: {ips:.2f} iters/sec", flush=True)
@@ -138,6 +197,11 @@ def main():
         rps, err = bench_predict()
         print(f"predict 2k rows x16f T24: {rps:.0f} rows/sec warm "
               f"(1 dispatch/call, host-walk parity {err:.1e})", flush=True)
+    if "ooc" in which:
+        rps, passes = bench_ooc()
+        print(f"out_of_core 3k rows x8f: {rps:.0f} streamed rows/sec spill "
+              f"({passes} hist passes, resident+spill bitwise parity)",
+              flush=True)
 
 
 if __name__ == "__main__":

@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R10.
+"""jaxlint built-in rules R1-R11.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -963,3 +963,96 @@ def r10_sync_in_span_close(pkg: PackageIndex) -> Iterator[Finding]:
                         f"span close path {fi.qualname} performs a fresh "
                         f"device pull ({last}) — a hidden blocking sync "
                         "per span", hint)
+
+
+# ---------------------------------------------------------------------------
+# R11 — whole-array-vmem-staging
+# ---------------------------------------------------------------------------
+
+def _r11_imports_pallas(mod) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            src = node.module or ""
+            if "pallas" in src or any("pallas" in (a.name or "")
+                                      for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any("pallas" in a.name for a in node.names):
+                return True
+    return False
+
+
+def _r11_variable_dim(shape_node: ast.AST) -> bool:
+    """A block shape with a NON-literal dimension — a runtime-dependent
+    size (``n``, ``n_pad``, ``x.shape[0]``...), the signature of a block
+    sized by the data rather than a fixed tile."""
+    if not isinstance(shape_node, ast.Tuple):
+        return False
+    return any(not isinstance(e, ast.Constant) for e in shape_node.elts)
+
+
+def _r11_const_index_map(node: ast.AST) -> bool:
+    """True when an index_map lambda sends EVERY grid step to the same
+    block (body is a literal, or a tuple of literals, ignoring the grid
+    args) — with a constant map the block IS the whole array."""
+    if not isinstance(node, ast.Lambda):
+        return False
+    body = node.body
+    elts = body.elts if isinstance(body, ast.Tuple) else [body]
+    return all(isinstance(e, ast.Constant) for e in elts)
+
+
+@register_rule("R11", "whole-array-vmem-staging")
+def r11_whole_array_vmem_staging(pkg: PackageIndex) -> Iterator[Finding]:
+    """A Pallas ``BlockSpec`` whose block shape carries a variable (data-
+    dependent) dimension AND whose index map sends every grid step to the
+    same block stages the ENTIRE array through VMEM: staging traffic is
+    O(N) however little the kernel touches, and the scoped-VMEM budget
+    turns into a hard row cap (the v1 partition kernel's deleted
+    ``_MAX_VMEM_ROWS = 650_000`` was exactly this).  The fix pattern is
+    an HBM ref + chunked DMA: keep the operand un-staged
+    (``memory_space=pltpu.ANY``) and stream fixed-size chunks through a
+    small double-buffered VMEM scratch via ``pltpu.make_async_copy``
+    (ops/partition_pallas.py v2).  Grid-blocked specs (index map uses a
+    grid arg) and fixed-size tiles are the NORMAL Pallas idiom and are
+    not flagged; an intentionally staged small variable-size block (an
+    O(S) per-segment table) takes a pragma with its reason."""
+    hint = ("stage per-chunk, not per-array: give the operand "
+            "memory_space=pltpu.ANY (HBM ref) and DMA fixed-size chunks "
+            "into a VMEM scratch with pltpu.make_async_copy, double-"
+            "buffered (copy chunk k+1 in while computing chunk k) — see "
+            "ops/partition_pallas.py and docs/ANALYSIS.md R11")
+    for mod in pkg.modules.values():
+        if not _r11_imports_pallas(mod):
+            continue
+        for fi in mod.functions.values():
+            for node in _own_body(fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = dotted_name(node.func)
+                if not fn or fn.split(".")[-1] != "BlockSpec":
+                    continue
+                block_shape = node.args[0] if node.args else None
+                index_map = node.args[1] if len(node.args) > 1 else None
+                is_hbm_ref = False
+                for kw in node.keywords:
+                    if kw.arg == "block_shape":
+                        block_shape = kw.value
+                    if kw.arg == "index_map":
+                        index_map = kw.value
+                    if kw.arg == "memory_space" and (
+                            dotted_name(kw.value) or "").endswith("ANY"):
+                        is_hbm_ref = True  # nothing is staged
+                if block_shape is None or not _r11_variable_dim(block_shape):
+                    continue
+                if is_hbm_ref:
+                    continue
+                if index_map is not None and not _r11_const_index_map(
+                        index_map):
+                    continue
+                yield _finding(
+                    fi, node, "R11",
+                    f"BlockSpec in {fi.qualname} stages a variable-size "
+                    "array whole in VMEM (non-literal block dimension, "
+                    "constant index map): staging is O(N) and the VMEM "
+                    "budget becomes a row cap", hint)

@@ -12,11 +12,13 @@ live on the TPU as jax arrays owned by the model objects.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -41,6 +43,14 @@ class CorruptModelError(LightGBMError):
 
 def _is_scipy_sparse(data) -> bool:
     return hasattr(data, "tocsr") and hasattr(data, "toarray")
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _ooc_fill_rows(dev, chunk, row_lo):
+    """One streamed-ingest step: place a fixed-shape row chunk into the
+    (donated) device matrix.  Donation keeps the fill O(chunk) traffic
+    per step instead of alloc+copy of the whole matrix."""
+    return jax.lax.dynamic_update_slice(dev, chunk, (row_lo, 0))
 
 
 def _to_2d_float(data) -> np.ndarray:
@@ -264,9 +274,17 @@ class Dataset:
             if _magic == b"PK\x03\x04":
                 # save_binary npz checkpoint (reference:
                 # DatasetLoader::LoadFromBinFile) — binned matrix + mappers
-                # reload directly, no raw parsing or re-binning
+                # reload directly, no raw parsing or re-binning.  With
+                # out_of_core= the matrix member is NOT materialized: it
+                # streams in row chunks through a reused host buffer
+                # (io/stream.py), and device residency follows
+                # max_rows_in_hbm (docs round 12)
                 from .binning import BinMapper
 
+                if cfg.out_of_core:
+                    from .io.stream import BinCacheStream
+
+                    self._ooc_stream = BinCacheStream(path)
                 with np.load(path, allow_pickle=False) as z:
                     sizes = z["upper_sizes"]
                     uppers = z["uppers"]
@@ -293,7 +311,8 @@ class Dataset:
                         off += s
                         coff += cs
                     pre_binner = DatasetBinner(mappers=mappers)
-                    pre_bins = np.asarray(z["bins"])
+                    pre_bins = (None if getattr(self, "_ooc_stream", None)
+                                is not None else np.asarray(z["bins"]))
                     loaded = {
                         "label": (z["label"] if z["label"].size else None),
                         "weight": (z["weight"] if z["weight"].size else None),
@@ -391,9 +410,10 @@ class Dataset:
         # src/io/sparse_bin.hpp — stored nonzeros + implicit zeros); only the
         # compact binned matrix is materialized, never dense raw floats
         sparse_csc = None
-        if pre_bins is not None:
+        if pre_bins is not None or getattr(self, "_ooc_stream", None) is not None:
             raw = None
-            num_feature = pre_bins.shape[1]
+            num_feature = (pre_bins.shape[1] if pre_bins is not None
+                           else self._ooc_stream.n_cols)
         elif _is_scipy_sparse(self.data) and cfg.is_enable_sparse:
             # (linear_tree + sparse raises below, before any raw upload)
             sparse_csc = self.data.tocsc()
@@ -460,13 +480,39 @@ class Dataset:
                 self.binner = DatasetBinner.fit(raw, **fit_kwargs)
         if pre_bins is not None:
             self.bins = pre_bins
+        elif getattr(self, "_ooc_stream", None) is not None:
+            self.bins = None  # never materialized host-side (out_of_core)
         elif sparse_csc is not None:
             self.bins = self.binner.transform_sparse(sparse_csc)
         else:
             self.bins = self.binner.transform(raw)
+        # out-of-core residency decision (docs round 12): with out_of_core=
+        # the binned matrix streams in row chunks; if the rows fit the
+        # max_rows_in_hbm budget the chunks ASSEMBLE the device matrix
+        # (resident regime — training is the standard growers, bit-for-bit)
+        # and otherwise the matrix never becomes device-resident (spill
+        # regime — chunked-histogram training, ops/treegrow_ooc.py)
+        self.ooc = bool(cfg.out_of_core)
+        self.ooc_spill = False
+        self.ooc_chunk_rows = 0
+        if self.ooc:
+            from .io.stream import DEFAULT_CHUNK_ROWS
+
+            n_rows_total = (self._ooc_stream.n_rows
+                            if getattr(self, "_ooc_stream", None) is not None
+                            else self.bins.shape[0])
+            self.ooc_chunk_rows = int(cfg.out_of_core_chunk_rows) or min(
+                DEFAULT_CHUNK_ROWS, n_rows_total)
+            cap = int(cfg.max_rows_in_hbm)
+            self.ooc_spill = 0 < cap < n_rows_total
         # int16 on device: half the HBM of int32 at Epsilon scale (max_bin
         # caps at 65535 by far); compute casts per tile
-        self.bins_device = jnp.asarray(self.bins, jnp.int16)
+        if self.ooc_spill:
+            self.bins_device = None  # larger than the HBM budget: streamed
+        elif self.ooc:
+            self.bins_device = self._ooc_assemble_device()
+        else:
+            self.bins_device = jnp.asarray(self.bins, jnp.int16)
         self._bins_device_t = None
         self.num_bins_pf_device = jnp.asarray(self.binner.num_bins_per_feature)
         self.missing_bin_pf_device = jnp.asarray(self.binner.missing_bin_per_feature)
@@ -482,6 +528,11 @@ class Dataset:
                 # data is encoded lazily (valid sets never need it — only the
                 # train set's histogram passes do)
                 self.efb = ref.efb._replace(bundled_bins=None)
+        elif cfg.enable_bundle and self.ooc:
+            # EFB's bundling passes scan the full host matrix, which the
+            # out-of-core path never materializes; the OOC growers run on
+            # the unbundled feature space (envelope note, docs round 12)
+            pass
         elif cfg.enable_bundle:
             from .io.efb import find_bundles
 
@@ -504,9 +555,12 @@ class Dataset:
                 self.max_num_bins = max(
                     self.max_num_bins, int(self.efb.gather_idx.shape[1])
                 )
-        self._num_data, self._num_feature = (
-            self.bins.shape if raw is None else raw.shape
-        )
+        if getattr(self, "_ooc_stream", None) is not None:
+            self._num_data, self._num_feature = self._ooc_stream.shape
+        else:
+            self._num_data, self._num_feature = (
+                self.bins.shape if raw is None else raw.shape
+            )
         if cfg.linear_tree or (ref is not None and getattr(ref, "raw_device", None) is not None):
             # linear trees need raw feature values at fit/score time
             # (reference: linear_tree_learner.cpp keeps a raw-data view)
@@ -521,6 +575,46 @@ class Dataset:
             self.data = None
         self._constructed = True
         return self
+
+    # -- out-of-core data plane (docs round 12) -------------------------
+    ooc = False
+    ooc_spill = False
+    ooc_chunk_rows = 0
+    _ooc_stream = None
+
+    def _ooc_assemble_device(self) -> jnp.ndarray:
+        """Resident regime: assemble the device matrix from streamed
+        chunks — one reused host buffer, one-deep upload prefetch, a
+        donated O(chunk) placement per step.  The assembled matrix is
+        IDENTICAL to a whole-array upload (chunking is pure placement),
+        so training downstream is bit-for-bit the in-memory path."""
+        from .io.stream import prefetch_device
+
+        if self._ooc_stream is None:
+            # host bins are already fully materialized (ndarray input, no
+            # cache to stream from) — chunked placement would rebuild the
+            # identical matrix with ceil(N/chunk) extra dispatches for
+            # zero host- or device-memory benefit; upload it whole, the
+            # in-memory path's own idiom
+            return jnp.asarray(self.bins, jnp.int16)
+        n, f = self._ooc_stream.shape
+        src = self._ooc_stream.chunks(self.ooc_chunk_rows)
+        dev = jnp.zeros((n, f), jnp.int16)
+        # no pad_rows: the tail chunk keeps its native shape (one extra
+        # compile) so dynamic_update_slice can never clamp-shift the fill
+        for row_lo, _m, chunk in prefetch_device(src, dtype=jnp.int16):
+            dev = _ooc_fill_rows(dev, chunk, jnp.int32(row_lo))
+        return dev
+
+    def ooc_chunk_iter(self):
+        """Fresh (row_lo, host_chunk_view) sweep over the binned matrix —
+        the spill-regime grower re-invokes this once per histogram pass
+        (ops/treegrow_ooc.py)."""
+        if self._ooc_stream is not None:
+            return self._ooc_stream.chunks(self.ooc_chunk_rows)
+        from .io.stream import array_chunks
+
+        return array_chunks(self.bins, self.ooc_chunk_rows)
 
     def efb_device_tables(self):
         """Lazy device tables for EFB training: (bundled_bins, gather,
@@ -554,9 +648,21 @@ class Dataset:
         partition reads become contiguous row slices (docs/PERF_NOTES.md).
         Built lazily: only TPU training paths request it."""
         if getattr(self, "_bins_device_t", None) is None:
-            self._bins_device_t = jnp.asarray(
-                np.ascontiguousarray(self.bins.T), jnp.int16
-            )
+            if self.bins is None:
+                if self.bins_device is None:
+                    raise LightGBMError(
+                        "bins_device_t needs a device-resident matrix, but "
+                        "this out_of_core dataset exceeds max_rows_in_hbm "
+                        "(spill regime) and only streams bins in chunks — "
+                        "raise max_rows_in_hbm or drop out_of_core")
+                # out-of-core resident: the host matrix was never
+                # materialized — transpose the assembled device matrix
+                self._bins_device_t = jnp.asarray(
+                    jnp.transpose(self.bins_device))
+            else:
+                self._bins_device_t = jnp.asarray(
+                    np.ascontiguousarray(self.bins.T), jnp.int16
+                )
         return self._bins_device_t
 
     def efb_bins_device_t(self) -> Optional[jnp.ndarray]:
@@ -689,6 +795,28 @@ class Dataset:
             feature = self.feature_names.index(feature)
         return int(self.binner.mappers[feature].num_bins)
 
+    def _host_bins(self, what: str) -> np.ndarray:
+        """Host binned matrix for paths that need the whole thing at once.
+        Resident out_of_core datasets never parse host bins, but hold the
+        assembled device matrix — materialize one host copy from it; the
+        spill regime has neither, so those paths are outside its envelope."""
+        if self.bins is not None:
+            return self.bins
+        if self.bins_device is not None:
+            # cached in a SEPARATE attribute so bins stays None (the OOC
+            # sentinel) — per-tree callers (categorical traversal during
+            # rollback/replay) must not pay a full device->host pull each
+            cache = getattr(self, "_host_bins_cache", None)
+            if cache is None or cache[0] is not self.bins_device:
+                cache = (self.bins_device, np.asarray(self.bins_device))
+                self._host_bins_cache = cache
+            return cache[1]
+        raise LightGBMError(
+            f"{what} needs the full binned matrix, but this out_of_core "
+            "dataset exceeds max_rows_in_hbm (spill regime) and only "
+            "streams bins in chunks — raise max_rows_in_hbm or drop "
+            "out_of_core; see ops/treegrow_ooc.py")
+
     def add_features_from(self, other: "Dataset") -> "Dataset":
         """Column-concatenate another constructed dataset (reference:
         Dataset::AddFeaturesFrom)."""
@@ -697,7 +825,9 @@ class Dataset:
         if self.num_data() != other.num_data():
             raise LightGBMError("Cannot add features from Dataset with a different number of rows")
         self.binner = DatasetBinner(mappers=list(self.binner.mappers) + list(other.binner.mappers))
-        self.bins = np.concatenate([self.bins, other.bins], axis=1)
+        self.bins = np.concatenate(
+            [self._host_bins("add_features_from"),
+             other._host_bins("add_features_from")], axis=1)
         self.bins_device = jnp.asarray(self.bins, jnp.int16)
         self._bins_device_t = None
         self.num_bins_pf_device = jnp.asarray(self.binner.num_bins_per_feature)
@@ -726,7 +856,7 @@ class Dataset:
         idx = np.asarray(used_indices, dtype=np.int64)
         sub = Dataset.__new__(Dataset)
         sub.__dict__.update({k: v for k, v in self.__dict__.items()})
-        sub.bins = self.bins[idx]
+        sub.bins = self._host_bins("subset")[idx]
         sub.bins_device = jnp.asarray(sub.bins, jnp.int16)
         sub._bins_device_t = None
         if getattr(self, "efb", None) is not None:
@@ -758,6 +888,11 @@ class Dataset:
         mappers directly, skipping raw parsing/binning (reference:
         DatasetLoader::LoadFromBinFile)."""
         self.construct()
+        if self.bins is None:
+            raise LightGBMError(
+                "save_binary needs the host binned matrix, which an "
+                "out_of_core dataset deliberately never materializes — "
+                "the source cache it streams from IS the binary file")
         # write to the EXACT filename (np.savez appends .npz to bare paths;
         # the reference honors the given name)
         with open(filename, "wb") as fh:
@@ -801,10 +936,18 @@ class Dataset:
         m = tree.num_internal
         if m == 0:
             return jnp.zeros((n,), jnp.int32)
+        if self.bins_device is None:
+            raise LightGBMError(
+                "binned-tree traversal needs device-resident bins; this "
+                "out_of_core dataset exceeds max_rows_in_hbm (spill "
+                "regime) — rollback/DART/valid-replay paths are outside "
+                "the OOC envelope (ops/treegrow_ooc.py)")
         if tree.num_cat > 0:
             # categorical nodes need bin-subset membership — host walk
             return jnp.asarray(
-                tree.predict_leaf_binned_batch(np.asarray(self.bins), self.binner)
+                tree.predict_leaf_binned_batch(
+                    np.asarray(self._host_bins("categorical-tree traversal")),
+                    self.binner)
             )
         if tree.threshold_bin is None:
             # tree came from a model string: recover bin-space thresholds from

@@ -683,7 +683,7 @@ def dataset_dump_text(ds, filename: str) -> bool:
             for row in arr:
                 f.write("\t".join(repr(float(v)) for v in row) + "\n")
         else:  # raw freed: dump binned values (still row-per-line)
-            for row in np.asarray(ds.bins):
+            for row in ds._host_bins("dump_text"):
                 f.write("\t".join(str(int(v)) for v in row) + "\n")
     return True
 

@@ -415,6 +415,29 @@ class Config:
     # and trains only the remaining rounds toward num_iterations
     resume: str = ""
 
+    # --- out-of-core data path (ours; docs/PERF_NOTES.md round 12) ---
+    # out_of_core: stream the binned matrix in row chunks through pinned,
+    # reused host buffers instead of materializing it whole.  From a
+    # save_binary cache the host never holds the full matrix; on device,
+    # residency is governed by max_rows_in_hbm (below).  Datasets whose
+    # rows exceed the device budget train via chunked histogram
+    # accumulation (ops/treegrow_ooc.py) — bins are streamed per pass and
+    # the device keeps only O(N) vectors + O(L*F*B) histograms.
+    out_of_core: bool = False
+    # max_rows_in_hbm: device-residency budget for the binned matrix, in
+    # rows.  0 (default) = unbounded: the matrix is assembled device-
+    # resident from the streamed chunks and training runs the standard
+    # growers unchanged.  N > max_rows_in_hbm selects the spill regime
+    # (chunked-histogram training).  Only meaningful with out_of_core.
+    max_rows_in_hbm: int = 0
+    # out_of_core_chunk_rows: rows per streamed chunk (the reused host
+    # buffer's size and the device chunk shape).  0 = auto (65536).
+    # Chunking never changes results: the ingest assembles the identical
+    # device matrix, and the spill grower's histogram accumulation is an
+    # order-preserving fold (tests/test_out_of_core.py pins bitwise
+    # equality across chunk sizes).
+    out_of_core_chunk_rows: int = 0
+
     # --- observability (ours; docs/OBSERVABILITY.md) ---
     # telemetry: the process-wide metrics/event registry (lightgbm_tpu/obs)
     # is DEFAULT-ON — it adds zero device dispatches and zero blocking

@@ -218,6 +218,18 @@ def train(
     # "train (total - k) more rounds" resume recipe both trust the name
     snapshot_base = booster.current_iteration()
 
+    if _obs.enabled() and cfg_probe.trace_file:
+        # ring-overflow spill sink rides the trace_file= opt-in
+        # (obs/trace.py): a long (out-of-core) run can no longer lose
+        # spans silently — evictions append to the sidecar JSONL and
+        # count trace_spans_spilled_total.  Best-effort, like the final
+        # write_trace: an unwritable sidecar must not cost the run.
+        try:
+            _trace.enable_spill(cfg_probe.trace_file + ".spill.jsonl")
+        except OSError as e:
+            log_warning("could not arm the trace spill sink next to "
+                        f"{cfg_probe.trace_file}: {e}")
+
     # the run-level span is HOST-CAUSAL wall clock (docs/OBSERVABILITY.md
     # "Span tracing"): per-round device-inclusive spans are the windowed
     # grower's, anchored at its accounted async-info resolves
@@ -260,9 +272,13 @@ def train(
     finally:
         train_span.set(trained_iterations=booster.current_iteration())
         train_span.__exit__(None, None, None)
+        # report (and the spill-sink disarm inside it) must run on EVERY
+        # exit path — a fault/non-finite abort that skipped it would leave
+        # the sink armed process-wide, appending later unrelated work's
+        # evictions to this run's sidecar
+        _finish_run_report(cfg_probe)
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
-    _finish_run_report(cfg_probe)
     return booster
 
 
@@ -301,6 +317,10 @@ def _finish_run_report(cfg: Config) -> None:
             log_warning(f"could not write trace to {cfg.trace_file}: {e}")
         else:
             log_info(f"Trace ({n_spans} spans) written to {cfg.trace_file}")
+        # disarm the run's spill sink: evictions from LATER work in this
+        # process (another train, serving) must not append to — and be
+        # mistaken for — this run's span history
+        _trace.disable_spill()
 
 
 def _replay_scores(gbdt) -> None:

@@ -327,6 +327,9 @@ class GBDT:
         if (
             self.cfg.feature_pre_filter
             and self.cfg.min_data_in_leaf > 1
+            and getattr(train_set, "bins", None) is not None
+            # out_of_core never materializes the host matrix the filter
+            # scans; the (purely optimizing) filter is skipped there
             and jax.process_count() <= 1
             # multi-controller: ranks may hold different row shards, so
             # local counts could derive DIVERGENT feature masks and break
@@ -522,6 +525,43 @@ class GBDT:
                     "monotone split; leaf values keep their creation-time "
                     "(clipped, quantized) outputs."
                 )
+        # out-of-core spill regime (docs round 12): the binned matrix is
+        # NOT device-resident — training routes to the chunk-streamed
+        # grower (ops/treegrow_ooc.py), whose envelope is the strict
+        # grower's core (numerical + categorical, bagging, max_depth).
+        # Features that need the whole matrix (or a grower outside the
+        # mirror) raise here rather than silently train something else.
+        self._ooc_spill = bool(getattr(train_set, "ooc_spill", False))
+        if self._ooc_spill:
+            mc_l = list(self.cfg.monotone_constraints or [])
+            blocked = {
+                "monotone_constraints": any(int(c) != 0 for c in mc_l),
+                "interaction_constraints": bool(
+                    self.cfg.interaction_constraints),
+                "forcedsplits_filename": bool(self.cfg.forcedsplits_filename),
+                "cegb penalties": any(
+                    p != 0 for p in
+                    (self.cfg.cegb_penalty_feature_coupled or [])
+                    + (self.cfg.cegb_penalty_feature_lazy or [])),
+                "linear_tree": bool(self.cfg.linear_tree),
+                "extra_trees / feature_fraction_bynode": bool(
+                    self.cfg.extra_trees
+                    or self.cfg.feature_fraction_bynode < 1.0),
+                "tree_learner != serial": self.cfg.tree_learner != "serial",
+                "boosting = dart": self.cfg.boosting == "dart",
+            }
+            bad = [k for k, v in blocked.items() if v]
+            if bad:
+                raise ValueError(
+                    "out_of_core spill training (rows > max_rows_in_hbm) "
+                    f"does not support: {', '.join(bad)} — raise "
+                    "max_rows_in_hbm (resident regime supports everything) "
+                    "or drop the option; see ops/treegrow_ooc.py")
+            if self.cfg.use_quantized_grad:
+                from ..utils.log import log_warning as _lw
+                _lw("use_quantized_grad is ignored by the out-of-core "
+                    "spill grower; this run trains float (strict-grower "
+                    "mirror)")
         self._linear = bool(self.cfg.linear_tree) and self.cfg.tree_learner == "serial"
         if self.cfg.linear_tree and not self._linear:
             log_warning(
@@ -563,13 +603,18 @@ class GBDT:
             if _jax.device_count() > 1:
                 from ..parallel.mesh import make_mesh
 
+                # resident out_of_core datasets never hold host bins; the
+                # sharded learners split a host copy once (spill regime is
+                # already gated to tree_learner=serial above)
+                host_bins = train_set._host_bins(
+                    f"tree_learner={self.cfg.tree_learner}")
                 mesh = make_mesh()
                 if self.cfg.tree_learner == "feature":
                     from ..parallel.feature_parallel import FeatureShardedData
 
                     self._fp = FeatureShardedData(
                         mesh,
-                        np.asarray(train_set.bins),
+                        np.asarray(host_bins),
                         np.asarray(train_set.binner.num_bins_per_feature),
                         np.asarray(train_set.binner.missing_bin_per_feature),
                     )
@@ -581,7 +626,7 @@ class GBDT:
                     )
                     self._dp = ShardedData(
                         mesh,
-                        np.asarray(train_set.bins),
+                        np.asarray(host_bins),
                         np.asarray(train_set.binner.num_bins_per_feature),
                         np.asarray(train_set.binner.missing_bin_per_feature),
                         process_local=self._pre_partition,
@@ -619,6 +664,9 @@ class GBDT:
             # a changed baked constant yields a fresh trace, so a previous
             # compile failure no longer applies — give fused another chance
             self._fused_disabled = False
+            # the fused predict+convert entry bakes objective constants
+            # (e.g. cfg.sigmoid) as traced constants too
+            self._convert_entry = None
 
     def add_valid(self, valid_set, name: str) -> None:
         valid_set.construct(reference=self.train_set)
@@ -812,6 +860,8 @@ class GBDT:
     _cegb_lazy = None
     _cegb_lazy_used = None
     _fused_disabled = False
+    _ooc_spill = False
+    _convert_entry = None
 
     def _localize_tree(self, arrays, leaf_id_pad):
         """Multi-controller runs: bring the (replicated) tree and the
@@ -843,6 +893,7 @@ class GBDT:
             grad is None
             and self.cfg.fused_training
             and not self._fused_disabled
+            and not self._ooc_spill  # bins are streamed, not traced inputs
             # each class tree inlines into the trace: cap the blowup
             and self.num_tree_per_iteration <= 8
             # very wide/deep shapes compile the combined trace pathologically
@@ -1185,7 +1236,31 @@ class GBDT:
                 jax.random.PRNGKey(self.cfg.extra_seed + self.iter_ * 131 + c)
                 if self._needs_node_rng else None
             )
-            if self._fp is not None:
+            if self._ooc_spill:
+                # out-of-core spill: the binned matrix streams through the
+                # chunked grower (a strict-grower mirror — bitwise on the
+                # scatter strategy, ops/treegrow_ooc.py)
+                from ..ops.treegrow_ooc import grow_tree_ooc
+
+                arrays, leaf_id = grow_tree_ooc(
+                    ts.ooc_chunk_iter,
+                    ts.num_data(),
+                    ts.num_feature(),
+                    jnp.asarray(gc, jnp.float32),
+                    jnp.asarray(hc, jnp.float32),
+                    jnp.asarray(row_mask, bool),
+                    jnp.asarray(sample_weight, jnp.float32),
+                    jnp.asarray(feature_mask, bool),
+                    ts.num_bins_pf_device,
+                    ts.missing_bin_pf_device,
+                    self._categorical_mask,
+                    num_leaves=self.cfg.num_leaves,
+                    num_bins=ts.max_num_bins,
+                    max_depth=self.cfg.max_depth,
+                    params=self._split_params,
+                    chunk_rows=ts.ooc_chunk_rows,
+                )
+            elif self._fp is not None:
                 from ..parallel.feature_parallel import grow_tree_feature_parallel
 
                 arrays, leaf_id = grow_tree_feature_parallel(
@@ -1966,6 +2041,64 @@ class GBDT:
         self._serve_note("raw_multiclass", n, t0c0, bucket=nb)
         return res
 
+    def _get_convert_entry(self):
+        """Jitted traversal + ``objective.convert_output`` in ONE trace:
+        a converted warm predict is one dispatch + one accounted pull
+        (round 12 — it was 2 dispatches: the raw traversal, then a
+        separate convert dispatch over the re-uploaded raw result).
+        Cached for the model's lifetime; reset_split_params nulls it when
+        a baked objective constant (e.g. ``sigmoid``) changes."""
+        if self._convert_entry is not None:
+            return self._convert_entry
+        obj = self.objective
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        # jaxlint: disable=R2 (cached in self._convert_entry; nulled only when a baked constant changes)
+        def run(x, sf, th, dl, mt, lc, rc, nl, lv, is_cat, cat_base,
+                cat_nwords, cat_words, active, *, k):
+            if k == 1:
+                out = predict_ops.predict_raw_values(
+                    x, sf, th, dl, mt, lc, rc, nl, lv, is_cat=is_cat,
+                    cat_base=cat_base, cat_nwords=cat_nwords,
+                    cat_words=cat_words, active=active)
+            else:
+                out = predict_ops.predict_raw_multiclass(
+                    x, sf, th, dl, mt, lc, rc, nl, lv, is_cat=is_cat,
+                    cat_base=cat_base, cat_nwords=cat_nwords,
+                    cat_words=cat_words, active=active, k=k)
+            # conversions are rowwise (sigmoid/exp/softmax): padded rows
+            # cannot leak into real ones, so the bucket ladder stays safe
+            return obj.convert_output(out)
+
+        self._convert_entry = run
+        return run
+
+    def _predict_converted(self, X, start_iteration, num_iteration):
+        """Fused converted predict (serving contract: 1 dispatch + 1
+        accounted pull, packed-cache hit, bucket ladder).  Returns None
+        when the fused entry does not apply (no trees, linear leaves,
+        RF averaging — the caller falls back to the 2-dispatch path,
+        also reachable via ``LGBMTPU_FUSED_CONVERT=0``)."""
+        s = self._packed(start_iteration, num_iteration)
+        if s is None or s["_linear"]:
+            return None
+        n = X.shape[0]
+        k = self.num_tree_per_iteration
+        t0c0 = self._serve_t0()
+        nb = _predict_bucket(n)
+        x = self._pad_rows(X, nb)
+        active = self._active_mask(n, nb)
+        run = self._get_convert_entry()
+        _san.record_dispatch()
+        out = run(x, s["split_feature"], s["threshold"], s["default_left"],
+                  s["missing_type"], s["left_child"], s["right_child"],
+                  s["num_leaves"], s["leaf_value"], s.get("is_cat"),
+                  s.get("cat_base"), s.get("cat_nwords"), s.get("cat_words"),
+                  active, k=k)
+        res = np.asarray(_san.sync_pull(out)[:n])
+        self._serve_note("converted", n, t0c0, bucket=nb)
+        return res
+
     def predict(self, X, raw_score=False, start_iteration=0, num_iteration=-1,
                 pred_leaf=False, pred_contrib=False) -> np.ndarray:
         X = np.asarray(X, dtype=np.float64)
@@ -1973,12 +2106,25 @@ class GBDT:
             return self._predict_leaf(X, start_iteration, num_iteration)
         if pred_contrib:
             return self.predict_contrib(X, start_iteration, num_iteration)
-        if (
+        early_stop = (
             self.cfg.pred_early_stop
             and not self.average_output  # RF averages trees; chunked sums break it
             and self.objective is not None
             and getattr(self.objective, "name", "") in ("binary", "multiclass", "multiclassova")
+        )
+        if (
+            not raw_score
+            and not early_stop
+            and self.objective is not None
+            # RF scales raw margins by 1/T on the host in f64 before
+            # converting — keep that exact path rather than re-deriving it
+            and not self.average_output
+            and os.environ.get("LGBMTPU_FUSED_CONVERT", "1") != "0"
         ):
+            res = self._predict_converted(X, start_iteration, num_iteration)
+            if res is not None:
+                return res
+        if early_stop:
             raw = self._predict_raw_early_stop(X, start_iteration, num_iteration)
         else:
             raw = self.predict_raw(X, start_iteration, num_iteration)

@@ -26,7 +26,15 @@ windowed grower's one-round-behind async info resolve, the predict entry's
 Finished spans land in a bounded ring (cap :data:`TRACE_RING_CAP`) and
 export as Chrome-trace / Perfetto-loadable JSON (:func:`to_chrome_trace`,
 :func:`write_trace`; ``python -m lightgbm_tpu.obs trace`` is the CLI form,
-``trace_file=`` the Config param).  The exported file keeps the raw span
+``trace_file=`` the Config param).  Long runs overflow the ring — an
+out-of-core training sweep emits far more than 8192 spans — and before
+round 12 the evictions were SILENT.  Now every eviction is accounted:
+with a spill sink enabled (:func:`enable_spill`; engine.train arms it
+next to ``trace_file=``) evicted spans append to a bounded JSONL file
+and count ``trace_spans_spilled_total``; past the byte bound, or with no
+sink, they count ``trace_spans_dropped_total`` — the ring can no longer
+lose history without the metrics saying so.  Spilling is pure host IO
+(no device value is ever touched — the jaxlint R10 discipline holds).  The exported file keeps the raw span
 records under a ``"lgbmtpu"`` key (schema :data:`SCHEMA_TRACE`) so it
 round-trips through the CLI while chrome://tracing and ui.perfetto.dev
 read the standard ``traceEvents`` list.
@@ -59,12 +67,90 @@ from . import metrics as _metrics
 SCHEMA_TRACE = "lgbmtpu-trace-v1"
 TRACE_RING_CAP = 8192
 
+SPILL_MAX_BYTES = 64 * 1024 * 1024  # default bound for the spill sink
+
 _lock = threading.RLock()
 _ring: "collections.deque" = collections.deque(maxlen=TRACE_RING_CAP)
 _ids = itertools.count(1)
 _tls = threading.local()
 _annotation_factory: Optional[
     Callable[[str, Dict[str, Any]], ContextManager]] = None
+_spill_fh = None
+_spill_path: Optional[str] = None
+_spill_bytes = 0
+_spill_max_bytes = SPILL_MAX_BYTES
+_spill_clean = False  # previous arm in THIS process was disarmed cleanly
+
+
+def enable_spill(path: str, max_bytes: int = SPILL_MAX_BYTES) -> None:
+    """Arm the ring-eviction spill sink: spans evicted from the full ring
+    append to ``path`` as JSONL (one raw span record per line), up to
+    ``max_bytes``; beyond the bound evictions fall back to the dropped
+    counter.  Appends on first arm in a process, so a watchdog-relaunched
+    run keeps its pre-crash history; re-arming AFTER a clean disarm
+    truncates (the previous run's complete history was sidecar + its own
+    trace export — a later run's evictions must not be appended to and
+    mistaken for it), as does switching to a different path mid-process."""
+    global _spill_fh, _spill_path, _spill_bytes, _spill_max_bytes, _spill_clean
+    with _lock:
+        if _spill_fh is not None:
+            try:
+                _spill_fh.close()
+            except OSError:
+                pass
+            # disarm BEFORE the open: if the new path fails to open, the
+            # sink must read as disarmed (counted drops), not as a live
+            # handle that every eviction write would find closed
+            _spill_fh = None
+        mode = ("w" if _spill_clean
+                or (_spill_path is not None and path != _spill_path)
+                else "a")
+        _spill_fh = open(path, mode, encoding="utf-8")
+        _spill_bytes = _spill_fh.tell()
+        _spill_path = path
+        _spill_max_bytes = int(max_bytes)
+        _spill_clean = False
+
+
+def disable_spill() -> Optional[str]:
+    """Close the spill sink; returns its path (None when never armed)."""
+    global _spill_fh, _spill_clean
+    with _lock:
+        if _spill_fh is not None:
+            try:
+                _spill_fh.close()
+            except OSError:
+                pass
+            _spill_fh = None
+            _spill_clean = True
+        return _spill_path
+
+
+def spill_path() -> Optional[str]:
+    return _spill_path
+
+
+def set_ring_cap(cap: int) -> None:
+    """Resize the span ring (tests; keeps the newest ``cap`` spans)."""
+    global _ring
+    with _lock:
+        _ring = collections.deque(_ring, maxlen=max(int(cap), 1))
+
+
+def _handle_eviction(evicted: Dict[str, Any]) -> None:
+    """Account one span falling off the full ring — spill when armed and
+    under the byte bound, count a drop otherwise.  Caller holds _lock."""
+    global _spill_bytes
+    if _spill_fh is not None and _spill_bytes < _spill_max_bytes:
+        try:
+            line = json.dumps(evicted, default=str) + "\n"
+            _spill_fh.write(line)
+            _spill_bytes += len(line.encode("utf-8"))
+            _metrics.counter("trace_spans_spilled_total").inc()
+            return
+        except (OSError, ValueError):
+            pass  # unwritable sink degrades to counted drops
+    _metrics.counter("trace_spans_dropped_total").inc()
 
 
 def set_annotation_factory(
@@ -204,6 +290,9 @@ def _append(name: str, ts: float, dur: float, attrs: Dict[str, Any],
     if parent_id is not None:
         rec["parent"] = parent_id
     with _lock:
+        if len(_ring) == _ring.maxlen:
+            # the deque would evict silently — account the victim first
+            _handle_eviction(_ring[0])
         _ring.append(rec)
 
 

@@ -18,7 +18,9 @@ order.  Two interchangeable implementations sit behind
   split segments (the in-place ``DataPartition::Split`` analogue), used
   by the fused windowed round on TPU; its raw output is merged back over
   the untouched positions here with the ``seg_id`` mask the admit phase
-  already computed.
+  already computed.  v2 keeps its buffers HBM-resident and streams
+  per-chunk DMA, so there is NO row cap — the kernel is taken at any N
+  (the v1 650k-row VMEM-staging fallback is deleted).
 
 Both return identical results; tests/test_partition.py pins the Pallas
 kernel (interpret mode) against the XLA path on the same fixtures.
@@ -47,17 +49,14 @@ def partition_rows(
     (``interpret=True`` runs the same kernel through the Pallas
     interpreter for off-chip tests); otherwise the O(N) XLA permutation.
     The choice is made at trace time — both paths are pure functions of
-    the same inputs with identical outputs.  The v1 kernel stages its
-    buffers whole in VMEM, so rows beyond its VMEM cap drop to the XLA
-    path automatically (see ops/partition_pallas.py).
+    the same inputs with identical outputs.  The v2 kernel is
+    HBM-resident with per-chunk DMA staging, so it is taken at ANY row
+    count (v1's >650k silent XLA fallback is gone; only
+    ``LGBMTPU_PARTITION_PALLAS=0`` and the degradation registry opt out).
     """
     if use_pallas or interpret:
         from ..utils import degrade as _degrade
-        from .partition_pallas import _MAX_VMEM_ROWS, partition_pallas_segments
-
-        if not interpret and order.shape[0] > _MAX_VMEM_ROWS:
-            return stable_partition_ranges(
-                order, seg_id, seg_start, seg_len, go_left)
+        from .partition_pallas import partition_pallas_segments
 
         def _pallas():
             raw, left_counts = partition_pallas_segments(

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for the leaf-ordered row partition.
+"""Pallas TPU kernel for the leaf-ordered row partition — v2, HBM-resident.
 
 The XLA implementation (ops/partition.py::stable_partition_ranges) is
 exact but pays O(N) regardless of how few rows a round actually splits:
@@ -11,40 +11,50 @@ proportional, like the reference's in-place ``DataPartition::Split``
 (src/treelearner/data_partition.hpp) which touches only the split leaf's
 ``[start, count)`` index range.
 
-This kernel is that in-place split, vectorized over all of a round's
-split segments:
+v1 (rounds 7-11) was that in-place split but staged ``order``/``go``/
+``out`` as whole-array VMEM blocks (~12 B/row across the three buffers):
+compute was segment-proportional, STAGING was O(N), and the scoped-VMEM
+budget capped the kernel at ``_MAX_VMEM_ROWS = 650_000`` rows with a
+silent XLA fallback above — exactly the regime the Higgs-11M target
+lives in (ROADMAP "Uncap N").  v2 removes the cap:
 
-* grid ``(S, 2, C)`` — per segment, a COUNT phase then a MOVE phase,
-  each sweeping fixed-size chunks; TPU grids execute sequentially, so
-  per-segment running counters live in SMEM scratch across chunks.
-* count phase: vectorized masked sum of ``go_left`` over the segment's
-  chunks -> ``n_left`` (needed before any element can be placed).
-* move phase: a chunk-local ``fori_loop`` placing each row id at
-  ``start + left_rank`` / ``start + n_left + right_rank``.  Stability is
-  inherited from the sequential sweep.
-* compute scales with the segments: chunks past ``seg_len`` are
-  ``pl.when``-skipped, so count-phase vector work and move-phase loop
-  trips are proportional to the segment total, not N.  STAGING is still
-  O(N): the v1 kernel keeps order/go/out as whole-array VMEM blocks
-  (~12 bytes/row across the three buffers), which is cheap next to the
-  2 cumsums + permutation scatter it replaces but caps N at the scoped
-  VMEM budget — the dispatcher (ops/partition.py::partition_rows) falls
-  back to the XLA path above ``_MAX_VMEM_ROWS`` rows, and an
-  HBM-resident variant with explicit per-chunk DMA is the documented
-  round-8 refinement (docs/NEXT.md).  Positions outside every segment
-  are left undefined in the raw output — the caller merges them back
-  with the ``seg_id`` mask it already has.
+* ``order``/``go_left``/``out`` live in HBM (``pltpu.ANY`` refs — no
+  BlockSpec staging at all); the kernel streams fixed-size chunks
+  through a small double-buffered VMEM scratch via
+  ``pltpu.make_async_copy`` DMA, starting chunk c+1's copy-in while
+  chunk c is being placed.  VMEM residency is O(_CHUNK), independent
+  of N — the jaxlint R11 ``whole-array-vmem-staging`` fix pattern.
+* grid ``(S,)`` — one sequential grid step per segment.  Per segment:
+  a COUNT sweep (vector masked sums of streamed ``go`` chunks ->
+  ``n_left``), then a MOVE sweep placing each input chunk's rows into
+  the segment's left run ``[start, start+n_left)`` and right run
+  ``[start+n_left, start+len)``.
+* the move sweep compacts each chunk's left/right rows into VMEM
+  staging buffers (scalar stores — the same SREG-bound ceiling as v1's
+  move loop) and writes each run back with a read-modify-write DMA
+  pair: the destination window is copied in, overlaid from its cursor,
+  and copied back, so the fixed-size DMA's tail can never clobber
+  neighbouring data (runs are cursor-contiguous; RMW makes the
+  overhang idempotent).  HBM traffic is ~4 reads + 2 writes per
+  segment chunk — segment-proportional, never O(N).
+* positions outside every segment are untouched in the raw output —
+  the caller merges them back with the ``seg_id`` mask it already has
+  (ops/partition.py does), same contract as v1.
+
+With staging gone the dispatcher no longer needs a row cap:
+``partition_rows`` takes this kernel at ANY N (the 650k fallback is
+deleted; ``LGBMTPU_PARTITION_PALLAS=0`` and the degradation registry
+remain the only opt-outs).
 
 Validation status (honest): equivalence vs ``stable_partition_ranges``
 is pinned in ``tests/test_partition.py`` through Mosaic INTERPRET mode —
-this container has no TPU.  The kernel compiles from constructs the
-toolchain accepts elsewhere in the repo (scalar prefetch, SMEM scratch,
-``pl.when``, dynamic ``pl.ds``), but the scalar-store move loop is
-untuned; on-chip the expected ceiling is SREG-bound element placement
-(~segment_rows scalar stores), which still beats the full-N scatter once
-windows are < ~N/4.  ``LGBMTPU_PARTITION_PALLAS=0`` falls back to the
-XLA path without retracing semantics (ops/treegrow_windowed.py reads it
-at trace time).
+this container has no TPU — including a slow-marked >650k-row case that
+v1 could not reach.  The DMA constructs follow the accelerator guide's
+double-buffering pattern; on-chip the expected ceiling is the scalar
+compaction stores plus the sequential RMW DMA chain (4 serialized DMAs
+per chunk), untuned.  The RMW pairs are correctness-first: a later chip
+session can drop the read half for full interior chunks (only boundary
+chunks need it).
 """
 
 from __future__ import annotations
@@ -56,63 +66,125 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_CHUNK = 512  # rows per grid step; VPU-wide for the count phase, and the
-# move phase's fori_loop body stays short enough to unroll per chunk
-
-# v1 stages order/go/out as full-array VMEM blocks: 3 buffers x 4 bytes x
-# n_pad must fit the ~16 MiB scoped-VMEM cap with headroom — above this
-# the dispatcher uses the XLA path (Epsilon's 400k rows fit; 1M does not)
-_MAX_VMEM_ROWS = 650_000
+_CHUNK = 512  # rows per DMA chunk; VPU-wide for the count phase, and the
+# move phase's compaction loop stays short enough per chunk
 
 
-def _partition_kernel(seg_start_ref, seg_len_ref, order_ref, go_ref,
-                      out_ref, lc_ref, carry):
-    """Grid (S, 2, C): segment s, phase (0=count, 1=move), chunk c.
+def _partition_kernel(seg_start_ref, seg_len_ref, order_hbm, go_hbm,
+                      out_hbm, lc_ref, obuf, gbuf, dbuf, sems):
+    """Grid (S,): one sequential step per segment.
 
-    carry (SMEM, i32): [0] n_left of the current segment, [1] left write
-    cursor, [2] right write cursor — valid across chunks because the TPU
-    grid is sequential (phase/chunk iterate fastest)."""
+    Scratch: ``obuf``/``gbuf`` (2, 1, _CHUNK) double-buffered input
+    chunks (order / go_left), ``dbuf`` (2, 1, _CHUNK) destination RMW
+    windows (left / right run), ``sems`` 6 DMA semaphores (order x2,
+    go x2, left dst, right dst)."""
     s = pl.program_id(0)
-    ph = pl.program_id(1)
-    c = pl.program_id(2)
     start = seg_start_ref[s]
-    base = start + c * _CHUNK
-    rem = seg_len_ref[s] - c * _CHUNK
+    seg_len = seg_len_ref[s]
+    nc = pl.cdiv(seg_len, _CHUNK)
 
-    @pl.when((ph == 0) & (c == 0))
-    def _reset_count():
-        carry[0] = 0
+    def go_copy(c, slot):
+        return pltpu.make_async_copy(
+            go_hbm.at[:, pl.ds(start + c * _CHUNK, _CHUNK)],
+            gbuf.at[slot], sems.at[2 + slot])
 
-    @pl.when((ph == 0) & (rem > 0))
-    def _count():
-        m = jnp.minimum(rem, _CHUNK)
-        vals = go_ref[:, pl.ds(base, _CHUNK)]  # (1, CHUNK) i32 0/1
+    def order_copy(c, slot):
+        return pltpu.make_async_copy(
+            order_hbm.at[:, pl.ds(start + c * _CHUNK, _CHUNK)],
+            obuf.at[slot], sems.at[slot])
+
+    # ---- COUNT: stream go chunks (double-buffered), masked vector sum ----
+    @pl.when(nc > 0)
+    def _warm_count():
+        go_copy(0, 0).start()
+
+    def count_body(c, acc):
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < nc)
+        def _prefetch():  # copy-in chunk c+1 while summing chunk c
+            go_copy(c + 1, 1 - slot).start()
+
+        go_copy(c, slot).wait()
+        m = jnp.minimum(seg_len - c * _CHUNK, _CHUNK)
         iota = jax.lax.broadcasted_iota(jnp.int32, (1, _CHUNK), 1)
-        carry[0] += jnp.sum(jnp.where(iota < m, vals, 0))
+        return acc + jnp.sum(jnp.where(iota < m, gbuf[slot], 0))
 
-    @pl.when((ph == 1) & (c == 0))
-    def _start_move():
-        lc_ref[0, s] = carry[0]
-        carry[1] = 0
-        carry[2] = 0
+    n_left = jax.lax.fori_loop(0, nc, count_body, jnp.int32(0))
+    lc_ref[0, s] = n_left
 
-    @pl.when((ph == 1) & (rem > 0))
-    def _move():
-        m = jnp.minimum(rem, _CHUNK)
-        n_left = carry[0]
+    # ---- MOVE: stream order+go chunks, compact, RMW the two runs ----
+    @pl.when(nc > 0)
+    def _warm_move():
+        order_copy(0, 0).start()
+        go_copy(0, 0).start()
 
-        def place(i, cur):
-            left_cur, right_cur = cur
-            g = go_ref[0, base + i]
-            dest = jnp.where(g > 0, start + left_cur,
-                             start + n_left + right_cur)
-            out_ref[0, dest] = order_ref[0, base + i]
-            return (left_cur + g, right_cur + 1 - g)
+    def move_body(c, cur):
+        lcur, rcur = cur
+        slot = jax.lax.rem(c, 2)
 
-        left_cur, right_cur = jax.lax.fori_loop(
-            0, m, place, (carry[1], carry[2]))
-        carry[1] = left_cur
-        carry[2] = right_cur
+        @pl.when(c + 1 < nc)
+        def _prefetch():
+            order_copy(c + 1, 1 - slot).start()
+            go_copy(c + 1, 1 - slot).start()
+
+        order_copy(c, slot).wait()
+        go_copy(c, slot).wait()
+        m = jnp.minimum(seg_len - c * _CHUNK, _CHUNK)
+
+        # left run RMW: read the destination window, overlay this chunk's
+        # left rows from the cursor, write back (the tail past the overlay
+        # is restored bit-for-bit, so the fixed-size DMA cannot clobber
+        # the right run or a neighbouring segment)
+        left_rd = pltpu.make_async_copy(
+            out_hbm.at[:, pl.ds(start + lcur, _CHUNK)], dbuf.at[0],
+            sems.at[4])
+        left_rd.start()
+        left_rd.wait()
+
+        def place_left(i, k):
+            g = gbuf[slot, 0, i]
+
+            @pl.when(g > 0)
+            def _():
+                dbuf[0, 0, k] = obuf[slot, 0, i]
+
+            return k + g
+
+        m_left = jax.lax.fori_loop(0, m, place_left, jnp.int32(0))
+        left_wr = pltpu.make_async_copy(
+            dbuf.at[0], out_hbm.at[:, pl.ds(start + lcur, _CHUNK)],
+            sems.at[4])
+        left_wr.start()
+        left_wr.wait()
+
+        # right run RMW (reads AFTER the left write retired: where the two
+        # fixed-size windows overlap, the read sees the left run's final
+        # bytes and the overlay/tail preserves them)
+        right_rd = pltpu.make_async_copy(
+            out_hbm.at[:, pl.ds(start + n_left + rcur, _CHUNK)], dbuf.at[1],
+            sems.at[5])
+        right_rd.start()
+        right_rd.wait()
+
+        def place_right(i, k):
+            g = gbuf[slot, 0, i]
+
+            @pl.when(g == 0)
+            def _():
+                dbuf[1, 0, k] = obuf[slot, 0, i]
+
+            return k + 1 - g
+
+        m_right = jax.lax.fori_loop(0, m, place_right, jnp.int32(0))
+        right_wr = pltpu.make_async_copy(
+            dbuf.at[1], out_hbm.at[:, pl.ds(start + n_left + rcur, _CHUNK)],
+            sems.at[5])
+        right_wr.start()
+        right_wr.wait()
+        return (lcur + m_left, rcur + m_right)
+
+    jax.lax.fori_loop(0, nc, move_body, (jnp.int32(0), jnp.int32(0)))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -127,36 +199,40 @@ def partition_pallas_segments(
     """Stably partition every segment of ``order`` by ``go_left``.
 
     Returns ``(raw_order, left_counts)`` where ``raw_order`` holds the
-    partitioned row ids INSIDE segments and undefined values outside —
-    merge with ``jnp.where(seg_id >= 0, raw_order, order)`` (the
-    dispatcher in ops/partition.py does).  Segments must be disjoint.
+    partitioned row ids INSIDE segments and the kernel's own untouched
+    output elsewhere — merge with ``jnp.where(seg_id >= 0, raw_order,
+    order)`` (the dispatcher in ops/partition.py does).  Segments must be
+    disjoint.  No row cap: inputs stay HBM-resident (module docstring).
     """
     n = order.shape[0]
     S = seg_start.shape[0]
-    C = pl.cdiv(n, _CHUNK)
-    # pad so every chunk slice is in range: a segment's last chunk may
-    # slice up to CHUNK-1 past N, and an out-of-range pl.ds start CLAMPS
-    # (silently reading shifted data) — the iota<rem mask then does the
-    # real bounding against the padded tail
-    n_pad = (C + 1) * _CHUNK
+    # pad so every fixed-size chunk DMA is in range: a segment's last
+    # chunk may reach up to CHUNK-1 past its end (<= n + CHUNK - 1), and
+    # the RMW windows reach the same bound — out-of-range dynamic slices
+    # CLAMP silently on TPU (docs/NEXT.md infra notes), so over-allocate
+    # instead of relying on clamping
+    n_pad = (pl.cdiv(n, _CHUNK) + 1) * _CHUNK
     order_p = jnp.pad(order, (0, n_pad - n))
     go_p = jnp.pad(go_left.astype(jnp.int32), (0, n_pad - n))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(S, 2, C),
+        grid=(S,),
         in_specs=[
-            pl.BlockSpec((1, n_pad), lambda s, p, c, *_: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n_pad), lambda s, p, c, *_: (0, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # order: HBM, DMA-chunked
+            pl.BlockSpec(memory_space=pltpu.ANY),  # go_left: HBM
         ],
         out_specs=[
-            pl.BlockSpec((1, n_pad), lambda s, p, c, *_: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S), lambda s, p, c, *_: (0, 0),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # out: HBM, run-wise DMA
+            # jaxlint: disable=R11 (left counts are O(S) segments — a few KB — not row-proportional; staging whole is the point)
+            pl.BlockSpec((1, S), lambda s, *_: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        scratch_shapes=[pltpu.SMEM((4,), jnp.int32)],
+        scratch_shapes=[
+            pltpu.VMEM((2, 1, _CHUNK), jnp.int32),  # order chunks (dbl-buf)
+            pltpu.VMEM((2, 1, _CHUNK), jnp.int32),  # go chunks (dbl-buf)
+            pltpu.VMEM((2, 1, _CHUNK), jnp.int32),  # left/right RMW windows
+            pltpu.SemaphoreType.DMA((6,)),
+        ],
     )
     raw, lc = pl.pallas_call(
         _partition_kernel,
@@ -166,7 +242,7 @@ def partition_pallas_segments(
             jax.ShapeDtypeStruct((1, S), jnp.int32),
         ],
         compiler_params=pltpu.TPUCompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+            dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
     )(seg_start.astype(jnp.int32), seg_len.astype(jnp.int32),

@@ -96,19 +96,43 @@ class WState(NamedTuple):
     tree: TreeArrays
 
 
-def _window_size(x: int, n: int, floor: int = 8192) -> int:
-    """Window size quantization.  Factor-4 steps to 128k, then factor-2,
-    clamped to round_up(N, floor): each distinct W is a separate remote
-    Mosaic compile of the fused round (1-5 min on this toolchain), so the
-    ladder stays short — but r5 WPROF showed early rounds with ~130-170k
-    small-children rows landing on W=524288 (> N=400k itself!) under pure
-    factor-4, paying 2.5-4x window overshoot exactly where passes are
-    biggest.  Ladder for N=400k: 8k, 32k, 128k, 256k, 400k-pad (5 sizes)."""
+def _ladder(n: int, floor: int = 8192):
+    """The W ladder for (n, floor): factor-4 steps to 128k, then
+    factor-2, clamped to (and ending at) round_up(n, floor).  Each
+    distinct W is a separate remote Mosaic compile of the fused round
+    (1-5 min on this toolchain), so the ladder stays short — but r5
+    WPROF showed early rounds with ~130-170k small-children rows landing
+    on W=524288 (> N=400k itself!) under pure factor-4, paying 2.5-4x
+    window overshoot exactly where passes are biggest.  Ladder for
+    N=400k: 8k, 32k, 128k, 256k, 400k-pad (5 sizes)."""
     cap = -(-n // floor) * floor
     w = floor
-    while w < x and w < cap:
+    while True:
+        yield min(w, cap)
+        if w >= cap:
+            return
         w *= 4 if w < 131072 else 2
-    return min(w, cap)
+
+
+def _window_size(x: int, n: int, floor: int = 8192) -> int:
+    """Window size quantization: the first ladder rung covering ``x``."""
+    for w in _ladder(n, floor):
+        if w >= x:
+            break
+    return w
+
+
+def _window_rung(w: int, n: int, floor: int = 8192) -> int:
+    """Ladder index of window size ``w`` (0 = the floor rung) for the
+    same (n, floor) the driver laddered with.  Span attribute only: the
+    whint-overshoot question — does the bound climb the ladder earlier
+    than the realized windows justify? — is answerable from one trace
+    when every ``windowed_round`` span carries its rung and the
+    transition that led to it (docs/NEXT.md round-11 queue)."""
+    for r, c in enumerate(_ladder(n, floor)):
+        if c >= w:
+            break
+    return r
 
 
 @functools.partial(
@@ -719,6 +743,7 @@ def _grow_windowed_impl(
     # intervals are device-inclusive without adding a single pull — the
     # pattern jaxlint R10 pins for span closes
     t_resolve_prev: Optional[float] = None
+    rung_prev: Optional[int] = None  # last resolved round's ladder rung
     t_last = _time.perf_counter() if prof else 0.0
     # every productive round admits >= 1 split, reads lag 1 round, plus
     # defensive headroom for retried (skipped) rounds
@@ -764,13 +789,24 @@ def _grow_windowed_impl(
                 # the round that retired between them (the first one also
                 # carries init + pipeline fill, flagged in the attrs)
                 t_now = _time.perf_counter()
+                # W-ladder context (round 12): the rung this round ran
+                # on, the transition that brought it there, and the
+                # whint that will ladder W two dispatches later — one
+                # trace now answers whether whint overshoots the
+                # realized windows (rows vs W per rung)
+                rung = _window_rung(w_ran, n)
                 _trace.record_span(
                     "windowed_round",
                     t_now - (t_resolve_prev if t_resolve_prev is not None
                              else t_open),
                     round=resolved, k_acc=k_acc, rows=total, W=w_ran,
+                    rung=rung,
+                    rung_delta=(0 if rung_prev is None
+                                else rung - rung_prev),
+                    whint=whint,
                     first=t_resolve_prev is None)
                 t_resolve_prev = t_now
+                rung_prev = rung
             if not finite:
                 _obs.counter("train_nonfinite_errors_total").inc()
                 _obs.event("nonfinite", phase="windowed", round=resolved)
@@ -812,14 +848,20 @@ def _grow_windowed_impl(
                 # round of a tree resolves HERE, one dispatch behind), and
                 # this resolve is just as accounted as the in-loop one
                 t_now = _time.perf_counter()
+                rung = _window_rung(windows[resolved - 1], n)
                 _trace.record_span(
                     "windowed_round",
                     t_now - (t_resolve_prev if t_resolve_prev is not None
                              else t_open),
                     round=resolved, k_acc=int(info[0]), rows=int(info[1]),
                     W=windows[resolved - 1],
+                    rung=rung,
+                    rung_delta=(0 if rung_prev is None
+                                else rung - rung_prev),
+                    whint=int(info[3]),
                     first=t_resolve_prev is None, drained=True)
                 t_resolve_prev = t_now
+                rung_prev = rung
             if not int(info[4]):
                 _obs.counter("train_nonfinite_errors_total").inc()
                 _obs.event("nonfinite", phase="windowed_drain",

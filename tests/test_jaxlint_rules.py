@@ -1361,3 +1361,103 @@ def test_r10_pragma_suppression(tmp_path):
     """}, rules=["R10"])
     assert not rep.findings
     assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R11 whole-array-vmem-staging
+# ---------------------------------------------------------------------------
+
+def test_r11_positive_whole_array_block(tmp_path):
+    """The v1 partition kernel's exact shape: a variable-size dimension
+    staged as ONE block (constant index map) — O(N) staging traffic and a
+    VMEM row cap."""
+    rep = _scan(tmp_path, {"mod.py": """
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def call_kernel(kernel, order, n):
+            return pl.pallas_call(
+                kernel,
+                in_specs=[
+                    pl.BlockSpec((1, n), lambda s: (0, 0),
+                                 memory_space=pltpu.VMEM),
+                ],
+            )(order)
+    """}, rules=["R11"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "R11"
+    assert "VMEM" in rep.findings[0].message
+
+
+def test_r11_positive_missing_index_map_defaults_to_whole(tmp_path):
+    """No index map at all stages the array whole too — same finding."""
+    rep = _scan(tmp_path, {"mod.py": """
+        from jax.experimental import pallas as pl
+
+        def build_spec(n_pad):
+            return pl.BlockSpec((n_pad,))
+    """}, rules=["R11"])
+    assert len(rep.findings) == 1, rep.findings
+
+
+def test_r11_positive_keyword_form(tmp_path):
+    """The same anti-pattern written with keyword arguments
+    (block_shape=/index_map=) is flagged too."""
+    rep = _scan(tmp_path, {"mod.py": """
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def build_spec(n_pad):
+            return pl.BlockSpec(block_shape=(1, n_pad),
+                                index_map=lambda s: (0, 0),
+                                memory_space=pltpu.VMEM)
+    """}, rules=["R11"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "R11"
+
+
+def test_r11_negative_hbm_ref_and_grid_blocking_and_fixed_tiles(tmp_path):
+    """The three normal idioms stay clean: the HBM-ref fix pattern
+    (memory_space=ANY), real grid blocking (index map uses a grid arg),
+    and literal fixed-size tiles."""
+    rep = _scan(tmp_path, {"mod.py": """
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def specs(n, row_tile, nc):
+            hbm = pl.BlockSpec(memory_space=pltpu.ANY)
+            hbm2 = pl.BlockSpec((1, n), lambda s: (0, 0),
+                                memory_space=pltpu.ANY)
+            grid_blocked = pl.BlockSpec((row_tile, nc), lambda j, i: (i, 0),
+                                        memory_space=pltpu.VMEM)
+            fixed = pl.BlockSpec((1, 512), lambda s: (0, 0),
+                                 memory_space=pltpu.VMEM)
+            return hbm, hbm2, grid_blocked, fixed
+    """}, rules=["R11"])
+    assert not rep.findings, rep.findings
+
+
+def test_r11_negative_no_pallas_import_not_scanned(tmp_path):
+    """BlockSpec-named calls outside pallas modules are someone else's
+    API — not scanned."""
+    rep = _scan(tmp_path, {"mod.py": """
+        def f(layout, n):
+            return layout.BlockSpec((1, n), lambda s: (0, 0))
+    """}, rules=["R11"])
+    assert not rep.findings, rep.findings
+
+
+def test_r11_pragma_suppression(tmp_path):
+    """An intentionally staged SMALL variable-size block (O(S) segment
+    table) documents itself with the pragma + reason."""
+    rep = _scan(tmp_path, {"mod.py": """
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def spec(S):
+            # jaxlint: disable=R11 (fixture: O(S) table, a few KB)
+            return pl.BlockSpec((1, S), lambda s: (0, 0),
+                                memory_space=pltpu.VMEM)
+    """}, rules=["R11"])
+    assert not rep.findings
+    assert len(rep.suppressed) == 1

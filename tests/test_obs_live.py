@@ -123,6 +123,159 @@ def test_chrome_trace_export_roundtrip(tmp_path):
         obs_trace.validate_trace({"traceEvents": []})
 
 
+def test_ring_overflow_spills_to_jsonl_in_order(tmp_path):
+    """Round 12: ring evictions are no longer silent — with a spill sink
+    armed, the OLDEST span falls off into the JSONL sidecar (in eviction
+    order) and counts trace_spans_spilled_total; the ring keeps the
+    newest cap spans exactly as before."""
+    path = str(tmp_path / "spill.jsonl")
+    obs_trace.set_ring_cap(4)
+    try:
+        obs_trace.enable_spill(path)
+        for i in range(10):
+            obs_trace.record_span(f"s{i}", 0.01, i=i)
+        assert [s["name"] for s in obs_trace.spans()] == [
+            f"s{i}" for i in range(6, 10)]
+        obs_trace.disable_spill()
+        lines = [json.loads(ln)
+                 for ln in Path(path).read_text().splitlines()]
+        assert [ln["name"] for ln in lines] == [f"s{i}" for i in range(6)]
+        assert lines[0]["attrs"] == {"i": 0}  # full records, not summaries
+        assert obs.counter("trace_spans_spilled_total").value == 6
+        assert obs.counter("trace_spans_dropped_total").value == 0
+    finally:
+        obs_trace.disable_spill()
+        obs_trace.set_ring_cap(obs_trace.TRACE_RING_CAP)
+
+
+def test_spill_byte_bound_and_no_sink_count_drops(tmp_path):
+    """Past max_bytes the sink stops growing and evictions degrade to the
+    dropped counter; with no sink at all every eviction is a counted
+    drop — either way the metrics always say what the ring lost."""
+    path = str(tmp_path / "spill.jsonl")
+    obs_trace.set_ring_cap(1)
+    try:
+        obs_trace.enable_spill(path, max_bytes=200)
+        for i in range(50):
+            obs_trace.record_span("pad", 0.01, i=i)
+        obs_trace.disable_spill()
+        spilled = obs.counter("trace_spans_spilled_total").value
+        dropped = obs.counter("trace_spans_dropped_total").value
+        assert spilled >= 1 and dropped >= 1
+        assert spilled + dropped == 49  # every eviction accounted
+        # the sink respects the bound (may overshoot by < one record)
+        assert Path(path).stat().st_size < 200 + 256
+        # no sink: pure drops
+        obs.reset()
+        obs_trace.reset_trace()
+        for i in range(5):
+            obs_trace.record_span("nosink", 0.01)
+        assert obs.counter("trace_spans_dropped_total").value == 4
+        assert obs.counter("trace_spans_spilled_total").value == 0
+    finally:
+        obs_trace.disable_spill()
+        obs_trace.set_ring_cap(obs_trace.TRACE_RING_CAP)
+
+
+def test_spill_survives_process_restart(tmp_path):
+    """A relaunched process (watchdog restart / resume=auto) re-arming
+    the same spill path APPENDS — the pre-crash span history the sink
+    exists to preserve is not truncated.  Re-arming after a CLEAN disarm
+    in the same process truncates instead: a later run's evictions must
+    not be appended to (and mistaken for) a finished run's history.
+    Switching paths mid-process also truncates the new file."""
+    path = str(tmp_path / "spill.jsonl")
+    obs_trace.set_ring_cap(1)
+    try:
+        obs_trace.enable_spill(path)
+        for i in range(4):
+            obs_trace.record_span(f"run1_{i}", 0.01)
+        obs_trace.disable_spill()
+        # simulate a fresh process: sink state and ring both start empty
+        obs_trace._spill_path = None
+        obs_trace._spill_fh = None
+        obs_trace._spill_clean = False
+        obs_trace.reset_trace()
+        obs_trace.enable_spill(path)
+        for i in range(4):
+            obs_trace.record_span(f"run2_{i}", 0.01)
+        obs_trace.disable_spill()
+        names = [json.loads(ln)["name"]
+                 for ln in Path(path).read_text().splitlines()]
+        assert names == ["run1_0", "run1_1", "run1_2",
+                         "run2_0", "run2_1", "run2_2"]
+        # in-process re-arm after the clean disarm above: SAME path
+        # truncates — run 3's sidecar holds only run 3's evictions
+        obs_trace.reset_trace()
+        obs_trace.enable_spill(path)
+        for i in range(3):
+            obs_trace.record_span(f"run3_{i}", 0.01)
+        obs_trace.disable_spill()
+        names = [json.loads(ln)["name"]
+                 for ln in Path(path).read_text().splitlines()]
+        assert names == ["run3_0", "run3_1"]
+        # mid-process path switch truncates the (stale) new target
+        obs_trace.reset_trace()
+        other = str(tmp_path / "other.jsonl")
+        Path(other).write_text('{"name": "stale"}\n')
+        obs_trace.enable_spill(other)
+        obs_trace.record_span("x", 0.01)
+        obs_trace.record_span("y", 0.01)
+        obs_trace.disable_spill()
+        assert "stale" not in Path(other).read_text()
+    finally:
+        obs_trace.disable_spill()
+        obs_trace.set_ring_cap(obs_trace.TRACE_RING_CAP)
+
+
+def test_engine_train_arms_spill_next_to_trace_file(tmp_path):
+    """engine.train with trace_file= arms the sidecar spill sink, so a
+    run that overflows the ring leaves <trace_file>.spill.jsonl behind."""
+    trace_path = str(tmp_path / "run_trace.json")
+    rng = np.random.RandomState(0)
+    X = rng.randn(80, 4)
+    y = (X[:, 0] > 0).astype(float)
+    obs_trace.set_ring_cap(2)
+    try:
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "trace_file": trace_path},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+        assert obs_trace.spill_path() == trace_path + ".spill.jsonl"
+        assert Path(trace_path + ".spill.jsonl").exists()
+        assert obs.counter("trace_spans_spilled_total").value >= 1
+        assert Path(trace_path).exists()  # the main export still lands
+    finally:
+        obs_trace.disable_spill()
+        obs_trace.set_ring_cap(obs_trace.TRACE_RING_CAP)
+
+
+def test_engine_train_disarms_spill_on_exception(tmp_path):
+    """The spill sink armed at train start must be disarmed on EVERY exit
+    path — a run killed by a mid-boost exception must not leave the sink
+    armed process-wide, or later unrelated work's ring evictions would be
+    appended to (and mistaken for) the dead run's span history."""
+    trace_path = str(tmp_path / "run_trace.json")
+    rng = np.random.RandomState(0)
+    X = rng.randn(80, 4)
+    y = (X[:, 0] > 0).astype(float)
+
+    def _boom(env):
+        raise RuntimeError("mid-boost failure")
+
+    try:
+        with pytest.raises(RuntimeError, match="mid-boost failure"):
+            lgb.train({"objective": "binary", "verbosity": -1,
+                       "trace_file": trace_path},
+                      lgb.Dataset(X, label=y), num_boost_round=3,
+                      callbacks=[_boom])
+        # spill_path() keeps the last-armed path for resume semantics; the
+        # armed/disarmed state is the open file handle
+        assert obs_trace._spill_fh is None  # disarmed despite the raise
+        assert Path(trace_path).exists()  # partial-run trace still lands
+    finally:
+        obs_trace.disable_spill()
+
+
 def test_span_exception_close_and_mismatched_exit():
     with pytest.raises(RuntimeError):
         with obs_trace.span("boom"):

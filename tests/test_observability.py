@@ -331,6 +331,19 @@ def test_budgets_hold_with_telemetry_on_and_snapshot_covers_run(tmp_path):
     assert len(obs_trace.spans("windowed_round")) == total_rounds
     assert any(s["attrs"].get("drained")
                for s in obs_trace.spans("windowed_round"))
+    # round-12 W-ladder context: every round span carries its rung, the
+    # transition that led there, and the whint it emitted — the rung must
+    # agree with the W the round ran on, and the deltas must chain
+    # (rung[i] - rung[i-1]) within one tree's span sequence
+    from lightgbm_tpu.ops.treegrow_windowed import _window_rung
+    wspans = obs_trace.spans("windowed_round")
+    for s in wspans:
+        a = s["attrs"]
+        assert a["rung"] == _window_rung(a["W"], n) and "whint" in a
+    for prev, cur in zip(wspans, wspans[1:]):
+        if not cur["attrs"]["first"]:
+            assert (cur["attrs"]["rung_delta"]
+                    == cur["attrs"]["rung"] - prev["attrs"]["rung"])
 
     # -- predict side: the round-9 warm budget with telemetry recording --
     bst, Xb, _ = _tiny_train(rounds=4)

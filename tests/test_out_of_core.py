@@ -1,0 +1,346 @@
+"""Out-of-core data-path equivalence (docs round 12, ISSUE 7).
+
+The contract under test: streaming the binned matrix — from a
+``save_binary`` cache or a host array, in ANY chunk size — may never
+change a trained model by a single bit.
+
+* resident regime (rows <= max_rows_in_hbm budget, or no budget): the
+  streamed chunks assemble the identical device matrix, training runs
+  the standard growers — bitwise trivially, pinned anyway.
+* spill regime (rows > max_rows_in_hbm): the chunked-histogram grower
+  (ops/treegrow_ooc.py) is a strict-grower mirror whose seeded
+  scatter-add fold is order-preserving — bitwise vs IN-MEMORY training
+  on the scatter histogram strategy (max_bin > 64), across chunk sizes
+  {1 row, odd, pow2, N}.
+* the windowed grower's 1-dispatch/0-sync steady-state budget stays
+  green when fed from a stream-assembled (out_of_core resident) matrix.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_data(n=400, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+# the spill grower mirrors the strict grower bitwise on the SCATTER
+# histogram strategy — max_bin > 64 selects it in-memory too (the wide
+# regime out-of-core exists for; ops/treegrow_ooc.py module docstring)
+_PARAMS = {
+    "objective": "binary",
+    "num_leaves": 7,
+    "max_bin": 255,
+    "verbosity": -1,
+    "feature_pre_filter": False,  # scans the host matrix OOC never holds
+    "min_data_in_leaf": 5,
+}
+
+
+def _train_model_str(train_set, rounds=3, **extra):
+    params = dict(_PARAMS)
+    params.update(extra)
+    bst = lgb.Booster(params=params, train_set=train_set)
+    for _ in range(rounds):
+        bst.update()
+    return bst, bst.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# streaming reader
+# ---------------------------------------------------------------------------
+
+def test_bin_cache_stream_round_trips_the_matrix(tmp_path):
+    """Chunked sequential reads of the npz member reassemble the exact
+    binned matrix — including through the REUSED buffer (consumers that
+    copy per chunk see stable data)."""
+    from lightgbm_tpu.io.stream import BinCacheStream
+
+    X, y = _make_data(n=123, f=5)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 255})
+    cache = str(tmp_path / "ds.bin")
+    ds.construct()
+    ds.save_binary(cache)
+    want = np.asarray(ds.bins)
+
+    stream = BinCacheStream(cache)
+    assert stream.shape == want.shape
+    for chunk_rows in (1, 7, 64, 123, 200):
+        got = np.zeros_like(want)
+        for lo, view in stream.chunks(chunk_rows):
+            got[lo:lo + view.shape[0]] = view  # copy out of the reused buf
+        np.testing.assert_array_equal(got, want)
+
+
+def test_prefetch_device_preserves_chunks_despite_buffer_reuse():
+    """The one-deep prefetch uploads with copy semantics: the reused host
+    buffer being refilled for chunk k+1 must not corrupt chunk k."""
+    from lightgbm_tpu.io.stream import prefetch_device
+
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, 100, (50, 4)).astype(np.int16)
+    buf = np.empty((8, 4), np.int16)
+
+    def reusing_chunks():
+        for lo in range(0, 50, 8):
+            m = min(8, 50 - lo)
+            buf[:m] = data[lo:lo + m]
+            yield lo, buf[:m]
+
+    seen = np.zeros_like(data)
+    for lo, m, dev in prefetch_device(reusing_chunks(), pad_rows=8):
+        seen[lo:lo + m] = np.asarray(dev)[:m]
+    np.testing.assert_array_equal(seen, data)
+
+
+# ---------------------------------------------------------------------------
+# resident regime: streamed ingest, standard growers
+# ---------------------------------------------------------------------------
+
+def test_resident_ooc_from_cache_is_bitwise_across_chunk_sizes(tmp_path):
+    X, y = _make_data()
+    n = X.shape[0]
+    mem_ds = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    _, want = _train_model_str(mem_ds)
+
+    base = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    cache = str(tmp_path / "train.bin")
+    base.construct()
+    base.save_binary(cache)
+
+    for chunk in (1, 37, 128, n):  # 1 row, odd, pow2, all-N
+        ds = lgb.Dataset(cache, params=dict(
+            _PARAMS, out_of_core=True, out_of_core_chunk_rows=chunk))
+        bst, got = _train_model_str(ds)
+        assert got == want, f"resident OOC diverged at chunk_rows={chunk}"
+        # the ingest never materialized a host matrix
+        assert ds.bins is None
+        assert ds.bins_device is not None and not ds.ooc_spill
+
+
+def test_resident_ooc_from_ndarray_uploads_whole_matrix():
+    """out_of_core=True on an in-memory ndarray (no cache to stream from)
+    in the resident regime takes the direct whole-array upload — host
+    bins already exist, chunked placement would be pure overhead — and
+    the device matrix is identical to the plain in-memory path's."""
+    X, y = _make_data()
+    mem = lgb.Dataset(X, label=y, params=dict(_PARAMS)).construct()
+    ooc = lgb.Dataset(X, label=y, params=dict(
+        _PARAMS, out_of_core=True)).construct()
+    assert not ooc.ooc_spill and ooc.bins is not None
+    np.testing.assert_array_equal(
+        np.asarray(ooc.bins_device), np.asarray(mem.bins_device))
+
+
+def test_resident_ooc_whole_matrix_paths_materialize_from_device(tmp_path):
+    """subset()/add_features_from() (and other whole-matrix consumers)
+    work on a resident out_of_core dataset by materializing ONE host copy
+    from the assembled device matrix — they do not crash on bins=None."""
+    X, y = _make_data(n=150, f=4)
+    base = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    cache = str(tmp_path / "r.bin")
+    base.construct()
+    base.save_binary(cache)
+
+    ds = lgb.Dataset(cache, params=dict(_PARAMS, out_of_core=True))
+    ds.construct()
+    assert ds.bins is None
+    sub = ds.subset([0, 5, 9, 44])
+    np.testing.assert_array_equal(sub.bins, np.asarray(base.bins)[[0, 5, 9, 44]])
+
+    ds2 = lgb.Dataset(cache, params=dict(_PARAMS, out_of_core=True))
+    ds2.construct()
+    other = lgb.Dataset(X[:, :2], label=y, params=dict(_PARAMS))
+    other.construct()
+    joined = ds2.add_features_from(other)
+    assert joined.bins.shape == (150, 6)
+
+
+def test_spill_ooc_whole_matrix_paths_raise_envelope_error(tmp_path):
+    """A cache-streamed spill dataset has NO whole matrix anywhere — the
+    same paths raise the clear envelope error, not a raw TypeError."""
+    X, y = _make_data(n=200, f=4)
+    base = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    cache = str(tmp_path / "s.bin")
+    base.construct()
+    base.save_binary(cache)
+    ds = lgb.Dataset(cache, params=dict(
+        _PARAMS, out_of_core=True, max_rows_in_hbm=50))
+    ds.construct()
+    assert ds.ooc_spill and ds.bins is None and ds.bins_device is None
+    with pytest.raises(lgb.basic.LightGBMError, match="spill regime"):
+        ds.subset([0, 1, 2])
+    other = lgb.Dataset(X[:, :2], label=y, params=dict(_PARAMS))
+    with pytest.raises(lgb.basic.LightGBMError, match="spill regime"):
+        ds.add_features_from(other)
+
+
+# ---------------------------------------------------------------------------
+# spill regime: chunked-histogram training
+# ---------------------------------------------------------------------------
+
+def test_spill_ooc_is_bitwise_identical_to_in_memory_training(tmp_path):
+    """The headline equivalence (ISSUE acceptance): rows exceed the HBM
+    budget, the matrix is never device-resident, and the trained model is
+    BIT-identical to plain in-memory training — across chunk sizes
+    {1, odd, pow2, N}, from both chunk sources (host array and cache)."""
+    X, y = _make_data()
+    n = X.shape[0]
+    mem_ds = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    _, want = _train_model_str(mem_ds)
+
+    base = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    cache = str(tmp_path / "train.bin")
+    base.construct()
+    base.save_binary(cache)
+
+    for chunk in (1, 37, 128, n):
+        ds = lgb.Dataset(cache, params=dict(
+            _PARAMS, out_of_core=True, max_rows_in_hbm=n // 4,
+            out_of_core_chunk_rows=chunk))
+        bst, got = _train_model_str(ds)
+        assert ds.ooc_spill and ds.bins_device is None
+        assert got == want, f"spill OOC diverged at chunk_rows={chunk}"
+
+    # host-array source (in-memory data whose DEVICE residency is capped)
+    ds = lgb.Dataset(X, label=y, params=dict(
+        _PARAMS, out_of_core=True, max_rows_in_hbm=100,
+        out_of_core_chunk_rows=53))
+    _, got = _train_model_str(ds)
+    assert ds.ooc_spill
+    assert got == want
+
+
+def test_spill_ooc_with_bagging_and_feature_fraction(tmp_path):
+    """Row/feature sampling rides the resident vectors, not the streamed
+    matrix — sampled runs must stay bitwise too."""
+    X, y = _make_data(n=350, seed=3)
+    extra = dict(bagging_fraction=0.7, bagging_freq=1, feature_fraction=0.8)
+    mem_ds = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    _, want = _train_model_str(mem_ds, **extra)
+
+    ds = lgb.Dataset(X, label=y, params=dict(
+        _PARAMS, out_of_core=True, max_rows_in_hbm=64,
+        out_of_core_chunk_rows=41))
+    _, got = _train_model_str(ds, **extra)
+    assert got == want
+
+
+def test_spill_predictions_match_in_memory(tmp_path):
+    X, y = _make_data(n=300, seed=5)
+    mem_ds = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    bst_mem, _ = _train_model_str(mem_ds)
+    ds = lgb.Dataset(X, label=y, params=dict(
+        _PARAMS, out_of_core=True, max_rows_in_hbm=50,
+        out_of_core_chunk_rows=64))
+    bst_ooc, _ = _train_model_str(ds)
+    np.testing.assert_array_equal(
+        bst_mem.predict(X), bst_ooc.predict(X))
+
+
+def test_spill_envelope_raises_on_unsupported_features():
+    X, y = _make_data(n=200)
+    ds = lgb.Dataset(X, label=y, params=dict(
+        _PARAMS, out_of_core=True, max_rows_in_hbm=50))
+    with pytest.raises(ValueError, match="out_of_core spill"):
+        lgb.Booster(params=dict(_PARAMS, out_of_core=True,
+                                max_rows_in_hbm=50,
+                                monotone_constraints=[1, 0, 0, 0, 0, 0]),
+                    train_set=ds)
+
+
+def test_spill_dispatch_accounting(tmp_path):
+    """The spill grower's cost model is explicit: ceil(N/chunk) chunk
+    dispatches per pass, 1 root pass + 1 pass per split, one accounted
+    pull per split decision — all visible to the sanitizer ledger."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.ops.treegrow_ooc import grow_tree_ooc
+    from lightgbm_tpu.io.stream import array_chunks
+    from lightgbm_tpu.binning import DatasetBinner
+
+    X, y = _make_data(n=256, f=5, seed=7)
+    binner = DatasetBinner.fit(X, max_bin=255)
+    bins = binner.transform(X)
+    n, f = bins.shape
+    stats = {}
+    tree, leaf_id = grow_tree_ooc(
+        lambda: array_chunks(bins, 64), n, f,
+        jnp.asarray(0.6 * (y - 0.5), jnp.float32),
+        jnp.ones((n,), jnp.float32),
+        jnp.ones((n,), bool), jnp.ones((n,), jnp.float32),
+        jnp.ones((f,), bool),
+        jnp.asarray(binner.num_bins_per_feature),
+        jnp.asarray(binner.missing_bin_per_feature),
+        num_leaves=7, num_bins=256, params=SplitParams(min_data_in_leaf=5.0),
+        chunk_rows=64, stats=stats)
+    assert int(tree.num_leaves) > 1
+    assert stats["passes"] == stats["splits"] + 1
+    assert stats["chunks"] == stats["passes"] * 4  # 256 rows / 64-row chunks
+    assert leaf_id.shape == (n,)
+
+
+# ---------------------------------------------------------------------------
+# the windowed budget pin with out_of_core on (resident regime)
+# ---------------------------------------------------------------------------
+
+def test_windowed_budget_green_on_stream_assembled_matrix(tmp_path):
+    """ISSUE acceptance: the steady-state windowed budget (1 dispatch /
+    0 syncs / 0 retraces per round) holds when the grower's bins come
+    from an out_of_core stream-assembled device matrix — the chunk feed
+    happens at ingest, the round loop's async-info protocol is
+    untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+    from lightgbm_tpu.utils.sanitizer import DispatchCounter
+
+    rng = np.random.RandomState(11)
+    n, f = 900, 8
+    X = rng.randn(n, f)
+    y = X @ rng.randn(f) + 0.2 * rng.randn(n)
+    mem = lgb.Dataset(X, label=y, params={"max_bin": 31})
+    mem.construct()
+    cache = str(tmp_path / "w.bin")
+    mem.save_binary(cache)
+    ooc = lgb.Dataset(cache, params={
+        "max_bin": 31, "out_of_core": True, "out_of_core_chunk_rows": 111})
+    ooc.construct()
+    # the stream-assembled matrix IS the in-memory matrix
+    np.testing.assert_array_equal(
+        np.asarray(ooc.bins_device), np.asarray(mem.bins_device))
+
+    bins_t = ooc.bins_device_t()
+    kw = dict(
+        row_mask=jnp.ones((n,), bool),
+        sample_weight=jnp.ones((n,), jnp.float32),
+        feature_mask=jnp.ones((f,), bool),
+        num_bins_pf=jnp.asarray(ooc.binner.num_bins_per_feature),
+        missing_bin_pf=jnp.asarray(ooc.binner.missing_bin_per_feature),
+    )
+    static = dict(num_leaves=15, num_bins=32, params=SplitParams(
+        min_data_in_leaf=5.0), leaf_tile=4, use_pallas=False)
+    grads = [jnp.asarray(0.6 * y + 0.05 * k, jnp.float32) for k in range(2)]
+    tree, leaf = grow_tree_windowed(bins_t, grads[0], kw["sample_weight"],
+                                    **kw, **static)
+    jax.block_until_ready(leaf)
+
+    stats = {}
+    with DispatchCounter() as d:
+        tree, leaf = grow_tree_windowed(bins_t, grads[1],
+                                        kw["sample_weight"], **kw, **static,
+                                        stats=stats)
+        jax.block_until_ready(leaf)
+    assert stats["rounds"] >= 3, stats
+    d.assert_round_budget(stats["rounds"], what="windowed rounds on OOC bins")
+    assert stats["host_syncs"] == 0, stats
+    assert stats["retries"] == 0, stats
+    d.assert_no_recompile("windowed rounds on a stream-assembled matrix")

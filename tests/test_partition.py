@@ -2,6 +2,7 @@
 DataPartition analogue that round 3's windowed histogram passes build on)."""
 
 import numpy as np
+import pytest
 
 from lightgbm_tpu.ops.partition import stable_partition_ranges
 
@@ -110,6 +111,79 @@ def test_partition_pallas_degenerate_segments():
     # dispatcher's seg_id merge must restore it
     np.testing.assert_array_equal(
         np.asarray(got)[seg_id < 0], order[seg_id < 0])
+
+
+def test_partition_rows_has_no_row_cap():
+    """The v1 kernel silently fell back to the XLA permutation above
+    650k rows (whole-array VMEM staging); v2 is HBM-resident and must
+    take the Pallas path at ANY N.  Pinned without executing a 1M-row
+    kernel: trace the dispatcher at 1M rows with a sentinel-raising
+    kernel — if the sentinel fires, the Pallas path was selected (the
+    old cap returned the XLA result before ever touching the kernel)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops import partition as part
+    from lightgbm_tpu.ops import partition_pallas as pp
+
+    assert not hasattr(pp, "_MAX_VMEM_ROWS"), \
+        "the whole-array VMEM row cap is back"
+
+    n, s = 1_000_000, 4
+
+    class _Sentinel(Exception):
+        pass
+
+    def _boom(*a, **k):
+        raise _Sentinel
+
+    orig = part.__dict__.get("partition_pallas_segments")
+    try:
+        import lightgbm_tpu.ops.partition_pallas as _ppmod
+
+        saved = _ppmod.partition_pallas_segments
+        _ppmod.partition_pallas_segments = _boom
+        with pytest.raises(_Sentinel):
+            jax.eval_shape(
+                lambda o, sid, st, ln, gl: part.partition_rows(
+                    o, sid, st, ln, gl, use_pallas=True),
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                jax.ShapeDtypeStruct((s,), jnp.int32),
+                jax.ShapeDtypeStruct((s,), jnp.int32),
+                jax.ShapeDtypeStruct((n,), bool),
+            )
+    finally:
+        _ppmod.partition_pallas_segments = saved
+        if orig is not None:
+            part.partition_pallas_segments = orig
+
+
+@pytest.mark.slow
+def test_partition_pallas_interpret_above_650k_rows():
+    """The regime v1 could not reach: >650k rows through the DMA kernel
+    (interpret mode), bitwise against the XLA permutation.  Slow-marked —
+    the interpreter streams ~1.4k chunks per segment sweep."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.partition import partition_rows
+
+    rng = np.random.RandomState(9)
+    n = 700_000  # > the deleted 650k cap
+    order = rng.permutation(n).astype(np.int32)
+    seg_start = np.asarray([0, 250_000, 400_128, 690_000], np.int32)
+    seg_len = np.asarray([200_000, 100_001, 150_000, 10_000], np.int32)
+    seg_id = np.full(n, -1, np.int32)
+    for s, (lo, ln) in enumerate(zip(seg_start, seg_len)):
+        seg_id[lo:lo + ln] = s
+    go_left = rng.rand(n) < 0.5
+
+    args = (jnp.asarray(order), jnp.asarray(seg_id), jnp.asarray(seg_start),
+            jnp.asarray(seg_len), jnp.asarray(go_left))
+    want, want_l = partition_rows(*args, use_pallas=False)
+    got, got_l = partition_rows(*args, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(got_l), np.asarray(want_l))
 
 
 def test_windowed_grower_with_pallas_partition_matches_xla_partition():

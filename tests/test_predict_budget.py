@@ -223,6 +223,57 @@ def test_pred_leaf_device_traversal_matches_host_walk():
     d.assert_no_recompile("warm pred_leaf")
 
 
+def test_converted_predict_is_one_dispatch_one_sync(monkeypatch):
+    """Round 12: objective.convert_output is FUSED into the traversal
+    dispatch — a converted warm predict is 1 dispatch + 1 accounted pull
+    (it was 2 dispatches: traversal, then a separate convert), and the
+    fused result is bitwise the legacy 2-dispatch path's."""
+    bst, X, _ = _binary_booster()
+    fused = bst.predict(X)  # warm: packs + compiles the fused bucket
+
+    with DispatchCounter() as d:
+        again = bst.predict(X)
+    assert d.dispatches == 1, d.dispatches
+    assert d.host_syncs == 1, d.host_syncs
+    d.assert_no_recompile("warm converted predict")
+    assert np.array_equal(fused, again)
+
+    # the legacy 2-dispatch path must still exist (escape hatch) and be
+    # bitwise identical
+    monkeypatch.setenv("LGBMTPU_FUSED_CONVERT", "0")
+    legacy_warm = bst.predict(X)  # warm the legacy convert executable
+    with DispatchCounter() as d2:
+        legacy = bst.predict(X)
+    assert d2.dispatches == 2, d2.dispatches
+    assert np.array_equal(fused, legacy) and np.array_equal(
+        legacy_warm, legacy)
+
+
+def test_converted_predict_multiclass_one_dispatch_and_bitwise(monkeypatch):
+    bm, Xm = _multiclass_booster()
+    fused = bm.predict(Xm)
+    with DispatchCounter() as d:
+        again = bm.predict(Xm)
+    assert d.dispatches == 1, d.dispatches
+    assert d.host_syncs == 1, d.host_syncs
+    d.assert_no_recompile("warm converted multiclass predict")
+    assert np.array_equal(fused, again)
+
+    monkeypatch.setenv("LGBMTPU_FUSED_CONVERT", "0")
+    legacy = bm.predict(Xm)
+    assert np.array_equal(fused, legacy)
+
+
+def test_converted_predict_bucket_padding_bit_identical(monkeypatch):
+    """The fused convert rides the same bucket ladder: padding may never
+    change a converted bit either."""
+    bst, X, _ = _binary_booster()
+    padded = bst.predict(X[:129])
+    monkeypatch.setenv("LGBMTPU_PREDICT_BUCKETS", "0")
+    unpadded = bst.predict(X[:129])
+    assert np.array_equal(padded, unpadded)
+
+
 # ---------------------------------------------------------------------------
 # stale-cache hazard (ISSUE satellite): mutation after a predict must
 # invalidate the packed ensemble
