@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R11.
+"""jaxlint built-in rules R1-R12.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -1056,3 +1056,90 @@ def r11_whole_array_vmem_staging(pkg: PackageIndex) -> Iterator[Finding]:
                     "array whole in VMEM (non-literal block dimension, "
                     "constant index map): staging is O(N) and the VMEM "
                     "budget becomes a row cap", hint)
+
+
+# ---------------------------------------------------------------------------
+# R12 — raw-model-write
+# ---------------------------------------------------------------------------
+
+# name fragments marking an expression as a model/snapshot artifact path —
+# matched case-insensitively against identifiers, attribute names, and
+# string literals inside the written-path expression
+_R12_ARTIFACT_TOKENS = ("model", "snapshot", "manifest", "checkpoint",
+                        "ckpt")
+
+
+def _r12_mentions_artifact(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        name = None
+        if isinstance(n, ast.Name):
+            name = n.id
+        elif isinstance(n, ast.Attribute):
+            name = n.attr
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            name = n.value
+        if name is not None:
+            low = name.lower()
+            if any(t in low for t in _R12_ARTIFACT_TOKENS):
+                return True
+    return False
+
+
+@register_rule("R12", "raw-model-write")
+def r12_raw_model_write(pkg: PackageIndex) -> Iterator[Finding]:
+    """A durable write of a model/snapshot artifact OUTSIDE
+    utils/checkpoint.py: ``open(path, "w"/"wb")``, ``np.save``/
+    ``np.savez[_compressed]``, or a hand-rolled ``os.replace`` whose
+    target expression names a model/snapshot/manifest path.  Every
+    durable model write must go through the atomic sha256-trailed helper
+    (``checkpoint.atomic_write_text`` / ``save_snapshot``): a raw
+    ``open(..., "w")`` torn by a crash leaves a half-file a restart
+    happily parses into a half-model — the silent-corruption class the
+    round-8 checkpoint layer exists to exclude — and a raw ``os.replace``
+    without the fsync'd temp protocol can still publish an incompletely
+    flushed file.  Writes of non-artifact paths (logs, predictions,
+    metrics, data caches with their own CRC trailers) are not flagged;
+    an intentional raw artifact write (e.g. generated source code whose
+    name merely contains 'model') takes a pragma with its reason."""
+    hint = ("route durable model writes through utils/checkpoint.py: "
+            "atomic_write_text(path, text) for plain models, "
+            "save_snapshot(path, text, iteration) for trailer-stamped "
+            "snapshots, write_fleet_checkpoint for fleet rounds — see "
+            "docs/ROBUSTNESS.md and docs/ANALYSIS.md R12")
+    for mod in pkg.modules.values():
+        if str(mod.path).endswith("checkpoint.py"):
+            continue  # the sanctioned writer itself
+        for fi in mod.functions.values():
+            for node in _own_body(fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = dotted_name(node.func) or ""
+                last = fn.split(".")[-1]
+                how = None
+                if last == "open" and "." not in fn and node.args:
+                    mode = None
+                    if (len(node.args) > 1
+                            and isinstance(node.args[1], ast.Constant)):
+                        mode = node.args[1].value
+                    for kw in node.keywords:
+                        if (kw.arg == "mode"
+                                and isinstance(kw.value, ast.Constant)):
+                            mode = kw.value.value
+                    if (isinstance(mode, str) and "w" in mode
+                            and _r12_mentions_artifact(node.args[0])):
+                        how = f"open(..., {mode!r})"
+                elif (_is_np_attr(node.func,
+                                  ("save", "savez", "savez_compressed"))
+                      and any(_r12_mentions_artifact(a)
+                              for a in node.args)):
+                    how = f"np.{last}"
+                elif (fn == "os.replace" and len(node.args) > 1
+                      and _r12_mentions_artifact(node.args[1])):
+                    how = "os.replace"
+                if how is not None:
+                    yield _finding(
+                        fi, node, "R12",
+                        f"{fi.qualname} writes a model/snapshot artifact "
+                        f"via raw {how} — outside the atomic "
+                        "sha256-trailed checkpoint helper, a crash can "
+                        "leave a torn file a restart will trust", hint)

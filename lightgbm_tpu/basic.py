@@ -900,10 +900,20 @@ class Dataset:
         return self
 
     def _savez_binary(self, fh) -> None:
+        from .io.stream import DEFAULT_CRC_ROWS, bin_crc32s
+
         ms = self.binner.mappers
+        # per-chunk CRC32 trailer table (io/stream.py): BinCacheStream
+        # re-verifies every streamed sweep against it, so a torn or
+        # bit-rotted cache fails row-ranged instead of training on
+        # garbage bins (docs/ROBUSTNESS.md).  Computed over the C-order
+        # bytes np.save writes.
+        bins_c = np.ascontiguousarray(self.bins)
         np.savez_compressed(
             fh,
-            bins=self.bins,
+            bins=bins_c,
+            bins_crc32=bin_crc32s(bins_c),
+            bins_crc_rows=np.asarray(DEFAULT_CRC_ROWS, np.int64),
             label=self.label if self.label is not None else np.zeros(0),
             weight=self.weight if self.weight is not None else np.zeros(0),
             group=self.group if self.group is not None else np.zeros(0, np.int64),
@@ -931,33 +941,26 @@ class Dataset:
     # -- tree traversal on binned data ----------------------------------
     def predict_leaf_binned_tree(self, tree: Tree) -> jnp.ndarray:
         """Leaf index per row for one tree on this dataset's binned matrix.
-        Pads node arrays to power-of-two buckets to bound jit recompiles."""
+        Pads node arrays to power-of-two buckets to bound jit recompiles.
+
+        Spill-regime out_of_core datasets (no device-resident matrix)
+        traverse CHUNK-WISE over the stream — the path crash-resume's
+        score replay takes (docs/ROBUSTNESS.md "Elastic fleet recovery"):
+        a resumed rank rebuilds its score state without ever
+        materializing the matrix."""
         n = self.num_data()
         m = tree.num_internal
         if m == 0:
             return jnp.zeros((n,), jnp.int32)
-        if self.bins_device is None:
-            raise LightGBMError(
-                "binned-tree traversal needs device-resident bins; this "
-                "out_of_core dataset exceeds max_rows_in_hbm (spill "
-                "regime) — rollback/DART/valid-replay paths are outside "
-                "the OOC envelope (ops/treegrow_ooc.py)")
-        if tree.num_cat > 0:
+        if tree.num_cat > 0 and self.bins_device is not None:
             # categorical nodes need bin-subset membership — host walk
             return jnp.asarray(
                 tree.predict_leaf_binned_batch(
                     np.asarray(self._host_bins("categorical-tree traversal")),
                     self.binner)
             )
-        if tree.threshold_bin is None:
-            # tree came from a model string: recover bin-space thresholds from
-            # the real-valued ones (exact when thresholds are this binner's
-            # bin uppers; reference stores bin uppers as thresholds)
-            tb = np.zeros(m, np.int32)
-            for i in range(m):
-                f = int(tree.split_feature[i])
-                tb[i] = int(self.binner.mappers[f].transform(np.asarray([tree.threshold[i]]))[0])
-            tree.threshold_bin = tb
+        # model-string-loaded trees: recover bin-space thresholds lazily
+        self._tree_threshold_bin(tree)
         cap = 1
         while cap < m:
             cap *= 2
@@ -966,6 +969,9 @@ class Dataset:
             out = np.full(cap, fill, dtype=np.asarray(a).dtype)
             out[:m] = a[:m]
             return jnp.asarray(out[None])
+
+        if self.bins_device is None:
+            return self._predict_leaf_binned_tree_streamed(tree, pad)
 
         leaf = predict_ops.predict_leaf_binned(
             self.bins_device,
@@ -978,6 +984,103 @@ class Dataset:
             jnp.asarray([tree.num_leaves], jnp.int32),
         )[0]
         return leaf
+
+    def _tree_threshold_bin(self, tree: Tree) -> None:
+        """Recover bin-space thresholds for a model-string-loaded tree
+        (exact when thresholds are this binner's bin uppers — the
+        reference stores bin uppers as thresholds)."""
+        if tree.threshold_bin is not None or tree.num_cat > 0:
+            return
+        m = tree.num_internal
+        tb = np.zeros(m, np.int32)
+        for i in range(m):
+            f = int(tree.split_feature[i])
+            tb[i] = int(self.binner.mappers[f].transform(
+                np.asarray([tree.threshold[i]]))[0])
+        tree.threshold_bin = tb
+
+    def predict_leaf_binned_trees_chunked(self, trees):
+        """One stream sweep for MANY trees: yields ``(row_lo, valid,
+        leaf)`` per chunk where ``leaf`` is the (T, chunk_rows) leaf
+        matrix from the stacked traversal kernel.  The spill-regime
+        resume replay path: T separate :meth:`predict_leaf_binned_tree`
+        sweeps would re-decompress the save_binary cache T times; this
+        pays ONE sequential pass for the whole ensemble."""
+        trees = list(trees)
+        if any(t.num_cat > 0 for t in trees):
+            raise LightGBMError(
+                "categorical trees are outside the chunked multi-tree "
+                "traversal (spill-regime replay; ops/treegrow_ooc.py)")
+        for t in trees:
+            self._tree_threshold_bin(t)
+        m_max = max((t.num_internal for t in trees), default=0)
+        cap = 1
+        while cap < max(m_max, 1):
+            cap *= 2
+
+        def stack(get, dtype, fill=0):
+            out = np.full((len(trees), cap), fill, dtype=dtype)
+            for ti, t in enumerate(trees):
+                m = t.num_internal
+                if m:
+                    out[ti, :m] = np.asarray(get(t))[:m]
+            return jnp.asarray(out)
+
+        args = (
+            self.missing_bin_pf_device,
+            stack(lambda t: t.split_feature, np.int32),
+            stack(lambda t: t.threshold_bin, np.int32),
+            stack(lambda t: t.default_left(), np.bool_),
+            stack(lambda t: t.left_child, np.int32, fill=-1),
+            stack(lambda t: t.right_child, np.int32, fill=-1),
+            jnp.asarray([t.num_leaves for t in trees], jnp.int32),
+        )
+        from .io.stream import prefetch_device
+
+        for row_lo, valid, dev in prefetch_device(
+                self.ooc_chunk_iter(), dtype=jnp.int16,
+                pad_rows=self.ooc_chunk_rows):
+            yield row_lo, valid, predict_ops.predict_leaf_binned(dev, *args)
+
+    def _predict_leaf_cat_streamed(self, tree: Tree) -> jnp.ndarray:
+        """Categorical-tree spill traversal: the stream yields HOST chunk
+        views, so the bin-subset host walk runs per chunk — no matrix
+        materialization (host walks are the resident categorical path's
+        behavior too)."""
+        parts = []
+        for _row_lo, chunk in self.ooc_chunk_iter():
+            parts.append(np.asarray(
+                tree.predict_leaf_binned_batch(np.array(chunk),
+                                               self.binner)))
+        return jnp.asarray(np.concatenate(parts).astype(np.int32))
+
+    def _predict_leaf_binned_tree_streamed(self, tree: Tree, pad):
+        """Spill-regime traversal: sweep the bin stream once, traversing
+        each uploaded chunk with the same jitted kernel the resident path
+        uses (chunks are padded to the stream's fixed chunk rows so the
+        whole sweep compiles once; the tail rides the same executable
+        with its pad rows discarded).  Per-chunk leaves stay ON DEVICE
+        and concatenate once at the end — the sweep adds no host pulls."""
+        if tree.num_cat > 0:
+            return self._predict_leaf_cat_streamed(tree)
+        args = (
+            self.missing_bin_pf_device,
+            pad(tree.split_feature),
+            pad(tree.threshold_bin),
+            pad(tree.default_left()),
+            pad(tree.left_child, fill=-1),
+            pad(tree.right_child, fill=-1),
+            jnp.asarray([tree.num_leaves], jnp.int32),
+        )
+        from .io.stream import prefetch_device
+
+        parts = []
+        for _row_lo, valid, dev in prefetch_device(
+                self.ooc_chunk_iter(), dtype=jnp.int16,
+                pad_rows=self.ooc_chunk_rows):
+            leaf = predict_ops.predict_leaf_binned(dev, *args)[0]
+            parts.append(leaf[:valid])
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
 
 class Booster:
@@ -1280,9 +1383,14 @@ class Booster:
 
     # -- serialization ----------------------------------------------------
     def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0,
-                        importance_type: str = None) -> str:
-        # None defers to saved_feature_importance_type (reference: config)
-        return self._gbdt.save_model_to_string(num_iteration, start_iteration, importance_type)
+                        importance_type: str = None,
+                        raw_deltas: bool = False) -> str:
+        # None defers to saved_feature_importance_type (reference: config).
+        # raw_deltas: snapshot form — pure-delta trees + init_scores header
+        # line, the bitwise-resume contract (docs/ROBUSTNESS.md)
+        return self._gbdt.save_model_to_string(
+            num_iteration, start_iteration, importance_type,
+            raw_deltas=raw_deltas)
 
     def save_model(self, filename, num_iteration: int = -1, start_iteration: int = 0,
                    importance_type: str = None) -> "Booster":

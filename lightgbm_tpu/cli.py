@@ -172,7 +172,7 @@ def _task_convert(cfg: Config) -> int:
         return 1
     bst = Booster(model_file=cfg.input_model)
     code = bst._gbdt.to_if_else()
-    with open(cfg.convert_model, "w") as fh:
+    with open(cfg.convert_model, "w") as fh:  # jaxlint: disable=R12 (generated C++ SOURCE, not a loadable model artifact: nothing ever parses it back as a checkpoint, so torn-write atomicity buys nothing here)
         fh.write(code)
     log_info(f"standalone C++ predictor written to {cfg.convert_model}")
     return 0

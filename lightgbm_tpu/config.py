@@ -410,10 +410,34 @@ class Config:
     output_model: str = "LightGBM_model.txt"
     saved_feature_importance_type: int = 0
     snapshot_freq: int = -1
-    # resume=auto (ours; docs/ROBUSTNESS.md): engine.train resumes from the
-    # newest VALID snapshot in output_model's family without naming a file,
-    # and trains only the remaining rounds toward num_iterations
+    # resume (ours; docs/ROBUSTNESS.md): "auto" resumes from the newest
+    # VALID snapshot in output_model's family without naming a file; a
+    # path to a fleet manifest (lgbmtpu-fleet-ckpt-v1, written by the
+    # launcher's coordinated checkpoints) resumes from that FLEET-VALID
+    # round — torn or unconfirmed manifests are refused.  Either way only
+    # the remaining rounds toward num_iterations are trained.
     resume: str = ""
+    # snapshot_keep (ours; docs/ROBUSTNESS.md "Elastic fleet recovery"):
+    # retention bound for the *.snapshot_iter_<k> family (and the
+    # launcher's fleet checkpoint rounds).  After each successful snapshot
+    # write the oldest snapshots beyond the newest snapshot_keep are
+    # pruned — but NEVER the newest one that verifies, whatever its age.
+    # 0 (default) = keep all, today's behavior.
+    snapshot_keep: int = 0
+    # heartbeat_timeout_s (ours; docs/ROBUSTNESS.md): hang-aware fleet
+    # watchdog.  Workers heartbeat by bumping the heartbeat_ts gauge at
+    # every boosting round (flushed by the periodic per-rank metrics
+    # snapshot — zero extra device dispatches, zero new threads); the
+    # launcher declares a rank HUNG when its heartbeat goes stale past
+    # this many seconds, kills its process group, and routes into the
+    # max_restarts relaunch path exactly as a death does.  Size it above
+    # the WORST-case round — including mid-run XLA recompiles (bucket-cap
+    # transitions), not just the steady state: the host is blocked during
+    # a compile, so a compile longer than the timeout reads as a hang
+    # (only the very first observation is automatically excused).
+    # 0 (default) = disabled (exit-code watchdog + launch timeout only).
+    # LGBMTPU_HEARTBEAT_TIMEOUT_S is the env spelling.
+    heartbeat_timeout_s: float = 0.0
 
     # --- out-of-core data path (ours; docs/PERF_NOTES.md round 12) ---
     # out_of_core: stream the binned matrix in row chunks through pinned,

@@ -7,6 +7,8 @@ callback ordering by `.order` / `.before_iteration`, EarlyStopException flow.
 from __future__ import annotations
 
 import copy
+import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -125,11 +127,60 @@ def train(
             log_warning(f"metrics endpoint could not start: {e}")
 
     resume = resume if resume is not None else (cfg_probe.resume or None)
-    if resume is not None:
-        if resume != "auto":
-            raise LightGBMError(
-                f"resume={resume!r} is not supported (only 'auto'; pass "
-                "init_model=<snapshot> to resume from a specific file)")
+    if resume is not None and resume != "auto":
+        # resume=<fleet manifest> (docs/ROBUSTNESS.md "Elastic fleet
+        # recovery"): the launcher's relaunch path hands every rank the
+        # newest FLEET-VALID manifest; a torn or unconfirmed one is
+        # refused outright — resuming into inconsistent fleet state would
+        # silently fork the ranks' models.
+        if init_model is not None:
+            # precedence decided FIRST: a manifest that will be ignored
+            # must not be able to abort the run on its own staleness
+            log_warning("resume=<manifest> ignored: an explicit init_model "
+                        "was given and takes precedence")
+        else:
+            if not os.path.exists(resume):
+                raise LightGBMError(
+                    f"resume={resume!r} is not supported: pass 'auto', a "
+                    "fleet manifest path (lgbmtpu-fleet-ckpt-v1), or "
+                    "init_model=<snapshot> for a specific file")
+            manifest = _checkpoint.fleet_manifest_valid(resume)
+            if manifest is None:
+                raise LightGBMError(
+                    f"resume manifest {resume} is not fleet-valid (torn, "
+                    "unconfirmed by some rank, or its snapshot fails "
+                    "verification) — refusing to resume into inconsistent "
+                    "fleet state (docs/ROBUSTNESS.md)")
+            rank = os.environ.get("LIGHTGBM_TPU_RANK", "0")
+            shard_fp = os.environ.get("LGBMTPU_SHARD_FINGERPRINT")
+            want_fp = (manifest.get("shards") or {}).get(rank)
+            if shard_fp and want_fp and shard_fp != want_fp:
+                raise LightGBMError(
+                    f"rank {rank}'s data shard fingerprint {shard_fp[:12]}… "
+                    f"does not match the manifest's {want_fp[:12]}… — the "
+                    "shard changed since the checkpoint; resuming would "
+                    "train round k+1 on different data than rounds 1..k")
+            it = int(manifest["round"])
+            if it > num_boost_round:
+                # overshoot guard (the resume='auto' branch bounds its
+                # scan with below_iter for the same reason): silently
+                # returning a model with MORE iterations than requested
+                # is the stale-newer hazard, not a resume
+                raise LightGBMError(
+                    f"resume manifest {resume} is at round {it}, beyond "
+                    f"the requested num_iterations={num_boost_round} — "
+                    "raise num_iterations or resume from an older "
+                    "manifest")
+            init_model = manifest["snapshot"]
+            num_boost_round = max(num_boost_round - it, 0)
+            _obs.counter("fleet_resumes_total").inc()
+            _obs.gauge("fleet_resumed_round").set(it)
+            _obs.event("fleet_resume", round=it, manifest=os.fspath(resume),
+                       snapshot=manifest["snapshot"])
+            log_info(
+                f"resume: fleet manifest {resume} (round {it}) — training "
+                f"{num_boost_round} remaining round(s) from its snapshot")
+    elif resume is not None:
         if init_model is not None:
             log_warning("resume='auto' ignored: an explicit init_model was "
                         "given and takes precedence")
@@ -160,18 +211,44 @@ def train(
     if init_model is not None:
         init_booster = _load_init_booster(init_model)
         # continued training (reference: GBDT continued training via
-        # input_model): seed with the SAVED form of the model — init scores
-        # folded into the trees — then replay scores from the trees alone, so
-        # the fresh booster's own boost_from_average must not contribute.
+        # input_model): seed with the source model's trees, then replay
+        # scores so the fresh booster's own boost_from_average must not
+        # contribute twice.
         import numpy as _np
         from .models.gbdt import GBDT as _GBDT
 
         gbdt = booster._gbdt
-        seeded = _GBDT.load_model_from_string(init_booster.model_to_string())
-        gbdt.models = seeded.models
-        gbdt.iter_ = seeded.iter_
-        gbdt.init_scores = [0.0] * gbdt.num_tree_per_iteration
+        src = init_booster._gbdt
+        if src.average_output:
+            # RF keeps the folded round-trip: averaged output folds the
+            # init score into EVERY tree, so the separated-init replay
+            # below would double-count it
+            seeded = _GBDT.load_model_from_string(
+                init_booster.model_to_string())
+            gbdt.models = seeded.models
+            gbdt.iter_ = seeded.iter_
+            gbdt.init_scores = [0.0] * gbdt.num_tree_per_iteration
+        else:
+            # seed with the source's EXACT state: pure-delta trees plus
+            # the init score kept separate (raw-delta snapshots and
+            # in-memory boosters carry it; legacy folded model files load
+            # with init_scores == 0 and folded trees, which reduces to the
+            # old behavior).  Rebuilding the score base as fl32(init) and
+            # replaying fl32(delta) per tree reproduces the live run's
+            # accumulation order, so crash-resume from a raw-delta
+            # snapshot is BITWISE-identical to uninterrupted training
+            # (docs/ROBUSTNESS.md "Elastic fleet recovery").
+            gbdt.models = copy.deepcopy(src.models)
+            gbdt.iter_ = (len(src.models)
+                          // max(gbdt.num_tree_per_iteration, 1))
+            gbdt.init_scores = list(src.init_scores)
         base = _np.zeros(gbdt._score.shape, dtype=_np.float32)
+        if any(s != 0.0 for s in gbdt.init_scores):
+            if gbdt.num_tree_per_iteration == 1:
+                base += _np.float32(gbdt.init_scores[0])
+            else:
+                base += _np.asarray(gbdt.init_scores,
+                                    dtype=_np.float32)[None, :]
         if train_set.init_score is not None:
             base += _np.asarray(train_set.init_score, _np.float32).reshape(base.shape)
         import jax.numpy as _jnp
@@ -235,11 +312,28 @@ def train(
     # grower's, anchored at its accounted async-info resolves
     train_span = _trace.span("train", num_boost_round=num_boost_round)
     train_span.__enter__()
+    # arm the heartbeat: heartbeat_done=0 marks this process as actively
+    # training, so the launcher's hang watchdog tracks staleness; the
+    # finally below retires it — otherwise the post-training tail (model
+    # save, final eval, fleet ack) would read as a stalled heartbeat and
+    # a slow endgame could be killed as a false hang
+    _obs.gauge("heartbeat_done").set(0.0)
     try:
         for i in range(num_boost_round):
-            # fault-injection site: preemption at the start of 1-based
-            # iteration i+1 (utils/faults.py; recovery = snapshot resume)
+            # heartbeat (docs/ROBUSTNESS.md "Elastic fleet recovery"): a
+            # monotonic host-clock gauge bumped by the MAIN thread each
+            # round and flushed by the existing periodic metrics snapshot
+            # — the launcher's hang watchdog declares a rank hung when
+            # the VALUE stops changing, so a rank wedged inside a
+            # collective is caught even though its snapshot-writer daemon
+            # thread keeps the file fresh.  One host gauge write: zero
+            # device dispatches, zero new threads.
+            _obs.gauge("heartbeat_ts").set(time.monotonic())
+            # fault-injection sites: preemption (hard exit) or a wedged
+            # collective (sleep forever) at the start of 1-based iteration
+            # i+1 (utils/faults.py; recovery = manifest/snapshot resume)
             _faults.maybe_crash("host_crash", i + 1)
+            _faults.maybe_hang("worker_hang", i + 1)
             for cb in callbacks_before:
                 cb(CallbackEnv(booster, params, i, 0, num_boost_round, []))
             finished = booster.update(fobj=fobj)
@@ -257,10 +351,18 @@ def train(
                 snap = f"{cfg_probe.output_model}.snapshot_iter_{global_iter}"
                 # atomic + integrity-trailed (utils/checkpoint.py): a crash
                 # mid-write can no longer leave a torn snapshot that a
-                # restart would load
-                _checkpoint.save_snapshot(snap, booster.model_to_string(),
-                                          global_iter)
+                # restart would load.  raw_deltas: snapshots carry pure-delta
+                # trees + an init_scores header so resume is bitwise
+                _checkpoint.save_snapshot(
+                    snap, booster.model_to_string(raw_deltas=True),
+                    global_iter)
                 log_info(f"Saved snapshot to {snap}")
+                if int(cfg_probe.snapshot_keep) > 0:
+                    # bounded retention (snapshot_keep=): prune the oldest
+                    # snapshots AFTER the new one landed; the newest
+                    # verifying snapshot is never pruned
+                    _checkpoint.prune_snapshots(cfg_probe.output_model,
+                                                int(cfg_probe.snapshot_keep))
             if finished:
                 log_info("Stopped training because there are no more leaves that meet the split requirements")
                 break
@@ -270,6 +372,10 @@ def train(
             booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
         train_span.set(early_stopped=True)
     finally:
+        # retire the heartbeat BEFORE the endgame (save/eval/ack tail can
+        # legitimately exceed the hang timeout); the periodic snapshot
+        # flushes it within one period
+        _obs.gauge("heartbeat_done").set(1.0)
         train_span.set(trained_iterations=booster.current_iteration())
         train_span.__exit__(None, None, None)
         # report (and the spill-sink disarm inside it) must run on EVERY
@@ -324,18 +430,69 @@ def _finish_run_report(cfg: Config) -> None:
 
 
 def _replay_scores(gbdt) -> None:
-    """Recompute train scores from existing trees (continued training)."""
+    """Recompute train scores from existing trees (continued training).
+    The per-tree f32 adds run in training order, so a resume from a
+    raw-delta snapshot reproduces the live score state bitwise
+    (docs/ROBUSTNESS.md "Elastic fleet recovery")."""
+    import numpy as _np
+
     import jax.numpy as jnp
 
+    if (getattr(gbdt.train_set, "ooc_spill", False) and len(gbdt.models) > 1
+            and all(t.num_cat == 0 for t in gbdt.models)):
+        # spill regime: one stream sweep for the whole ensemble — a
+        # per-tree replay would re-decompress the bin cache T times.
+        # Categorical trees fall through to the per-tree loop below
+        # (predict_leaf_binned_tree streams them host-chunk-wise): slower
+        # (one sweep per tree) but a resume must never fail over it.
+        _replay_scores_streamed(gbdt)
+        return
     k = gbdt.num_tree_per_iteration
     for i, tree in enumerate(gbdt.models):
         c = i % k
-        leaf = gbdt.train_set.predict_leaf_binned_tree(tree)
-        vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf]
+        if tree.is_linear:
+            # linear leaves carry per-leaf linear terms — a
+            # leaf_value-only replay would silently drop them (mirror of
+            # GBDT.add_valid's continued-training replay)
+            vals = jnp.asarray(
+                tree.predict_batch(_np.asarray(gbdt.train_set.raw_device)),
+                jnp.float32)
+        else:
+            leaf = gbdt.train_set.predict_leaf_binned_tree(tree)
+            vals = jnp.asarray(tree.leaf_value, jnp.float32)[leaf]
         if k == 1:
             gbdt._score = gbdt._score + vals
         else:
             gbdt._score = gbdt._score.at[:, c].add(vals)
+
+
+def _replay_scores_streamed(gbdt) -> None:
+    """Spill-regime replay: ONE sequential pass over the bin stream for
+    ALL trees (Dataset.predict_leaf_binned_trees_chunked), folding each
+    chunk's per-tree f32 leaf values into the score in training order —
+    the same per-row add sequence as the tree-at-a-time replay, so the
+    result stays bitwise while the cache is decompressed once instead of
+    once per tree."""
+    import numpy as _np
+
+    import jax.numpy as jnp
+
+    k = gbdt.num_tree_per_iteration
+    trees = gbdt.models
+    leaf_vals = [jnp.asarray(t.leaf_value, jnp.float32) for t in trees]
+    parts = []
+    for _row_lo, valid, leaf in gbdt.train_set.predict_leaf_binned_trees_chunked(trees):
+        chunk = gbdt._score[_row_lo:_row_lo + valid] if k == 1 else \
+            gbdt._score[_row_lo:_row_lo + valid, :]
+        for i in range(len(trees)):
+            vals = leaf_vals[i][leaf[i, :valid]]
+            if k == 1:
+                chunk = chunk + vals
+            else:
+                chunk = chunk.at[:, i % k].add(vals)
+        parts.append(chunk)
+    if parts:
+        gbdt._score = jnp.concatenate(parts, axis=0)
 
 
 class CVBooster:

@@ -34,11 +34,50 @@ from __future__ import annotations
 
 import ast
 import zipfile
+import zlib
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
 DEFAULT_CHUNK_ROWS = 65536
+
+# fixed CRC32 block size for save_binary caches (rows per CRC entry) —
+# independent of the READ chunk size, so any sweep granularity verifies
+# against the same trailer table
+DEFAULT_CRC_ROWS = 65536
+
+
+class CorruptBinCacheError(RuntimeError):
+    """A ``save_binary`` cache failed integrity verification while
+    streaming: a per-chunk CRC32 mismatch, a truncated member, or a
+    decompression failure.  Carries the failing CRC chunk and its row
+    range, so the error names WHERE the cache is bad instead of letting
+    training proceed on garbage bins."""
+
+    def __init__(self, path: str, member: str, chunk_index: int,
+                 row_lo: int, row_hi: int, reason: str):
+        super().__init__(
+            f"{path}:{member} is corrupt at CRC chunk {chunk_index} "
+            f"(rows [{row_lo}, {row_hi})): {reason} — the bin cache is "
+            "torn or bit-rotted; rebuild it with save_binary "
+            "(docs/ROBUSTNESS.md)")
+        self.path = path
+        self.member = member
+        self.chunk_index = chunk_index
+        self.row_lo = row_lo
+        self.row_hi = row_hi
+
+
+def bin_crc32s(bins: np.ndarray,
+               crc_rows: int = DEFAULT_CRC_ROWS) -> np.ndarray:
+    """Per-block CRC32 table over a C-order 2-D binned matrix — the
+    values ``save_binary`` stores next to the matrix and
+    :class:`BinCacheStream` verifies on read."""
+    bins = np.ascontiguousarray(bins)
+    crc_rows = max(int(crc_rows), 1)
+    out = [zlib.crc32(bins[lo:lo + crc_rows]) & 0xFFFFFFFF
+           for lo in range(0, bins.shape[0], crc_rows)]
+    return np.asarray(out, np.uint32)
 
 
 def _read_npy_header(fh) -> Tuple[tuple, np.dtype, bool]:
@@ -78,6 +117,34 @@ class BinCacheStream:
                 f"streaming (shape={shape}, fortran={fortran})")
         self.shape = shape
         self.dtype = dtype
+        # per-chunk CRC trailer table (written by save_binary since round
+        # 13).  Old trailerless caches still load — with a warning, since
+        # nothing can vouch for their bytes.
+        self.crc_rows: Optional[int] = None
+        self.crcs: Optional[np.ndarray] = None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                if (f"{member}_crc32" in z.files
+                        and f"{member}_crc_rows" in z.files):
+                    self.crcs = np.asarray(z[f"{member}_crc32"], np.uint32)
+                    self.crc_rows = max(int(z[f"{member}_crc_rows"]), 1)
+        except (OSError, ValueError, zipfile.BadZipFile):
+            pass  # chunk reads will surface real corruption row-ranged
+        if self.crcs is not None:
+            expect = -(-self.shape[0] // self.crc_rows) if self.shape[0] else 0
+            if len(self.crcs) != expect:
+                raise CorruptBinCacheError(
+                    path, self.member, 0, 0, min(self.crc_rows,
+                                                 self.shape[0]),
+                    f"CRC table has {len(self.crcs)} entries, "
+                    f"expected {expect}")
+        else:
+            from ..utils.log import log_warning
+
+            log_warning(
+                f"bin cache {path} carries no per-chunk CRC trailers "
+                "(pre-round-13 format): reads cannot be verified against "
+                "bit-rot — re-run save_binary to upgrade it")
 
     @property
     def n_rows(self) -> int:
@@ -87,14 +154,33 @@ class BinCacheStream:
     def n_cols(self) -> int:
         return self.shape[1]
 
+    def _corrupt(self, row: int, reason: str) -> CorruptBinCacheError:
+        crc_rows = self.crc_rows or DEFAULT_CRC_ROWS
+        chunk = row // crc_rows
+        return CorruptBinCacheError(
+            self.path, self.member, chunk, chunk * crc_rows,
+            min((chunk + 1) * crc_rows, self.shape[0]), reason)
+
     def chunks(self, chunk_rows: int) -> Iterator[Tuple[int, np.ndarray]]:
         """Sequential (row_lo, chunk_view) sweep; the view aliases one
-        reused buffer of ``chunk_rows`` rows (allocated once here)."""
+        reused buffer of ``chunk_rows`` rows (allocated once here).
+
+        Every sweep re-verifies the per-chunk CRC32 table when the cache
+        carries one: the rolling CRC is checked at each CRC-block
+        boundary BEFORE the rows completing the block are yielded, so a
+        corrupt or truncated cache raises the row-ranged
+        :class:`CorruptBinCacheError` at the failing chunk instead of
+        feeding garbage bins to training.  (With the default read chunk
+        == CRC block size, no unverified row is ever yielded; smaller
+        read chunks may see at most one partially-verified trailing
+        block's rows before its boundary check runs.)"""
         n, f = self.shape
         chunk_rows = max(int(chunk_rows), 1)
         buf = np.empty((chunk_rows, f), self.dtype)  # the reused buffer
         flat = buf.reshape(-1).view(np.uint8)
         row_bytes = f * self.dtype.itemsize
+        verify = self.crcs is not None
+        crc_cur = 0  # rolling CRC of the current (partial) CRC block
         with zipfile.ZipFile(self.path) as zf, zf.open(self.member) as fh:
             _read_npy_header(fh)  # skip to element 0
             lo = 0
@@ -104,12 +190,34 @@ class BinCacheStream:
                 got = 0
                 mv = memoryview(flat)[:want]
                 while got < want:
-                    k = fh.readinto(mv[got:])
+                    try:
+                        k = fh.readinto(mv[got:])
+                    except (zipfile.BadZipFile, zlib.error, OSError) as e:
+                        raise self._corrupt(
+                            lo + got // row_bytes,
+                            f"{type(e).__name__}: {e}") from None
                     if not k:
-                        raise EOFError(
-                            f"{self.path}:{self.member} truncated at row "
-                            f"{lo + got // row_bytes}")
+                        raise self._corrupt(lo + got // row_bytes,
+                                            "truncated member")
                     got += k
+                if verify:
+                    # feed the freshly read rows into the rolling CRC,
+                    # checking every block boundary they complete
+                    pos, row, end_row = 0, lo, lo + m
+                    while row < end_row:
+                        block = row // self.crc_rows
+                        block_end = min((block + 1) * self.crc_rows, n)
+                        take = min(block_end, end_row) - row
+                        crc_cur = zlib.crc32(
+                            mv[pos:pos + take * row_bytes], crc_cur)
+                        pos += take * row_bytes
+                        row += take
+                        if row == block_end:
+                            if (crc_cur & 0xFFFFFFFF) != int(
+                                    self.crcs[block]):
+                                raise self._corrupt(block_end - 1,
+                                                    "CRC32 mismatch")
+                            crc_cur = 0
                 yield lo, buf[:m]
                 lo += m
 

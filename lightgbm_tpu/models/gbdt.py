@@ -2366,18 +2366,21 @@ class GBDT:
             return "regression sqrt"
         return o
 
-    def _trees_for_export(self, start: int, num_iteration: int) -> List[Tree]:
+    def _trees_for_export(self, start: int, num_iteration: int,
+                          fold: bool = True) -> List[Tree]:
         """Trees with the init score folded in so the saved model is
         self-contained (reference: Tree::AddBias semantics): for gbdt/dart the
         first tree per class gets +init; for RF (averaged output) EVERY tree
-        gets +init so avg(trees) = init + avg(deltas)."""
+        gets +init so avg(trees) = init + avg(deltas).  ``fold=False``
+        returns the raw iteration window unchanged — the raw-delta
+        snapshot form, which carries init separately."""
         import copy as _copy
 
         k = self.num_tree_per_iteration
         lo = start * k
         hi = len(self.models) if num_iteration < 0 else min((start + num_iteration) * k, len(self.models))
         trees = list(self.models[lo:hi])
-        if lo != 0 or not any(s != 0.0 for s in self.init_scores):
+        if not fold or lo != 0 or not any(s != 0.0 for s in self.init_scores):
             return trees
         if self.average_output:
             fold_idx = range(len(trees))
@@ -2395,7 +2398,8 @@ class GBDT:
         return trees
 
     def save_model_to_string(self, num_iteration: int = -1, start_iteration: int = 0,
-                             importance_type: str = None) -> str:
+                             importance_type: str = None,
+                             raw_deltas: bool = False) -> str:
         # never serialize (or snapshot) a model poisoned by non-finite
         # training values — the deferred guard is settled here at the latest
         self._guard_check()
@@ -2407,7 +2411,17 @@ class GBDT:
                 else "split"
             )
         k = self.num_tree_per_iteration
-        trees = self._trees_for_export(start_iteration, num_iteration)
+        # raw_deltas: the snapshot form (docs/ROBUSTNESS.md "Elastic fleet
+        # recovery") — trees stay PURE deltas and the boost_from_average
+        # init score is carried as an explicit `init_scores=` header line
+        # instead of being folded into tree 0's float64 leaf values.
+        # Folding rounds (fl64(v0+init)), so a resume replaying folded
+        # trees reconstructs fl32(v0+init) where the live run held
+        # fl32(init)+fl32(v0) — a last-ulp score skew that cascades into
+        # every post-resume tree.  Raw-delta snapshots make crash-resume
+        # BITWISE-identical to uninterrupted training.
+        trees = self._trees_for_export(start_iteration, num_iteration,
+                                       fold=not raw_deltas)
         feature_names = self.feature_names or [f"Column_{i}" for i in range(self.train_set.num_feature())]
         if self.binner is not None:
             infos = []
@@ -2421,7 +2435,7 @@ class GBDT:
         else:
             infos = ["none"] * len(feature_names)
 
-        blocks = [t.to_string(i) for i, t in enumerate(trees)]
+        blocks = [t.to_string(i, precise=raw_deltas) for i, t in enumerate(trees)]
         tree_sizes = [len(b) + 1 for b in blocks]
         lines = [
             "tree",
@@ -2432,6 +2446,11 @@ class GBDT:
             f"max_feature_idx={len(feature_names) - 1}",
             f"objective={self._objective_string()}",
             *(["average_output"] if self.average_output else []),
+            # exact decimal round-trip (repr) — float() recovers the same
+            # f64 bits, so a resumed run rebuilds the identical score base
+            *([f"init_scores=" + " ".join(repr(float(s))
+                                          for s in self.init_scores)]
+              if raw_deltas else []),
             "feature_names=" + " ".join(feature_names),
             "feature_infos=" + " ".join(infos),
             "tree_sizes=" + " ".join(str(s) for s in tree_sizes),
@@ -2479,7 +2498,22 @@ class GBDT:
         booster.average_output = any(
             line.strip() == "average_output" for line in header.splitlines()
         )
-        booster.init_scores = [0.0] * booster.num_tree_per_iteration  # folded into trees
+        if "init_scores" in kv:
+            # raw-delta snapshot form: trees are pure deltas, the init
+            # score rides this header line (save_model_to_string raw_deltas)
+            booster.init_scores = [float(v) for v in kv["init_scores"].split()]
+            if len(booster.init_scores) != booster.num_tree_per_iteration:
+                # a count mismatch means a torn header or a class-count
+                # mix-up; silently zeroing would load a model whose
+                # predictions are missing the boost_from_average base
+                raise ValueError(
+                    f"snapshot init_scores header has "
+                    f"{len(booster.init_scores)} entries but "
+                    f"num_tree_per_iteration is "
+                    f"{booster.num_tree_per_iteration} — torn or "
+                    "mismatched raw-delta snapshot (docs/ROBUSTNESS.md)")
+        else:
+            booster.init_scores = [0.0] * booster.num_tree_per_iteration  # folded into trees
         trees_part = rest.split("\nend of trees")[0]
         blocks = ("Tree=" + trees_part).split("\nTree=")
         for b in blocks:

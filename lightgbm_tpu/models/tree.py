@@ -281,13 +281,20 @@ class Tree:
     # ------------------------------------------------------------------
     # LightGBM text model format (reference: Tree::ToString in tree.cpp)
     # ------------------------------------------------------------------
-    def to_string(self, tree_idx: int) -> str:
+    def to_string(self, tree_idx: int, precise: bool = False) -> str:
+        # precise=True is the CHECKPOINT form (GBDT.save_model_to_string
+        # raw_deltas): every float field round-trips exactly (.17g), so a
+        # crash-resume replays bit-identical tree state.  The default
+        # keeps the reference's %g widths for the stats fields — its
+        # Tree::ToString prints gains/weights/internal values at 6
+        # significant digits.
+        g = "{:.17g}" if precise else "{:g}"
         m = self.num_internal
         lines = [f"Tree={tree_idx}"]
         lines.append(f"num_leaves={self.num_leaves}")
         lines.append(f"num_cat={self.num_cat}")
         lines.append("split_feature=" + _join_arr(self.split_feature[:m], "{:d}"))
-        lines.append("split_gain=" + _join_arr(self.split_gain[:m], "{:g}"))
+        lines.append("split_gain=" + _join_arr(self.split_gain[:m], g))
         lines.append("threshold=" + _join_arr(self.threshold[:m], "{:.17g}"))
         lines.append("decision_type=" + _join_arr(self.decision_type[:m], "{:d}"))
         lines.append("left_child=" + _join_arr(self.left_child[:m], "{:d}"))
@@ -296,11 +303,11 @@ class Tree:
             "leaf_value=" + _join_arr(self.leaf_value[: self.num_leaves], "{:.17g}")
         )
         lines.append(
-            "leaf_weight=" + _join_arr(self.leaf_weight[: self.num_leaves], "{:g}")
+            "leaf_weight=" + _join_arr(self.leaf_weight[: self.num_leaves], g)
         )
         lines.append("leaf_count=" + _join_arr(self.leaf_count[: self.num_leaves], "{:d}"))
-        lines.append("internal_value=" + _join_arr(self.internal_value[:m], "{:g}"))
-        lines.append("internal_weight=" + _join_arr(self.internal_weight[:m], "{:g}"))
+        lines.append("internal_value=" + _join_arr(self.internal_value[:m], g))
+        lines.append("internal_weight=" + _join_arr(self.internal_weight[:m], g))
         lines.append("internal_count=" + _join_arr(self.internal_count[:m], "{:d}"))
         if self.num_cat > 0:
             lines.append("cat_boundaries=" + _join_arr(self.cat_boundaries, "{:d}"))
@@ -316,7 +323,7 @@ class Tree:
             flat_c = ["{:.17g}".format(float(v)) for l in range(L) for v in self.leaf_coeff[l]]
             lines.append("leaf_features=" + " ".join(flat_f))
             lines.append("leaf_coeff=" + " ".join(flat_c))
-        lines.append(f"shrinkage={self.shrinkage:g}")
+        lines.append("shrinkage=" + g.format(self.shrinkage))
         lines.append("")
         return "\n".join(lines)
 

@@ -55,12 +55,14 @@ UNHEALTHY_COUNTERS = (
     ("launcher_worker_deaths_total", "launcher worker died"),
     ("launcher_timeouts_total", "launcher watchdog timeout"),
     ("checkpoint_torn_total", "torn checkpoint detected"),
+    ("fleet_hangs_total", "hung rank detected by the heartbeat watchdog"),
 )
 DEGRADED_COUNTERS = (
     ("degrade_disabled_total", "Pallas kernel degraded to XLA fallback"),
     ("launcher_relaunches_total", "fleet relaunched after a failure"),
     ("train_windowed_retries_total", "windowed W-bound prediction retries"),
     ("checkpoint_fallbacks_total", "resume fell back to an older snapshot"),
+    ("fleet_resumes_total", "fleet resumed from a checkpoint round"),
     ("faults_injected_total", "injected faults fired (test harness armed)"),
 )
 

@@ -28,6 +28,7 @@ ordinary `lightgbm_tpu.train`, exactly like the reference's `_train_part`.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import signal
@@ -40,7 +41,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..config import _ALIASES, Config
 from ..obs import metrics as _obs
+from ..utils import checkpoint as _checkpoint
 from ..utils.log import log_warning
 
 _WORKER_SRC = r"""
@@ -53,6 +56,7 @@ from lightgbm_tpu.parallel.distributed import init_distributed
 shard = np.load(os.environ["LGBM_TPU_SHARD"], allow_pickle=True)
 net = {k: shard[k].item() for k in ("num_machines", "machines",
                                     "local_listen_port", "time_out")}
+rank = os.environ["LIGHTGBM_TPU_RANK"]
 
 # per-rank metrics flight recorder (docs/OBSERVABILITY.md "Fleet
 # metrics"): atomic snapshot writes start BEFORE the rendezvous and
@@ -66,15 +70,20 @@ if _snap_path:
         _snap_path,
         float(os.environ.get("LGBMTPU_METRICS_SNAPSHOT_PERIOD_S", "1.0")))
 
-assert init_distributed(Config.from_dict(net))
+if int(net["num_machines"]) > 1:
+    # a 1-rank fleet skips the multi-process runtime entirely (the
+    # simulated-rank recovery tests drive every launcher/checkpoint path
+    # this way on containers whose jax lacks multiproc collectives)
+    assert init_distributed(Config.from_dict(net))
 
 import lightgbm_tpu as lgb
 
 params = dict(np.load(os.environ["LGBM_TPU_PARAMS"], allow_pickle=True)[
     "params"].item())
 params.update(net)
-params["pre_partition"] = True
-params.setdefault("tree_learner", "data")
+params["pre_partition"] = int(net["num_machines"]) > 1
+if int(net["num_machines"]) > 1:
+    params.setdefault("tree_learner", "data")
 ds = lgb.Dataset(
     shard["X"],
     label=shard["y"],
@@ -110,12 +119,50 @@ if os.environ.get("LGBMTPU_FAULT"):
     _fault_cb.before_iteration = True
     _fault_cb.order = -100
     callbacks.append(_fault_cb)
+
+# coordinated fleet checkpoints (docs/ROBUSTNESS.md "Elastic fleet
+# recovery"): every ckpt_freq GLOBAL iterations rank 0 writes the
+# fleet snapshot + manifest through utils/checkpoint.py and every other
+# rank drops its sha-carrying ack — the round becomes resumable only
+# once ALL ranks confirmed, so a crash anywhere in the window leaves the
+# previous fleet-valid round authoritative
+_ckpt_dir = os.environ.get("LGBMTPU_FLEET_CKPT_DIR")
+_ckpt_freq = int(os.environ.get("LGBMTPU_FLEET_SNAPSHOT_FREQ", "0") or 0)
+if _ckpt_dir and _ckpt_freq > 0:
+    from lightgbm_tpu.utils import checkpoint as _ckpt
+
+    _world = int(net["num_machines"])
+    _keep = int(os.environ.get("LGBMTPU_FLEET_SNAPSHOT_KEEP", "0") or 0)
+    _rank_i = int(rank)
+    _shards = {}
+    _shards_json = os.environ.get("LGBMTPU_FLEET_SHARDS_JSON")
+    if _shards_json and os.path.exists(_shards_json):
+        with open(_shards_json) as fh:
+            _shards = json.load(fh)
+
+    def _fleet_ckpt_cb(env):
+        it = env.model.current_iteration()  # GLOBAL iteration: resumed
+        if it % _ckpt_freq:                 # runs keep the numbering
+            return
+        text = env.model.model_to_string(raw_deltas=True)
+        if _rank_i == 0:
+            _ckpt.write_fleet_checkpoint(_ckpt_dir, text, it, _world,
+                                         _shards, keep=_keep)
+        else:
+            _ckpt.confirm_fleet_checkpoint(_ckpt_dir, it, _rank_i, text)
+    _fleet_ckpt_cb.order = 100
+    callbacks.append(_fleet_ckpt_cb)
+
 bst = lgb.train(params, ds, int(os.environ["LGBM_TPU_ROUNDS"]),
                 valid_sets=valid_sets or None,
                 valid_names=valid_names or None,
-                callbacks=callbacks)
+                callbacks=callbacks,
+                # resume-to-round relaunch: the launcher hands a restarted
+                # fleet the newest fleet-VALID manifest; engine.train
+                # verifies it (incl. this rank's shard fingerprint) and
+                # trains only the remaining rounds
+                resume=os.environ.get("LGBMTPU_RESUME_MANIFEST"))
 out = os.environ["LGBM_TPU_MODEL_OUT"]
-rank = os.environ["LIGHTGBM_TPU_RANK"]
 bst.save_model(out + f".rank{rank}")
 if rank == "0":
     meta = {"best_iteration": bst.best_iteration,
@@ -140,15 +187,17 @@ _LAST_LAUNCH_DIR: Optional[str] = None
 
 
 class WorkerFailure(RuntimeError):
-    """A launcher worker died (non-zero exit) or the launch timed out.
-    Carries the failing rank (or None for timeouts) so retry logic and
-    tests can tell the cases apart."""
+    """A launcher worker died (non-zero exit), HUNG (heartbeat went stale
+    past the timeout), or the launch timed out.  Carries the failing rank
+    (or None for timeouts) so retry logic and tests can tell the cases
+    apart."""
 
     def __init__(self, msg: str, rank: Optional[int] = None,
-                 timed_out: bool = False):
+                 timed_out: bool = False, hung: bool = False):
         super().__init__(msg)
         self.rank = rank
         self.timed_out = timed_out
+        self.hung = hung
 
 
 def _kill_worker_group(proc: subprocess.Popen) -> None:
@@ -179,19 +228,63 @@ def _log_tail(log_path: str, nbytes: int = 2000) -> str:
         return f"<log unreadable: {e}>"
 
 
+def _read_heartbeat(snap_path: Optional[str]) -> Optional[float]:
+    """The ``heartbeat_ts`` gauge from a per-rank metrics snapshot file
+    (the atomic JSON the worker's periodic writer keeps), or None while
+    the rank has not started training / written a snapshot yet — or has
+    RETIRED its heartbeat (``heartbeat_done``, set by engine.train's
+    finally): the post-training tail (model save, final eval, fleet ack)
+    may legitimately exceed the hang timeout and must not read as a
+    stalled round loop."""
+    if not snap_path:
+        return None
+    try:
+        with open(snap_path, encoding="utf-8") as fh:
+            snap = json.load(fh)
+        gauges = snap.get("gauges", {})
+        if gauges.get("heartbeat_done"):
+            return None
+        hb = gauges.get("heartbeat_ts")
+        return float(hb) if hb is not None else None
+    except (OSError, ValueError, AttributeError):
+        return None  # missing/partial file: not a heartbeat signal yet
+
+
 def _watch_workers(workers, timeout_s: float,
-                   poll_interval: float = 0.1) -> None:
-    """Per-worker liveness watchdog: poll + exit-code harvest.
+                   poll_interval: float = 0.1,
+                   heartbeat_timeout_s: Optional[float] = None,
+                   heartbeat_paths: Optional[Dict[int, str]] = None) -> None:
+    """Per-worker liveness watchdog: poll + exit-code harvest, plus
+    HEARTBEAT staleness (docs/ROBUSTNESS.md "Elastic fleet recovery").
 
     ``workers`` is a list of (rank, Popen, log_path).  Returns when every
     worker exits 0.  A worker exiting non-zero fails the run within
     ~poll_interval seconds — not after a ``communicate(timeout=600)``
     hang waiting on the survivors, which block forever on the dead
-    rank's collectives — with that worker's log tail in the error.  On
-    failure or timeout the WHOLE process group of every worker is killed
-    and every tail is harvested (docs/ROBUSTNESS.md)."""
+    rank's collectives — with that worker's log tail in the error.
+
+    With ``heartbeat_timeout_s`` > 0 and per-rank snapshot paths, a rank
+    whose ``heartbeat_ts`` gauge stops CHANGING for longer than the
+    timeout is declared HUNG (the wedged-in-a-collective class an
+    exit-code watchdog can never catch: the process is alive, its
+    snapshot-writer daemon keeps the file fresh, but the main thread
+    stopped making rounds).  Change-tracking — not file mtime, not clock
+    comparison — is deliberate on both counts: the daemon writer keeps
+    mtime moving during a hang, and the gauge is the WORKER's monotonic
+    clock, incomparable across processes.  Staleness is armed per rank
+    from its first observed heartbeat; rendezvous hangs before round 1
+    stay covered by ``timeout_s``.  The hung rank's process group is
+    killed and the failure routes into the restart path exactly as a
+    death does.
+
+    On failure or timeout the WHOLE process group of every worker is
+    killed and every tail is harvested (docs/ROBUSTNESS.md)."""
     deadline = time.monotonic() + timeout_s
     done = set()
+    # rank -> (value, t_change, changed_once): staleness is armed only
+    # after the heartbeat has been seen to CHANGE (see below)
+    hb_seen: Dict[int, Tuple[float, float, bool]] = {}
+    hb_next = 0.0
     try:
         while len(done) < len(workers):
             for rank, proc, log_path in workers:
@@ -212,6 +305,56 @@ def _watch_workers(workers, timeout_s: float,
                     f"remaining workers killed. Tail of rank {rank}'s log "
                     f"({log_path}):\n{_log_tail(log_path)}",
                     rank=rank)
+            now = time.monotonic()
+            if (heartbeat_timeout_s and heartbeat_paths
+                    and now >= hb_next):
+                # re-read the small per-rank JSONs at most ~1 Hz (and at
+                # least 4x per timeout window), not per 0.1 s poll tick
+                hb_next = now + min(1.0, heartbeat_timeout_s / 4.0)
+                stalest: Optional[Tuple[float, int, "subprocess.Popen", str]] = None
+                for rank, proc, log_path in workers:
+                    if rank in done or proc.poll() is not None:
+                        continue
+                    hb = _read_heartbeat(heartbeat_paths.get(rank))
+                    if hb is None:
+                        continue
+                    prev = hb_seen.get(rank)
+                    if prev is None:
+                        # first observation arms tracking only: round 1
+                        # includes jit COMPILATION, which stalls the
+                        # heartbeat for arbitrarily long without being a
+                        # hang — staleness counts only once the value has
+                        # been seen to CHANGE (round 2 onward); earlier
+                        # hangs stay covered by the launch timeout
+                        hb_seen[rank] = (hb, now, False)
+                        continue
+                    if hb != prev[0]:
+                        hb_seen[rank] = (hb, now, True)
+                        continue
+                    if not prev[2]:
+                        continue
+                    stale = now - prev[1]
+                    if stale > heartbeat_timeout_s and (
+                            stalest is None or stale > stalest[0]):
+                        # a wedged collective stalls EVERY rank's
+                        # heartbeat; blame the stalest rank — it stopped
+                        # first, the rest are its victims
+                        stalest = (stale, rank, proc, log_path)
+                if stalest is not None:
+                    stale, rank, proc, log_path = stalest
+                    _obs.counter("fleet_hangs_total").inc()
+                    _obs.event("worker_hang", worker_rank=rank,
+                               stale_s=round(stale, 3),
+                               heartbeat_timeout_s=heartbeat_timeout_s,
+                               log=log_path)
+                    _kill_worker_group(proc)
+                    raise WorkerFailure(
+                        f"launcher worker rank {rank} HUNG: heartbeat "
+                        f"unchanged for {stale:.1f}s "
+                        f"(> {heartbeat_timeout_s:g}s); process group "
+                        f"killed. Tail of rank {rank}'s log "
+                        f"({log_path}):\n{_log_tail(log_path)}",
+                        rank=rank, hung=True)
             if time.monotonic() > deadline:
                 _obs.counter("launcher_timeouts_total").inc()
                 _obs.event("launch_timeout", timeout_s=timeout_s)
@@ -367,6 +510,7 @@ def train_distributed(
     env_extra: Optional[Dict[str, str]] = None,
     max_restarts: int = 0,
     restart_backoff_s: float = 1.0,
+    heartbeat_timeout_s: Optional[float] = None,
 ):
     """Shard rows over `num_machines` local worker processes, train with
     tree_learner=data under pre_partition, and return (rank 0's Booster,
@@ -375,11 +519,21 @@ def train_distributed(
     early stopping fires identically on every rank.
 
     Worker liveness is supervised by :func:`_watch_workers`: a dead rank
-    fails the launch in seconds with its log tail, and every failure path
-    kills the full worker process groups (no zombies).  ``max_restarts``
-    relaunches the whole fleet after a failure (fresh ports, re-written
-    shards) with exponential backoff — workers are stateless between
-    launches, so a full relaunch is the correct recovery unit."""
+    fails the launch in seconds with its log tail, a HUNG rank (heartbeat
+    stale past ``heartbeat_timeout_s``, or the
+    ``LGBMTPU_HEARTBEAT_TIMEOUT_S`` env / ``heartbeat_timeout_s`` param
+    spelling) is killed and treated exactly like a death, and every
+    failure path kills the full worker process groups (no zombies).
+
+    ``max_restarts`` relaunches the whole fleet after a failure (fresh
+    ports, re-written shards) with exponential backoff.  With
+    ``snapshot_freq`` > 0 in ``params`` the fleet additionally keeps
+    COORDINATED checkpoints (rank-0 snapshot + manifest + per-rank acks,
+    utils/checkpoint.py), and a relaunch resumes every rank from the
+    newest fleet-VALID round instead of round 0 — bitwise-identical to an
+    uninterrupted run (docs/ROBUSTNESS.md "Elastic fleet recovery");
+    without a valid manifest the relaunch falls back to a from-scratch
+    restart, the round-8 behavior."""
     import lightgbm_tpu as lgb
 
     n = X.shape[0]
@@ -414,11 +568,36 @@ def train_distributed(
 
     global _LAST_LAUNCH_DIR
     tmp = _LAST_LAUNCH_DIR = tempfile.mkdtemp(prefix="lgbm_tpu_launch_")
+    # fleet checkpoint cadence rides the standard snapshot params; the
+    # launcher OWNS snapshotting for its workers (the per-round callback
+    # in the worker body runs the manifest protocol), so the params the
+    # workers' engine.train sees have snapshot_freq stripped — every rank
+    # writing its own local snapshot family would race on shared paths
+    # and vouch for nothing fleet-wide
+    cfg_launch = Config.from_dict(params)
+    fleet_freq = max(int(cfg_launch.snapshot_freq), 0)
+    fleet_keep = max(int(cfg_launch.snapshot_keep), 0)
+    params = {k: v for k, v in dict(params).items()
+              if _ALIASES.get(k, k) != "snapshot_freq"}
+    if heartbeat_timeout_s is None:
+        env_hb = os.environ.get("LGBMTPU_HEARTBEAT_TIMEOUT_S")
+        heartbeat_timeout_s = (float(env_hb) if env_hb
+                               else float(cfg_launch.heartbeat_timeout_s))
     params_path = os.path.join(tmp, "params.npz")
     np.savez(params_path, params=np.asarray(dict(params), dtype=object))
     model_out = os.path.join(tmp, "model.txt")
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    # per-rank data-shard fingerprints: stamped into the fleet manifest by
+    # rank 0 and checked by every resumed rank, so a resume can never
+    # continue round k+1 on different data than rounds 1..k trained on.
+    # Filled by the first _spawn_all (identical across relaunches — the
+    # shard plan is deterministic) and published as one JSON file.
+    shard_fps: Dict[str, str] = {}
+    shards_json = os.path.join(tmp, "fleet_shards.json")
+    # the newest fleet-valid manifest to resume from (set by the restart
+    # path after a failure; None = fresh start)
+    relaunch = {"resume_manifest": None}
 
     def _launch_once() -> None:
         # fresh ports per attempt: the previous fleet's listen sockets may
@@ -436,9 +615,19 @@ def train_distributed(
                 if p.poll() is None:
                     _kill_worker_group(p)
             raise
-        _watch_workers(workers, timeout_s)
+        _watch_workers(
+            workers, timeout_s,
+            heartbeat_timeout_s=heartbeat_timeout_s or None,
+            heartbeat_paths={
+                r: os.path.join(tmp, f"worker{r}.metrics.json")
+                for r in range(num_machines)})
 
     def _spawn_all(workers, ports, machines) -> None:
+        # phase 1 — write EVERY rank's shard file and publish the full
+        # fingerprint table BEFORE any worker starts: rank 0 (spawned
+        # first) reads fleet_shards.json once at startup, so writing it
+        # while spawning the last rank would race — a manifest with no
+        # fingerprints silently disables the changed-data resume guard
         for rank in range(num_machines):
             Xs, ys, ws, gs = _rank_arrays(shard_slices, shard_groups, per,
                                           rank, X, y, weight)
@@ -457,8 +646,23 @@ def train_distributed(
                 shard_arrays[f"ev{i}_g"] = (gv if gv is not None
                                             else np.asarray(()))
                 shard_arrays[f"ev{i}_name"] = name
+            np.savez(os.path.join(tmp, f"shard{rank}.npz"), **shard_arrays)
+            if str(rank) not in shard_fps:
+                # fingerprint the shard DATA (not the npz bytes — zip
+                # timestamps differ across relaunches): what round k+1
+                # must see again for a resume to be sound
+                h = hashlib.sha256()
+                for arr in (Xs, ys, ws):
+                    h.update(np.ascontiguousarray(arr).tobytes())
+                if gs is not None:
+                    h.update(np.ascontiguousarray(gs).tobytes())
+                shard_fps[str(rank)] = h.hexdigest()
+        if not os.path.exists(shards_json):
+            with open(shards_json, "w", encoding="utf-8") as fh:
+                json.dump(shard_fps, fh)
+        # phase 2 — spawn
+        for rank in range(num_machines):
             shard_path = os.path.join(tmp, f"shard{rank}.npz")
-            np.savez(shard_path, **shard_arrays)
             env = dict(os.environ)
             env.update(env_extra or {})
             env["LIGHTGBM_TPU_RANK"] = str(rank)
@@ -477,13 +681,34 @@ def train_distributed(
             # per-rank metrics flight recorder: the worker body writes
             # atomic snapshots here periodically (and one exact final
             # write on clean exit); aggregate_fleet_metrics merges them
-            # into fleet_metrics.json on every exit path
+            # into fleet_metrics.json on every exit path — and the hang
+            # watchdog reads each rank's heartbeat_ts gauge out of the
+            # same file (no extra channel)
             env["LGBMTPU_METRICS_SNAPSHOT_FILE"] = os.path.join(
                 tmp, f"worker{rank}.metrics.json")
+            # coordinated fleet checkpoints + resume-to-round relaunch
+            # (docs/ROBUSTNESS.md "Elastic fleet recovery")
+            if fleet_freq > 0:
+                env["LGBMTPU_FLEET_CKPT_DIR"] = tmp
+                env["LGBMTPU_FLEET_SNAPSHOT_FREQ"] = str(fleet_freq)
+                env["LGBMTPU_FLEET_SNAPSHOT_KEEP"] = str(fleet_keep)
+                env["LGBMTPU_FLEET_SHARDS_JSON"] = shards_json
+            env["LGBMTPU_SHARD_FINGERPRINT"] = shard_fps[str(rank)]
+            if relaunch["resume_manifest"]:
+                env["LGBMTPU_RESUME_MANIFEST"] = relaunch["resume_manifest"]
             if env.get("LGBMTPU_FAULT"):
                 # make injected faults once-only ACROSS restarts, so a
                 # relaunched fleet runs clean (utils/faults.py)
                 env.setdefault("LGBMTPU_FAULT_ONCE_DIR", tmp)
+            # a RELAUNCH must not inherit the previous attempt's metrics
+            # snapshot: the old file's static heartbeat_ts would read as a
+            # live-but-stalled heartbeat while the new worker is still
+            # importing, and the hang watchdog would kill it before its
+            # first write
+            try:
+                os.unlink(env["LGBMTPU_METRICS_SNAPSHOT_FILE"])
+            except OSError:
+                pass
             # log file instead of a PIPE: a chatty worker cannot deadlock
             # on a full pipe buffer, and the watchdog can harvest tails
             # after the process is gone
@@ -511,13 +736,36 @@ def train_distributed(
                     raise
                 delay = restart_backoff_s * (2 ** attempt)
                 attempt += 1
+                # resume-to-round (docs/ROBUSTNESS.md "Elastic fleet
+                # recovery"): relaunch from the newest fleet-VALID
+                # checkpoint round instead of round 0.  Only a manifest
+                # that parses, whose snapshot verifies against its
+                # ensemble sha, and that EVERY rank acked qualifies — a
+                # crash mid-protocol (the manifest_write window) leaves
+                # the previous round authoritative, and no manifest at
+                # all falls back to the round-8 from-scratch restart.
+                resumed_round = None
+                if fleet_freq > 0:
+                    fm = _checkpoint.latest_valid_fleet_manifest(
+                        tmp, num_machines)
+                    if fm is not None:
+                        resumed_round, mpath, _ = fm
+                        relaunch["resume_manifest"] = mpath
+                        _obs.counter("fleet_resumes_total").inc()
+                        _obs.gauge("fleet_resumed_round").set(resumed_round)
+                        _obs.event("fleet_resume", round=resumed_round,
+                                   manifest=mpath, attempt=attempt)
                 _obs.counter("launcher_relaunches_total").inc()
                 _obs.event("fleet_relaunch", attempt=attempt,
-                           backoff_s=delay, cause=str(e)[:200])
+                           backoff_s=delay, cause=str(e)[:200],
+                           hung=bool(getattr(e, "hung", False)),
+                           resumed_round=resumed_round)
                 log_warning(
                     f"launcher attempt {attempt}/{max_restarts + 1} failed "
                     f"({str(e)[:200]}); relaunching all workers in "
-                    f"{delay:.1f}s")
+                    f"{delay:.1f}s"
+                    + (f" from fleet checkpoint round {resumed_round}"
+                       if resumed_round is not None else " from scratch"))
                 time.sleep(delay)
     finally:
         # fleet-level observability artifact: merge every rank's JSONL

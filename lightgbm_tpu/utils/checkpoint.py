@@ -30,10 +30,12 @@ to write a model atomically.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import re
 import tempfile
-from typing import List, Optional, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
 from . import faults
 
@@ -216,3 +218,262 @@ def latest_valid_snapshot(path: str,
         if verify_file(snap) is True:
             return it, snap
     return None
+
+
+# ---------------------------------------------------------------------------
+# retention: bounded snapshot families (snapshot_keep=)
+# ---------------------------------------------------------------------------
+
+def prune_snapshots(path: str, keep: int) -> List[Tuple[int, str]]:
+    """Delete the oldest snapshots in ``path``'s family beyond the newest
+    ``keep`` of them — but NEVER the newest snapshot that actually
+    verifies, whatever its age: retention must not be able to throw away
+    the only state a resume could use (a family whose newest ``keep``
+    entries are all torn keeps its last good snapshot).  ``keep <= 0``
+    means keep-all (the default behavior).  Returns the pruned
+    ``(iteration, path)`` pairs; each deletion is evented through obs."""
+    if keep <= 0:
+        return []
+    family = snapshot_family(path)  # newest first
+    newest_valid: Optional[str] = None
+    for _, snap in family:
+        if verify_file(snap) is True:
+            newest_valid = snap
+            break
+    pruned: List[Tuple[int, str]] = []
+    for it, snap in family[keep:]:
+        if snap == newest_valid:
+            continue
+        try:
+            os.unlink(snap)
+        except OSError:
+            continue  # already gone / unremovable: not worth failing a run
+        pruned.append((it, snap))
+    if pruned:
+        from ..obs import metrics as _obs
+
+        _obs.counter("checkpoint_pruned_total").inc(len(pruned))
+        _obs.event("checkpoint_prune", path=os.fspath(path),
+                   kept=keep, pruned=[p for _, p in pruned])
+    return pruned
+
+
+# ---------------------------------------------------------------------------
+# fleet-consistent checkpoints (docs/ROBUSTNESS.md "Elastic fleet recovery")
+#
+# A fleet checkpoint for round k is three things, all in the launch dir:
+#   fleet.snapshot_iter_<k>            rank 0's model snapshot (sha256
+#                                      trailer via save_snapshot, raw-delta
+#                                      form so resume is bitwise)
+#   fleet.manifest_iter_<k>.json       the manifest (schema below), written
+#                                      ATOMICALLY and only AFTER the
+#                                      snapshot is durable
+#   fleet.manifest_iter_<k>.ack.rank<r>  one marker per non-zero rank,
+#                                      carrying that rank's own ensemble
+#                                      sha256 at round k
+#
+# A round is *fleet-valid* — and only then resumable — when the manifest
+# parses, the snapshot's trailer verifies, the snapshot payload hashes to
+# the manifest's ensemble_sha256, and every rank 1..W-1 has acked with a
+# MATCHING ensemble sha.  A crash anywhere in the protocol (including the
+# armed ``manifest_write`` injection window between snapshot and manifest)
+# leaves the previous fleet-valid round authoritative.
+# ---------------------------------------------------------------------------
+
+FLEET_SCHEMA = "lgbmtpu-fleet-ckpt-v1"
+_FLEET_MANIFEST_RE = re.compile(r"^fleet\.manifest_iter_(?P<it>\d+)\.json$")
+
+
+def fleet_snapshot_path(d: str, round_i: int) -> str:
+    return os.path.join(d, f"fleet.snapshot_iter_{round_i}")
+
+
+def fleet_manifest_path(d: str, round_i: int) -> str:
+    return os.path.join(d, f"fleet.manifest_iter_{round_i}.json")
+
+
+def fleet_ack_path(d: str, round_i: int, rank: int) -> str:
+    return os.path.join(d, f"fleet.manifest_iter_{round_i}.ack.rank{rank}")
+
+
+def ensemble_digest(model_text: str) -> str:
+    """sha256 over the model text normalized exactly as the snapshot
+    trailer hashes it (trailing newline ensured) — so the manifest's
+    ensemble_sha256 equals the snapshot trailer's digest and cross-checks
+    are byte-for-byte."""
+    if not model_text.endswith("\n"):
+        model_text += "\n"
+    return _digest(model_text)
+
+
+def write_fleet_checkpoint(d: str, model_text: str, round_i: int,
+                           world_size: int,
+                           shard_fingerprints: Optional[Dict[str, str]] = None,
+                           keep: int = 0) -> str:
+    """Rank 0's half of the protocol: durable snapshot FIRST, manifest
+    publish SECOND (the ordering is the whole point — a manifest may never
+    refer to a snapshot that might not exist).  ``shard_fingerprints``
+    maps rank -> data-shard sha256 so a resumed rank can refuse to
+    continue on changed data.  ``keep`` > 0 prunes old fleet rounds after
+    a successful publish (never the newest valid one).  Returns the
+    manifest path."""
+    snap = fleet_snapshot_path(d, round_i)
+    save_snapshot(snap, model_text, round_i)
+    # torn-fleet-state injection window (utils/faults.py manifest_write):
+    # the snapshot is durable but the manifest making it fleet-valid is
+    # not yet — a crash here must leave the PREVIOUS round authoritative
+    faults.maybe_crash("manifest_write", round_i)
+    manifest = {
+        "schema": FLEET_SCHEMA,
+        "round": int(round_i),
+        "snapshot": os.path.basename(snap),
+        "ensemble_sha256": ensemble_digest(model_text),
+        "world_size": int(world_size),
+        "shards": {str(r): str(fp)
+                   for r, fp in (shard_fingerprints or {}).items()},
+        "ts": time.time(),
+    }
+    atomic_write_text(fleet_manifest_path(d, round_i),
+                      json.dumps(manifest, indent=1) + "\n")
+    from ..obs import metrics as _obs
+
+    _obs.counter("fleet_checkpoints_total").inc()
+    _obs.event("fleet_checkpoint", round=int(round_i),
+               manifest=fleet_manifest_path(d, round_i),
+               world_size=int(world_size))
+    if keep > 0:
+        prune_fleet_checkpoints(d, keep)
+    return fleet_manifest_path(d, round_i)
+
+
+def confirm_fleet_checkpoint(d: str, round_i: int, rank: int,
+                             model_text: Optional[str] = None) -> str:
+    """A non-zero rank's half: drop the ack marker for round ``round_i``.
+    With ``model_text`` the ack carries this rank's own ensemble sha256,
+    so fleet validity additionally proves cross-rank state CONSISTENCY
+    (an empty ack only proves liveness through the round).  Markers are
+    written atomically — a torn ack must read as absent, not garbage."""
+    ack = fleet_ack_path(d, round_i, rank)
+    sha = ensemble_digest(model_text) if model_text is not None else ""
+    atomic_write_text(ack, sha + "\n")
+    return ack
+
+
+def fleet_manifest_valid(manifest_path: str,
+                         world_size: Optional[int] = None
+                         ) -> Optional[Dict]:
+    """The fleet-validity check.  Returns the manifest dict (with
+    ``snapshot`` resolved to an absolute path) when EVERY leg holds:
+
+    * the manifest parses and carries the ``lgbmtpu-fleet-ckpt-v1`` schema
+      (with a sane round and world_size);
+    * ``world_size``, when given, matches the manifest's (a resume must
+      not mix fleet sizes — shard fingerprints are per-rank);
+    * the snapshot exists and its sha256 trailer verifies;
+    * the snapshot payload hashes to the manifest's ``ensemble_sha256``;
+    * every rank 1..W-1 has an ack, and every sha-carrying ack matches.
+
+    Anything else returns None — an unconfirmed or torn round is never
+    resumed into."""
+    d = os.path.dirname(os.path.abspath(manifest_path))
+    try:
+        with open(manifest_path, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(manifest, dict) or manifest.get("schema") != FLEET_SCHEMA:
+        return None
+    try:
+        round_i = int(manifest["round"])
+        w = int(manifest["world_size"])
+        snap_name = str(manifest["snapshot"])
+        want_sha = str(manifest["ensemble_sha256"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if round_i < 1 or w < 1:
+        return None
+    if world_size is not None and w != int(world_size):
+        return None
+    snap = os.path.join(d, snap_name)
+    payload, ok = read_and_verify(snap)
+    if ok is not True or _digest(payload) != want_sha:
+        return None
+    for r in range(1, w):
+        try:
+            with open(fleet_ack_path(d, round_i, r),
+                      encoding="utf-8") as fh:
+                ack_sha = fh.read().strip()
+        except OSError:
+            return None  # unconfirmed rank: not fleet-valid
+        if ack_sha and ack_sha != want_sha:
+            return None  # rank diverged from rank 0's ensemble
+    manifest = dict(manifest)
+    manifest["snapshot"] = snap
+    return manifest
+
+
+def latest_valid_fleet_manifest(d: str,
+                                world_size: Optional[int] = None
+                                ) -> Optional[Tuple[int, str, Dict]]:
+    """Newest fleet-VALID round in directory ``d``: scans
+    ``fleet.manifest_iter_<k>.json`` newest-first and returns
+    ``(round, manifest_path, manifest)`` for the first one that passes
+    :func:`fleet_manifest_valid`, else None."""
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        return None
+    rounds = []
+    for name in entries:
+        m = _FLEET_MANIFEST_RE.match(name)
+        if m is not None:
+            rounds.append(int(m.group("it")))
+    for round_i in sorted(rounds, reverse=True):
+        path = fleet_manifest_path(d, round_i)
+        manifest = fleet_manifest_valid(path, world_size)
+        if manifest is not None:
+            return round_i, path, manifest
+    return None
+
+
+def prune_fleet_checkpoints(d: str, keep: int) -> List[int]:
+    """Fleet-side retention: drop whole rounds (snapshot + manifest +
+    acks) beyond the newest ``keep``, never the newest fleet-VALID round.
+    Returns the pruned round numbers."""
+    if keep <= 0:
+        return []
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        return []
+    rounds = set()
+    for name in entries:
+        m = _FLEET_MANIFEST_RE.match(name)
+        if m is not None:
+            rounds.add(int(m.group("it")))
+        sm = _SNAPSHOT_RE.match(name)
+        if sm is not None and sm.group("prefix") == "fleet":
+            rounds.add(int(sm.group("it")))
+    ordered = sorted(rounds, reverse=True)
+    newest_valid = latest_valid_fleet_manifest(d)
+    keep_round = newest_valid[0] if newest_valid else None
+    pruned: List[int] = []
+    for round_i in ordered[keep:]:
+        if round_i == keep_round:
+            continue
+        victims = [fleet_snapshot_path(d, round_i),
+                   fleet_manifest_path(d, round_i)]
+        victims += [os.path.join(d, n) for n in entries
+                    if n.startswith(f"fleet.manifest_iter_{round_i}.ack.")]
+        for path in victims:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        pruned.append(round_i)
+    if pruned:
+        from ..obs import metrics as _obs
+
+        _obs.counter("fleet_checkpoints_pruned_total").inc(len(pruned))
+        _obs.event("fleet_checkpoint_prune", kept=keep, pruned=pruned)
+    return pruned

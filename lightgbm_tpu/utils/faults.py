@@ -11,15 +11,27 @@ production code:
 
     LGBMTPU_FAULT=<site>:<round>[,<site>:<round>...]
 
+Rank-gated sites additionally accept the inline three-field form
+``<site>:<rank>:<round>`` (``worker_hang:1:3`` = rank 1 hangs at round 3),
+equivalent to setting ``LGBMTPU_FAULT_RANK`` for that one site.
+
 Sites (see docs/ROBUSTNESS.md for the exact trigger points):
 
 ``host_crash``      engine.train round loop — hard process exit
                     (``os._exit``) at the START of 1-based boosting
                     iteration <round>.
+``worker_hang``     same trigger point — the process SLEEPS FOREVER
+                    instead of dying, modelling a rank wedged inside a
+                    collective: exit-code watchdogs never fire, only the
+                    heartbeat watchdog catches it.  Rank-gated.
 ``snapshot_write``  utils/checkpoint.py atomic writer — hard process exit
                     mid-write (after a partial payload is flushed to the
                     TEMP file, before ``os.replace``) for the snapshot
                     covering iteration <round>.
+``manifest_write``  utils/checkpoint.py fleet-checkpoint writer — hard
+                    process exit BETWEEN the rank-0 snapshot landing and
+                    the fleet manifest publish: the torn-fleet-state
+                    window the manifest protocol exists to exclude.
 ``worker_death``    parallel/launcher.py worker body — hard process exit at
                     the start of iteration <round>, gated to one rank via
                     ``LGBMTPU_FAULT_RANK`` (compared against the worker's
@@ -52,13 +64,14 @@ launcher watchdog tests) without paying a backend bring-up.
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, Optional, Tuple
 
 # exit code for injected hard crashes — distinctive enough that a watchdog
 # log or a test can tell an injected death from a real one
 CRASH_EXIT_CODE = 113
 
-_RANK_GATED_SITES = ("worker_death",)
+_RANK_GATED_SITES = ("worker_death", "worker_hang")
 
 # sites whose <round> is a per-site CALL counter rather than an explicit
 # round number passed by the caller (trace-time sites have no round)
@@ -76,39 +89,69 @@ class InjectedFault(RuntimeError):
         self.round_i = round_i
 
 
-_spec_cache: Tuple[Optional[str], Dict[str, int]] = (None, {})
+_spec_cache: Tuple[Optional[str], Dict[str, int], Dict[str, str]] = (
+    None, {}, {})
 _fired: set = set()
 _call_counts: Dict[str, int] = {}
 
 
-def parse_spec(raw: Optional[str] = None) -> Dict[str, int]:
-    """``"site:round,site:round"`` -> {site: round}.  Malformed entries
-    raise ValueError immediately — a typo'd fault spec silently arming
-    nothing would invalidate the test that set it."""
-    if raw is None:
-        raw = os.environ.get("LGBMTPU_FAULT", "")
-    out: Dict[str, int] = {}
+def _parse_full(raw: str) -> Tuple[Dict[str, int], Dict[str, str]]:
+    """``"site:round,site:rank:round"`` -> ({site: round}, {site: rank}).
+    Malformed entries raise ValueError immediately — a typo'd fault spec
+    silently arming nothing would invalidate the test that set it."""
+    rounds: Dict[str, int] = {}
+    ranks: Dict[str, str] = {}
     for entry in raw.split(","):
         entry = entry.strip()
         if not entry:
             continue
-        site, sep, rnd = entry.partition(":")
-        if not sep or not site:
+        parts = entry.split(":")
+        if len(parts) == 2 and parts[0]:
+            site, rnd = parts
+        elif len(parts) == 3 and parts[0]:
+            # inline rank gate: <site>:<rank>:<round> (rank-gated sites)
+            site, rank, rnd = parts
+            ranks[site] = str(int(rank))
+        else:
             raise ValueError(
-                f"malformed LGBMTPU_FAULT entry {entry!r}: want <site>:<round>")
-        out[site] = int(rnd)
-    return out
+                f"malformed LGBMTPU_FAULT entry {entry!r}: want "
+                "<site>:<round> or <site>:<rank>:<round>")
+        rounds[site] = int(rnd)
+    return rounds, ranks
 
 
-def _spec() -> Dict[str, int]:
+def parse_spec(raw: Optional[str] = None) -> Dict[str, int]:
+    """``"site:round,site:round"`` -> {site: round} (rank qualifiers in the
+    three-field form are validated and dropped here; :func:`_spec_ranks`
+    carries them)."""
+    if raw is None:
+        raw = os.environ.get("LGBMTPU_FAULT", "")
+    return _parse_full(raw)[0]
+
+
+def _refresh_spec() -> None:
     global _spec_cache  # jaxlint: disable=R5 (host-side env-spec memo; fault arming is DELIBERATELY a trace-time decision for the pallas sites and a host decision everywhere else — nothing here touches traced values)
     raw = os.environ.get("LGBMTPU_FAULT", "")
     if _spec_cache[0] != raw:
-        _spec_cache = (raw, parse_spec(raw))
+        rounds, ranks = _parse_full(raw)
+        _spec_cache = (raw, rounds, ranks)
+
+
+def _spec() -> Dict[str, int]:
+    _refresh_spec()
     return _spec_cache[1]
 
 
+def _spec_ranks() -> Dict[str, str]:
+    _refresh_spec()
+    return _spec_cache[2]
+
+
 def _rank_allows(site: str) -> bool:
+    inline = _spec_ranks().get(site)
+    if inline is not None:
+        # inline <site>:<rank>:<round> form wins over the env gate
+        return os.environ.get("LIGHTGBM_TPU_RANK", "") == inline
     if site not in _RANK_GATED_SITES:
         return True
     want = os.environ.get("LGBMTPU_FAULT_RANK")
@@ -183,6 +226,19 @@ def maybe_crash(site: str, round_i: Optional[int] = None) -> None:
         os._exit(CRASH_EXIT_CODE)
 
 
+def maybe_hang(site: str, round_i: Optional[int] = None) -> None:
+    """Sleep FOREVER when the site fires — the wedged-in-a-collective
+    failure class (a rank stuck in an all-reduce never exits, so exit-code
+    watchdogs never fire; only heartbeat staleness catches it).  The fault
+    event and the cross-process once-marker are written by :func:`fire`
+    BEFORE the hang, so a watchdog relaunch runs clean."""
+    if fire(site, round_i):
+        print(f"[LightGBM-TPU] [Fault] injected {site} hang "
+              f"(round {round_i}) — sleeping forever", flush=True)
+        while True:
+            time.sleep(3600)
+
+
 def maybe_fail(site: str, round_i: Optional[int] = None) -> None:
     """Raise :class:`InjectedFault` when the site fires (kernel-failure
     sites — the degradation path in utils/degrade.py recognizes it)."""
@@ -211,4 +267,4 @@ def reset() -> None:
     global _spec_cache
     _fired.clear()
     _call_counts.clear()
-    _spec_cache = (None, {})
+    _spec_cache = (None, {}, {})

@@ -1,0 +1,393 @@
+"""Elastic fleet recovery (docs/ROBUSTNESS.md "Elastic fleet recovery",
+ISSUE 8): coordinated fleet checkpoints (rank-0 snapshot + manifest +
+per-rank sha acks), the hang-aware heartbeat watchdog, and
+resume-to-round relaunches that reproduce an uninterrupted run BITWISE.
+
+Layers under test:
+
+* the manifest protocol itself (utils/checkpoint.py) with SIMULATED
+  ranks — runs everywhere, no subprocesses;
+* engine.train's ``resume=<manifest>`` verification (torn / unconfirmed
+  manifests refused, shard-fingerprint mismatch refused);
+* the end-to-end elastic scenarios through the REAL launcher with a
+  1-rank fleet (no multi-process collectives needed, so these run on
+  the container jax): LGBMTPU_FAULT=host_crash:<k> and
+  worker_hang:<rank>:<k> under max_restarts=1 resume from round k's
+  fleet manifest and finish bitwise-identical to an uninterrupted
+  launcher run;
+* the loopback 2-rank variant, slow-marked and self-skipping where the
+  container jax lacks multiproc collectives (PR 3 note).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import LightGBMError
+from lightgbm_tpu.utils import checkpoint as ckpt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CPU_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+def _data(n=400, f=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X @ rng.randn(f) > 0).astype(np.float64)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+          "min_data_in_leaf": 5}
+
+
+_MODEL_TEXT_CACHE = {}
+
+
+def _model_text(rounds=2, seed=3):
+    key = (rounds, seed)
+    if key not in _MODEL_TEXT_CACHE:
+        X, y = _data(seed=seed)
+        bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), rounds)
+        _MODEL_TEXT_CACHE[key] = bst.model_to_string(raw_deltas=True)
+    return _MODEL_TEXT_CACHE[key]
+
+
+# ---------------------------------------------------------------------------
+# the manifest protocol, simulated ranks (no subprocesses)
+# ---------------------------------------------------------------------------
+
+def test_manifest_schema_and_roundtrip(tmp_path):
+    d = str(tmp_path)
+    text = _model_text()
+    mpath = ckpt.write_fleet_checkpoint(d, text, 4, 3,
+                                        {"0": "fp0", "1": "fp1", "2": "fp2"})
+    raw = json.load(open(mpath))
+    assert raw["schema"] == "lgbmtpu-fleet-ckpt-v1"
+    assert raw["round"] == 4 and raw["world_size"] == 3
+    assert raw["ensemble_sha256"] == ckpt.ensemble_digest(text)
+    assert raw["shards"] == {"0": "fp0", "1": "fp1", "2": "fp2"}
+    # snapshot landed through the trailer-stamped path
+    assert ckpt.verify_file(ckpt.fleet_snapshot_path(d, 4)) is True
+
+
+def test_unconfirmed_round_is_not_fleet_valid(tmp_path):
+    """rank-0's snapshot + manifest alone do NOT make a round resumable:
+    every non-zero rank must ack — and with a MATCHING ensemble sha."""
+    d = str(tmp_path)
+    text = _model_text()
+    mpath = ckpt.write_fleet_checkpoint(d, text, 2, 3, {})
+    assert ckpt.fleet_manifest_valid(mpath) is None  # no acks yet
+    ckpt.confirm_fleet_checkpoint(d, 2, 1, text)
+    assert ckpt.fleet_manifest_valid(mpath) is None  # rank 2 still silent
+    ckpt.confirm_fleet_checkpoint(d, 2, 2, text)
+    m = ckpt.fleet_manifest_valid(mpath)
+    assert m is not None and m["round"] == 2
+    assert ckpt.latest_valid_fleet_manifest(d, 3)[0] == 2
+    # world-size mismatch is refused (a resume must not mix fleet sizes)
+    assert ckpt.fleet_manifest_valid(mpath, world_size=2) is None
+
+
+def test_diverged_rank_ack_invalidates_the_round(tmp_path):
+    """An ack carrying a DIFFERENT ensemble sha proves the fleet forked —
+    that round must never be resumed into."""
+    d = str(tmp_path)
+    text = _model_text()
+    mpath = ckpt.write_fleet_checkpoint(d, text, 2, 2, {})
+    ckpt.confirm_fleet_checkpoint(d, 2, 1, text + "# divergent\n")
+    assert ckpt.fleet_manifest_valid(mpath) is None
+
+
+def test_torn_manifest_and_torn_snapshot_are_refused(tmp_path):
+    d = str(tmp_path)
+    text = _model_text()
+    mpath = ckpt.write_fleet_checkpoint(d, text, 2, 1, {})
+    assert ckpt.fleet_manifest_valid(mpath) is not None
+    # tear the snapshot: round 2 stops being fleet-valid
+    spath = ckpt.fleet_snapshot_path(d, 2)
+    snap_text = open(spath).read()
+    open(spath, "w").write(snap_text[: len(snap_text) // 2])
+    assert ckpt.fleet_manifest_valid(mpath) is None
+    # restore; tear the manifest JSON instead
+    open(spath, "w").write(snap_text)
+    assert ckpt.fleet_manifest_valid(mpath) is not None
+    mtext = open(mpath).read()
+    open(mpath, "w").write(mtext[: len(mtext) // 2])
+    assert ckpt.fleet_manifest_valid(mpath) is None
+    assert ckpt.latest_valid_fleet_manifest(d, 1) is None
+
+
+def test_latest_valid_skips_newer_torn_round(tmp_path):
+    """The previous fleet-valid round stays authoritative when the newest
+    round's manifest (or snapshot) is torn."""
+    d = str(tmp_path)
+    ckpt.write_fleet_checkpoint(d, _model_text(2), 2, 1, {})
+    ckpt.write_fleet_checkpoint(d, _model_text(4), 4, 1, {})
+    os.unlink(ckpt.fleet_manifest_path(d, 4))  # crash before publish
+    found = ckpt.latest_valid_fleet_manifest(d, 1)
+    assert found is not None and found[0] == 2
+
+
+def test_engine_refuses_invalid_manifest_and_changed_shard(tmp_path,
+                                                          monkeypatch):
+    d = str(tmp_path)
+    X, y = _data()
+    text = _model_text()
+    mpath = ckpt.write_fleet_checkpoint(d, text, 2, 2, {"0": "fp-original"})
+    # unconfirmed (rank 1 never acked): refused
+    with pytest.raises(LightGBMError, match="not fleet-valid"):
+        lgb.train(PARAMS, lgb.Dataset(X, label=y), 6, resume=mpath)
+    ckpt.confirm_fleet_checkpoint(d, 2, 1, text)
+    # confirmed but THIS rank's data shard changed: refused
+    monkeypatch.setenv("LIGHTGBM_TPU_RANK", "0")
+    monkeypatch.setenv("LGBMTPU_SHARD_FINGERPRINT", "fp-changed")
+    with pytest.raises(LightGBMError, match="fingerprint"):
+        lgb.train(PARAMS, lgb.Dataset(X, label=y), 6, resume=mpath)
+    # matching fingerprint resumes: 2 checkpointed + 4 remaining rounds
+    monkeypatch.setenv("LGBMTPU_SHARD_FINGERPRINT", "fp-original")
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), 6, resume=mpath)
+    assert bst.num_trees() == 6
+
+
+def test_manifest_resume_is_bitwise_identical(tmp_path):
+    """The core exactness contract WITHOUT the launcher: train 2 rounds
+    through the fleet-checkpoint callback, resume from the round-2
+    manifest, and match the uninterrupted 6-round run's model text
+    byte for byte (raw-delta snapshots + separated init score + .17g
+    checkpoint serialization make the round-trip lossless)."""
+    d = str(tmp_path)
+    X, y = _data()
+    full = lgb.train(PARAMS, lgb.Dataset(X, label=y), 6)
+
+    def cb(env):
+        it = env.model.current_iteration()
+        if it % 2 == 0:
+            ckpt.write_fleet_checkpoint(
+                d, env.model.model_to_string(raw_deltas=True), it, 1, {})
+    cb.order = 100
+    lgb.train(PARAMS, lgb.Dataset(X, label=y), 2, callbacks=[cb])
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), 6,
+                        resume=ckpt.fleet_manifest_path(d, 2),
+                        callbacks=[cb])
+    assert resumed.model_to_string() == full.model_to_string()
+    # ...and the resumed run kept checkpointing on the GLOBAL numbering
+    assert ckpt.latest_valid_fleet_manifest(d, 1)[0] == 6
+
+
+def test_fleet_retention_prunes_old_rounds_never_newest_valid(tmp_path):
+    d = str(tmp_path)
+    for k in (2, 4, 6):
+        ckpt.write_fleet_checkpoint(d, _model_text(k), k, 1, {})
+    pruned = ckpt.prune_fleet_checkpoints(d, keep=2)
+    assert pruned == [2]
+    assert not os.path.exists(ckpt.fleet_manifest_path(d, 2))
+    assert ckpt.latest_valid_fleet_manifest(d, 1)[0] == 6
+    # newest round torn: keep=1 must NOT prune the newest VALID round
+    os.unlink(ckpt.fleet_manifest_path(d, 6))
+    pruned = ckpt.prune_fleet_checkpoints(d, keep=1)
+    assert 4 not in pruned
+    assert ckpt.latest_valid_fleet_manifest(d, 1)[0] == 4
+
+
+# ---------------------------------------------------------------------------
+# manifest_write crash injection: the torn-fleet-state window
+# ---------------------------------------------------------------------------
+
+_MANIFEST_CRASH_SCRIPT = """
+import os, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils import checkpoint as ckpt
+
+rng = np.random.RandomState(3)
+X = rng.randn(400, 5)
+y = (X @ rng.randn(5) > 0).astype(np.float64)
+d = {d!r}
+
+def cb(env):
+    it = env.model.current_iteration()
+    if it % 2 == 0:
+        ckpt.write_fleet_checkpoint(
+            d, env.model.model_to_string(raw_deltas=True), it, 1, {{}})
+cb.order = 100
+lgb.train({params!r}, lgb.Dataset(X, label=y), 6, callbacks=[cb])
+print("COMPLETED_WITHOUT_FAULT", flush=True)
+"""
+
+
+def test_manifest_write_crash_leaves_previous_round_authoritative(tmp_path):
+    """Crash BETWEEN the rank-0 snapshot landing and the manifest publish
+    (the manifest_write site): the round-4 snapshot exists on disk but
+    round 2 stays the newest fleet-valid state, and resuming from it
+    reproduces the uninterrupted run bitwise."""
+    from lightgbm_tpu.utils.faults import CRASH_EXIT_CODE
+
+    d = str(tmp_path)
+    env = dict(os.environ, LGBMTPU_FAULT="manifest_write:4", **_CPU_ENV)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _MANIFEST_CRASH_SCRIPT.format(
+            repo=REPO, d=d, params=PARAMS)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == CRASH_EXIT_CODE, (r.stdout, r.stderr)
+    assert "COMPLETED_WITHOUT_FAULT" not in r.stdout
+
+    # the snapshot landed, the manifest did not: round 4 is torn state
+    assert os.path.exists(ckpt.fleet_snapshot_path(d, 4))
+    assert not os.path.exists(ckpt.fleet_manifest_path(d, 4))
+    found = ckpt.latest_valid_fleet_manifest(d, 1)
+    assert found is not None and found[0] == 2
+
+    X, y = _data()
+    full = lgb.train(PARAMS, lgb.Dataset(X, label=y), 6)
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), 6,
+                        resume=found[1])
+    assert resumed.model_to_string() == full.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# elastic e2e through the real launcher (1-rank fleet: runs everywhere)
+# ---------------------------------------------------------------------------
+
+def _fleet_events(tmp):
+    path = os.path.join(tmp, "fleet_events.jsonl")
+    with open(path, encoding="utf-8") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def _launch(params, X, y, rounds=6, **kw):
+    from lightgbm_tpu.parallel import launcher
+
+    bst, files = launcher.train_distributed(
+        params, X, y, num_boost_round=rounds, num_machines=1,
+        env_extra=dict(_CPU_ENV), **kw)
+    return bst, files, launcher._LAST_LAUNCH_DIR
+
+
+def _e2e_params(X):
+    return dict(PARAMS, bin_construct_sample_cnt=len(X), snapshot_freq=2)
+
+
+@pytest.fixture(scope="module")
+def uninterrupted_ref_text():
+    """One uninterrupted 1-rank launcher run shared by both elastic-e2e
+    scenarios (each worker pays a full jax import — sharing the
+    reference keeps the module inside the tier-1 budget)."""
+    X, y = _data()
+    assert "LGBMTPU_FAULT" not in os.environ
+    _, ref_files, _ = _launch(_e2e_params(X), X, y)
+    return open(ref_files[0]).read()
+
+
+def test_elastic_resume_after_host_crash_is_bitwise(monkeypatch,
+                                                    uninterrupted_ref_text):
+    """THE acceptance scenario: rank 0 is killed at round 5 under
+    max_restarts=1; the relaunch resumes every rank from round 4's fleet
+    manifest (not round 0) and the final rank-0 model file is
+    byte-identical to an uninterrupted launcher run's."""
+    X, y = _data()
+    params = _e2e_params(X)
+
+    monkeypatch.setenv("LGBMTPU_FAULT", "host_crash:5")
+    _, files, tmp = _launch(params, X, y, max_restarts=1,
+                            restart_backoff_s=0.1)
+    assert open(files[0]).read() == uninterrupted_ref_text
+
+    ev = _fleet_events(tmp)
+    kinds = [e["kind"] for e in ev]
+    assert "worker_death" in kinds and "fleet_relaunch" in kinds
+    resumes = [e for e in ev if e["kind"] == "fleet_resume"]
+    assert resumes and all(e["round"] == 4 for e in resumes)
+    # the relaunched worker trained ONLY the remaining rounds (5, 6)
+    relaunch_ts = max(e["ts"] for e in ev if e["kind"] == "fleet_relaunch")
+    post = [e for e in ev
+            if e["kind"] == "boost_round" and e["ts"] > relaunch_ts]
+    assert len(post) == 2, [e["kind"] for e in ev]
+
+
+def test_hung_rank_is_detected_killed_and_resumed_bitwise(
+        monkeypatch, uninterrupted_ref_text):
+    """worker_hang:<rank>:<round>: a rank that sleeps forever inside the
+    round loop never exits, so only the heartbeat watchdog can catch it.
+    It must be declared hung within a bounded multiple of the timeout
+    (stale_s recorded in the event trail), killed, and the relaunch must
+    resume from the last fleet-valid round and finish bitwise."""
+    X, y = _data()
+    params = _e2e_params(X)
+
+    timeout = 4.0
+    monkeypatch.setenv("LGBMTPU_FAULT", "worker_hang:0:3")
+    _, files, tmp = _launch(params, X, y, max_restarts=1,
+                            restart_backoff_s=0.1,
+                            heartbeat_timeout_s=timeout)
+    assert open(files[0]).read() == uninterrupted_ref_text
+
+    ev = _fleet_events(tmp)
+    hangs = [e for e in ev if e["kind"] == "worker_hang"]
+    assert len(hangs) == 1 and hangs[0]["worker_rank"] == 0
+    # detection bound: staleness at detection within 2x the timeout
+    # (one timeout to qualify + at most one snapshot period + one check
+    # interval of slack)
+    assert timeout < hangs[0]["stale_s"] <= 2 * timeout
+    assert [e["round"] for e in ev if e["kind"] == "fleet_resume"] == [2, 2]
+    assert any(e["kind"] == "fleet_relaunch" and e.get("hung")
+               for e in ev)
+
+
+# ---------------------------------------------------------------------------
+# loopback multi-rank variant (slow; self-skips on the container jax)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_elastic_resume_loopback_two_ranks(monkeypatch):
+    """The 2-rank loopback form of the acceptance scenario: rank 1 dies
+    at round 3; the fleet relaunches from the newest fleet-valid manifest
+    (every rank confirmed) and both ranks converge to the identical model
+    an uninterrupted 2-rank run produces.  Self-skips where the container
+    jax lacks multiproc collectives (PR 3 note)."""
+    from lightgbm_tpu.parallel.launcher import WorkerFailure, train_distributed
+
+    X, y = _data(n=4000, f=6, seed=11)
+    params = dict(PARAMS, bin_construct_sample_cnt=len(X), snapshot_freq=2)
+    try:
+        _, ref_files = train_distributed(
+            params, X, y, num_boost_round=6, num_machines=2,
+            env_extra=dict(_CPU_ENV))
+    except WorkerFailure as e:
+        pytest.skip(f"container jax lacks loopback multiproc collectives: "
+                    f"{str(e)[:160]}")
+    ref_text = open(ref_files[0]).read()
+
+    monkeypatch.setenv("LGBMTPU_FAULT", "worker_death:3")
+    monkeypatch.setenv("LGBMTPU_FAULT_RANK", "1")
+    bst, files = train_distributed(
+        params, X, y, num_boost_round=6, num_machines=2,
+        max_restarts=1, restart_backoff_s=0.1, env_extra=dict(_CPU_ENV))
+    texts = [open(f).read() for f in files]
+    assert texts[0] == texts[1] == ref_text
+    from lightgbm_tpu.parallel import launcher
+
+    ev = _fleet_events(launcher._LAST_LAUNCH_DIR)
+    resumes = [e for e in ev if e["kind"] == "fleet_resume"]
+    assert resumes and all(e["round"] == 2 for e in resumes)
+
+
+def test_manifest_resume_refuses_overshoot(tmp_path):
+    """A manifest round BEYOND the requested num_iterations is refused —
+    silently returning a bigger model than asked is the stale-newer
+    hazard, not a resume."""
+    d = str(tmp_path)
+    X, y = _data()
+    mpath = ckpt.write_fleet_checkpoint(d, _model_text(4), 4, 1, {})
+    with pytest.raises(LightGBMError, match="beyond the requested"):
+        lgb.train(PARAMS, lgb.Dataset(X, label=y), 2, resume=mpath)

@@ -1461,3 +1461,77 @@ def test_r11_pragma_suppression(tmp_path):
     """}, rules=["R11"])
     assert not rep.findings
     assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R12 raw-model-write
+# ---------------------------------------------------------------------------
+
+def test_r12_positive_raw_open_write_of_model_artifact(tmp_path):
+    """A raw open(..., 'w'/'wb') of a model/snapshot path outside the
+    checkpoint helper is the torn-file class the atomic writer exists to
+    exclude."""
+    rep = _scan(tmp_path, {"mod.py": """
+        def save(model_path, text, snap):
+            with open(model_path, "w") as fh:
+                fh.write(text)
+            with open(snap + ".snapshot_iter_3", "wb") as fh:
+                fh.write(text.encode())
+    """}, rules=["R12"])
+    assert len(rep.findings) == 2, rep.findings
+    assert all(f.rule == "R12" for f in rep.findings)
+
+
+def test_r12_positive_np_save_and_os_replace(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import os
+        import numpy as np
+
+        def persist(arrays, tmp, manifest_path):
+            np.savez("ensemble_snapshot.npz", **arrays)
+            os.replace(tmp, manifest_path)
+    """}, rules=["R12"])
+    assert len(rep.findings) == 2, rep.findings
+
+
+def test_r12_negative_non_artifact_writes_and_reads(tmp_path):
+    """Logs, predictions, data paths: not artifacts.  Reading a model is
+    not a write.  Mode must actually contain 'w'."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        def ok(log_path, model_path, data):
+            with open(log_path, "w") as fh:
+                fh.write("line")
+            with open(model_path) as fh:
+                text = fh.read()
+            with open(model_path, "rb") as fh:
+                raw = fh.read()
+            np.savez("bins_cache.npz", bins=data)
+            return text, raw
+    """}, rules=["R12"])
+    assert not rep.findings, rep.findings
+
+
+def test_r12_negative_checkpoint_module_exempt(tmp_path):
+    """utils/checkpoint.py IS the sanctioned writer — its own raw
+    open/os.replace are the implementation, not a violation."""
+    rep = _scan(tmp_path, {"checkpoint.py": """
+        import os
+
+        def atomic_write_text(model_path, text, tmp):
+            with open(tmp, "w") as fh:
+                fh.write(text)
+            os.replace(tmp, model_path)
+    """}, rules=["R12"])
+    assert not rep.findings, rep.findings
+
+
+def test_r12_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        def convert(cfg, code):
+            with open(cfg.convert_model, "w") as fh:  # jaxlint: disable=R12 (fixture: generated source, not a loadable artifact)
+                fh.write(code)
+    """}, rules=["R12"])
+    assert not rep.findings
+    assert len(rep.suppressed) == 1

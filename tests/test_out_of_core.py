@@ -16,6 +16,8 @@ change a trained model by a single bit.
   green when fed from a stream-assembled (out_of_core resident) matrix.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -344,3 +346,255 @@ def test_windowed_budget_green_on_stream_assembled_matrix(tmp_path):
     assert stats["host_syncs"] == 0, stats
     assert stats["retries"] == 0, stats
     d.assert_no_recompile("windowed rounds on a stream-assembled matrix")
+
+
+# ---------------------------------------------------------------------------
+# per-chunk CRC32 integrity (round 13, ISSUE 8): a corrupt or truncated
+# bin cache fails fast + row-ranged instead of training on garbage bins
+# ---------------------------------------------------------------------------
+
+def _make_cache(tmp_path, n=300, f=4, name="crc.bin"):
+    X, y = _make_data(n=n, f=f)
+    ds = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    ds.construct()
+    cache = str(tmp_path / name)
+    ds.save_binary(cache)
+    return cache, np.asarray(ds.bins)
+
+
+def _rewrite_member(src, dst, member, transform):
+    """Copy an npz, applying ``transform(bytes)`` to one member (None
+    drops it)."""
+    import zipfile
+
+    with zipfile.ZipFile(src) as zin, zipfile.ZipFile(dst, "w") as zout:
+        for name in zin.namelist():
+            data = zin.read(name)
+            if name == member:
+                data = transform(data)
+                if data is None:
+                    continue
+            zout.writestr(name, data)
+
+
+def test_save_binary_carries_crc_table_and_verifies(tmp_path):
+    from lightgbm_tpu.io.stream import BinCacheStream, bin_crc32s
+
+    cache, bins = _make_cache(tmp_path)
+    s = BinCacheStream(cache)
+    assert s.crcs is not None and s.crc_rows > 0
+    np.testing.assert_array_equal(s.crcs, bin_crc32s(bins, s.crc_rows))
+    got = np.zeros_like(bins)
+    for lo, view in s.chunks(37):
+        got[lo:lo + view.shape[0]] = view
+    np.testing.assert_array_equal(got, bins)
+
+
+def test_corrupt_bin_cache_raises_row_ranged_error(tmp_path):
+    """A flipped byte in the bins member surfaces as CorruptBinCacheError
+    naming the failing CRC chunk and its row range — never as garbage
+    bins silently reaching training.  Exercised with a small custom CRC
+    block size so the MIDDLE chunk is the one named."""
+    import zlib
+
+    from lightgbm_tpu.io.stream import (BinCacheStream,
+                                        CorruptBinCacheError, bin_crc32s)
+
+    cache, bins = _make_cache(tmp_path)
+    # rebuild the cache with 64-row CRC blocks and corrupt a row in
+    # block 2 (rows 128..191) — stored UNCOMPRESSED so the byte flip
+    # reaches the CRC check rather than a zlib error
+    bad_bins = bins.copy()
+    bad_bins[150, 1] ^= 0x1
+    crc_rows = 64
+
+    def poison(_):
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, bad_bins)
+        return buf.getvalue()
+
+    bad = str(tmp_path / "bad.bin")
+    _rewrite_member(cache, bad, "bins.npy", poison)
+    _rewrite_member(bad, bad + "2", "bins_crc_rows.npy", lambda _: (
+        lambda b: (np.save(b, np.asarray(crc_rows, np.int64)), b.getvalue())[1])(
+        __import__("io").BytesIO()))
+    good_crcs = bin_crc32s(bins, crc_rows)  # CRCs of the TRUE data
+
+    def crc_member(_):
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, good_crcs)
+        return buf.getvalue()
+
+    final = str(tmp_path / "final.bin")
+    _rewrite_member(bad + "2", final, "bins_crc32.npy", crc_member)
+
+    s = BinCacheStream(final)
+    assert s.crc_rows == crc_rows
+    with pytest.raises(CorruptBinCacheError) as ei:
+        for _ in s.chunks(50):
+            pass
+    assert ei.value.chunk_index == 150 // crc_rows
+    assert ei.value.row_lo == 128 and ei.value.row_hi == 192
+    assert "rows [128, 192)" in str(ei.value)
+
+
+def test_truncated_bin_cache_raises_corrupt_error(tmp_path):
+    from lightgbm_tpu.io.stream import BinCacheStream, CorruptBinCacheError
+
+    cache, bins = _make_cache(tmp_path)
+
+    def truncate(data):
+        return data[: len(data) - len(data) // 3]
+
+    torn = str(tmp_path / "torn.bin")
+    _rewrite_member(cache, torn, "bins.npy", truncate)
+    with pytest.raises(CorruptBinCacheError, match="corrupt at CRC chunk"):
+        for _ in BinCacheStream(torn).chunks(64):
+            pass
+
+
+def test_corrupt_cache_fails_training_not_silently(tmp_path):
+    """End to end: an out_of_core dataset built on a corrupt cache raises
+    CorruptBinCacheError during ingest — training never sees the bins."""
+    from lightgbm_tpu.io.stream import CorruptBinCacheError
+
+    cache, bins = _make_cache(tmp_path)
+    bad_bins = bins.copy()
+    bad_bins[7, 0] ^= 0x1
+
+    def poison(_):
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, bad_bins)
+        return buf.getvalue()
+
+    bad = str(tmp_path / "bad_e2e.bin")
+    _rewrite_member(cache, bad, "bins.npy", poison)
+    ds = lgb.Dataset(bad, params=dict(_PARAMS, out_of_core=True))
+    with pytest.raises(CorruptBinCacheError):
+        _train_model_str(ds)
+
+
+def test_legacy_trailerless_cache_loads_with_warning(tmp_path, caplog):
+    """Pre-round-13 caches (no CRC members) still stream — with a logged
+    warning, since nothing can vouch for their bytes."""
+    from lightgbm_tpu.io.stream import BinCacheStream
+
+    cache, bins = _make_cache(tmp_path)
+    legacy = str(tmp_path / "legacy.bin")
+    _rewrite_member(cache, legacy, "bins_crc32.npy", lambda _: None)
+    _rewrite_member(legacy, legacy + "2", "bins_crc_rows.npy",
+                    lambda _: None)
+    s = BinCacheStream(legacy + "2")
+    assert s.crcs is None
+    got = np.zeros_like(bins)
+    for lo, view in s.chunks(100):
+        got[lo:lo + view.shape[0]] = view
+    np.testing.assert_array_equal(got, bins)
+
+
+# ---------------------------------------------------------------------------
+# crash-at-round-k resume equivalence in the SPILL regime (ISSUE 8):
+# stream + chunked-histogram state resumes bitwise, across chunk sizes
+# ---------------------------------------------------------------------------
+
+_OOC_CRASH_SCRIPT = """
+import os, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+import lightgbm_tpu as lgb
+
+params = dict({params!r}, out_of_core=True, max_rows_in_hbm={hbm},
+              out_of_core_chunk_rows={chunk}, snapshot_freq=2,
+              output_model={out!r})
+ds = lgb.Dataset({cache!r}, params=params)
+lgb.train(params, ds, 6)
+print("COMPLETED_WITHOUT_FAULT", flush=True)
+"""
+
+
+@pytest.mark.parametrize("chunk", [53])
+def test_spill_crash_at_round_k_resume_is_bitwise(tmp_path, chunk):
+    """Kill the host at round 5 of 6 while training a cache-streamed
+    SPILL dataset; re-running the command with resume=auto continues
+    from the round-4 snapshot — stream position restarts per pass and
+    the chunked-histogram folds replay — and the final model is BITWISE
+    identical to the uninterrupted spill run (which is itself bitwise
+    the in-memory model, pinned above)."""
+    import subprocess
+    import sys
+
+    from lightgbm_tpu.utils.faults import CRASH_EXIT_CODE
+
+    X, y = _make_data()
+    n = X.shape[0]
+    base = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    base.construct()
+    cache = str(tmp_path / "train.bin")
+    base.save_binary(cache)
+
+    ooc = dict(_PARAMS, out_of_core=True, max_rows_in_hbm=n // 4,
+               out_of_core_chunk_rows=chunk)
+    full_ds = lgb.Dataset(cache, params=ooc)
+    full = lgb.train(ooc, full_ds, 6)
+
+    out = str(tmp_path / f"m{chunk}.txt")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, LGBMTPU_FAULT="host_crash:5",
+               JAX_PLATFORMS="cpu")
+    env.pop("PYTEST_CURRENT_TEST", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _OOC_CRASH_SCRIPT.format(
+            repo=repo, params=_PARAMS, hbm=n // 4, chunk=chunk,
+            out=out, cache=cache)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == CRASH_EXIT_CODE, (r.stdout, r.stderr)
+
+    resume_params = dict(ooc, snapshot_freq=2, output_model=out)
+    ds = lgb.Dataset(cache, params=resume_params)
+    resumed = lgb.train(resume_params, ds, 6, resume="auto")
+    assert resumed.num_trees() == 6
+    assert ds.ooc_spill
+    # params echo differs (snapshot_freq/output_model); the TREES must
+    # not differ by a single bit
+    def trees(s):
+        return s.partition("\nTree=")[2]
+
+    assert trees(resumed.model_to_string()) == trees(full.model_to_string())
+
+
+def test_spill_resume_with_categorical_trees(tmp_path):
+    """Categorical splits are inside the spill envelope, so resume must
+    handle them too: the streamed multi-tree replay excludes cat trees,
+    and the per-tree fallback walks host chunks — still bitwise."""
+    rng = np.random.RandomState(5)
+    n = 300
+    X = np.hstack([rng.randint(0, 8, (n, 2)).astype(float),
+                   rng.randn(n, 3)])
+    y = ((X[:, 0] == 3) | (X[:, 2] > 0)).astype(float)
+    base_params = dict(_PARAMS, categorical_feature=[0, 1])
+    base = lgb.Dataset(X, label=y, params=base_params,
+                       categorical_feature=[0, 1])
+    base.construct()
+    cache = str(tmp_path / "cat.bin")
+    base.save_binary(cache)
+
+    P = dict(base_params, out_of_core=True, max_rows_in_hbm=64,
+             out_of_core_chunk_rows=53)
+    full = lgb.train(P, lgb.Dataset(cache, params=P), 4)
+    assert any(t.num_cat > 0 for t in full._gbdt.models)
+
+    run = dict(P, snapshot_freq=2, output_model=str(tmp_path / "m.txt"))
+    lgb.train(run, lgb.Dataset(cache, params=run), 2)
+    resumed = lgb.train(run, lgb.Dataset(cache, params=run), 4,
+                        resume="auto")
+
+    def trees(s):
+        return s.partition("\nTree=")[2]
+
+    assert trees(resumed.model_to_string()) == trees(full.model_to_string())

@@ -421,3 +421,65 @@ def test_auto_resume_rejects_unknown_mode(tmp_path):
     X, y = _data(seed=14)
     with pytest.raises(lgb.basic.LightGBMError):
         lgb.train(PARAMS, lgb.Dataset(X, label=y), 2, resume="latest")
+
+
+# ---------------------------------------------------------------------------
+# tier-1: snapshot retention (snapshot_keep=, round 13)
+# ---------------------------------------------------------------------------
+
+def test_snapshot_keep_prunes_oldest_after_each_write(tmp_path):
+    """snapshot_keep=2 with snapshot_freq=1 leaves exactly the newest two
+    snapshots on disk after training (default 0 keeps all — pinned by
+    every other test in this file)."""
+    X, y = _data(seed=15)
+    out = str(tmp_path / "m.txt")
+    lgb.train({**PARAMS, "snapshot_freq": 1, "snapshot_keep": 2,
+               "output_model": out}, lgb.Dataset(X, label=y), 5)
+    assert [it for it, _ in checkpoint.snapshot_family(out)] == [5, 4]
+    # resume still works from what retention kept
+    resumed = lgb.train({**PARAMS, "snapshot_freq": 1, "snapshot_keep": 2,
+                         "output_model": out},
+                        lgb.Dataset(X, label=y), 6, resume="auto")
+    assert resumed.num_trees() == 6
+
+
+def test_prune_never_removes_newest_valid_snapshot(tmp_path):
+    """A family whose newest entries are all torn keeps its last GOOD
+    snapshot whatever the keep bound — retention must not be able to
+    throw away the only resumable state."""
+    X, y = _data(seed=16)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), 2)
+    out = str(tmp_path / "m.txt")
+    for it in (1, 2, 3, 4):
+        checkpoint.save_snapshot(f"{out}.snapshot_iter_{it}",
+                                 bst.model_to_string(), it)
+    for it in (3, 4):  # newest two torn
+        p = f"{out}.snapshot_iter_{it}"
+        t = open(p).read()
+        open(p, "w").write(t[: len(t) // 2])
+    pruned = checkpoint.prune_snapshots(out, keep=2)
+    # 1 pruned; 2 survives as the newest VALID despite being beyond keep
+    assert [it for it, _ in pruned] == [1]
+    assert checkpoint.latest_valid_snapshot(out) == (
+        2, f"{out}.snapshot_iter_2")
+
+
+def test_linear_tree_resume_replays_linear_terms(tmp_path):
+    """Resume of a linear_tree model must replay the per-leaf LINEAR
+    terms, not just leaf_value — a constant-only replay rebuilds a wrong
+    score base and every post-resume tree diverges."""
+    rng = np.random.RandomState(17)
+    X = rng.randn(400, 3)
+    y = X[:, 0] * np.where(X[:, 1] > 0, 2.0, -1.0) + 0.05 * rng.randn(400)
+    params = {"objective": "regression", "num_leaves": 4, "verbosity": -1,
+              "linear_tree": True, "min_data_in_leaf": 10}
+    full = lgb.train(params, lgb.Dataset(X, label=y), 4)
+
+    out = str(tmp_path / "lin.txt")
+    lgb.train({**params, "snapshot_freq": 2, "output_model": out},
+              lgb.Dataset(X, label=y), 2)
+    resumed = lgb.train(params, lgb.Dataset(X, label=y), 2,
+                        init_model=f"{out}.snapshot_iter_2")
+    assert resumed.num_trees() == 4
+    np.testing.assert_allclose(resumed.predict(X), full.predict(X),
+                               rtol=1e-4, atol=1e-5)

@@ -159,3 +159,81 @@ def test_distributed_init_exhausts_retries(monkeypatch):
     with pytest.raises(RuntimeError, match="never came up"):
         distributed.init_distributed(cfg)
     assert distributed._initialized is False
+
+
+# ---------------------------------------------------------------------------
+# hang-aware heartbeat watchdog (round 13) — thin subprocesses, no jax
+# ---------------------------------------------------------------------------
+
+def _write_heartbeat(path, value):
+    import json
+
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"gauges": {"heartbeat_ts": value}}, fh)
+
+
+def test_hung_worker_detected_via_stale_heartbeat(tmp_path):
+    """A worker that stays ALIVE but whose heartbeat stops changing is
+    declared hung within a bounded multiple of the timeout, killed, and
+    reported as WorkerFailure(hung=True) — the exit-code watchdog alone
+    would sit out the full launch timeout."""
+    import threading
+
+    from lightgbm_tpu.parallel.launcher import _watch_workers
+
+    workers = [_worker(tmp_path, 0, "import time; time.sleep(600)")]
+    hb_path = str(tmp_path / "w0.metrics.json")
+
+    def beat():
+        # two distinct values ARM staleness (round-1 compiles must not
+        # trip the detector), then the heartbeat goes silent
+        _write_heartbeat(hb_path, 1.0)
+        time.sleep(0.4)
+        _write_heartbeat(hb_path, 2.0)
+
+    threading.Thread(target=beat, daemon=True).start()
+    t0 = time.monotonic()
+    with pytest.raises(WorkerFailure) as ei:
+        _watch_workers(workers, timeout_s=600,
+                       heartbeat_timeout_s=1.0,
+                       heartbeat_paths={0: hb_path})
+    elapsed = time.monotonic() - t0
+    assert ei.value.hung and ei.value.rank == 0 and not ei.value.timed_out
+    assert "HUNG" in str(ei.value)
+    assert elapsed < 10, f"hang detection took {elapsed:.1f}s"
+    assert workers[0][1].poll() is not None, "hung worker left alive"
+
+
+def test_static_heartbeat_from_the_start_never_trips(tmp_path):
+    """Staleness is armed only after the heartbeat has been seen to
+    CHANGE: a value that is static from the first observation models (a)
+    round-1 jit compilation and (b) a stale snapshot file left by a
+    previous launch attempt — neither may be declared a hang."""
+    from lightgbm_tpu.parallel.launcher import _watch_workers
+
+    hb_path = str(tmp_path / "w0.metrics.json")
+    _write_heartbeat(hb_path, 42.0)  # pre-existing, never changes
+    workers = [_worker(tmp_path, 0, "import time; time.sleep(3)")]
+    _watch_workers(workers, timeout_s=60,
+                   heartbeat_timeout_s=0.5,
+                   heartbeat_paths={0: hb_path})
+    assert workers[0][1].returncode == 0
+
+
+def test_missing_or_torn_heartbeat_file_is_not_a_hang(tmp_path):
+    """No snapshot yet (worker still importing) and torn JSON both read
+    as 'no heartbeat signal', covered by the launch timeout — not a
+    hang verdict."""
+    from lightgbm_tpu.parallel.launcher import (_read_heartbeat,
+                                                _watch_workers)
+
+    assert _read_heartbeat(str(tmp_path / "nope.json")) is None
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"gauges": {"heartbeat_')
+    assert _read_heartbeat(str(torn)) is None
+    assert _read_heartbeat(None) is None
+
+    workers = [_worker(tmp_path, 0, "import time; time.sleep(2)")]
+    _watch_workers(workers, timeout_s=60, heartbeat_timeout_s=0.5,
+                   heartbeat_paths={0: str(torn)})
+    assert workers[0][1].returncode == 0
