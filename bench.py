@@ -341,6 +341,39 @@ def main():
         from benchmarks.ooc_bench import main as ooc_main
 
         return ooc_main()
+    if os.environ.get("BENCH_MODE") == "multichip":
+        # sharded fused windowed dryrun (round 14): the one-dispatch
+        # windowed round under shard_map with the histogram merge an
+        # in-dispatch psum / psum_scatter, validated for tree equality +
+        # the per-rank round budget on an n-device mesh (off-chip this is
+        # the CPU loopback mesh; on a slice the same lever exercises real
+        # ICI).  Writes MULTICHIP_r06-format JSON to stdout.
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import __graft_entry__ as _ge
+
+        n_dev = int(os.environ.get("MULTICHIP_DEVICES", "8"))
+        result = {"n_devices": n_dev, "mode": "sharded_fused_windowed",
+                  "merges": {}, "ok": True}
+        for merge in ("psum", "scatter"):
+            import io
+            from contextlib import redirect_stdout
+
+            buf = io.StringIO()
+            try:
+                with redirect_stdout(buf):
+                    _ge.dryrun_multichip_windowed(n_dev, merge)
+                result["merges"][merge] = {
+                    "rc": 0, "ok": True,
+                    "tail": buf.getvalue()[-500:]}
+            except Exception as e:  # noqa: BLE001 — artifact robustness
+                result["merges"][merge] = {
+                    "rc": 1, "ok": False,
+                    "tail": (buf.getvalue() + f"\n{type(e).__name__}: "
+                             f"{e}")[-800:]}
+                result["ok"] = False
+        print(json.dumps(result, indent=2))
+        return 0 if result["ok"] else 1
     # persistent XLA compilation cache (measured r5: cuts warmups ~2.4x on
     # the second process — kernel smoke 31->21 s, primary compile
     # 104->43 s — the warmups were the reason Epsilon kept falling off the

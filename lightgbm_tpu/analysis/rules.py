@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R12.
+"""jaxlint built-in rules R1-R13.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -1143,3 +1143,92 @@ def r12_raw_model_write(pkg: PackageIndex) -> Iterator[Finding]:
                         f"via raw {how} — outside the atomic "
                         "sha256-trailed checkpoint helper, a crash can "
                         "leave a torn file a restart will trust", hint)
+
+
+# ---------------------------------------------------------------------------
+# R13 — collective-outside-fused-round
+# ---------------------------------------------------------------------------
+
+_R13_COLLECTIVES = ("psum", "psum_scatter", "all_gather", "pmax", "pmin",
+                    "pmean", "all_to_all", "ppermute")
+
+
+def _r13_body_has_collective(fi: FuncInfo) -> bool:
+    for node in _own_body(fi, include_nested=True):
+        if isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            if fn and fn.split(".")[-1] in _R13_COLLECTIVES:
+                return True
+    return False
+
+
+@register_rule("R13", "collective-outside-fused-round")
+def r13_collective_outside_fused_round(pkg: PackageIndex) -> Iterator[Finding]:
+    """A cross-device collective issued from a HOST round loop that also
+    dispatches donated (fused-round) state — either an eager
+    ``jax.lax.psum``/``psum_scatter``/``all_gather`` call, or a second
+    jitted dispatch whose body performs the collective.  Either form
+    reintroduces the per-round collective round-trip LightGBM's Network
+    layer pays (a ReduceScatter per split): one extra dispatch per round
+    plus a device-queue barrier at exactly the cadence the fused round
+    exists to remove.  On the sharded path the merge belongs INSIDE the
+    donated round body — one dispatch, the collective in-trace
+    (ops/treegrow_windowed.py::_round_fused under shard_map,
+    docs/DISTRIBUTED.md "Sharded fused rounds").  Collectives inside the
+    donated callee itself are the FIX, not a finding; loops with no
+    donated dispatch (setup/eval phases) are out of scope."""
+    hint = ("fold the collective into the donated round body (psum/"
+            "psum_scatter inside the shard_mapped fused round — see "
+            "parallel/data_parallel.py::grow_tree_windowed_data_parallel "
+            "and docs/ANALYSIS.md R13); if the host truly needs the "
+            "reduced value, return it in the round's async info vector")
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if pkg.is_hot(fi):
+                continue
+            loops = [node for node in _own_body(fi)
+                     if isinstance(node, (ast.For, ast.While))]
+            for loop in loops:
+                loop_nodes = set(ast.walk(loop)) - {loop}
+                donated_lines = set()
+                for node in _own_body(fi):
+                    if node not in loop_nodes or not isinstance(
+                            node, ast.Call):
+                        continue
+                    target = pkg.resolve_call(mod, node.func)
+                    callee = pkg.lookup(target) if target else None
+                    if callee is not None and callee.jit is not None and (
+                            callee.jit.donate_argnums
+                            or callee.jit.donate_argnames):
+                        donated_lines.add(node.lineno)
+                if not donated_lines:
+                    continue  # not a fused-round loop
+                for node in _own_body(fi):
+                    if node not in loop_nodes or not isinstance(
+                            node, ast.Call):
+                        continue
+                    if node.lineno in donated_lines:
+                        continue  # the fused round itself
+                    fn = dotted_name(node.func) or ""
+                    last = fn.split(".")[-1]
+                    if last in _R13_COLLECTIVES:
+                        yield _finding(
+                            fi, node, "R13",
+                            f"host-issued collective {fn}() in "
+                            f"{fi.qualname}'s fused round loop — a "
+                            "per-round collective dispatch OUTSIDE the "
+                            "donated round body", hint)
+                        continue
+                    target = pkg.resolve_call(mod, node.func)
+                    callee = pkg.lookup(target) if target else None
+                    if (callee is not None and callee.jit is not None
+                            and not (callee.jit.donate_argnums
+                                     or callee.jit.donate_argnames)
+                            and _r13_body_has_collective(callee)):
+                        yield _finding(
+                            fi, node, "R13",
+                            f"{callee.qualname} (jitted, collective-"
+                            f"bearing) dispatched per round in "
+                            f"{fi.qualname}'s fused round loop — the "
+                            "merge pays a second dispatch instead of "
+                            "riding the donated round", hint)

@@ -438,6 +438,18 @@ class Config:
     # 0 (default) = disabled (exit-code watchdog + launch timeout only).
     # LGBMTPU_HEARTBEAT_TIMEOUT_S is the env spelling.
     heartbeat_timeout_s: float = 0.0
+    # slow_rank_factor (ours; docs/OBSERVABILITY.md "Fleet metrics"):
+    # straggler DETECTION threshold for the launcher's heartbeat watchdog.
+    # A rank whose heartbeat AGE (seconds since its value last changed)
+    # exceeds slow_rank_factor x the fleet median age — and a 1 s absolute
+    # floor, so an idle-but-healthy fleet's jitter can't trip it — emits a
+    # fleet_slow_rank event and bumps fleet_slow_ranks_total, once per
+    # slow episode.  Detection only: nothing is killed (full stalls are
+    # heartbeat_timeout_s's job); the signal is for dashboards watching
+    # the live launcher /metrics endpoint, where per-rank heartbeat age is
+    # a labeled gauge.  0 = off.  LGBMTPU_SLOW_RANK_FACTOR is the env
+    # spelling.
+    slow_rank_factor: float = 3.0
 
     # --- out-of-core data path (ours; docs/PERF_NOTES.md round 12) ---
     # out_of_core: stream the binned matrix in row chunks through pinned,

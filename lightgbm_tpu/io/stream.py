@@ -104,9 +104,22 @@ class BinCacheStream:
     ``view`` is a window into the SAME reused buffer — consumers must
     copy (device upload copies) before advancing.  Re-iterable: each
     :meth:`chunks` call reopens the member (a fresh sequential
-    decompress — the out-of-core price for a full pass)."""
+    decompress — the out-of-core price for a full pass).
 
-    def __init__(self, path: str, member: str = "bins") -> None:
+    ``shard=(row_lo, row_hi)`` restricts the stream to that row range —
+    the rank-sharded form for distributed out-of-core training: each
+    rank streams ONLY its shard of one shared cache (the fleet manifest
+    already fingerprints per-rank shards, docs/ROBUSTNESS.md), paying a
+    seek instead of a whole-prefix decompress on the stored (default
+    ``save_binary``) members.  ``chunks`` then yields GLOBAL row_lo
+    values within [row_lo, row_hi); CRC32 blocks are verified whenever
+    the stream covers them from their true start — blocks cut by a shard
+    boundary cannot be (their prefix bytes were never read) and are
+    skipped, so a whole-cache sweep still verifies everything while a
+    shard sweep verifies every fully-covered block."""
+
+    def __init__(self, path: str, member: str = "bins",
+                 shard: Optional[Tuple[int, int]] = None) -> None:
         self.path = path
         self.member = member + ".npy"
         with zipfile.ZipFile(path) as zf, zf.open(self.member) as fh:
@@ -117,6 +130,15 @@ class BinCacheStream:
                 f"streaming (shape={shape}, fortran={fortran})")
         self.shape = shape
         self.dtype = dtype
+        if shard is not None:
+            lo, hi = int(shard[0]), int(shard[1])
+            if not (0 <= lo < hi <= shape[0]):
+                raise ValueError(
+                    f"shard range [{lo}, {hi}) is outside the cache's "
+                    f"{shape[0]} rows")
+            self.shard = (lo, hi)
+        else:
+            self.shard = None
         # per-chunk CRC trailer table (written by save_binary since round
         # 13).  Old trailerless caches still load — with a warning, since
         # nothing can vouch for their bytes.
@@ -151,6 +173,13 @@ class BinCacheStream:
         return self.shape[0]
 
     @property
+    def shard_rows(self) -> int:
+        """Rows this stream actually yields (== n_rows without a shard)."""
+        if self.shard is None:
+            return self.shape[0]
+        return self.shard[1] - self.shard[0]
+
+    @property
     def n_cols(self) -> int:
         return self.shape[1]
 
@@ -173,19 +202,37 @@ class BinCacheStream:
         feeding garbage bins to training.  (With the default read chunk
         == CRC block size, no unverified row is ever yielded; smaller
         read chunks may see at most one partially-verified trailing
-        block's rows before its boundary check runs.)"""
+        block's rows before its boundary check runs.)
+
+        With a ``shard`` the sweep covers only [row_lo, row_hi): the
+        member is seeked to row_lo (stored members skip the prefix
+        without decompressing it) and blocks the shard enters mid-way
+        are skipped by verification, never trusted blind — a corrupt
+        byte inside any FULLY covered block still raises row-ranged."""
         n, f = self.shape
+        lo0, hi0 = self.shard if self.shard is not None else (0, n)
         chunk_rows = max(int(chunk_rows), 1)
         buf = np.empty((chunk_rows, f), self.dtype)  # the reused buffer
         flat = buf.reshape(-1).view(np.uint8)
         row_bytes = f * self.dtype.itemsize
         verify = self.crcs is not None
         crc_cur = 0  # rolling CRC of the current (partial) CRC block
+        # a shard entering a CRC block mid-way cannot verify it (the
+        # block's leading bytes were never read); arm from the first
+        # block the shard covers from its true start
+        crc_valid = verify and (not lo0 or lo0 % self.crc_rows == 0)
         with zipfile.ZipFile(self.path) as zf, zf.open(self.member) as fh:
             _read_npy_header(fh)  # skip to element 0
-            lo = 0
-            while lo < n:
-                m = min(chunk_rows, n - lo)
+            if lo0:
+                try:
+                    fh.seek(fh.tell() + lo0 * row_bytes)
+                except (OSError, zipfile.BadZipFile, zlib.error) as e:
+                    raise self._corrupt(
+                        lo0, f"seek to shard start failed: "
+                        f"{type(e).__name__}: {e}") from None
+            lo = lo0
+            while lo < hi0:
+                m = min(chunk_rows, hi0 - lo)
                 want = m * row_bytes
                 got = 0
                 mv = memoryview(flat)[:want]
@@ -208,16 +255,19 @@ class BinCacheStream:
                         block = row // self.crc_rows
                         block_end = min((block + 1) * self.crc_rows, n)
                         take = min(block_end, end_row) - row
-                        crc_cur = zlib.crc32(
-                            mv[pos:pos + take * row_bytes], crc_cur)
+                        if crc_valid:
+                            crc_cur = zlib.crc32(
+                                mv[pos:pos + take * row_bytes], crc_cur)
                         pos += take * row_bytes
                         row += take
                         if row == block_end:
-                            if (crc_cur & 0xFFFFFFFF) != int(
+                            if crc_valid and (crc_cur & 0xFFFFFFFF) != int(
                                     self.crcs[block]):
                                 raise self._corrupt(block_end - 1,
                                                     "CRC32 mismatch")
                             crc_cur = 0
+                            crc_valid = verify  # past the shard's cut
+                            # block, every block starts from its true head
                 yield lo, buf[:m]
                 lo += m
 

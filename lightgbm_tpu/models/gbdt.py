@@ -816,6 +816,47 @@ class GBDT:
             and not self._linear
         )
 
+    def _use_windowed_dp(self, ts) -> bool:
+        """Sharded fused windowed round gate (docs/DISTRIBUTED.md "Sharded
+        fused rounds"): the one-dispatch windowed round over the ICI mesh,
+        with the histogram merge a single in-dispatch psum/psum_scatter
+        (parallel/data_parallel.py::grow_tree_windowed_data_parallel).
+        Mirrors :meth:`_use_windowed`'s envelope minus the single-device
+        requirement; configurations outside it fall back to the
+        multi-dispatch sharded rounds grower (fast-DP) or the strict
+        sharded grower, which support everything.  EFB is excluded (the
+        bundled tables are not threaded through the sharded path yet)."""
+        mode = self.cfg.tree_growth_mode
+        return (
+            self._on_tpu
+            and bool(self.cfg.extra.get("windowed_growth", False))
+            and self._dp is not None
+            and self.cfg.tree_learner in ("data", "voting")
+            and (mode == "rounds" or (mode == "auto" and self._on_tpu))
+            and getattr(ts, "efb", None) is None
+            and ts.num_feature() >= 512
+            and self.cfg.num_leaves >= 64
+            and self._monotone is None
+            and self._interaction_sets is None
+            and self._forced_schedule() is None
+            and self._cegb_lazy is None
+            and self._cegb_coupled is None
+            and not self._linear
+        )
+
+    def _windowed_dp_merge(self) -> str:
+        """Merge strategy for the sharded fused round: tree_learner=voting
+        maps to the owned-feature ``psum_scatter`` variant (the reference's
+        ReduceScatter + per-rank feature ownership — half the merge bytes,
+        split search parallelized over F), tree_learner=data to the plain
+        ``psum`` (replicated split search, the latency-lean ICI default).
+        Per-node feature sampling forces psum: under owned features each
+        rank would sample only its block (see
+        grow_tree_windowed_data_parallel)."""
+        if self.cfg.tree_learner == "voting" and not self._needs_node_rng:
+            return "scatter"
+        return "psum"
+
     @property
     def _monotone_method(self) -> str:
         """Effective monotone method for the growers: 'advanced' downgrades
@@ -1282,6 +1323,42 @@ class GBDT:
                     monotone_method=self._monotone_method,
                 )
                 arrays, leaf_id = self._localize_tree(arrays, leaf_id)
+            elif self._dp is not None and self._use_windowed_dp(ts):
+                # the tentpole path: sharded one-dispatch windowed rounds —
+                # histogram merge is one psum/psum_scatter INSIDE the
+                # donated dispatch, 1 dispatch + 0 blocking syncs per rank
+                from ..parallel.data_parallel import (
+                    grow_tree_windowed_data_parallel)
+
+                dp = self._dp
+                quant = self.cfg.use_quantized_grad
+                arrays, leaf_id_pad = grow_tree_windowed_data_parallel(
+                    dp,
+                    dp.pad_rows_device(gc, jnp.float32),
+                    dp.pad_rows_device(hc, jnp.float32),
+                    dp.pad_rows_device(row_mask, bool, fill=False),
+                    dp.pad_rows_device(sample_weight, jnp.float32, fill=1.0),
+                    feature_mask,
+                    self._categorical_mask,
+                    node_rng,
+                    (jax.random.PRNGKey(self.cfg.seed * 1000003 + self.iter_ * 31 + c)
+                     if quant else None),
+                    self._feature_contri,
+                    num_leaves=self.cfg.num_leaves,
+                    num_bins=ts.max_num_bins,
+                    max_depth=self.cfg.max_depth,
+                    params=self._split_params,
+                    leaf_tile=self._leaf_tile(ts, use_efb=False),
+                    hist_precision=self.cfg.hist_precision,
+                    use_pallas=self._on_tpu,
+                    quantize_bins=(self.cfg.num_grad_quant_bins if quant else 0),
+                    stochastic_rounding=bool(self.cfg.stochastic_rounding),
+                    quant_renew=bool(self.cfg.quant_train_renew_leaf),
+                    merge=self._windowed_dp_merge(),
+                    guard_label=f" (boosting iteration {self.iter_ + 1})",
+                )
+                arrays, leaf_id_pad = self._localize_tree(arrays, leaf_id_pad)
+                leaf_id = leaf_id_pad[: ts.num_data()]
             elif self._dp is not None and self._use_fast_dp:
                 from ..parallel.data_parallel import grow_tree_fast_data_parallel
 

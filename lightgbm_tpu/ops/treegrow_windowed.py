@@ -48,12 +48,24 @@ driver counts every dispatch and host pull through utils/sanitizer.py,
 tests/test_retrace.py pins "1 dispatch, 0 blocking syncs per round,
 zero retraces" at fixed shape.
 
-Scope (gated in models/gbdt.py): single device; numerical AND (round 5)
-categorical splits + EFB bundles; no forced splits / interaction
-constraints / monotone constraints / CEGB-lazy — configurations outside
-this envelope fall back to the full-pass rounds grower, which supports
-everything.  Quantized int8 training IS supported (it is the wide-regime
-TPU default).
+Scope (gated in models/gbdt.py): numerical AND (round 5) categorical
+splits + EFB bundles; no forced splits / interaction constraints /
+monotone constraints / CEGB-lazy — configurations outside this envelope
+fall back to the full-pass rounds grower, which supports everything.
+Quantized int8 training IS supported (it is the wide-regime TPU
+default).
+
+Round 14 (docs/DISTRIBUTED.md "Sharded fused rounds"): the fused round
+also runs SPMD over the ICI mesh.  ``_round_fused`` takes an
+``axis_name``; under shard_map each rank histograms its local row
+shard's window and the leaf-histogram merge is ONE in-dispatch
+collective (psum, or psum_scatter + owned-feature split search), with
+the 5-scalar info vector collective-merged so the one-round-behind
+host protocol stays rank-consistent.  The host loop is shared
+(:func:`_run_fused_rounds`); the shard_map plumbing and the SPMD entry
+live in parallel/data_parallel.py::grow_tree_windowed_data_parallel.
+The 1-dispatch/0-sync budget pin holds PER RANK (single-controller: one
+host dispatch fans out over the mesh; tests/test_retrace.py).
 """
 
 from __future__ import annotations
@@ -135,11 +147,60 @@ def _window_rung(w: int, n: int, floor: int = 8192) -> int:
     return r
 
 
+def _split_tables(axis_name, merge, f_loc, num_bins_pf, missing_bin_pf,
+                  feature_mask, categorical_mask, feature_contri):
+    """Per-rank feature tables for the split search.  Replicated (full-F)
+    outside the owned-feature merge; under ``merge="scatter"`` each rank
+    searches only its contiguous F/R feature block (reference: the
+    data-parallel learner's per-rank feature ownership after
+    ReduceScatter), so the tables are dynamic-sliced at this rank's
+    offset.  Returns the tables plus the rank's feature offset (None when
+    features are not owned)."""
+    if axis_name is None or merge != "scatter":
+        return (num_bins_pf, missing_bin_pf, feature_mask, categorical_mask,
+                feature_contri, None)
+    f0 = jax.lax.axis_index(axis_name) * f_loc
+
+    def sl(v):
+        return (None if v is None
+                else jax.lax.dynamic_slice_in_dim(v, f0, f_loc, 0))
+
+    return (sl(num_bins_pf), sl(missing_bin_pf), sl(feature_mask),
+            sl(categorical_mask), sl(feature_contri), f0)
+
+
+def _merge_best(bb: BestSplit, axis_name, f0) -> BestSplit:
+    """Owned-feature winner election (reference: SyncUpGlobalBestSplit —
+    Allreduce of per-rank SplitInfo): globalize each rank's best feature
+    index, pmax the gain, tie-break to the lowest-ranked owner (= lowest
+    global feature block, matching the replicated argmax), and broadcast
+    every winner field from the owner by psum-masking.  All in-dispatch:
+    no host-loop collective, no extra dispatch."""
+    if axis_name is None or f0 is None:
+        return bb
+    bb = bb._replace(feature=bb.feature + f0)
+    ax_i = jax.lax.axis_index(axis_name)
+    gmax = jax.lax.pmax(bb.gain, axis_name)
+    cand = jnp.where(bb.gain >= gmax, ax_i, jnp.int32(2 ** 30))
+    mine = jax.lax.pmin(cand, axis_name) == ax_i
+
+    def bcast(x):
+        m = mine.reshape(mine.shape + (1,) * (x.ndim - 1))
+        if x.dtype == bool:
+            return jax.lax.psum(
+                jnp.where(m, x, False).astype(jnp.int32), axis_name) > 0
+        return jax.lax.psum(jnp.where(m, x, jnp.zeros((), x.dtype)),
+                            axis_name)
+
+    return BestSplit(*[bcast(x) for x in bb])
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "max_depth", "params",
                      "leaf_tile", "W", "use_pallas", "quantize_bins",
-                     "hist_precision", "has_cat", "pallas_partition"),
+                     "hist_precision", "has_cat", "pallas_partition",
+                     "axis_name", "merge"),
     donate_argnums=(0,),  # the 1.5 GB-at-Epsilon hist state threads
     # linearly through the host round loop; donation lets XLA update it in
     # place instead of alloc+copy per call (benchmarks/probe_r5_fixed.py)
@@ -174,6 +235,8 @@ def _round_fused(
     hist_precision: str,
     has_cat: bool = False,
     pallas_partition: bool = False,
+    axis_name: Optional[str] = None,
+    merge: str = "psum",
 ):
     """One whole boosting round in one traced body: gain admission,
     segment partition, bookkeeping, window gather, multi-leaf pass,
@@ -186,10 +249,25 @@ def _round_fused(
     kept as a device-verified safety net), the round applies NOTHING
     (bitwise-identical state passthrough) and reports fits_W=0 with the
     needed total so the host retries at a corrected W.
+
+    With ``axis_name`` the body runs SPMD under shard_map over the mesh
+    data axis (docs/DISTRIBUTED.md "Sharded fused rounds"): rows (and
+    every row-indexed input) are this RANK's shard, the leaf-histogram
+    merge is a single in-dispatch collective — ``psum`` with
+    ``merge="psum"`` (replicated histograms, replicated split search) or
+    ``psum_scatter`` with ``merge="scatter"`` (owned-feature split search
+    + winner election, the ReduceScatter analogue) — and the 5-scalar
+    info vector is collective-merged so every rank's host ladder sees
+    identical values.  Physical row bookkeeping (order, leaf ranges,
+    partition) stays rank-local; split decisions and tree arrays are
+    replicated.
     """
     L = num_leaves
     f = bins_t.shape[0]
     n = state.order.shape[0]
+
+    def pall(x):  # cross-rank sum; identity single-device
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
     eps = KMIN_SCORE / 2
     idx = jnp.arange(L, dtype=jnp.int32)
     pos = jnp.arange(n, dtype=jnp.int32)
@@ -253,15 +331,28 @@ def _round_fused(
         go_left = jnp.where(in_cat, gc, go_left)
 
     # ---- on-device window verification (the fused round's safety net) ----
-    # per-rank left counts from the one-hot the decisions already built —
+    # per-slot left counts from the one-hot the decisions already built —
     # O(tile*N) elementwise, no extra cumsums; in-segment positions only
     in_seg_all = seg_id >= 0
     left_counts = jnp.sum(
         (oh & (go_left & in_seg_all)[None, :]).astype(jnp.int32), axis=1)
+    # which child gets histogrammed directly must be the GLOBALLY smaller
+    # one: under SPMD every rank contributes its local window rows to one
+    # collective-merged histogram, so ranks must agree on the side even
+    # when their local row splits disagree (single-device: pall is the
+    # identity and this is exactly min(left, count-left))
+    left_small = 2 * pall(left_counts) <= pall(seg_len)  # (tile,)
     win_cnt_rk = jnp.where(
-        live_rk, jnp.minimum(left_counts, seg_len - left_counts), 0)
-    total = jnp.sum(win_cnt_rk)
+        live_rk,
+        jnp.where(left_small, left_counts, seg_len - left_counts), 0)
+    total = jnp.sum(win_cnt_rk)  # LOCAL rows this rank must window
     ok = total <= W  # guaranteed by the whint bound; verified anyway
+    if axis_name is not None:
+        # one rank breaching skips the round EVERYWHERE (the no-op must be
+        # fleet-consistent), and the host's corrected W must cover the
+        # worst rank — merged here so the async info vector is replicated
+        ok = jax.lax.pmin(ok.astype(jnp.int32), axis_name) > 0
+        total = jax.lax.pmax(total, axis_name)
 
     # everything applied below is gated on `ok`: a breached prediction
     # makes the whole round a bitwise no-op (state threads through
@@ -361,8 +452,10 @@ def _round_fused(
     # counts, which under bagging can pick the physically BIGGER child
     # and desynchronize the window sum from the verified total; which
     # child is histogrammed directly vs recovered by subtraction does
-    # not change the children's histograms)
-    left_smaller_rk = 2 * n_left_seg <= seg_len  # (tile,) per rank
+    # not change the children's histograms).  Under SPMD the choice is
+    # by GLOBAL counts (left_small above) so every rank windows the same
+    # child and the collective merge sums one child's rows.
+    left_smaller_rk = left_small  # (tile,) per slot, rank-consistent
     fresh = jnp.where(accept, True, jnp.zeros((L,), bool))
     fresh = fresh.at[right_pos].set(True, mode="drop")
     pos_r = jnp.where(accept, acc_rank, leaf_tile)
@@ -436,6 +529,21 @@ def _round_fused(
         fresh_hists = unbundle(
             jax.vmap(one)(jnp.arange(leaf_tile, dtype=jnp.int32)))
 
+    # ---- in-dispatch cross-rank histogram merge (the tentpole) ----
+    # each rank histogrammed ONLY its local shard of the window; the merge
+    # is one collective INSIDE the already-donated dispatch — no host-loop
+    # collective, no second dispatch (reference: DataParallelTreeLearner's
+    # per-split ReduceScatter, paid here once per ROUND).  "psum" leaves
+    # every rank with the global (tile, 3, F, B) block; "scatter" leaves
+    # each rank the global block for its OWNED F/R feature slice only
+    # (half the merge bytes, split search parallelized over F).
+    if axis_name is not None:
+        if merge == "scatter":
+            fresh_hists = jax.lax.psum_scatter(
+                fresh_hists, axis_name, scatter_dimension=2, tiled=True)
+        else:
+            fresh_hists = jax.lax.psum(fresh_hists, axis_name)
+
     # COMPACT sibling recovery (round 5, mirrors treegrow_fast): gather the
     # <= tile parent hists from the left-child slots, subtract, scatter
     # both children once — O(tile) state traffic instead of full-(L,...)
@@ -452,22 +560,28 @@ def _round_fused(
     hist = state.hist.at[lpos].set(left_hists, mode="drop").at[rpos].set(
         right_hists, mode="drop")
 
-    # fresh-leaf split search directly on the compact child hists
+    # fresh-leaf split search directly on the compact child hists; under
+    # merge="scatter" each rank searches its owned feature block and the
+    # winner is elected + broadcast in-dispatch (_merge_best)
     node_ids = jnp.clip(leaf_parent, 0, None) * 2 + leaf_side + 1
     cand = jnp.concatenate([sl, sr])
     cand_ok = jnp.concatenate([active, active])
     cand_hists = jnp.concatenate([left_hists, right_hists], axis=0)
     ci = jnp.where(cand_ok, cand, 0)
+    nb_l, mb_l, fm_l, cm_l, fc_l, f0 = _split_tables(
+        axis_name, merge, state.hist.shape[2], num_bins_pf, missing_bin_pf,
+        feature_mask, categorical_mask, feature_contri)
     bb = _batched_best(
         cand_hists, leaf_sum_g[ci], leaf_sum_h[ci],
-        leaf_count[ci], num_bins_pf, missing_bin_pf, params,
-        feature_mask, categorical_mask, None, None,
+        leaf_count[ci], nb_l, mb_l, params,
+        fm_l, cm_l, None, None,
         jnp.full((2 * leaf_tile,), -jnp.inf, jnp.float32),
         jnp.full((2 * leaf_tile,), jnp.inf, jnp.float32),
         None, node_ids[ci], rng_key,
         depth=leaf_depth[ci], parent_out=leaf_out[ci],
-        feature_contri=feature_contri,
+        feature_contri=fc_l,
     )
+    bb = _merge_best(bb, axis_name, f0)
     scatter_pos = jnp.where(cand_ok, cand, 2 * L)
 
     def merge(old, new):
@@ -484,8 +598,17 @@ def _round_fused(
     # factor-2 ladder absorbs the slack; always an over- (never under-)
     # estimate, so the on-device `ok` check cannot trip while the host
     # ladders this value.
+    #
+    # SPMD variant: the halving argument is GLOBAL (the window child is
+    # the globally smaller one), but W bounds each rank's LOCAL window —
+    # and a globally-small child can hold up to ALL of one rank's rows of
+    # its ancestor.  The sound local bound drops the halving: top-(tile ∧
+    # budget) local leaf_cnt over live leaves covers both following
+    # rounds (window children under one live ancestor are disjoint row
+    # subsets of it).  pmax makes the laddered W cover the worst rank.
     live_next = idx < num_leaves_new
-    half_cnt = jnp.where(live_next, leaf_cnt // 2, 0)
+    half_cnt = jnp.where(
+        live_next, leaf_cnt // 2 if axis_name is None else leaf_cnt, 0)
     k_top = min(leaf_tile, L)
     top_halves = jax.lax.top_k(half_cnt, k_top)[0]
     budget_next = jnp.maximum(L - num_leaves_new, 0)
@@ -493,6 +616,8 @@ def _round_fused(
         jnp.arange(k_top, dtype=jnp.int32) < jnp.minimum(
             budget_next, leaf_tile),
         top_halves, 0))
+    if axis_name is not None:
+        whint = jax.lax.pmax(whint, axis_name)
 
     state = WState(
         order=new_order, leaf_start=leaf_start, leaf_cnt=leaf_cnt,
@@ -511,6 +636,11 @@ def _round_fused(
               & jnp.isfinite(leaf_sum_h).all()
               & jnp.isfinite(leaf_out).all()
               & ~jnp.isnan(best.gain).any())
+    if axis_name is not None:
+        # replicated by construction (split stats come from the merged
+        # histograms), but pmin pins rank consistency as an invariant —
+        # the host's one-round-behind guard must never see ranks disagree
+        finite = jax.lax.pmin(finite.astype(jnp.int32), axis_name) > 0
     info = jnp.stack([
         k_acc, total, ok.astype(jnp.int32), whint.astype(jnp.int32),
         finite.astype(jnp.int32),
@@ -522,7 +652,7 @@ def _round_fused(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "params", "leaf_tile",
                      "use_pallas", "quantize_bins", "hist_precision",
-                     "stochastic_rounding"),
+                     "stochastic_rounding", "axis_name", "merge"),
 )
 def _w_init(
     bins_t, grad, hess, row_mask, sample_weight, num_bins_pf,
@@ -538,20 +668,32 @@ def _w_init(
     quantize_bins: int,
     hist_precision: str,
     stochastic_rounding: bool,
+    axis_name: Optional[str] = None,
+    merge: str = "psum",
 ):
-    """Root state: quantize gradients, run the one full-N pass, seed best."""
+    """Root state: quantize gradients, run the one full-N pass, seed best.
+
+    Under ``axis_name`` (SPMD, see :func:`_round_fused`): rows are this
+    rank's shard, quantization scales are pmaxed so every rank encodes
+    int8 gradients on the same grid, and the root histogram is merged
+    with the same collective the rounds use."""
     f, n = bins_t.shape
     L = num_leaves
     grad = grad.astype(jnp.float32) * sample_weight
     hess = hess.astype(jnp.float32) * sample_weight
     grad_true, hess_true = grad, hess
 
+    def pmaxg(x):
+        return jax.lax.pmax(x, axis_name) if axis_name is not None else x
+
     gq = hq = quant_scale = None
     if quantize_bins:
         half = max(quantize_bins // 2, 1)
         inbag = row_mask.astype(jnp.float32)
-        g_scale = jnp.maximum(jnp.max(jnp.abs(grad) * inbag) / half, 1e-30)
-        h_scale = jnp.maximum(jnp.max(hess * inbag) / quantize_bins, 1e-30)
+        g_scale = jnp.maximum(
+            pmaxg(jnp.max(jnp.abs(grad) * inbag)) / half, 1e-30)
+        h_scale = jnp.maximum(
+            pmaxg(jnp.max(hess * inbag)) / quantize_bins, 1e-30)
         gs, hs = grad / g_scale, hess / h_scale
         if stochastic_rounding:
             kg, kh = jax.random.split(
@@ -585,7 +727,17 @@ def _w_init(
         hist0 = unbundle1(histogram(
             hist_src, grad, hess, row_mask.astype(jnp.float32), num_bins,
             strategy="scatter")[None])
+    # totals from feature 0 of the LOCAL hist, summed across ranks (a
+    # 3-scalar psum); the histogram itself merges with the round's
+    # collective — psum (replicated) or psum_scatter (owned F/R slice)
     sum0 = jnp.sum(hist0[:, 0, :], axis=1)  # totals from feature 0: (3,)
+    if axis_name is not None:
+        sum0 = jax.lax.psum(sum0, axis_name)
+        if merge == "scatter":
+            hist0 = jax.lax.psum_scatter(
+                hist0, axis_name, scatter_dimension=1, tiled=True)
+        else:
+            hist0 = jax.lax.psum(hist0, axis_name)
     g0, h0, c0 = sum0[0], sum0[1], sum0[2]
     leaf_out0 = leaf_output(g0, h0, params)
 
@@ -608,21 +760,24 @@ def _w_init(
         is_cat=jnp.zeros((L - 1,), bool),
         cat_mask=jnp.zeros((L - 1, num_bins), bool),
     )
+    nb_l, mb_l, fm_l, cm_l, fc_l, f0_off = _split_tables(
+        axis_name, merge, hist0.shape[1], num_bins_pf, missing_bin_pf,
+        feature_mask, categorical_mask, feature_contri)
     best0 = _set_best(
         _empty_best(L, num_bins), jnp.asarray(0),
         jax.tree.map(
             lambda a: a[0],
-            _batched_best(
+            _merge_best(_batched_best(
                 hist0[None], jnp.asarray([g0]), jnp.asarray([h0]),
-                jnp.asarray([c0]), num_bins_pf, missing_bin_pf, params,
-                feature_mask, categorical_mask, None, None,
+                jnp.asarray([c0]), nb_l, mb_l, params,
+                fm_l, cm_l, None, None,
                 jnp.asarray([-jnp.inf], jnp.float32),
                 jnp.asarray([jnp.inf], jnp.float32),
                 None, jnp.asarray([0], jnp.int32), rng_key,
                 depth=jnp.asarray([0.0], jnp.float32),
                 parent_out=jnp.asarray([leaf_out0]),
-                feature_contri=feature_contri,
-            ),
+                feature_contri=fc_l,
+            ), axis_name, f0_off),
         ),
     )
     state = WState(
@@ -630,7 +785,8 @@ def _w_init(
         leaf_start=jnp.zeros((L,), jnp.int32),
         leaf_cnt=jnp.zeros((L,), jnp.int32).at[0].set(n),
         leaf_id=jnp.zeros((n,), jnp.int32),
-        hist=jnp.zeros((L, 3, f, num_bins), jnp.float32).at[0].set(hist0),
+        hist=jnp.zeros((L, 3, hist0.shape[1], num_bins),
+                       jnp.float32).at[0].set(hist0),
         best=best0,
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
         leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(h0),
@@ -645,9 +801,11 @@ def _w_init(
     return state, grad, hess, gq, hq, quant_scale, grad_true, hess_true
 
 
-@functools.partial(jax.jit, static_argnames=("params", "quant_renew"))
+@functools.partial(jax.jit, static_argnames=("params", "quant_renew",
+                                             "axis_name"))
 def _w_finalize(state: WState, grad_true, hess_true, row_mask,
-                *, params: SplitParams, quant_renew: bool):
+                *, params: SplitParams, quant_renew: bool,
+                axis_name: Optional[str] = None):
     L = state.leaf_out.shape[0]
     if quant_renew:
         mrow = row_mask.astype(jnp.float32)
@@ -655,6 +813,9 @@ def _w_finalize(state: WState, grad_true, hess_true, row_mask,
             grad_true * mrow)
         Ht = jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(
             hess_true * mrow)
+        if axis_name is not None:  # true-gradient renewal is a global sum
+            Gt = jax.lax.psum(Gt, axis_name)
+            Ht = jax.lax.psum(Ht, axis_name)
         leaf_value = leaf_output(Gt, Ht, params)
     else:
         leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)
@@ -718,8 +879,6 @@ def _grow_windowed_impl(
         stochastic_rounding=stochastic_rounding, **common)
 
     n = bins_t.shape[1]
-    prof = os.environ.get("LGBMTPU_WPROF") == "1"
-    enforce = os.environ.get("LGBMTPU_DISPATCH_BUDGET") == "1"
     # the Pallas segment partition is the TPU default; LGBMTPU_PARTITION
     # _PALLAS=0 drops to the O(N) XLA permutation (same results), as does
     # a prior kernel failure recorded in the degradation registry (folded
@@ -728,9 +887,45 @@ def _grow_windowed_impl(
         os.environ.get("LGBMTPU_PARTITION_PALLAS", "1") != "0") and (
         _degrade.available(_degrade.PARTITION))
 
+    def round_fn(st, W):
+        st, info = _round_fused(
+            st, bins_t, g_d, h_d, gq, hq, qs, row_mask,
+            num_bins_pf, missing_bin_pf, feature_mask, rng_key,
+            feature_contri, categorical_mask,
+            efb_bins_t, efb_gather, efb_default,
+            max_depth=max_depth, W=W, use_pallas=use_pallas,
+            quantize_bins=quantize_bins, hist_precision=hist_precision,
+            has_cat=categorical_mask is not None,
+            pallas_partition=pallas_partition, **common)
+        return st, info
+
     # round 1 needs no feedback: a round's window (the small children)
     # can never exceed floor(N/2) rows, whatever it admits
-    W = _window_size(max(n // 2, 1), n)
+    state = _run_fused_rounds(
+        round_fn, state, n_ladder=n,
+        w_first=_window_size(max(n // 2, 1), n),
+        num_leaves=num_leaves, stats=stats, guard_label=guard_label)
+
+    return _w_finalize(state, g_true, h_true, row_mask, params=params,
+                       quant_renew=bool(quant_renew and quantize_bins))
+
+
+def _run_fused_rounds(round_fn, state, *, n_ladder: int, w_first: int,
+                      num_leaves: int, stats: Optional[dict],
+                      guard_label: str):
+    """The one-dispatch/zero-sync round protocol (module docstring),
+    factored out of :func:`_grow_windowed_impl` so the SPMD driver
+    (parallel/data_parallel.py::grow_tree_windowed_data_parallel) runs
+    the IDENTICAL host loop — same W ladder, same one-round-behind async
+    info reads, same drain, same dispatch/sync accounting and telemetry —
+    over a shard_mapped round.  ``round_fn(state, W) -> (state', info)``
+    must be a single donated dispatch; ``n_ladder`` is the row count the
+    W ladder quantizes against (the LOCAL shard size under SPMD: W bounds
+    each rank's own window)."""
+    prof = os.environ.get("LGBMTPU_WPROF") == "1"
+    enforce = os.environ.get("LGBMTPU_DISPATCH_BUDGET") == "1"
+    n = n_ladder
+    W = w_first
     pending: list = []  # dispatched rounds whose info is still in flight
     n_leaves = 1
     rounds = 0
@@ -755,15 +950,7 @@ def _grow_windowed_impl(
     try:
         while rounds < max_rounds:
             _san.record_dispatch()
-            state, info_d = _round_fused(
-                state, bins_t, g_d, h_d, gq, hq, qs, row_mask,
-                num_bins_pf, missing_bin_pf, feature_mask, rng_key,
-                feature_contri, categorical_mask,
-                efb_bins_t, efb_gather, efb_default,
-                max_depth=max_depth, W=W, use_pallas=use_pallas,
-                quantize_bins=quantize_bins, hist_precision=hist_precision,
-                has_cat=categorical_mask is not None,
-                pallas_partition=pallas_partition, **common)
+            state, info_d = round_fn(state, W)
             _san.async_pull_start(info_d)
             pending.append(info_d)
             rounds += 1
@@ -914,8 +1101,7 @@ def _grow_windowed_impl(
                 "retries — the whint bound under-predicted (see "
                 "ops/treegrow_windowed.py round-7 notes)")
 
-    return _w_finalize(state, g_true, h_true, row_mask, params=params,
-                       quant_renew=bool(quant_renew and quantize_bins))
+    return state
 
 
 def grow_tree_windowed(*args, use_pallas: bool = True, **kwargs):

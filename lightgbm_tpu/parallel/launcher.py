@@ -250,10 +250,27 @@ def _read_heartbeat(snap_path: Optional[str]) -> Optional[float]:
         return None  # missing/partial file: not a heartbeat signal yet
 
 
+_SLOW_RANK_FLOOR_S = 1.0  # hard minimum for the slow-rank age floor; the
+# effective floor adds headroom for the snapshot period + read cadence
+# (see _watch_workers) so write/read phase aliasing can't false-positive
+
+
+def _snapshot_period() -> float:
+    """The workers' periodic metrics-snapshot period (the granularity at
+    which heartbeat values can possibly change on disk)."""
+    try:
+        return float(os.environ.get(
+            "LGBMTPU_METRICS_SNAPSHOT_PERIOD_S", "1.0"))
+    except ValueError:
+        return 1.0
+
+
 def _watch_workers(workers, timeout_s: float,
                    poll_interval: float = 0.1,
                    heartbeat_timeout_s: Optional[float] = None,
-                   heartbeat_paths: Optional[Dict[int, str]] = None) -> None:
+                   heartbeat_paths: Optional[Dict[int, str]] = None,
+                   slow_rank_factor: float = 0.0,
+                   hb_ages: Optional[Dict[int, float]] = None) -> None:
     """Per-worker liveness watchdog: poll + exit-code harvest, plus
     HEARTBEAT staleness (docs/ROBUSTNESS.md "Elastic fleet recovery").
 
@@ -277,6 +294,16 @@ def _watch_workers(workers, timeout_s: float,
     killed and the failure routes into the restart path exactly as a
     death does.
 
+    ``slow_rank_factor`` > 0 adds straggler DETECTION on the same
+    heartbeat reads (nothing is killed): a rank whose heartbeat age
+    exceeds factor x the fleet median (and a 1 s floor) emits one
+    ``fleet_slow_rank`` event + ``fleet_slow_ranks_total`` bump per slow
+    episode — the class where a rank still makes rounds but k x slower
+    than its peers, which the full-stall watchdog can never see.
+    ``hb_ages``, when given, is kept updated with each rank's current
+    heartbeat age — the launcher's live /metrics collector reads it for
+    the per-rank ``fleet_heartbeat_age_s`` labeled gauge.
+
     On failure or timeout the WHOLE process group of every worker is
     killed and every tail is harvested (docs/ROBUSTNESS.md)."""
     deadline = time.monotonic() + timeout_s
@@ -285,6 +312,9 @@ def _watch_workers(workers, timeout_s: float,
     # after the heartbeat has been seen to CHANGE (see below)
     hb_seen: Dict[int, Tuple[float, float, bool]] = {}
     hb_next = 0.0
+    slow_active: set = set()  # ranks currently in a slow episode
+    watch_hb = bool((heartbeat_timeout_s or slow_rank_factor
+                     or hb_ages is not None) and heartbeat_paths)
     try:
         while len(done) < len(workers):
             for rank, proc, log_path in workers:
@@ -306,17 +336,22 @@ def _watch_workers(workers, timeout_s: float,
                     f"({log_path}):\n{_log_tail(log_path)}",
                     rank=rank)
             now = time.monotonic()
-            if (heartbeat_timeout_s and heartbeat_paths
-                    and now >= hb_next):
+            if watch_hb and now >= hb_next:
                 # re-read the small per-rank JSONs at most ~1 Hz (and at
                 # least 4x per timeout window), not per 0.1 s poll tick
-                hb_next = now + min(1.0, heartbeat_timeout_s / 4.0)
+                hb_next = now + (min(1.0, heartbeat_timeout_s / 4.0)
+                                 if heartbeat_timeout_s else 1.0)
                 stalest: Optional[Tuple[float, int, "subprocess.Popen", str]] = None
+                ages: Dict[int, float] = {}  # armed ranks' heartbeat age
                 for rank, proc, log_path in workers:
                     if rank in done or proc.poll() is not None:
+                        if hb_ages is not None:
+                            hb_ages.pop(rank, None)
                         continue
                     hb = _read_heartbeat(heartbeat_paths.get(rank))
                     if hb is None:
+                        if hb_ages is not None:
+                            hb_ages.pop(rank, None)  # retired/not started
                         continue
                     prev = hb_seen.get(rank)
                     if prev is None:
@@ -330,16 +365,48 @@ def _watch_workers(workers, timeout_s: float,
                         continue
                     if hb != prev[0]:
                         hb_seen[rank] = (hb, now, True)
+                        ages[rank] = 0.0
                         continue
                     if not prev[2]:
                         continue
                     stale = now - prev[1]
-                    if stale > heartbeat_timeout_s and (
-                            stalest is None or stale > stalest[0]):
+                    ages[rank] = stale
+                    if heartbeat_timeout_s and stale > heartbeat_timeout_s \
+                            and (stalest is None or stale > stalest[0]):
                         # a wedged collective stalls EVERY rank's
                         # heartbeat; blame the stalest rank — it stopped
                         # first, the rest are its victims
                         stalest = (stale, rank, proc, log_path)
+                if hb_ages is not None:
+                    hb_ages.update(ages)
+                if slow_rank_factor and len(ages) >= 2:
+                    # straggler detection on the SAME reads: slow = this
+                    # rank's heartbeat age is factor x the fleet median
+                    # (and past the absolute floor — an idle fleet's
+                    # read-phase jitter must not trip it).  Emitted once
+                    # per episode; the rank clears when it catches up.
+                    # LOWER-middle median: the upper pick would let one
+                    # straggler inflate its own threshold — on a 2-rank
+                    # fleet a 60x-slow rank would BE the "median" and
+                    # never trip.  Floor sized over the snapshot-write
+                    # period + the 1 Hz read cadence: a healthy rank
+                    # whose write phase lands just after our read shows
+                    # age ~(period + read tick) without being slow.
+                    med = sorted(ages.values())[(len(ages) - 1) // 2]
+                    slow_floor = max(_SLOW_RANK_FLOOR_S,
+                                     2.0 * _snapshot_period() + 1.0)
+                    for rank, age in ages.items():
+                        slow = age > max(slow_rank_factor * med, slow_floor)
+                        if slow and rank not in slow_active:
+                            slow_active.add(rank)
+                            _obs.counter("fleet_slow_ranks_total").inc()
+                            _obs.event(
+                                "fleet_slow_rank", worker_rank=rank,
+                                age_s=round(age, 3),
+                                fleet_median_s=round(med, 3),
+                                factor=slow_rank_factor)
+                        elif not slow:
+                            slow_active.discard(rank)
                 if stalest is not None:
                     stale, rank, proc, log_path = stalest
                     _obs.counter("fleet_hangs_total").inc()
@@ -373,6 +440,47 @@ def _watch_workers(workers, timeout_s: float,
             if p2.poll() is None:
                 _kill_worker_group(p2)
         raise
+
+
+def _fleet_live_collector(tmp: str, num_machines: int,
+                          hb_ages: Dict[int, float]):
+    """Snapshot-time collector serving the LIVE fleet view from the
+    launcher's own /metrics endpoint (docs/OBSERVABILITY.md "Fleet
+    metrics"): every per-rank periodic snapshot file is merged in with
+    ``rank="r"`` labels — while the workers are still RUNNING, not only
+    in the at-exit fleet_metrics.json merge — plus each rank's current
+    heartbeat age (``fleet_heartbeat_age_s{rank="r"}``) as the watchdog
+    tracks it.  Registered per launch (same collector name: the next
+    launch replaces it); pure host-side file reads, zero device work,
+    and a torn mid-write file just skips one scrape (the worker's writes
+    are atomic)."""
+    def collect() -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {"counters": {}, "gauges": {}}
+        for r in range(num_machines):
+            path = os.path.join(tmp, f"worker{r}.metrics.json")
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    snap = json.load(fh)
+            except (OSError, ValueError):
+                continue  # not written yet / torn: skip this scrape
+            if not isinstance(snap, dict):
+                continue
+            for name, v in (snap.get("counters") or {}).items():
+                try:
+                    out["counters"][_obs.labeled(name, rank=r)] = int(v)
+                except (TypeError, ValueError):
+                    pass
+            for name, v in (snap.get("gauges") or {}).items():
+                try:
+                    out["gauges"][_obs.labeled(name, rank=r)] = float(v)
+                except (TypeError, ValueError):
+                    pass
+        for r, age in list(hb_ages.items()):
+            out["gauges"][_obs.labeled("fleet_heartbeat_age_s", rank=r)] = (
+                float(age))
+        return out
+
+    return collect
 
 
 def aggregate_fleet_events(tmp: str, num_machines: int,
@@ -583,6 +691,23 @@ def train_distributed(
         env_hb = os.environ.get("LGBMTPU_HEARTBEAT_TIMEOUT_S")
         heartbeat_timeout_s = (float(env_hb) if env_hb
                                else float(cfg_launch.heartbeat_timeout_s))
+    env_slow = os.environ.get("LGBMTPU_SLOW_RANK_FACTOR")
+    slow_rank_factor = (float(env_slow) if env_slow
+                        else float(cfg_launch.slow_rank_factor))
+    # live fleet observability (docs/OBSERVABILITY.md "Fleet metrics"):
+    # the launcher's own /metrics endpoint serves the merged per-rank
+    # snapshots + heartbeat ages WHILE workers run.  Opt-in via the same
+    # metrics_port=/LGBMTPU_METRICS_PORT gate the trainers use; the
+    # collector stays registered after the run (the snapshot files
+    # persist), so a post-mortem scrape still sees the last fleet state.
+    hb_ages: Dict[int, float] = {}
+    _obs.register_collector(
+        "fleet_live", _fleet_live_collector(tmp, num_machines, hb_ages))
+    from ..obs import server as _obs_server
+
+    _obs_server.maybe_start(
+        int(cfg_launch.metrics_port) if cfg_launch.is_set("metrics_port")
+        else None)
     params_path = os.path.join(tmp, "params.npz")
     np.savez(params_path, params=np.asarray(dict(params), dtype=object))
     model_out = os.path.join(tmp, "model.txt")
@@ -620,7 +745,9 @@ def train_distributed(
             heartbeat_timeout_s=heartbeat_timeout_s or None,
             heartbeat_paths={
                 r: os.path.join(tmp, f"worker{r}.metrics.json")
-                for r in range(num_machines)})
+                for r in range(num_machines)},
+            slow_rank_factor=slow_rank_factor,
+            hb_ages=hb_ages)
 
     def _spawn_all(workers, ports, machines) -> None:
         # phase 1 — write EVERY rank's shard file and publish the full

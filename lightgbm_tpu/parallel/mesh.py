@@ -32,6 +32,13 @@ def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = Non
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
+def data_axis_size(mesh: Mesh) -> int:
+    """Ranks along the data axis — the R in the sharded fused round's
+    per-rank row/feature math (local rows = padded // R; the scatter
+    merge pads F to a multiple of R)."""
+    return int(mesh.shape[DATA_AXIS])
+
+
 def make_mesh_2d(n_data: int, n_feature: int, devices: Optional[Sequence] = None) -> Mesh:
     """(data, feature) mesh for combined data+feature parallel histograms."""
     if devices is None:

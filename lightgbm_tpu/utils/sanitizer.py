@@ -239,6 +239,15 @@ class DispatchCounter(CompileCounter):
     >>> with DispatchCounter() as d:
     ...     grow_tree_windowed(...)
     >>> d.assert_round_budget(rounds, what="windowed growth")
+
+    Per-rank semantics under SPMD (docs/DISTRIBUTED.md "Sharded fused
+    rounds"): the ledger is per host PROCESS.  Single-controller, the
+    host's one dispatch of a shard_mapped round IS every rank's dispatch
+    — so "1 dispatch / 0 blocking syncs per round" counted here is the
+    per-rank budget, and the in-dispatch collectives (psum/psum_scatter)
+    add neither dispatches nor host syncs by construction.  In
+    multi-controller runs each process carries its own ledger, pinning
+    its own rank's budget independently.
     """
 
     def __enter__(self) -> "DispatchCounter":

@@ -391,3 +391,61 @@ def test_manifest_resume_refuses_overshoot(tmp_path):
     mpath = ckpt.write_fleet_checkpoint(d, _model_text(4), 4, 1, {})
     with pytest.raises(LightGBMError, match="beyond the requested"):
         lgb.train(PARAMS, lgb.Dataset(X, label=y), 2, resume=mpath)
+
+
+# ---------------------------------------------------------------------------
+# live fleet /metrics from the launcher (round 14)
+# ---------------------------------------------------------------------------
+
+def test_launcher_live_fleet_metrics_endpoint(monkeypatch):
+    """metrics_port= in the launch params starts an endpoint in the
+    LAUNCHER process whose /metrics serves the merged per-rank snapshot
+    files with rank labels — queryable while workers run AND after (the
+    collector stays registered over the persisted files), not only via
+    the at-exit fleet_metrics.json merge."""
+    import threading
+    import urllib.request
+
+    from lightgbm_tpu.obs import server as obs_server
+
+    X, y = _data()
+    params = dict(PARAMS, bin_construct_sample_cnt=len(X), metrics_port=0)
+
+    live = {"scrapes": 0, "labeled": False}
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            srv = obs_server.get_server()
+            if srv is not None:
+                try:
+                    text = urllib.request.urlopen(
+                        srv.url("/metrics"), timeout=2).read().decode()
+                    live["scrapes"] += 1
+                    if 'rank="0"' in text:
+                        live["labeled"] = True
+                except OSError:
+                    pass
+            time.sleep(0.15)
+
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+    try:
+        _launch(params, X, y, rounds=3)
+    finally:
+        stop.set()
+        t.join(3)
+    try:
+        srv = obs_server.get_server()
+        assert srv is not None, "launcher did not start the live endpoint"
+        # deterministic post-run scrape: the per-rank snapshot files
+        # persist and the collector is still registered, so rank-labeled
+        # families (incl. the worker's own heartbeat gauge) must appear
+        text = urllib.request.urlopen(
+            srv.url("/metrics"), timeout=5).read().decode()
+        assert 'rank="0"' in text, text[:800]
+        assert live["scrapes"] > 0, "endpoint never answered during the run"
+    finally:
+        from lightgbm_tpu.obs import metrics as _obs
+        _obs.REGISTRY.register_collector("fleet_live", lambda: {})
+        obs_server.stop_server()

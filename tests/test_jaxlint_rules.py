@@ -1535,3 +1535,108 @@ def test_r12_pragma_suppression(tmp_path):
     """}, rules=["R12"])
     assert not rep.findings
     assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R13 collective-outside-fused-round
+# ---------------------------------------------------------------------------
+
+def test_r13_positive_eager_collective_in_round_loop(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def round_fused(state, grad):
+            return state + grad, state.sum()
+
+        def drive(state, grad):
+            for _ in range(10):
+                state, hist = round_fused(state, grad)
+                merged = jax.lax.psum(hist, "data")
+            return merged
+    """}, rules=["R13"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "R13"
+    assert "psum" in rep.findings[0].message
+
+
+def test_r13_positive_jitted_collective_helper_per_round(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @jax.jit
+        def merge_hists(h):
+            return jax.lax.psum_scatter(h, "data")
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def round_fused(state, grad):
+            return state + grad, state.sum()
+
+        def drive(state, grad):
+            for _ in range(10):
+                state, hist = round_fused(state, grad)
+                hist = merge_hists(hist)
+            return hist
+    """}, rules=["R13"])
+    assert len(rep.findings) == 1, rep.findings
+    assert "merge_hists" in rep.findings[0].message
+
+
+def test_r13_negative_collective_inside_donated_round(tmp_path):
+    """The FIX pattern: the collective lives inside the donated round
+    body (in-dispatch merge) — no finding."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def round_fused(state, grad):
+            hist = jax.lax.psum(grad, "data")
+            return state + hist, hist.sum()
+
+        def drive(state, grad):
+            for _ in range(10):
+                state, info = round_fused(state, grad)
+            return state
+    """}, rules=["R13"])
+    assert rep.findings == []
+
+
+def test_r13_negative_loop_without_donated_dispatch(tmp_path):
+    """Collectives in setup/eval loops with no donated round dispatch
+    are out of scope (not the per-round regression class)."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        @jax.jit
+        def evaluate(score):
+            return score.sum()
+
+        def eval_all(scores):
+            out = []
+            for s in scores:
+                loss = evaluate(s)
+                out.append(jax.lax.psum(loss, "data"))
+            return out
+    """}, rules=["R13"])
+    assert rep.findings == []
+
+
+def test_r13_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def round_fused(state, grad):
+            return state + grad, state.sum()
+
+        def drive(state, grad):
+            for _ in range(10):
+                state, hist = round_fused(state, grad)
+                merged = jax.lax.psum(hist, "data")  # jaxlint: disable=R13 (fixture: debug-only fleet probe)
+            return merged
+    """}, rules=["R13"])
+    assert rep.findings == []

@@ -287,3 +287,110 @@ def test_two_process_training_equality(tmp_path):
     with open(out + ".rank1") as fh:
         m1 = fh.read()
     assert m0 == m1  # both processes hold the identical model
+
+
+_WINDOWED_WORKER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel.distributed import init_distributed
+
+cfg = Config.from_dict({{
+    "num_machines": 2,
+    "machines": "127.0.0.1:{port},127.0.0.1:{port2}",
+    "local_listen_port": {port},
+    "time_out": 2,
+}})
+assert init_distributed(cfg)
+
+import jax
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.gbdt import GBDT
+
+assert jax.process_count() == 2
+rank = jax.process_index()
+
+rng = np.random.RandomState(11)
+X = rng.randn(4000, 6)
+y = (X @ rng.randn(6) + 0.3 * rng.randn(4000) > 0).astype(float)
+params = {{"objective": "binary", "num_leaves": 15, "verbosity": -1,
+           "min_data_in_leaf": 10, "max_bin": 63}}
+
+# force the windowed gates (the real ones require a TPU + wide shape);
+# the serial reference runs the single-device windowed grower on this
+# process's default device, the distributed run takes the sharded fused
+# round across BOTH processes' devices (in-dispatch psum over DCN)
+GBDT._use_windowed = lambda self, ts: jax.device_count() == 1
+GBDT._use_windowed_dp = lambda self, ts: self._dp is not None
+
+b_dp = lgb.train(dict(params, tree_learner="data"),
+                 lgb.Dataset(X, label=y), num_boost_round=6)
+p_d = b_dp.predict(X, raw_score=True)
+text = b_dp.model_to_string()
+import hashlib
+print("MODEL_SHA", rank, hashlib.sha256(text.encode()).hexdigest(),
+      flush=True)
+
+b_serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=6)
+p_s = b_serial.predict(X, raw_score=True)
+if not np.allclose(p_s, p_d, rtol=5e-3, atol=5e-3):
+    print("MISMATCH", float(np.max(np.abs(p_s - p_d))), flush=True)
+    sys.exit(3)
+print(f"RANK{{rank}}_WINDOWED_OK", flush=True)
+"""
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_MULTIHOST") == "1", reason="opt-out")
+def test_two_process_sharded_windowed_training(tmp_path):
+    """2-rank multiproc variant of the sharded fused windowed round
+    (ISSUE 9): both processes drive the identical shard_mapped one-
+    dispatch round, the histogram merge crosses the process boundary,
+    and every rank's model matches the serial windowed model (and each
+    other, byte-identically).  Self-skips where the container jax lacks
+    loopback multiproc collectives (PR 3 note)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port, port2 = 29781, 29782
+    procs = []
+    for rank in range(2):
+        script = _WINDOWED_WORKER.format(repo=repo, port=port, port2=port2)
+        env = dict(os.environ)
+        env["LIGHTGBM_TPU_RANK"] = str(rank)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env.pop("PYTEST_CURRENT_TEST", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=360)
+        outs.append(out.decode())
+    if any(p.returncode != 0 for p in procs):
+        if any("MISMATCH" in o for o in outs):
+            raise AssertionError(
+                "sharded windowed model diverged from serial:\n"
+                + "\n".join(o[-2000:] for o in outs))
+        # skip ONLY on the multiproc-collective infra signature (the PR 3
+        # container limitation — the sibling 2-process tests fail the
+        # same way at HEAD here); an application-level failure in the
+        # sharded path must stay a loud failure on healthy jax builds
+        infra = ("multihost_utils", "xla_extension", "jax.distributed",
+                 "UNIMPLEMENTED", "coordination", "DEADLINE_EXCEEDED")
+        if any(sig in o for o in outs for sig in infra):
+            pytest.skip("container jax lacks loopback multiproc "
+                        "collectives: "
+                        + outs[0][-300:].replace("\n", " ")[:200])
+        raise AssertionError(
+            "sharded windowed 2-process worker failed (not the known "
+            "collective-infra signature):\n"
+            + "\n".join(o[-2000:] for o in outs))
+    shas = set()
+    for rank, out in enumerate(outs):
+        assert f"RANK{rank}_WINDOWED_OK" in out, out[-2000:]
+        shas.update(line.split()[-1] for line in out.splitlines()
+                    if line.startswith("MODEL_SHA"))
+    assert len(shas) == 1, "ranks hold different models"

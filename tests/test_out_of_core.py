@@ -598,3 +598,99 @@ def test_spill_resume_with_categorical_trees(tmp_path):
         return s.partition("\nTree=")[2]
 
     assert trees(resumed.model_to_string()) == trees(full.model_to_string())
+
+
+# ---------------------------------------------------------------------------
+# rank-sharded streams (round 14): each rank streams only its (row_lo,
+# row_hi) shard of one shared save_binary cache
+# ---------------------------------------------------------------------------
+
+def test_shard_stream_parity_with_whole_cache(tmp_path):
+    """A (row_lo, row_hi) shard stream must yield byte-identical rows to
+    the same slice of a whole-cache sweep — across shard boundaries that
+    cut CRC blocks and chunk sizes that straddle them."""
+    from lightgbm_tpu.io.stream import BinCacheStream
+
+    cache, bins = _make_cache(tmp_path, n=300, f=4)
+    whole = np.zeros_like(bins)
+    for lo, view in BinCacheStream(cache).chunks(41):
+        whole[lo:lo + view.shape[0]] = view
+    np.testing.assert_array_equal(whole, bins)
+    for lo, hi in ((0, 100), (100, 230), (230, 300), (37, 263), (299, 300)):
+        s = BinCacheStream(cache, shard=(lo, hi))
+        assert s.shard_rows == hi - lo and s.n_rows == bins.shape[0]
+        got = np.zeros((hi - lo, bins.shape[1]), bins.dtype)
+        first = None
+        for glo, view in s.chunks(41):
+            first = glo if first is None else first
+            got[glo - lo: glo - lo + view.shape[0]] = view
+        assert first == lo  # yields GLOBAL row offsets
+        np.testing.assert_array_equal(got, bins[lo:hi])
+
+
+def test_shard_stream_rejects_bad_range(tmp_path):
+    from lightgbm_tpu.io.stream import BinCacheStream
+
+    cache, bins = _make_cache(tmp_path)
+    for bad in ((-1, 10), (10, 10), (0, bins.shape[0] + 1), (20, 5)):
+        with pytest.raises(ValueError):
+            BinCacheStream(cache, shard=bad)
+
+
+def _poisoned_cache(tmp_path, bins, cache, crc_rows=64, bad_row=150):
+    """Rebuild ``cache`` with ``crc_rows``-row CRC blocks over the TRUE
+    data but one corrupted row in the bins member (the
+    test_corrupt_bin_cache_raises_row_ranged_error recipe)."""
+    import io
+
+    from lightgbm_tpu.io.stream import bin_crc32s
+
+    bad_bins = bins.copy()
+    bad_bins[bad_row, 1] ^= 0x1
+
+    def npy_bytes(arr):
+        buf = io.BytesIO()
+        np.save(buf, arr)
+        return buf.getvalue()
+
+    p1 = str(tmp_path / "shard_bad1.bin")
+    p2 = str(tmp_path / "shard_bad2.bin")
+    final = str(tmp_path / "shard_bad.bin")
+    _rewrite_member(cache, p1, "bins.npy", lambda _: npy_bytes(bad_bins))
+    _rewrite_member(p1, p2, "bins_crc_rows.npy",
+                    lambda _: npy_bytes(np.asarray(crc_rows, np.int64)))
+    _rewrite_member(p2, final, "bins_crc32.npy",
+                    lambda _: npy_bytes(bin_crc32s(bins, crc_rows)))
+    return final, bad_bins
+
+
+def test_shard_stream_verifies_fully_covered_crc_blocks(tmp_path):
+    """Shard sweeps keep the integrity contract wherever it is provable:
+    a corrupt byte in a FULLY covered CRC block raises row-ranged; blocks
+    the shard cuts mid-way are skipped (their leading bytes were never
+    read), not trusted blind."""
+    from lightgbm_tpu.io.stream import BinCacheStream, CorruptBinCacheError
+
+    cache, bins = _make_cache(tmp_path)
+    final, bad_bins = _poisoned_cache(tmp_path, bins, cache)
+    # corruption at row 150 lives in CRC block 2 (rows [128, 192))
+    s = BinCacheStream(final, shard=(128, 300))
+    with pytest.raises(CorruptBinCacheError) as ei:
+        for _ in s.chunks(50):
+            pass
+    assert ei.value.row_lo == 128 and ei.value.row_hi == 192
+
+    # shard entering block 2 mid-way: the block is unverifiable and
+    # skipped; later blocks still verify — the sweep completes with the
+    # shard's bytes intact
+    s2 = BinCacheStream(final, shard=(140, 300))
+    got = np.zeros((160, bins.shape[1]), bins.dtype)
+    for glo, view in s2.chunks(33):
+        got[glo - 140: glo - 140 + view.shape[0]] = view
+    np.testing.assert_array_equal(got, bad_bins[140:300])
+
+    # shard ending inside block 2 never completes the block: no check
+    # fires, the partial rows stream through
+    s3 = BinCacheStream(final, shard=(0, 160))
+    rows = sum(v.shape[0] for _, v in s3.chunks(64))
+    assert rows == 160

@@ -186,3 +186,61 @@ def test_windowed_budget_gate_enforces(monkeypatch):
     monkeypatch.setattr(san, "async_pull_result", leaky)
     with pytest.raises(san.BudgetError):
         grow_tree_windowed(bins_t, grads[1], hess, **kw, **static)
+
+
+def test_sharded_windowed_one_dispatch_zero_syncs_per_rank_telemetry_on():
+    """ISSUE 9 acceptance: the SHARDED fused windowed round (8-device
+    loopback mesh, in-dispatch psum merge) keeps the 1-dispatch/0-sync/
+    0-retrace steady-state budget PER RANK — single-controller, so the
+    host's one dispatch IS every rank's dispatch — with telemetry and
+    span tracing default-ON, pinned by the same DispatchCounter the
+    single-device round uses."""
+    from lightgbm_tpu.obs import metrics as obs_metrics
+    from lightgbm_tpu.obs import trace as obs_trace
+    from lightgbm_tpu.parallel.data_parallel import (
+        ShardedData, grow_tree_windowed_data_parallel)
+    from lightgbm_tpu.parallel.mesh import make_mesh
+    from lightgbm_tpu.utils.sanitizer import DispatchCounter
+
+    assert obs_metrics.enabled()  # telemetry default-on: the pin's point
+    rng = np.random.RandomState(9)
+    n, f = 1024, 8
+    X = rng.randn(n, f)
+    y = X @ rng.randn(f) + 0.2 * rng.randn(n)
+    from lightgbm_tpu.binning import DatasetBinner
+
+    binner = DatasetBinner.fit(X, max_bin=31)
+    mesh = make_mesh()
+    sd = ShardedData(mesh, binner.transform(X),
+                     binner.num_bins_per_feature,
+                     binner.missing_bin_per_feature)
+    grads = [sd.pad_rows((0.6 * y + 0.05 * k).astype(np.float32))
+             for k in range(2)]
+    hess = sd.pad_rows(np.ones(n, np.float32))
+    sw = sd.pad_rows(np.ones(n, np.float32), fill=1.0)
+    kw = dict(num_leaves=15, num_bins=32,
+              params=SplitParams(min_data_in_leaf=5.0), leaf_tile=4,
+              use_pallas=False)
+    # warmup: compiles sharded init, the fused round at this shard size's
+    # ladder rung(s), and finalize
+    tree, leaf = grow_tree_windowed_data_parallel(
+        sd, grads[0], hess, sd.row_valid, sw, jnp.ones((f,), bool), **kw)
+    jax.block_until_ready(leaf)
+    assert int(tree.num_leaves) > 1
+
+    spans_before = len(obs_trace.spans("windowed_round"))
+    stats = {}
+    with DispatchCounter() as d:
+        tree, leaf = grow_tree_windowed_data_parallel(
+            sd, grads[1], hess, sd.row_valid, sw, jnp.ones((f,), bool),
+            stats=stats, **kw)
+        jax.block_until_ready(leaf)
+    assert stats["rounds"] >= 3, stats
+    d.assert_round_budget(stats["rounds"], what="sharded windowed rounds")
+    assert stats["host_syncs"] == 0 and stats["retries"] == 0, stats
+    assert stats["async_resolves"] <= stats["rounds"], stats
+    d.assert_no_recompile("sharded windowed steady state")
+    # the obs/span hooks rode the SAME accounted resolves: every round of
+    # the second tree left a windowed_round span, none added a sync
+    assert (len(obs_trace.spans("windowed_round")) - spans_before
+            == stats["rounds"])

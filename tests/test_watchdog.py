@@ -237,3 +237,116 @@ def test_missing_or_torn_heartbeat_file_is_not_a_hang(tmp_path):
     _watch_workers(workers, timeout_s=60, heartbeat_timeout_s=0.5,
                    heartbeat_paths={0: str(torn)})
     assert workers[0][1].returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# slow-rank detection + live fleet collector (round 14) — thin processes
+# ---------------------------------------------------------------------------
+
+def test_slow_rank_detection_emits_event_and_counter(tmp_path, monkeypatch):
+    """A rank that keeps beating but k x slower than the fleet median is
+    DETECTED (event + counter + exported age), not killed — the class
+    the full-stall watchdog can never see."""
+    import threading
+
+    from lightgbm_tpu.obs import metrics as _obs
+    from lightgbm_tpu.parallel import launcher
+
+    monkeypatch.setattr(launcher, "_SLOW_RANK_FLOOR_S", 0.05)
+    # the effective floor adds 2x the snapshot period (write/read phase
+    # aliasing headroom); shrink it so the thin-process stall qualifies
+    monkeypatch.setenv("LGBMTPU_METRICS_SNAPSHOT_PERIOD_S", "0.1")
+    workers = [_worker(tmp_path, r, "import time; time.sleep(6)")
+               for r in range(3)]
+    paths = {r: str(tmp_path / f"w{r}.metrics.json") for r in range(3)}
+    stop = threading.Event()
+
+    def beat():
+        v = 0.0
+        while not stop.is_set():
+            v += 1.0
+            for r in (0, 1):
+                _write_heartbeat(paths[r], v)
+            if v <= 12:  # rank 2 arms (changes across several polls)...
+                _write_heartbeat(paths[2], v)
+            time.sleep(0.2)  # ...then stalls at ~2.4 s while 0/1 beat on
+
+    threading.Thread(target=beat, daemon=True).start()
+    c0 = _obs.counter("fleet_slow_ranks_total").value
+    ages = {}
+    try:
+        launcher._watch_workers(workers, timeout_s=60, heartbeat_paths=paths,
+                                slow_rank_factor=3.0, hb_ages=ages)
+    finally:
+        stop.set()
+    assert _obs.counter("fleet_slow_ranks_total").value >= c0 + 1
+    evs = [e for e in _obs.events("fleet_slow_rank")
+           if e.get("worker_rank") == 2]
+    assert evs, "slow rank 2 not detected"
+    assert evs[-1]["age_s"] > 0 and evs[-1]["factor"] == 3.0
+    # no rank was killed: detection only
+    assert all(p.returncode == 0 for _, p, _ in workers)
+
+
+def test_slow_rank_not_tripped_by_healthy_jitter(tmp_path, monkeypatch):
+    """All ranks beating at the same cadence: ages stay under the
+    absolute floor and no slow-rank event fires."""
+    import threading
+
+    from lightgbm_tpu.obs import metrics as _obs
+    from lightgbm_tpu.parallel import launcher
+
+    workers = [_worker(tmp_path, r, "import time; time.sleep(3)")
+               for r in range(2)]
+    paths = {r: str(tmp_path / f"h{r}.metrics.json") for r in range(2)}
+    stop = threading.Event()
+
+    def beat():
+        v = 0.0
+        while not stop.is_set():
+            v += 1.0
+            for r in range(2):
+                _write_heartbeat(paths[r], v)
+            time.sleep(0.2)
+
+    threading.Thread(target=beat, daemon=True).start()
+    c0 = _obs.counter("fleet_slow_ranks_total").value
+    try:
+        launcher._watch_workers(workers, timeout_s=60, heartbeat_paths=paths,
+                                slow_rank_factor=3.0, hb_ages={})
+    finally:
+        stop.set()
+    assert _obs.counter("fleet_slow_ranks_total").value == c0
+
+
+def test_fleet_live_collector_labels_ranks_and_skips_torn(tmp_path):
+    """The launcher-side live collector merges per-rank snapshot files
+    into rank-labeled metric names (+ heartbeat ages from the watchdog's
+    shared dict); a torn rank file skips one scrape, never raises."""
+    import json
+
+    from lightgbm_tpu.obs import metrics as _obs
+    from lightgbm_tpu.parallel.launcher import _fleet_live_collector
+
+    for r in range(2):
+        (tmp_path / f"worker{r}.metrics.json").write_text(json.dumps(
+            {"counters": {"boost_rounds_total": 5 + r},
+             "gauges": {"heartbeat_ts": 1.5}}))
+    (tmp_path / "worker2.metrics.json").write_text('{"torn')
+    out = _fleet_live_collector(str(tmp_path), 3, {0: 0.0, 1: 2.5})()
+    assert out["counters"]['boost_rounds_total{rank="0"}'] == 5
+    assert out["counters"]['boost_rounds_total{rank="1"}'] == 6
+    assert out["gauges"]['heartbeat_ts{rank="1"}'] == 1.5
+    assert out["gauges"]['fleet_heartbeat_age_s{rank="1"}'] == 2.5
+    assert not any('rank="2"' in k for k in out["counters"])
+
+    # registered, the families reach the Prometheus exposition with real
+    # label sets — what a dashboard scraping the LAUNCHER's endpoint sees
+    _obs.REGISTRY.register_collector(
+        "fleet_live", _fleet_live_collector(str(tmp_path), 3, {1: 2.5}))
+    try:
+        text = _obs.render_prometheus()
+        assert 'fleet_heartbeat_age_s{rank="1"} 2.5' in text
+        assert 'boost_rounds_total{rank="0"} 5' in text
+    finally:
+        _obs.REGISTRY.register_collector("fleet_live", lambda: {})
