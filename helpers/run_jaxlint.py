@@ -1,17 +1,41 @@
 #!/usr/bin/env python
-"""Standalone jaxlint runner for pre-commit use:
+"""Standalone static-analysis runner for pre-commit use — BOTH layers:
 
-    python helpers/run_jaxlint.py                  # scan lightgbm_tpu/
+    python helpers/run_jaxlint.py                  # AST lint + jaxpr audit
+    python helpers/run_jaxlint.py --ast-only       # fast, no JAX touched
+    python helpers/run_jaxlint.py --no-runtime     # audit without the
+                                                   # executing ledger check
     python helpers/run_jaxlint.py --show-suppressed
     python helpers/run_jaxlint.py lightgbm_tpu/ops --rules R1,R3
+    python helpers/run_jaxlint.py --jaxpr --contract windowed_round_float
 
-Exit code 0 = clean (same contract tests/test_jaxlint_gate.py enforces in
-tier-1), 1 = unsuppressed findings, 2 = bad usage.  Runs without touching
-JAX device state, so it is safe anywhere — no TPU, no compile cache.
+Layer 1 (jaxlint, rules R1-R14) scans source ASTs and runs without
+touching JAX device state.  Layer 2 (jaxpr audit, rules J1-J6) traces
+the registered flagship executables hermetically on the host CPU and
+verifies their IR contracts (analysis/contracts.py) — the layer that
+sees through the closure-dispatched round body.  Layer 2 piggybacks
+only on FULL default scans: ``--ast-only``, ``--list-rules``,
+``--rules`` subsets, and explicit sub-package paths keep the run at
+layer 1 (a scoped question gets a scoped answer; the audit is whole-
+package by nature and costs real tracing time).  Exit code 0 = clean
+(the contract tests/test_jaxlint_gate.py + tests/test_jaxpr_audit.py
+enforce in tier-1), 1 = findings, 2 = bad usage.
 """
 
+import os
 import sys
 from pathlib import Path
+
+# the jaxpr layer's sharded contracts want a loopback multi-device mesh;
+# this must land BEFORE the lightgbm_tpu import below pulls jax in (under
+# `python -m lightgbm_tpu.analysis` the parent package import beats main(),
+# so the audit there runs on however many devices already exist — the
+# contracts trace identically, only the lowering differs)
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
@@ -19,7 +43,30 @@ from lightgbm_tpu.analysis.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
     argv = sys.argv[1:]
-    if not any(not a.startswith("-") for a in argv):
+    ast_only = "--ast-only" in argv
+    argv = [a for a in argv if a != "--ast-only"]
+    jaxpr_flags = ("--jaxpr", "--contract", "--list-contracts")
+    jaxpr_only = any(a.startswith(f) for a in argv for f in jaxpr_flags)
+    if ast_only and jaxpr_only:
+        print("error: --ast-only contradicts --jaxpr/--contract/"
+              "--list-contracts", file=sys.stderr)
+        sys.exit(2)
+    # the jaxpr layer only piggybacks on FULL default scans: an
+    # informational run (--list-rules) or a scoped one (--rules,
+    # explicit sub-package paths) asked layer 1 a narrow question, and
+    # silently paying the whole audit behind it would be a surprise
+    narrow = any(a.startswith(("--rules", "--list-rules")) for a in argv)
+    scoped = any(not a.startswith("-") for a in argv)
+    if not scoped:
         pkg = Path(__file__).resolve().parent.parent / "lightgbm_tpu"
-        argv = [str(pkg)] + argv
-    sys.exit(main(argv))
+        argv = ([] if jaxpr_only else [str(pkg)]) + argv
+    if jaxpr_only:
+        sys.exit(main(argv))
+    rc = main(argv)
+    if not (ast_only or narrow or scoped):
+        # layer 2 shares the exit-code contract; forward the flags it
+        # understands (--no-runtime skips the executing ledger check)
+        passthru = [a for a in argv
+                    if a in ("--show-suppressed", "--no-runtime")]
+        rc = max(rc, main(["--jaxpr"] + passthru))
+    sys.exit(rc)

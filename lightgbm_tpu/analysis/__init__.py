@@ -14,12 +14,24 @@ R4    collective-axis-name     psum/all_gather/... axis strings must match
                                the mesh module's declared axis constants
 R5    impure-under-jit         Python RNG / time.* / global mutation inside
                                traced functions
+...   (R6-R14: see docs/ANALYSIS.md for the full catalogue)
 ====  =======================  =============================================
+
+A second, trace-level layer lives in :mod:`.jaxpr_audit` +
+:mod:`.contracts` (rules J1-J6): it traces the registered flagship
+executables hermetically and verifies the one-dispatch /
+one-collective / all-donated contracts on the jaxpr — the properties
+the AST rules structurally cannot see through the shared round driver's
+closure dispatch.  Import it explicitly (it is not imported here, so
+``lightgbm_tpu.analysis`` stays JAX-free for pre-commit use).
 
 Usage::
 
     python -m lightgbm_tpu.analysis lightgbm_tpu/            # full package
     python -m lightgbm_tpu.analysis --rules R1,R3 ops/        # subset
+    python -m lightgbm_tpu.analysis --strict-pragmas          # stale=fail
+    python -m lightgbm_tpu.analysis --jaxpr                   # traced-IR audit
+    python -m lightgbm_tpu.analysis --jaxpr --contract windowed_round_float
 
 or from tests::
 

@@ -1,13 +1,27 @@
 """CLI: ``python -m lightgbm_tpu.analysis [paths...]``.
 
-Exit status 0 when no unsuppressed findings, 1 otherwise, 2 on bad usage —
-so the pytest gate (tests/test_jaxlint_gate.py) and pre-commit runs
-(helpers/run_jaxlint.py) share one entry point.
+Two layers, one entry point (docs/ANALYSIS.md):
+
+* default — **jaxlint**, the AST pass over source (rules R1-R14).  Runs
+  without touching JAX device state.  Stale pragmas (a ``disable=Rn``
+  whose line no longer triggers Rn) warn by default; ``--strict-pragmas``
+  promotes them to findings.
+* ``--jaxpr`` — the **jaxpr executable audit** (rules J1-J6 over the
+  registered contracts, analysis/contracts.py).  Traces the flagship
+  executables hermetically on the host CPU; ``--contract NAME`` selects
+  a subset (repeatable), ``--no-runtime`` skips the DispatchCounter
+  ledger cross-check (which executes a tiny sharded training).
+
+Exit status 0 when no unsuppressed findings, 1 otherwise, 2 on bad usage
+— so the pytest gates (tests/test_jaxlint_gate.py, tests/
+test_jaxpr_audit.py) and pre-commit runs (helpers/run_jaxlint.py) share
+one contract.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from pathlib import Path
 
@@ -15,20 +29,108 @@ from .core import RULES, run
 from . import rules  # noqa: F401
 
 
+def _ensure_loopback_devices() -> None:
+    """Arm the loopback host-device env for the sharded contracts if jax
+    has not loaded yet.  Under ``python -m lightgbm_tpu.analysis`` the
+    parent package import pulls jax in before main() runs, so this is
+    usually a no-op there — the audit then runs on however many devices
+    exist (the collectives trace identically; only the lowering differs).
+    helpers/run_jaxlint.py sets the flag before ANY import, and the
+    pytest gate inherits conftest's 8-device flag."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _main_jaxpr(args) -> int:
+    _ensure_loopback_devices()
+    from . import jaxpr_audit
+    from .contracts import CONTRACTS
+
+    if args.list_contracts:
+        for name in sorted(CONTRACTS):
+            c = CONTRACTS[name]
+            print(f"{name}  [{len(c.collectives)} collective(s), "
+                  f"{len(c.donated_args)} donated arg(s)]")
+            print(f"      {c.description}")
+        for rid in sorted(jaxpr_audit.JAXPR_RULES):
+            print(f"{rid}  {jaxpr_audit.JAXPR_RULES[rid]}")
+        return 0
+
+    names = list(args.contract) if args.contract else None
+    if names:
+        unknown = [n for n in names if n not in CONTRACTS]
+        if unknown:
+            print(f"error: unknown contracts {unknown}; known: "
+                  f"{sorted(CONTRACTS)}", file=sys.stderr)
+            return 2
+    report = jaxpr_audit.run_jaxpr_audit(names, runtime=not args.no_runtime)
+    for f in report.findings:
+        print(f.format())
+    if args.show_suppressed:
+        for f, reason in report.waived:
+            print(f"[waived: {reason}] {f.format()}")
+    for r in report.results:
+        coll = r.detail.get("collectives")
+        extra = f", collectives: {len(coll)}" if coll is not None else ""
+        print(f"jaxpr-audit: {r.name}: "
+              f"{'ok' if r.ok else f'{len(r.findings)} finding(s)'}"
+              f"{extra}", file=sys.stderr)
+    for merge, summary in report.ledger.items():
+        print(f"jaxpr-audit: ledger[{merge}]: {summary}", file=sys.stderr)
+    n, w = len(report.findings), len(report.waived)
+    print(f"jaxpr-audit: {n} finding(s), {w} waived", file=sys.stderr)
+    return 0 if report.ok else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m lightgbm_tpu.analysis",
-        description="jaxlint: JAX/TPU purity & recompile static analysis")
+        description="jaxlint: JAX/TPU purity & recompile static analysis "
+                    "(AST layer R1-R14; --jaxpr: traced-IR audit J1-J6)")
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/directories to scan (default: the "
                              "installed lightgbm_tpu package)")
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids (default: all)")
     parser.add_argument("--show-suppressed", action="store_true",
-                        help="also list pragma-suppressed findings")
+                        help="also list pragma-suppressed (or contract-"
+                             "waived) findings")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalogue and exit")
+    parser.add_argument("--strict-pragmas", action="store_true",
+                        help="promote stale pragmas (suppressions whose "
+                             "line no longer triggers the named rule) "
+                             "from warnings to findings")
+    parser.add_argument("--jaxpr", action="store_true",
+                        help="run the jaxpr executable audit (J1-J6 over "
+                             "the registered contracts) instead of the "
+                             "AST layer")
+    parser.add_argument("--contract", action="append", metavar="NAME",
+                        help="audit only this contract (repeatable; "
+                             "implies --jaxpr)")
+    parser.add_argument("--list-contracts", action="store_true",
+                        help="print the contract + J-rule catalogue and "
+                             "exit (implies --jaxpr)")
+    parser.add_argument("--no-runtime", action="store_true",
+                        help="--jaxpr: skip the DispatchCounter ledger "
+                             "cross-check (pure trace/lower, no "
+                             "execution)")
     args = parser.parse_args(argv)
+
+    if args.jaxpr or args.contract or args.list_contracts:
+        if args.paths:
+            # the audit runs REGISTERED contracts, not source paths — a
+            # path here means the caller expects a scoped scan it would
+            # not get; fail loudly like other bad usage
+            print("error: --jaxpr audits registered contracts and takes "
+                  "no paths (use --contract NAME to select)",
+                  file=sys.stderr)
+            return 2
+        return _main_jaxpr(args)
 
     if args.list_rules:
         for rid in sorted(RULES):
@@ -56,14 +158,19 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
 
-    report = run(roots, rule_ids)
+    report = run(roots, rule_ids, strict_pragmas=args.strict_pragmas)
     for f in report.findings:
         print(f.format())
     if args.show_suppressed:
         for f, p in report.suppressed:
             print(f"[suppressed: {p.reason}] {f.format()}")
+    if report.stale and not args.strict_pragmas:
+        # default-on warning: retired pragmas must not accumulate
+        for f in report.stale:
+            print(f"warning: {f.format()}", file=sys.stderr)
     n, s = len(report.findings), len(report.suppressed)
-    print(f"jaxlint: {n} finding(s), {s} suppressed", file=sys.stderr)
+    print(f"jaxlint: {n} finding(s), {s} suppressed, "
+          f"{len(report.stale)} stale pragma(s)", file=sys.stderr)
     return 0 if report.ok else 1
 
 

@@ -1,0 +1,504 @@
+"""Executable contracts for the jaxpr audit layer (docs/ANALYSIS.md
+"Jaxpr audit layer").
+
+A *contract* pins the traced-IR invariants of one flagship executable —
+the properties the AST rules structurally cannot see (R1/R6/R13's
+documented static limits: the shared ``_run_fused_rounds`` driver
+receives its donated dispatch as a closure, so a second collective or a
+dropped donation INSIDE the traced round body is invisible to source
+lint).  Each contract bundles:
+
+* a **builder** that constructs the executable and hermetic example
+  arguments (CPU, no chip, no network; ShapeDtypeStructs wherever the
+  trace does not need data, so building mostly never executes device
+  code — the one exception is the converted-predict contract, which
+  trains a 2-iteration toy booster to audit the REAL fused entry);
+* the **declared invariants** the auditor (jaxpr_audit.py) checks on the
+  traced jaxpr and lowered StableHLO:
+
+  - ``collectives``: the exact ordered ``prim@axis`` sequence the
+    executable may contain (J1).  Declaring the order pins cross-variant
+    consistency: the psum and scatter merge variants share the same
+    protocol spine (declared via ``spine``), so an accidental reorder or
+    an extra collective in either fails the audit, not the chip session.
+  - ``donated_args``: positional args whose buffers are donated; J2
+    asserts every live donated leaf is actually consumable (and, where
+    the platform lowers aliasing, actually aliased).
+  - ``max_const_bytes``: J5's baked-constant ceiling for this trace.
+  - ``max_live_bytes``: J6's conservative peak-live-bytes budget — an
+    O(L*F*B) state blowup in the round body fails CI here before it
+    fails allocation on a v5e.
+
+Contracts are DECLARED NEXT TO the invariants they pin, in this module,
+with contract-level **waivers** replacing line pragmas (a traced jaxpr
+has no source line to hang a pragma on): ``waivers={"J6": "reason"}``
+suppresses rule J6 for that contract, reason mandatory — a reasonless or
+unknown-rule waiver is itself a P0 finding, exactly like the lint
+layer's pragma hygiene.
+
+Adding a contract::
+
+    @contract(
+        "my_executable",
+        description="what it is and why its IR shape matters",
+        collectives=("psum@data",),     # () = the body must be collective-free
+        donated_args=(0,),
+        max_live_bytes=1 << 22,
+        family="my_family", spine=(0, 0),
+    )
+    def _build_my_executable() -> Target:
+        ...
+        return Target(fn=jitted, args=(...), kwargs=dict(static=...))
+
+JAX is imported only inside builders, so importing this module (and the
+``lightgbm_tpu.analysis`` package) stays device-state-free; the CLI sets
+the loopback-device env BEFORE any builder runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import inspect
+from typing import Callable, Dict, Mapping, Optional, Tuple
+
+# shared hermetic shapes: every fixture sits far below one W-ladder rung
+# (n < 8192 => the single rung W=8192 covers any round), so the windowed
+# contracts trace the same one-rung executable the tier-1 budget pins run
+_N, _F, _L, _TILE, _BINS = 512, 8, 7, 4, 32
+_W = 8192  # the floor rung: _window_size(n // 2, n) for every n < 8192
+
+
+@dataclasses.dataclass
+class Target:
+    """What a builder hands the auditor: the jitted callable plus the
+    exact (positional args, static kwargs) to trace/lower it with."""
+
+    fn: object
+    args: tuple
+    kwargs: dict
+    note: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    name: str
+    description: str
+    build: Callable[[], Target]
+    collectives: Tuple[str, ...]
+    donated_args: Tuple[int, ...]
+    max_const_bytes: int
+    max_live_bytes: int
+    family: str
+    spine: Tuple[int, int]  # (prefix, suffix) lengths shared family-wide
+    waivers: Mapping[str, str]
+    file: str
+    line: int
+    # True when the BUILDER executes device code (not just trace/lower) —
+    # e.g. trains a toy model.  Cost-sensitive callers (bench.py on chip,
+    # where every compile is a remote Mosaic compile) can exclude these.
+    executes: bool = False
+
+
+CONTRACTS: Dict[str, Contract] = {}
+
+
+def contract(name: str, *, description: str,
+             collectives: Tuple[str, ...] = (),
+             donated_args: Tuple[int, ...] = (),
+             max_const_bytes: int = 1 << 16,
+             max_live_bytes: int,
+             family: str = "",
+             spine: Tuple[int, int] = (0, 0),
+             waivers: Optional[Mapping[str, str]] = None,
+             executes: bool = False):
+    """Register a contract; the decorated function is its builder."""
+
+    def deco(build: Callable[[], Target]) -> Callable[[], Target]:
+        if name in CONTRACTS:
+            raise ValueError(f"duplicate contract {name!r}")
+        frame = inspect.stack()[1]
+        CONTRACTS[name] = Contract(
+            name=name, description=description, build=build,
+            collectives=tuple(collectives),
+            donated_args=tuple(donated_args),
+            max_const_bytes=max_const_bytes,
+            max_live_bytes=max_live_bytes, family=family, spine=spine,
+            waivers=dict(waivers or {}), file=frame.filename,
+            line=frame.lineno, executes=executes)
+        return build
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# shared builders
+# ---------------------------------------------------------------------------
+
+def _sds(shape, dtype):
+    import jax
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _split_params():
+    from ..ops.split import SplitParams
+    return SplitParams(min_data_in_leaf=5.0)
+
+
+def _round_common():
+    return dict(num_leaves=_L, num_bins=_BINS, params=_split_params(),
+                leaf_tile=_TILE)
+
+
+def _single_state(quantize_bins: int):
+    """WState avals for the single-device round via eval_shape over
+    ``_w_init`` — abstract, nothing executes."""
+    import functools as ft
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import treegrow_windowed as tw
+
+    row = lambda dt: _sds((_N,), dt)  # noqa: E731
+    pf = _sds((_F,), jnp.int32)
+    out = jax.eval_shape(
+        ft.partial(tw._w_init.__wrapped__, use_pallas=False,
+                   quantize_bins=quantize_bins, hist_precision="f32",
+                   stochastic_rounding=False, **_round_common()),
+        _sds((_F, _N), jnp.int16), row(jnp.float32), row(jnp.float32),
+        row(jnp.bool_), row(jnp.float32), pf, pf, _sds((_F,), jnp.bool_),
+        None, None, None)
+    return out[0]
+
+
+def _windowed_single_target(quantize_bins: int) -> Target:
+    import jax.numpy as jnp
+
+    from ..ops import treegrow_windowed as tw
+
+    row = lambda dt: _sds((_N,), dt)  # noqa: E731
+    pf = _sds((_F,), jnp.int32)
+    q = bool(quantize_bins)
+    args = (
+        _single_state(quantize_bins), _sds((_F, _N), jnp.int16),
+        row(jnp.float32), row(jnp.float32),
+        row(jnp.int8) if q else None, row(jnp.int8) if q else None,
+        _sds((3,), jnp.float32) if q else None,
+        row(jnp.bool_), pf, pf, _sds((_F,), jnp.bool_),
+        None, None, None, None, None, None,
+    )
+    kw = dict(max_depth=-1, W=_W, use_pallas=False,
+              quantize_bins=quantize_bins, hist_precision="f32",
+              **_round_common())
+    return Target(tw._round_fused, args, kw,
+                  note="single-device fused round (CPU trace: XLA "
+                       "histogram fallback, Pallas off)")
+
+
+def audit_mesh():
+    """The loopback mesh the sharded contracts trace over: up to 4 host
+    devices (tests force 8 via conftest's XLA_FLAGS; the CLI sets the
+    same flag before jax loads).  On a single-device interpreter the
+    collectives still trace identically — axis size only changes the
+    lowering, not the jaxpr."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+    return make_mesh(min(4, len(jax.devices())))
+
+
+def _windowed_sharded_target(merge: str) -> Target:
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import data_parallel as dp
+    from ..parallel.mesh import data_axis_size
+
+    mesh = audit_mesh()
+    n_dev = data_axis_size(mesh)
+    f_pad = (-(-_F // n_dev) * n_dev) if merge == "scatter" else _F
+    row = lambda dt: _sds((_N,), dt)  # noqa: E731
+    bt = _sds((f_pad, _N), jnp.int16)
+    pf = _sds((f_pad,), jnp.int32)
+    fm = _sds((f_pad,), jnp.bool_)
+    init_statics = tuple(sorted(dict(
+        _round_common(), use_pallas=False, quantize_bins=0,
+        hist_precision="f32", stochastic_rounding=False).items()))
+    init_fn = dp._windowed_init_sharded(mesh, merge, (), init_statics)
+    state = jax.eval_shape(init_fn, bt, row(jnp.float32), row(jnp.float32),
+                           row(jnp.bool_), row(jnp.float32), pf, pf, fm)[0]
+    round_statics = tuple(sorted(dict(
+        _round_common(), max_depth=-1, use_pallas=False, quantize_bins=0,
+        hist_precision="f32", has_cat=False,
+        pallas_partition=False).items()))
+    fn = dp._windowed_round_sharded(mesh, _W, merge, (), round_statics)
+    args = (state, bt, row(jnp.float32), row(jnp.float32), row(jnp.bool_),
+            pf, pf, fm)
+    return Target(fn, args, {},
+                  note=f"jit(shard_map) fused round, merge={merge!r}, "
+                       f"{n_dev}-device loopback mesh")
+
+
+# the sharded round's protocol spine, identical across merge variants
+# (J1 family check): window verification + info-vector merge...
+_ROUND_PREFIX = (
+    "psum@data",   # global left counts (window-child election)
+    "psum@data",   # global segment lengths (same election)
+    "pmin@data",   # info: ok — one rank breaching skips the round fleet-wide
+    "pmax@data",   # info: total — corrected W must cover the worst rank
+)
+# ...and the two trailing info merges after the split search
+_ROUND_SUFFIX = (
+    "pmax@data",   # info: whint — laddered W covers the worst rank
+    "pmin@data",   # info: finite — rank-consistent non-finite guard
+)
+
+# the owned-feature winner election (_merge_best + _split_tables) between
+# the scatter merge and the info suffix: globalize the feature index,
+# elect by gain, psum-mask-broadcast every BestSplit field from the owner
+_SCATTER_ELECTION = (
+    "axis_index@data",             # _split_tables: this rank's F/R offset
+    "axis_index@data",             # _merge_best: owner election index
+    "pmax@data", "pmin@data",      # gain max, lowest-rank tie-break
+) + ("psum@data",) * 12            # one masked broadcast per BestSplit field
+
+
+# ---------------------------------------------------------------------------
+# windowed fused round (ops/treegrow_windowed.py, parallel/data_parallel.py)
+# ---------------------------------------------------------------------------
+
+@contract(
+    "windowed_round_float",
+    description="single-device fused windowed round, float histograms — "
+                "the one-dispatch donated executable tests/test_retrace.py "
+                "budget-pins; its body must stay collective-free, f64-free, "
+                "callback-free, with every donated WState buffer consumable",
+    collectives=(),
+    donated_args=(0,),
+    # measured peak ≈ 4.03 MB at the 512x8/L7/B32 fixture shape (the CPU
+    # fallback's vmapped window histogram dominates); 10 MB keeps ~2.5x
+    # headroom while still catching an O(L*F*B) state duplication
+    max_live_bytes=10 << 20,
+    family="windowed_single",
+)
+def _build_windowed_round_float() -> Target:
+    return _windowed_single_target(0)
+
+
+@contract(
+    "windowed_round_quantized",
+    description="single-device fused windowed round, int8-quantized config "
+                "(CPU trace: dequantized fallback histograms) — the wide-"
+                "regime default; same contract as the float round",
+    collectives=(),
+    donated_args=(0,),
+    max_live_bytes=10 << 20,
+    family="windowed_single",
+)
+def _build_windowed_round_quantized() -> Target:
+    return _windowed_single_target(16)
+
+
+@contract(
+    "windowed_round_sharded_psum",
+    description="SPMD fused windowed round over the ICI mesh, merge='psum' "
+                "(tree_learner=data): exactly ONE large in-dispatch "
+                "collective — the leaf-histogram psum — plus the declared "
+                "scalar protocol merges, all on the data axis, in order",
+    collectives=_ROUND_PREFIX + ("psum@data",) + _ROUND_SUFFIX,
+    donated_args=(0,),
+    max_live_bytes=10 << 20,  # sharded measured ≈ 4.09 MB
+    family="windowed_sharded",
+    spine=(len(_ROUND_PREFIX), len(_ROUND_SUFFIX)),
+)
+def _build_windowed_round_sharded_psum() -> Target:
+    return _windowed_sharded_target("psum")
+
+
+@contract(
+    "windowed_round_sharded_scatter",
+    description="SPMD fused windowed round, merge='scatter' "
+                "(tree_learner=voting): ONE large in-dispatch collective — "
+                "the psum_scatter histogram merge — then the owned-feature "
+                "winner election (all small-operand), same protocol spine "
+                "as the psum variant",
+    collectives=(_ROUND_PREFIX + ("psum_scatter@data",)
+                 + _SCATTER_ELECTION + _ROUND_SUFFIX),
+    donated_args=(0,),
+    max_live_bytes=10 << 20,  # sharded measured ≈ 4.09 MB
+    family="windowed_sharded",
+    spine=(len(_ROUND_PREFIX), len(_ROUND_SUFFIX)),
+)
+def _build_windowed_round_sharded_scatter() -> Target:
+    return _windowed_sharded_target("scatter")
+
+
+# ---------------------------------------------------------------------------
+# warm predict entries (ops/predict.py, models/gbdt.py)
+# ---------------------------------------------------------------------------
+
+_PN, _PF, _PT, _PL = 128, 8, 8, 8  # bucket rows, features, trees, leaves
+
+
+def _packed_sds():
+    import jax.numpy as jnp
+    m = _PL - 1
+    return dict(
+        split_feature=_sds((_PT, m), jnp.int32),
+        threshold=_sds((_PT, m), jnp.float32),
+        default_left=_sds((_PT, m), jnp.bool_),
+        missing_type=_sds((_PT, m), jnp.int32),
+        left_child=_sds((_PT, m), jnp.int32),
+        right_child=_sds((_PT, m), jnp.int32),
+        num_leaves=_sds((_PT,), jnp.int32),
+        leaf_value=_sds((_PT, _PL), jnp.float32),
+    )
+
+
+@contract(
+    "predict_warm_single",
+    description="warm single-class predict traversal (predict_raw_values) "
+                "on a bucket-padded batch with an active mask — the 1-"
+                "dispatch serving entry tests/test_predict_budget.py pins",
+    collectives=(),
+    # measured peak ≈ 44 KB at the 128x8/T8 fixture; 1 MB bounds a
+    # traversal that starts materializing per-(tree,row,node) temporaries
+    max_live_bytes=1 << 20,
+)
+def _build_predict_warm_single() -> Target:
+    import jax.numpy as jnp
+
+    from ..ops import predict as predict_ops
+    s = _packed_sds()
+    args = (_sds((_PN, _PF), jnp.float32), s["split_feature"],
+            s["threshold"], s["default_left"], s["missing_type"],
+            s["left_child"], s["right_child"], s["num_leaves"],
+            s["leaf_value"])
+    return Target(predict_ops.predict_raw_values, args,
+                  dict(active=_sds((_PN,), jnp.bool_)),
+                  note="non-categorical pack (the cat variant adds bitset "
+                       "gathers, same contract class)")
+
+
+@contract(
+    "predict_warm_multiclass",
+    description="warm multiclass predict (predict_raw_multiclass, k=4): "
+                "all classes reduced in the SAME single dispatch via the "
+                "class-reshaped sum — no per-class loop may reappear",
+    collectives=(),
+    max_live_bytes=1 << 20,
+)
+def _build_predict_warm_multiclass() -> Target:
+    import jax.numpy as jnp
+
+    from ..ops import predict as predict_ops
+    s = _packed_sds()
+    args = (_sds((_PN, _PF), jnp.float32), s["split_feature"],
+            s["threshold"], s["default_left"], s["missing_type"],
+            s["left_child"], s["right_child"], s["num_leaves"],
+            s["leaf_value"])
+    return Target(predict_ops.predict_raw_multiclass, args,
+                  dict(active=_sds((_PN,), jnp.bool_), k=4))
+
+
+@functools.lru_cache(maxsize=1)
+def _tiny_booster():
+    """A 2-iteration toy binary booster: the ONLY contract builder that
+    executes device code, because the fused converted-predict entry is an
+    instance-cached jit closing over the model's real objective
+    (models/gbdt.py::_get_convert_entry) — auditing a replica would let
+    the real entry drift."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(192, _PF)
+    y = (X[:, 0] + 0.25 * X[:, 1] > 0).astype(np.float64)
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 4,
+                              "min_data_in_leaf": 5, "verbosity": -1},
+                      train_set=d)
+    for _ in range(2):
+        bst.update()
+    return bst._gbdt
+
+
+@contract(
+    "predict_warm_converted",
+    description="fused converted predict (traversal + objective."
+                "convert_output in ONE trace, models/gbdt.py::"
+                "_get_convert_entry) — the round-12 single-dispatch entry; "
+                "audited on a real 2-iteration binary booster",
+    collectives=(),
+    max_live_bytes=1 << 20,
+    executes=True,  # the builder trains the toy booster
+)
+def _build_predict_warm_converted() -> Target:
+    import jax.numpy as jnp
+
+    g = _tiny_booster()
+    s = g._packed(0, -1)
+    run = g._get_convert_entry()
+    args = (_sds((_PN, _PF), jnp.float32), s["split_feature"],
+            s["threshold"], s["default_left"], s["missing_type"],
+            s["left_child"], s["right_child"], s["num_leaves"],
+            s["leaf_value"], s.get("is_cat"), s.get("cat_base"),
+            s.get("cat_nwords"), s.get("cat_words"),
+            _sds((_PN,), jnp.bool_))
+    return Target(run, args, dict(k=1))
+
+
+# ---------------------------------------------------------------------------
+# spill grower chunk steps (ops/treegrow_ooc.py)
+# ---------------------------------------------------------------------------
+
+_CN, _CC = 4096, 1024  # padded resident rows, chunk rows (both < 8192)
+
+
+@contract(
+    "ooc_root_chunk",
+    description="spill-grower root-pass chunk step (_root_chunk_step): the "
+                "donated histogram fold plus in-jit mask/slice — the one "
+                "accounted dispatch per chunk the OOC docstring promises",
+    collectives=(),
+    donated_args=(0,),
+    # measured peak ≈ 0.5 MB (chunk payload broadcast); 2 MB headroom
+    max_live_bytes=2 << 20,
+)
+def _build_ooc_root_chunk() -> Target:
+    import jax.numpy as jnp
+
+    from ..ops.treegrow_ooc import _root_chunk_step
+    args = (_sds((3, _F, _BINS), jnp.float32), _sds((_CC, _F), jnp.int16),
+            _sds((), jnp.int32), _sds((_CC,), jnp.bool_),
+            _sds((_CN,), jnp.float32), _sds((_CN,), jnp.float32),
+            _sds((_CN,), jnp.bool_))
+    return Target(_root_chunk_step, args, dict(num_bins=_BINS))
+
+
+@contract(
+    "ooc_split_chunk",
+    description="spill-grower split-sweep chunk step (_split_chunk_step): "
+                "fused partition + small-child histogram fold, leaf ids "
+                "AND the accumulator donated",
+    collectives=(),
+    donated_args=(0, 1),
+    max_live_bytes=2 << 20,
+)
+def _build_ooc_split_chunk() -> Target:
+    import jax.numpy as jnp
+
+    from ..ops.treegrow_ooc import _split_chunk_step
+    sel = dict(best_leaf=_sds((), jnp.int32), feature=_sds((), jnp.int32),
+               threshold_bin=_sds((), jnp.int32),
+               default_left=_sds((), jnp.bool_), is_cat=_sds((), jnp.bool_),
+               cat_mask=_sds((_BINS,), jnp.bool_),
+               new_leaf=_sds((), jnp.int32), small_leaf=_sds((), jnp.int32))
+    args = (_sds((_CN,), jnp.int32), _sds((3, _F, _BINS), jnp.float32),
+            _sds((_CC, _F), jnp.int16), _sds((), jnp.int32),
+            _sds((_CC,), jnp.bool_), _sds((_CN,), jnp.float32),
+            _sds((_CN,), jnp.float32), _sds((_CN,), jnp.bool_),
+            _sds((_F,), jnp.int32), sel)
+    return Target(_split_chunk_step, args, dict(num_bins=_BINS))

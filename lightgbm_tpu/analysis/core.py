@@ -220,8 +220,31 @@ class ModuleInfo:
         self._collect_axis_constants()
 
     # -- pragmas ---------------------------------------------------------
+    def _comment_lines(self) -> Set[int]:
+        """Lines carrying a REAL comment token.  The pragma regex alone
+        also matches pragma-shaped text inside string literals (the
+        docstring examples in this very package) — those never suppressed
+        anything, but the stale-pragma pass would flag them as retired.
+        Tokenizing once keeps pragmas a comments-only construct."""
+        import io
+        import tokenize
+        out: Set[int] = set()
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO("\n".join(self.source_lines)).readline):
+                if tok.type == tokenize.COMMENT:
+                    out.add(tok.start[0])
+        except (tokenize.TokenError, IndentationError):
+            # unterminated constructs: fall back to every line (regex-only
+            # behavior) rather than silently dropping real pragmas
+            return set(range(1, len(self.source_lines) + 1))
+        return out
+
     def _collect_pragmas(self) -> None:
+        comment_lines = self._comment_lines()
         for i, text in enumerate(self.source_lines, start=1):
+            if i not in comment_lines:
+                continue
             m = PRAGMA_RE.search(text)
             if not m:
                 continue
@@ -513,15 +536,28 @@ def register_rule(rule_id: str, name: str) -> Callable[[RuleFn], RuleFn]:
 class Report:
     findings: List[Finding]
     suppressed: List[Tuple[Finding, Pragma]]
+    # pragmas whose line no longer triggers a rule they name: each entry is
+    # a ready-to-print Finding (rule "P1") pointing at the pragma line.
+    # Default-on WARNING (the CLI prints them to stderr); --strict-pragmas
+    # promotes them into `findings` so retired suppressions cannot
+    # accumulate silently (the per-round R1 pragma retired in round 7 is
+    # the precedent this guards).
+    stale: List[Finding] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
         return not self.findings
 
 
-def run(roots: Iterable[Path], rule_ids: Optional[Iterable[str]] = None
-        ) -> Report:
-    """Run the selected rules over the roots; apply pragma suppression."""
+def run(roots: Iterable[Path], rule_ids: Optional[Iterable[str]] = None,
+        strict_pragmas: bool = False) -> Report:
+    """Run the selected rules over the roots; apply pragma suppression.
+
+    ``strict_pragmas`` promotes stale-pragma findings (P1: a
+    ``disable=Rn`` whose line no longer triggers rule Rn) from warnings
+    into real findings.  Staleness is only judged for rules that were
+    actually selected this run — a subset run cannot conclude anything
+    about an unselected rule's pragmas."""
     from . import rules as _rules  # noqa: F401  (registers built-in rules)
 
     pkg = PackageIndex(roots)
@@ -557,5 +593,36 @@ def run(roots: Iterable[Path], rule_ids: Optional[Iterable[str]] = None
             suppressed.append((f, p))
         else:
             findings.append(f)
+
+    # stale-pragma detection: a suppression whose target line no longer
+    # triggers the rule it names.  Judged against the RAW findings (before
+    # suppression), per named rule, only for rules selected this run.
+    triggered = {(f.file, f.line, f.rule) for f in raw}
+    triggered_lines = {(f.file, f.line) for f in raw}
+    sel = set(selected)
+    stale: List[Finding] = []
+    for mod in pkg.modules.values():
+        for p in mod.pragmas:
+            for rid in p.rules:
+                if rid == "ALL":
+                    if (sel == set(RULES)
+                            and (str(mod.path), p.line) not in triggered_lines):
+                        stale.append(Finding(
+                            str(mod.path), p.pragma_line, "P1",
+                            "stale pragma: disable=ALL but line "
+                            f"{p.line} triggers no rule at all",
+                            "delete the retired suppression"))
+                elif rid in RULES and rid in sel and (
+                        str(mod.path), p.line, rid) not in triggered:
+                    stale.append(Finding(
+                        str(mod.path), p.pragma_line, "P1",
+                        f"stale pragma: disable={rid} but line {p.line} "
+                        f"no longer triggers {rid}",
+                        "delete the retired suppression (reason: "
+                        f"{p.reason!r})"))
+    stale.sort(key=lambda f: (f.file, f.line))
+    if strict_pragmas:
+        findings.extend(stale)
+
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
-    return Report(findings=findings, suppressed=suppressed)
+    return Report(findings=findings, suppressed=suppressed, stale=stale)

@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R13.
+"""jaxlint built-in rules R1-R14.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -1232,3 +1232,65 @@ def r13_collective_outside_fused_round(pkg: PackageIndex) -> Iterator[Finding]:
                             f"{fi.qualname}'s fused round loop — the "
                             "merge pays a second dispatch instead of "
                             "riding the donated round", hint)
+
+
+# ---------------------------------------------------------------------------
+# R14 — metadata-via-device-pull
+# ---------------------------------------------------------------------------
+
+_R14_META_ATTRS = ("shape", "ndim", "size", "dtype")
+
+
+def _r14_np_convert_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _is_np_attr(
+        node.func, _NP_SYNC_FUNCS)
+
+
+@register_rule("R14", "metadata-via-device-pull")
+def r14_metadata_via_device_pull(pkg: PackageIndex) -> Iterator[Finding]:
+    """Reading METADATA through a whole-array host conversion:
+    ``np.asarray(x).shape`` / ``np.asarray(x).dtype`` /
+    ``len(np.asarray(x))`` / ``x.shape[0].item()``.  On a jitted output
+    the ``np.asarray`` is a BLOCKING device pull of the entire buffer —
+    paid to read a property (``.shape``/``.dtype``/``len``) the array
+    object already exposes for free, device or host (the exact class the
+    round-14 review caught in ``grow_tree_windowed_data_parallel``, which
+    read ``num_bins_pf``'s length via ``np.asarray`` once per tree).
+    Unlike R1 this fires EVERYWHERE, not just hot paths: a metadata read
+    never needs the conversion, so the pull is pure waste wherever it
+    sits — and on host inputs it is still a gratuitous O(N) copy."""
+    hint = ("read .shape/.dtype/len() directly off the array (device "
+            "arrays expose them without a transfer), or np.shape(x) for "
+            "maybe-list inputs; convert once and bind the result if the "
+            "DATA is genuinely needed too")
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            for node in _own_body(fi):
+                if (isinstance(node, ast.Attribute)
+                        and node.attr in _R14_META_ATTRS
+                        and _r14_np_convert_call(node.value)):
+                    yield _finding(
+                        fi, node, "R14",
+                        f"np.asarray(...).{node.attr} in {fi.qualname}: "
+                        "a whole-array pull/copy to read metadata the "
+                        "array already exposes", hint)
+                elif (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "len" and len(node.args) == 1
+                        and _r14_np_convert_call(node.args[0])):
+                    yield _finding(
+                        fi, node, "R14",
+                        f"len(np.asarray(...)) in {fi.qualname}: a "
+                        "whole-array pull/copy to read a length "
+                        ".shape already exposes", hint)
+                elif (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item" and not node.args
+                        and isinstance(node.func.value, ast.Subscript)
+                        and isinstance(node.func.value.value, ast.Attribute)
+                        and node.func.value.value.attr == "shape"):
+                    yield _finding(
+                        fi, node, "R14",
+                        f".shape[...].item() in {fi.qualname}: shape "
+                        "entries are Python ints already — .item() here "
+                        "signals a device round-trip habit", hint)

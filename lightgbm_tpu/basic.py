@@ -966,7 +966,8 @@ class Dataset:
             cap *= 2
 
         def pad(a, fill=0):
-            out = np.full(cap, fill, dtype=np.asarray(a).dtype)
+            a = np.asarray(a)  # convert ONCE; dtype reads off the binding
+            out = np.full(cap, fill, dtype=a.dtype)
             out[:m] = a[:m]
             return jnp.asarray(out[None])
 

@@ -332,7 +332,7 @@ class XentLambdaMetric(Metric):
 
     def eval_sums(self, pred, label, weight, query_boundaries=None):
         v = self.eval(pred, label, weight)[0][1]
-        n = len(np.asarray(label))
+        n = np.shape(label)[0]  # metadata only — no conversion (jaxlint R14)
         return [(self.name, v * n, float(n), False)]
 
 

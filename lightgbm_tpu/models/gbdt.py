@@ -2124,7 +2124,11 @@ class GBDT:
         (round 12 — it was 2 dispatches: the raw traversal, then a
         separate convert dispatch over the re-uploaded raw result).
         Cached for the model's lifetime; reset_split_params nulls it when
-        a baked objective constant (e.g. ``sigmoid``) changes."""
+        a baked objective constant (e.g. ``sigmoid``) changes.  The
+        entry's traced IR is pinned by the ``predict_warm_converted``
+        audit contract on a real toy booster (analysis/contracts.py) —
+        precisely because this jit closes over instance state the AST
+        rules cannot follow."""
         if self._convert_entry is not None:
             return self._convert_entry
         obj = self.objective

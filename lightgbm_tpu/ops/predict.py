@@ -34,6 +34,13 @@ and feeds the ``predict_warm_latency_ms`` reservoirs.  Adding host-side
 counters or timers INSIDE these jitted bodies would either break the trace
 or run once at trace time (jaxlint R5); timing around them without the
 sync is the jaxlint-R9 mistiming class.
+
+IR contract (round 15): the warm entries are pinned on the traced jaxpr
+by the ``predict_warm_single`` / ``_multiclass`` / ``_converted`` audit
+contracts (analysis/contracts.py, tests/test_jaxpr_audit.py) —
+collective-free, callback-free, f64-free bodies with no oversized baked
+constants and a bounded live set; a per-class host loop or an in-trace
+transfer reappearing here fails the audit statically.
 """
 
 from __future__ import annotations

@@ -45,6 +45,10 @@ grower's host analogue) plus ``ceil(N/chunk)`` chunk dispatches per
 pass.  The windowed 1-dispatch/0-sync budget applies to the RESIDENT
 out-of-core regime (standard growers over a stream-assembled device
 matrix), not to spill-mode growth; tests/test_out_of_core.py pins both.
+The chunk steps' IR is pinned by the ``ooc_root_chunk`` /
+``ooc_split_chunk`` audit contracts (analysis/contracts.py): donated
+accumulators consumable, collective/callback/transfer-free bodies,
+bounded live set (docs/ANALYSIS.md "Jaxpr audit layer").
 """
 
 from __future__ import annotations

@@ -66,6 +66,18 @@ host protocol stays rank-consistent.  The host loop is shared
 live in parallel/data_parallel.py::grow_tree_windowed_data_parallel.
 The 1-dispatch/0-sync budget pin holds PER RANK (single-controller: one
 host dispatch fans out over the mesh; tests/test_retrace.py).
+
+Round 15: the round executable's IR is ALSO pinned statically — the
+jaxpr audit contracts ``windowed_round_float`` / ``_quantized`` /
+``_sharded_psum`` / ``_sharded_scatter`` (analysis/contracts.py) trace
+:func:`_round_fused` hermetically and verify the exact collective
+sequence (one large merge per strategy, declared protocol spine), every
+donated WState buffer consumable, and a f64/callback/transfer-free body
+under a live-set budget.  Because :func:`_run_fused_rounds` receives the
+dispatch as a closure, the AST rules (R1/R6/R13) cannot see into this
+body — a change to the collectives or the donation structure here must
+update the contract declarations next to their reasoning, or it fails
+tests/test_jaxpr_audit.py (docs/ANALYSIS.md "Jaxpr audit layer").
 """
 
 from __future__ import annotations

@@ -85,7 +85,9 @@ class ShardedData:
     def pad_rows(self, arr: np.ndarray, fill=0.0) -> jnp.ndarray:
         pad = self.local_padded - self.num_data
         if pad:
-            arr = np.concatenate([np.asarray(arr), np.full((pad,) + np.shape(arr)[1:], fill, np.asarray(arr).dtype)])
+            a = np.asarray(arr)  # convert ONCE; metadata reads off the binding
+            arr = np.concatenate(
+                [a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
         return self._put_rows(arr)
 
     def local_rows(self, global_arr) -> np.ndarray:

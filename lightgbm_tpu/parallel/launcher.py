@@ -667,7 +667,7 @@ def train_distributed(
         we = (np.asarray(eval_weight[i], np.float64).ravel()
               if eval_weight is not None and eval_weight[i] is not None
               else None)
-        ne = np.asarray(Xe).shape[0]
+        ne = np.shape(Xe)[0]  # metadata only — no conversion (jaxlint R14)
         sl, gr, pe = _shard_plan(ne, num_machines, ge)
         name = (eval_names[i] if eval_names is not None
                 else f"valid_{i}")

@@ -293,6 +293,37 @@ class DispatchCounter(CompileCounter):
                 "see docs/ANALYSIS.md (R6)")
 
 
+def assert_ledger_agreement(stats: dict, *, collectives_per_round: int,
+                            what: str = "sharded fused rounds") -> dict:
+    """Static-auditor <-> runtime-ledger cross-check (docs/ANALYSIS.md
+    "Jaxpr audit layer").
+
+    The jaxpr auditor (analysis/jaxpr_audit.py J1) counts the collectives
+    INSIDE the traced round executable; this check confirms the runtime
+    ledger agrees they all rode the single donated dispatch: a driver
+    ``stats`` dict (the windowed grower's) must show exactly ONE dispatch
+    and ZERO blocking host syncs per round.  If a collective had leaked
+    into the host loop (R13's runtime twin — a second dispatch or an
+    eager collective), the dispatch count would exceed the round count
+    and the two ledgers would disagree.  Returns the agreement summary
+    embedded in audit verdicts; raises :class:`BudgetError` on mismatch.
+    """
+    rounds = int(stats.get("rounds", 0))
+    dispatches = int(stats.get("dispatches", -1))
+    syncs = int(stats.get("host_syncs", -1))
+    if rounds <= 0 or dispatches != rounds or syncs != 0:
+        raise BudgetError(
+            f"{what}: runtime ledger ({rounds} rounds, {dispatches} "
+            f"dispatches, {syncs} blocking syncs) cannot carry the "
+            f"audited {collectives_per_round} in-dispatch collectives "
+            "per round — a collective or a second dispatch leaked into "
+            "the host loop; see docs/ANALYSIS.md (J1/R13)")
+    return {"rounds": rounds, "dispatches": dispatches,
+            "host_syncs": syncs,
+            "collectives_per_round": collectives_per_round,
+            "in_dispatch_collectives": rounds * collectives_per_round}
+
+
 # ---------------------------------------------------------------------------
 # donation
 # ---------------------------------------------------------------------------

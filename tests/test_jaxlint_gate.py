@@ -42,7 +42,17 @@ def test_known_intentional_suppressions_are_still_needed():
 
 def test_all_rules_are_registered():
     assert {"R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8",
-            "R9", "R10", "R11", "R12", "R13"} <= set(RULES)
+            "R9", "R10", "R11", "R12", "R13", "R14"} <= set(RULES)
+
+
+def test_package_has_zero_stale_pragmas():
+    """Every suppression in the tree still earns its keep: a pragma whose
+    line no longer triggers the named rule (like the per-round R1 pragma
+    retired in round 7) must be deleted, not accumulated."""
+    report = run([PKG_DIR], strict_pragmas=True)
+    stale = [f for f in report.findings if f.rule == "P1"]
+    assert not stale, "stale pragmas (delete the retired suppressions):\n" \
+        + "\n".join(f.format() for f in stale)
 
 
 def test_cli_exit_codes():

@@ -1640,3 +1640,138 @@ def test_r13_pragma_suppression(tmp_path):
             return merged
     """}, rules=["R13"])
     assert rep.findings == []
+
+
+# ---------------------------------------------------------------------------
+# R14 metadata-via-device-pull
+# ---------------------------------------------------------------------------
+
+def test_r14_positive_asarray_shape(tmp_path):
+    """The PR-9 review class: reading a length through a whole-array
+    conversion of a (possibly jitted) output."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x).shape[0]
+    """}, rules=["R14"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "R14"
+    assert rep.findings[0].line == 5
+
+
+def test_r14_positive_len_of_asarray_and_dtype(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        def f(x, y):
+            n = len(np.asarray(x))
+            out = np.full(n, 0, dtype=np.array(y).dtype)
+            return out
+    """}, rules=["R14"])
+    assert len(rep.findings) == 2, rep.findings
+    assert sorted(f.line for f in rep.findings) == [5, 6]
+
+
+def test_r14_positive_shape_item(tmp_path):
+    """.item() on a shape entry: shape entries are already Python ints."""
+    rep = _scan(tmp_path, {"mod.py": """
+        def f(x):
+            return x.shape[0].item()
+    """}, rules=["R14"])
+    assert len(rep.findings) == 1, rep.findings
+
+
+def test_r14_negative_direct_metadata_and_bound_conversion(tmp_path):
+    """Reading .shape/.dtype directly, np.shape(), and converting ONCE
+    into a binding whose data is then used are all clean."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        def f(x):
+            n = x.shape[0]
+            m = np.shape(x)[0]
+            a = np.asarray(x)
+            return a.dtype, a[: n + m]
+    """}, rules=["R14"])
+    assert rep.findings == [], rep.findings
+
+
+def test_r14_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x).shape  # jaxlint: disable=R14 (x is a host list; conversion is how we learn the shape)
+    """}, rules=["R14"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# stale-pragma detection (P1)
+# ---------------------------------------------------------------------------
+
+def test_stale_pragma_reported_as_warning_by_default(tmp_path):
+    """A suppression whose line no longer triggers the named rule is
+    reported in Report.stale but does not fail the default run."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        def f(x):
+            return x + 1  # jaxlint: disable=R1 (retired: the pull was removed)
+    """})
+    assert rep.findings == []
+    assert len(rep.stale) == 1
+    assert rep.stale[0].rule == "P1"
+    assert "R1" in rep.stale[0].message
+
+
+def test_stale_pragma_fails_under_strict(tmp_path):
+    import textwrap
+    root = tmp_path / "fixture_pkg"
+    root.mkdir()
+    (root / "mod.py").write_text(textwrap.dedent("""
+        def f(x):
+            return x  # jaxlint: disable=R5 (retired)
+    """))
+    rep = run([root], strict_pragmas=True)
+    assert not rep.ok
+    assert any(f.rule == "P1" for f in rep.findings)
+
+
+def test_live_pragma_is_not_stale(tmp_path):
+    """A pragma that still suppresses a real finding stays untouched."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x)  # jaxlint: disable=R1 (fixture: intentional)
+    """})
+    assert rep.findings == []
+    assert rep.stale == []
+    assert len(rep.suppressed) == 1
+
+
+def test_stale_pragma_subset_run_does_not_misjudge(tmp_path):
+    """A subset run (--rules) cannot conclude staleness for unselected
+    rules: a pragma naming an unselected rule is left alone."""
+    rep = _scan(tmp_path, {"mod.py": """
+        def f(x):
+            return x  # jaxlint: disable=R5 (would be stale under a full run)
+    """}, rules=["R1"])
+    assert rep.stale == []
+
+
+def test_pragma_inside_docstring_is_ignored(tmp_path):
+    """Pragma-shaped text in a string literal is documentation, not a
+    suppression — it must neither suppress nor count as stale."""
+    rep = _scan(tmp_path, {"mod.py": '''
+        def f(x):
+            """Example: y = np.asarray(d)  # jaxlint: disable=R1 (why)"""
+            return x
+    '''})
+    assert rep.stale == []
+    assert rep.suppressed == []

@@ -1,0 +1,324 @@
+"""Tier-1 jaxpr-audit gate + per-J-rule fixtures (docs/ANALYSIS.md
+"Jaxpr audit layer").
+
+Gate half: every registered contract (analysis/contracts.py) must verify
+clean on the container CPU — the sharded fused round shows exactly the
+declared collectives (ONE large merge per strategy) on the declared mesh
+axis, every live donated buffer is consumable, zero f64 casts, zero host
+callbacks, the live-set estimate under budget — and the runtime
+DispatchCounter ledger agrees the collectives all rode the single
+per-round dispatch.  This is the static gate for the regression class
+the AST rules cannot see (the shared ``_run_fused_rounds`` driver
+dispatches through a closure, R1/R6/R13 static-limits note).
+
+Fixture half: each J rule is exercised on a deliberately broken tiny
+executable (all under 8192 rows, so windowed fixtures stay on one
+W-ladder rung), mirroring tests/test_jaxlint_rules.py's
+positive/negative/waiver pattern.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.analysis import jaxpr_audit
+from lightgbm_tpu.analysis.contracts import CONTRACTS, Contract, Target
+
+
+# ---------------------------------------------------------------------------
+# the gate: one full audit per session, asserted from every angle
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def report():
+    return jaxpr_audit.run_jaxpr_audit()
+
+
+def test_contract_catalogue_pins_the_flagships():
+    assert {
+        "windowed_round_float", "windowed_round_quantized",
+        "windowed_round_sharded_psum", "windowed_round_sharded_scatter",
+        "predict_warm_single", "predict_warm_multiclass",
+        "predict_warm_converted", "ooc_root_chunk", "ooc_split_chunk",
+    } <= set(CONTRACTS)
+
+
+def test_all_contracts_verify_clean(report):
+    assert report.ok, (
+        "jaxpr-audit findings (fix the executable or waive in "
+        "analysis/contracts.py with a reason):\n"
+        + "\n".join(f.format() for f in report.findings))
+
+
+def test_sharded_rounds_have_exactly_one_large_collective(report):
+    """The headline invariant: per merge strategy, ONE collective moves
+    histogram-sized bytes; everything else is scalar protocol traffic."""
+    for r in report.results:
+        if not r.name.startswith("windowed_round_sharded"):
+            continue
+        assert r.detail.get("large_collectives") == 1, (r.name, r.detail)
+
+
+def test_single_device_bodies_are_collective_free(report):
+    for r in report.results:
+        if r.name in ("windowed_round_float", "windowed_round_quantized",
+                      "predict_warm_single", "predict_warm_multiclass",
+                      "predict_warm_converted", "ooc_root_chunk",
+                      "ooc_split_chunk"):
+            assert r.detail.get("collectives") == [], (r.name, r.detail)
+
+
+def test_donations_all_consumable(report):
+    """J2 detail: every live donated leaf structurally matched an output
+    (and on the single-device lowering, actually carries the aliasing
+    attr — the sharded CPU lowering drops aliasing wholesale, which is
+    why the structural check is the platform-independent half)."""
+    for r in report.results:
+        live = r.detail.get("live_donated_leaves")
+        if not live:
+            continue
+        if r.name.startswith("windowed_round_sharded"):
+            continue  # aliasing attrs absent in multi-device CPU lowering
+        assert r.detail.get("aliased_in_lowering") == live, (r.name, r.detail)
+
+
+def test_ledger_crosscheck_agrees(report):
+    """The sanitizer cross-check: the tiny sharded training's runtime
+    ledger shows 1 dispatch / 0 blocking syncs per round, so every
+    audited collective rode the one donated dispatch."""
+    for merge in ("psum", "scatter"):
+        summary = report.ledger[merge]
+        assert summary["dispatches"] == summary["rounds"] > 0, summary
+        assert summary["host_syncs"] == 0, summary
+        assert summary["collectives_per_round"] == len(
+            CONTRACTS[f"windowed_round_sharded_{merge}"].collectives)
+
+
+def test_windowed_fixture_shapes_stay_on_one_rung():
+    """All audited windowed fixtures sit under 8192 rows — the floor
+    W-ladder rung — so the traced executable is the same one-rung round
+    the budget pins exercise."""
+    from lightgbm_tpu.analysis.contracts import _N, _W
+    from lightgbm_tpu.ops.treegrow_windowed import _window_size
+    assert _N < 8192
+    assert _window_size(max(_N // 2, 1), _N) == _W == 8192
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: deliberately broken executables
+# ---------------------------------------------------------------------------
+
+def _fixture_contract(name, build, *, collectives=(), donated_args=(),
+                      max_const_bytes=1 << 16, max_live_bytes=1 << 22,
+                      waivers=None):
+    return Contract(
+        name=name, description="fixture", build=build,
+        collectives=tuple(collectives), donated_args=tuple(donated_args),
+        max_const_bytes=max_const_bytes, max_live_bytes=max_live_bytes,
+        family="", spine=(0, 0), waivers=dict(waivers or {}),
+        file=__file__, line=0)
+
+
+def _loopback_shard_map(body, n_out=1):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.parallel.compat import shard_map
+    from lightgbm_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(min(4, len(jax.devices())))
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("data"),),
+        out_specs=tuple([P()] * n_out) if n_out > 1 else P(),
+        check_vma=False))
+
+
+def test_j1_two_collective_round_fails():
+    """A deliberately TWO-psum round body against a one-psum declaration:
+    the exact regression (a second in-dispatch merge) R13 cannot see
+    through the closure dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(x):  # x: (rows, bins) shard
+        h = jax.lax.psum(x, "data")            # the declared merge
+        h2 = jax.lax.psum(h * 2.0, "data")     # the smuggled second one
+        return h + h2
+
+    fn = _loopback_shard_map(body)
+    c = _fixture_contract(
+        "fixture_two_collectives",
+        lambda: Target(fn, (jax.ShapeDtypeStruct((256, 32), jnp.float32),),
+                       {}),
+        collectives=("psum@data",))
+    res = jaxpr_audit.audit_contract(c)
+    assert any(f.rule == "J1" for f in res.findings), res.findings
+    assert "sequence mismatch" in " ".join(
+        f.message for f in res.findings if f.rule == "J1")
+
+
+def test_j1_undeclared_axis_fails():
+    """A collective on an axis the mesh module never declared."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from lightgbm_tpu.parallel.compat import shard_map
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("rows",))
+    fn = jax.jit(shard_map(
+        lambda x: jax.lax.psum(x, "rows"), mesh=mesh,
+        in_specs=(P("rows"),), out_specs=P(), check_vma=False))
+    c = _fixture_contract(
+        "fixture_bad_axis",
+        lambda: Target(fn, (jax.ShapeDtypeStruct((64,), jnp.float32),), {}),
+        collectives=("psum@rows",))
+    res = jaxpr_audit.audit_contract(c)
+    assert any(f.rule == "J1" and "undeclared axis" in f.message
+               for f in res.findings), res.findings
+
+
+@pytest.mark.filterwarnings("ignore:Some donated buffers were not usable")
+def test_j2_dropped_donation_fails():
+    """A donated buffer whose aval matches no output: XLA would warn once
+    and copy forever — the audit fails it statically."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, x):
+        return jnp.sum(state) + jnp.sum(x)  # scalar out: (128,128) donor dies
+
+    c = _fixture_contract(
+        "fixture_dropped_donation",
+        lambda: Target(step, (jax.ShapeDtypeStruct((128, 128), jnp.float32),
+                              jax.ShapeDtypeStruct((128, 128), jnp.float32)),
+                       {}),
+        donated_args=(0,))
+    res = jaxpr_audit.audit_contract(c)
+    assert any(f.rule == "J2" for f in res.findings), res.findings
+
+
+def test_j2_consumed_donation_passes():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, x):
+        return state + x
+
+    c = _fixture_contract(
+        "fixture_consumed_donation",
+        lambda: Target(step, (jax.ShapeDtypeStruct((64, 64), jnp.float32),
+                              jax.ShapeDtypeStruct((64, 64), jnp.float32)),
+                       {}),
+        donated_args=(0,))
+    res = jaxpr_audit.audit_contract(c)
+    assert res.ok, res.findings
+    assert res.detail["aliased_in_lowering"] == 1
+
+
+def test_j3_f64_leak_fails():
+    """An f64 promotion inside the body (traced under x64 so the cast is
+    real, as a chip run with x64 enabled would see it)."""
+    import jax
+    import jax.numpy as jnp
+
+    with jax.experimental.enable_x64():
+        f = jax.jit(lambda x: x.astype(jnp.float64).sum())
+        c = _fixture_contract(
+            "fixture_f64_leak",
+            lambda: Target(f, (jax.ShapeDtypeStruct((64,), jnp.float32),),
+                           {}))
+        res = jaxpr_audit.audit_contract(c)
+    assert any(f_.rule == "J3" for f_ in res.findings), res.findings
+
+
+def test_j4_host_callback_fails():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct((64,), jnp.float32), x)
+        return y.sum()
+
+    c = _fixture_contract(
+        "fixture_callback",
+        lambda: Target(jax.jit(f),
+                       (jax.ShapeDtypeStruct((64,), jnp.float32),), {}))
+    res = jaxpr_audit.audit_contract(c)
+    assert any(f_.rule == "J4" for f_ in res.findings), res.findings
+
+
+def test_j5_oversized_baked_constant_fails():
+    """A closure-captured concrete array above the contract threshold:
+    baked into the trace, re-materialized every dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    table = jnp.asarray(np.random.RandomState(0).randn(4096, 8),
+                        jnp.float32)  # 128 KiB > the 64 KiB default
+
+    def f(x):
+        return x @ table.T
+
+    c = _fixture_contract(
+        "fixture_baked_constant",
+        lambda: Target(jax.jit(f),
+                       (jax.ShapeDtypeStruct((16, 8), jnp.float32),), {}))
+    res = jaxpr_audit.audit_contract(c)
+    assert any(f_.rule == "J5" and "baked constant" in f_.message
+               for f_ in res.findings), res.findings
+
+
+def test_j6_live_set_budget_fails_on_blowup():
+    """An O(L*F*B)-style intermediate blowing a tight budget."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        big = jnp.broadcast_to(x[:, None], (4096, 512)) * 2.0  # 8 MB f32
+        return big.sum()
+
+    c = _fixture_contract(
+        "fixture_live_blowup",
+        lambda: Target(jax.jit(f),
+                       (jax.ShapeDtypeStruct((4096,), jnp.float32),), {}),
+        max_live_bytes=1 << 20)
+    res = jaxpr_audit.audit_contract(c)
+    assert any(f_.rule == "J6" for f_ in res.findings), res.findings
+
+
+def test_waiver_suppresses_with_reason_and_p0_without():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        big = jnp.broadcast_to(x[:, None], (4096, 512)) * 2.0
+        return big.sum()
+
+    build = lambda: Target(  # noqa: E731
+        jax.jit(f), (jax.ShapeDtypeStruct((4096,), jnp.float32),), {})
+    waived = jaxpr_audit.audit_contract(_fixture_contract(
+        "fixture_waived", build, max_live_bytes=1 << 20,
+        waivers={"J6": "fixture: the blowup is the point"}))
+    assert waived.ok and len(waived.waived) == 1
+
+    bad = jaxpr_audit.audit_contract(_fixture_contract(
+        "fixture_bad_waiver", build, max_live_bytes=1 << 20,
+        waivers={"J6": "", "J99": "no such rule"}))
+    assert sum(1 for f in bad.findings if f.rule == "P0") == 2
+    assert any(f.rule == "J6" for f in bad.findings)  # empty reason ≠ waived
+
+
+def test_cli_jaxpr_selection_and_exit_codes():
+    from lightgbm_tpu.analysis.__main__ import main
+    assert main(["--list-contracts"]) == 0
+    assert main(["--jaxpr", "--contract", "ooc_root_chunk",
+                 "--no-runtime"]) == 0
+    assert main(["--jaxpr", "--contract", "no_such_contract"]) == 2
