@@ -182,11 +182,61 @@ def bench_ooc(n_rows=3000, n_feat=8, rounds=3):
     return n_rows * passes / dt, passes
 
 
+def bench_megakernel(n_rows=2000, n_feat=10):
+    """Round-16 smoke: the megakernel round (interpret mode) must grow
+    the BIT-identical tree to the three-pass round, and the metrics
+    snapshot must carry the megakernel keys — so an off-chip CI run
+    catches megakernel regressions in the artifact path, not just in
+    tier-1."""
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+    from lightgbm_tpu.binning import DatasetBinner
+    from lightgbm_tpu.obs import metrics as _obs
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(n_rows, n_feat)
+    y = X @ rng.randn(n_feat) + 0.2 * rng.randn(n_rows)
+    binner = DatasetBinner.fit(X, max_bin=63)
+    args = (jnp.asarray(binner.transform(X).T, jnp.int16),
+            jnp.asarray(0.6 * y, jnp.float32), jnp.ones((n_rows,), jnp.float32),
+            jnp.ones((n_rows,), bool), jnp.ones((n_rows,), jnp.float32),
+            jnp.ones((n_feat,), bool),
+            jnp.asarray(binner.num_bins_per_feature),
+            jnp.asarray(binner.missing_bin_per_feature))
+    kw = dict(num_leaves=15, num_bins=64,
+              params=SplitParams(min_data_in_leaf=5.0), leaf_tile=4,
+              use_pallas=False)
+
+    os.environ["LGBMTPU_MEGAKERNEL"] = "0"
+    t0, l0 = grow_tree_windowed(*args, **kw)
+    os.environ["LGBMTPU_MEGAKERNEL"] = "interpret"
+    try:
+        t_start = time.perf_counter()
+        t1, l1 = grow_tree_windowed(*args, **kw)
+        dt = time.perf_counter() - t_start
+    finally:
+        os.environ.pop("LGBMTPU_MEGAKERNEL", None)
+    for name in t0._fields:
+        a, b = np.asarray(getattr(t0, name)), np.asarray(getattr(t1, name))
+        assert np.array_equal(a, b), f"megakernel diverged on {name}"
+    assert np.array_equal(np.asarray(l0), np.asarray(l1))
+
+    snap = _obs.snapshot()
+    _obs.validate_snapshot(snap)
+    assert snap["counters"].get("train_megakernel_trees_total", 0) >= 1, (
+        "metrics snapshot missing the megakernel counter")
+    return int(t0.num_leaves), dt
+
+
 def main():
     n = int(os.environ.get("SMOKE_ROWS", 1_000_000))
     iters = int(os.environ.get("SMOKE_ITERS", 10))
     which = (sys.argv[1].split(",") if len(sys.argv) > 1
-             else ["rank", "multiclass", "predict", "ooc"])
+             else ["rank", "multiclass", "predict", "ooc", "megakernel"])
     if "rank" in which:
         ips = bench_rank(n, q_len=128, iters=iters)
         print(f"lambdarank {n//1000}k rows x64f q128 63bins: {ips:.2f} iters/sec", flush=True)
@@ -201,6 +251,11 @@ def main():
         rps, passes = bench_ooc()
         print(f"out_of_core 3k rows x8f: {rps:.0f} streamed rows/sec spill "
               f"({passes} hist passes, resident+spill bitwise parity)",
+              flush=True)
+    if "megakernel" in which:
+        leaves, dt = bench_megakernel()
+        print(f"megakernel 2k rows x10f: {leaves}-leaf tree bitwise == "
+              f"three-pass round ({dt:.1f}s interpret, snapshot keys ok)",
               flush=True)
 
 

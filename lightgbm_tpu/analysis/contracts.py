@@ -98,6 +98,13 @@ class Contract:
     # e.g. trains a toy model.  Cost-sensitive callers (bench.py on chip,
     # where every compile is a remote Mosaic compile) can exclude these.
     executes: bool = False
+    # J7 (hbm-sweep-bound): positional index of the bin-matrix argument
+    # and the per-round sweep budget the statically estimated bin-matrix
+    # bytes-read must stay under.  None = J7 not pinned for this contract
+    # (the sweep estimate is only meaningful at W≈N fixture shapes — see
+    # the *_sweeps contracts below).
+    bin_arg: Optional[int] = None
+    max_bin_sweeps: Optional[float] = None
 
 
 CONTRACTS: Dict[str, Contract] = {}
@@ -111,7 +118,9 @@ def contract(name: str, *, description: str,
              family: str = "",
              spine: Tuple[int, int] = (0, 0),
              waivers: Optional[Mapping[str, str]] = None,
-             executes: bool = False):
+             executes: bool = False,
+             bin_arg: Optional[int] = None,
+             max_bin_sweeps: Optional[float] = None):
     """Register a contract; the decorated function is its builder."""
 
     def deco(build: Callable[[], Target]) -> Callable[[], Target]:
@@ -125,7 +134,8 @@ def contract(name: str, *, description: str,
             max_const_bytes=max_const_bytes,
             max_live_bytes=max_live_bytes, family=family, spine=spine,
             waivers=dict(waivers or {}), file=frame.filename,
-            line=frame.lineno, executes=executes)
+            line=frame.lineno, executes=executes,
+            bin_arg=bin_arg, max_bin_sweeps=max_bin_sweeps)
         return build
 
     return deco
@@ -145,12 +155,12 @@ def _split_params():
     return SplitParams(min_data_in_leaf=5.0)
 
 
-def _round_common():
-    return dict(num_leaves=_L, num_bins=_BINS, params=_split_params(),
-                leaf_tile=_TILE)
+def _round_common(n_leaves=_L, bins=_BINS, tile=_TILE):
+    return dict(num_leaves=n_leaves, num_bins=bins, params=_split_params(),
+                leaf_tile=tile)
 
 
-def _single_state(quantize_bins: int):
+def _single_state(quantize_bins: int, n=_N, f=_F, common=None):
     """WState avals for the single-device round via eval_shape over
     ``_w_init`` — abstract, nothing executes."""
     import functools as ft
@@ -160,40 +170,44 @@ def _single_state(quantize_bins: int):
 
     from ..ops import treegrow_windowed as tw
 
-    row = lambda dt: _sds((_N,), dt)  # noqa: E731
-    pf = _sds((_F,), jnp.int32)
+    row = lambda dt: _sds((n,), dt)  # noqa: E731
+    pf = _sds((f,), jnp.int32)
     out = jax.eval_shape(
         ft.partial(tw._w_init.__wrapped__, use_pallas=False,
                    quantize_bins=quantize_bins, hist_precision="f32",
-                   stochastic_rounding=False, **_round_common()),
-        _sds((_F, _N), jnp.int16), row(jnp.float32), row(jnp.float32),
-        row(jnp.bool_), row(jnp.float32), pf, pf, _sds((_F,), jnp.bool_),
+                   stochastic_rounding=False, **(common or _round_common())),
+        _sds((f, n), jnp.int16), row(jnp.float32), row(jnp.float32),
+        row(jnp.bool_), row(jnp.float32), pf, pf, _sds((f,), jnp.bool_),
         None, None, None)
     return out[0]
 
 
-def _windowed_single_target(quantize_bins: int) -> Target:
+def _windowed_single_target(quantize_bins: int, n=_N, f=_F, tile=_TILE,
+                            megakernel: bool = False) -> Target:
     import jax.numpy as jnp
 
     from ..ops import treegrow_windowed as tw
 
-    row = lambda dt: _sds((_N,), dt)  # noqa: E731
-    pf = _sds((_F,), jnp.int32)
+    common = _round_common(tile=tile)
+    row = lambda dt: _sds((n,), dt)  # noqa: E731
+    pf = _sds((f,), jnp.int32)
     q = bool(quantize_bins)
     args = (
-        _single_state(quantize_bins), _sds((_F, _N), jnp.int16),
+        _single_state(quantize_bins, n, f, common), _sds((f, n), jnp.int16),
         row(jnp.float32), row(jnp.float32),
         row(jnp.int8) if q else None, row(jnp.int8) if q else None,
         _sds((3,), jnp.float32) if q else None,
-        row(jnp.bool_), pf, pf, _sds((_F,), jnp.bool_),
+        row(jnp.bool_), pf, pf, _sds((f,), jnp.bool_),
         None, None, None, None, None, None,
     )
     kw = dict(max_depth=-1, W=_W, use_pallas=False,
               quantize_bins=quantize_bins, hist_precision="f32",
-              **_round_common())
+              megakernel=megakernel, mk_interpret=megakernel, **common)
     return Target(tw._round_fused, args, kw,
-                  note="single-device fused round (CPU trace: XLA "
-                       "histogram fallback, Pallas off)")
+                  note=("megakernel round (interpret-mode Pallas call in "
+                        "the trace)" if megakernel else
+                        "single-device fused round (CPU trace: XLA "
+                        "histogram fallback, Pallas off)"))
 
 
 def audit_mesh():
@@ -208,7 +222,7 @@ def audit_mesh():
     return make_mesh(min(4, len(jax.devices())))
 
 
-def _windowed_sharded_target(merge: str) -> Target:
+def _windowed_sharded_target(merge: str, megakernel: bool = False) -> Target:
     import jax
     import jax.numpy as jnp
 
@@ -231,13 +245,15 @@ def _windowed_sharded_target(merge: str) -> Target:
     round_statics = tuple(sorted(dict(
         _round_common(), max_depth=-1, use_pallas=False, quantize_bins=0,
         hist_precision="f32", has_cat=False,
-        pallas_partition=False).items()))
+        pallas_partition=False, megakernel=megakernel,
+        mk_interpret=megakernel).items()))
     fn = dp._windowed_round_sharded(mesh, _W, merge, (), round_statics)
     args = (state, bt, row(jnp.float32), row(jnp.float32), row(jnp.bool_),
             pf, pf, fm)
     return Target(fn, args, {},
                   note=f"jit(shard_map) fused round, merge={merge!r}, "
-                       f"{n_dev}-device loopback mesh")
+                       f"{n_dev}-device loopback mesh"
+                       + (", megakernel round body" if megakernel else ""))
 
 
 # the sharded round's protocol spine, identical across merge variants
@@ -332,6 +348,79 @@ def _build_windowed_round_sharded_psum() -> Target:
 )
 def _build_windowed_round_sharded_scatter() -> Target:
     return _windowed_sharded_target("scatter")
+
+
+# ---------------------------------------------------------------------------
+# round megakernel (ops/round_pallas.py) + J7 sweep pins
+# ---------------------------------------------------------------------------
+# J7's sweep estimate is shape-relative (the window gather reads W
+# columns), so the sweep-pinned contracts trace at n == _W == 8192 (still
+# exactly ONE ladder rung) with f=64/tile=2 to keep the decisions-gather
+# epsilon (tile/f) small: the legacy round's three window-scale reads
+# document as 3 + tile/f ≈ 3.03, the megakernel's single kernel charge as
+# 1 + tile/f ≈ 1.03.
+
+_NS, _FS, _TILES = 8192, 64, 2  # the W=N sweep-pin fixture shape
+
+
+@contract(
+    "windowed_round_megakernel",
+    description="single-device MEGAKERNEL round (ops/round_pallas.py, "
+                "interpret-mode Pallas call in the trace): partition + "
+                "one-sweep window histogram + on-core per-feature gain "
+                "reduction in ONE kernel — collective-free, donated, and "
+                "<= 1 bin-matrix sweep (+ the tile/f decisions-gather "
+                "epsilon) by J7's static estimate",
+    collectives=(),
+    donated_args=(0,),
+    # the kernel's ref plumbing + the vmapped on-core gain planes at the
+    # 8192x64 fixture measure ≈27 MB peak-live; 64 MB headroom still
+    # catches an O(L*F*B) state duplication
+    max_live_bytes=64 << 20,
+    family="windowed_single",
+    bin_arg=1,
+    max_bin_sweeps=1.1,
+)
+def _build_windowed_round_megakernel() -> Target:
+    return _windowed_single_target(0, n=_NS, f=_FS, tile=_TILES,
+                                   megakernel=True)
+
+
+@contract(
+    "windowed_round_three_pass_sweeps",
+    description="the LEGACY three-pass round at the same W=N fixture — "
+                "J7 documents its three bin-matrix sweeps (window gather "
+                "+ transpose of the materialized copy + the histogram's "
+                "int cast, ~3 + tile/f) next to the megakernel's one; "
+                "this contract is the baseline the 3->1 claim is pinned "
+                "against",
+    collectives=(),
+    donated_args=(0,),
+    max_live_bytes=64 << 20,  # the (W, F) window copy + scatter payloads
+    family="windowed_single",
+    bin_arg=1,
+    max_bin_sweeps=3.2,
+)
+def _build_windowed_round_three_pass_sweeps() -> Target:
+    return _windowed_single_target(0, n=_NS, f=_FS, tile=_TILES)
+
+
+@contract(
+    "windowed_round_sharded_megakernel_psum",
+    description="SPMD megakernel round, merge='psum': the kernel fuses "
+                "each rank's partition + window histogram, and the round "
+                "keeps the IDENTICAL collective protocol as the three-"
+                "pass sharded round (windowed_round_sharded_psum) — the "
+                "single large in-dispatch histogram merge UNCHANGED, "
+                "pinned by J1's exact-sequence + family-spine checks",
+    collectives=_ROUND_PREFIX + ("psum@data",) + _ROUND_SUFFIX,
+    donated_args=(0,),
+    max_live_bytes=10 << 20,
+    family="windowed_sharded",
+    spine=(len(_ROUND_PREFIX), len(_ROUND_SUFFIX)),
+)
+def _build_windowed_round_sharded_megakernel_psum() -> Target:
+    return _windowed_sharded_target("psum", megakernel=True)
 
 
 # ---------------------------------------------------------------------------

@@ -68,6 +68,8 @@ JAXPR_RULES: Dict[str, str] = {
           "baked constant",
     "J6": "live-set bound — conservative peak live bytes within the "
           "contract budget",
+    "J7": "hbm-sweep-bound — statically estimated bin-matrix bytes read "
+          "per round body within the contract's sweep budget",
 }
 
 # jax collective primitives -> the spelling contracts declare
@@ -479,6 +481,124 @@ def peak_live_bytes(jaxpr) -> int:
     return peak
 
 
+# ---------------------------------------------------------------------------
+# J7: bin-matrix sweep estimate
+# ---------------------------------------------------------------------------
+
+# layout-movement primitives: reading a tracked array through these is a
+# bin-matrix read, and their matrix-scale outputs stay tracked (the
+# materialized window copy the three-pass round re-reads).  Compute
+# primitives (arithmetic, convert_element_type, the scatter itself) charge
+# their tracked-operand read but do NOT propagate: the first compute
+# consumer is the chain's final charged read — the rule that makes the
+# estimate the ROADMAP's "three passes over the bins" (gather + transpose
+# + the histogram's int cast), not a count of every downstream artifact.
+_J7_GATHER_PRIMS = {"gather", "dynamic_slice", "slice"}
+_J7_MOVE_PRIMS = {"transpose", "reshape", "copy", "squeeze", "rev",
+                  "broadcast_in_dim"}
+_J7_CALL_PRIMS = {"pjit", "closed_call", "core_call", "shard_map"}
+
+
+def _j7_sub_jaxpr(eqn):
+    import jax.core as jc
+    sub = eqn.params.get("jaxpr")
+    if isinstance(sub, jc.ClosedJaxpr):
+        return sub.jaxpr
+    return sub
+
+
+def bin_sweep_bytes(jaxpr, seed_vars, matrix_elems: int,
+                    matrix_bytes: int) -> int:
+    """Walk the jaxpr charging every read of the bin matrix or a
+    matrix-scale array derived from it by pure layout movement.
+
+    Charges: gather-family reads cost ``out_elems x src_itemsize`` (you
+    read what you fetch — a W-column window gather reads W*F elements
+    however large N is); movement/compute reads cost the tracked
+    operand's bytes; a ``pallas_call`` consuming the matrix is charged
+    exactly ONE sweep — the kernel contract (HBM-resident ``ANY`` refs,
+    per-chunk DMA, every window column fetched once) is what jaxlint R11
+    and the kernel's own parity tests verify, and the single charge is
+    what makes the FUSION count visible next to the three separate
+    charges the three-pass body accrues.  Control-flow bodies
+    (scan/while/cond) are charged one conservative operand read without
+    recursion — no audited round threads the matrix through them."""
+    jx = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+
+    def elems(v) -> int:
+        n = 1
+        for d in getattr(getattr(v, "aval", None), "shape", ()):
+            n *= int(d)
+        return n
+
+    def walk(jxp, tracked) -> int:
+        charged = 0
+        for eqn in jxp.eqns:
+            hit = [v for v in eqn.invars if _is_var(v) and v in tracked]
+            if not hit:
+                continue
+            name = eqn.primitive.name
+            if name in _J7_CALL_PRIMS:
+                sub = _j7_sub_jaxpr(eqn)
+                if sub is None:
+                    charged += sum(_aval_bytes(v.aval) for v in hit)
+                    continue
+                inner = {iv for ov, iv in zip(eqn.invars, sub.invars)
+                         if _is_var(ov) and ov in tracked}
+                charged += walk(sub, inner)
+                for sv, ov in zip(sub.outvars, eqn.outvars):
+                    if _is_var(sv) and sv in inner:
+                        tracked.add(ov)
+                continue
+            if name == "pallas_call":
+                charged += matrix_bytes  # one sweep by kernel contract
+                continue
+            if name in _J7_GATHER_PRIMS:
+                out_e = sum(elems(v) for v in eqn.outvars)
+                charged += out_e * hit[0].aval.dtype.itemsize
+            else:
+                charged += sum(_aval_bytes(v.aval) for v in hit)
+            if name in (_J7_GATHER_PRIMS | _J7_MOVE_PRIMS):
+                for v in eqn.outvars:
+                    if elems(v) >= matrix_elems:
+                        tracked.add(v)
+        return charged
+
+    return walk(jx, set(seed_vars))
+
+
+def _check_j7(c: Contract, target: Target, jaxpr
+              ) -> Tuple[List[Finding], Dict[str, object]]:
+    if c.bin_arg is None:
+        return [], {}
+    _leaves, ranges = _flat_arg_leaves(target)
+    lo, hi = ranges[c.bin_arg]
+    if hi - lo != 1:
+        return [_finding(
+            c, "J7", f"bin_arg={c.bin_arg} is not a single-leaf array arg",
+            "declare the positional index of the bin matrix itself")], {}
+    jx = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    seed = jx.invars[lo]
+    m_elems = 1
+    for d in seed.aval.shape:
+        m_elems *= int(d)
+    m_bytes = _aval_bytes(seed.aval)
+    got = bin_sweep_bytes(jaxpr, [seed], m_elems, m_bytes)
+    sweeps = got / max(m_bytes, 1)
+    findings = []
+    if c.max_bin_sweeps is not None and sweeps > c.max_bin_sweeps:
+        findings.append(_finding(
+            c, "J7",
+            f"estimated {sweeps:.2f} bin-matrix sweeps per round exceeds "
+            f"the {c.max_bin_sweeps}-sweep contract budget",
+            "a new full read of the bin matrix (or a matrix-scale copy "
+            "of it) entered the round body — the megakernel's whole "
+            "point is ONE sweep; route new bin consumers through the "
+            "kernel or raise the budget consciously (docs/ANALYSIS.md "
+            "J7)"))
+    return findings, {"bin_sweeps": round(sweeps, 3)}
+
+
 def _check_j6(c: Contract, jaxpr) -> Tuple[List[Finding], Dict[str, object]]:
     peak = peak_live_bytes(jaxpr)
     findings = []
@@ -526,6 +646,9 @@ def audit_contract(c: Contract) -> ContractResult:
     j6, d6 = _check_j6(c, jaxpr)
     raw += j6
     detail.update(d6)
+    j7, d7 = _check_j7(c, target, jaxpr)
+    raw += j7
+    detail.update(d7)
 
     # waiver hygiene first: unknown rules / missing reasons are P0 (never
     # waivable), mirroring the lint layer's pragma policy
@@ -667,6 +790,12 @@ def verdict(runtime: bool = False, exec_contracts: bool = True) -> dict:
                     for f, reason in rep.waived],
         "ledger": rep.ledger,
     }
+    # J7 sweep estimates ride the artifact next to the pass/fail rows —
+    # a chip bench row carries the 3-vs-1 bin-sweep proof explicitly
+    sweeps = {r.name: r.detail["bin_sweeps"] for r in rep.results
+              if "bin_sweeps" in r.detail}
+    if sweeps:
+        out["bin_sweeps"] = sweeps
     if skipped:
         out["skipped_exec_contracts"] = skipped
     return out

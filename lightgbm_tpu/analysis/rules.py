@@ -13,6 +13,7 @@ Scope vocabulary used below:
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterator, Optional
 
 from .core import (Finding, FuncInfo, PackageIndex, dotted_name,
@@ -1002,6 +1003,37 @@ def _r11_const_index_map(node: ast.AST) -> bool:
     return all(isinstance(e, ast.Constant) for e in elts)
 
 
+def _r11_module_int_consts(mod) -> set:
+    """Module-level ``NAME = <int literal>`` assignments — fixed tile
+    constants (``_CHUNK = 512``) that are fine in scratch shapes."""
+    out = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Constant) and isinstance(
+                node.value.value, int):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+_R11_CONST_NAME = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+
+
+def _r11_scratch_dim_ok(e: ast.AST, consts: set) -> bool:
+    """A scratch dimension is fine when it is a literal, a module-level
+    int constant, or an ALL-CAPS identifier (the config-tile convention —
+    ``_CHUNK``, ``FB``, a budget-derived feature block); a lowercase
+    name (``n``, ``n_pad``, ``rows``) is the data-sized signature."""
+    if isinstance(e, ast.Constant):
+        return True
+    name = dotted_name(e)
+    if name:
+        last = name.split(".")[-1]
+        return last in consts or bool(_R11_CONST_NAME.match(last))
+    return False
+
+
 @register_rule("R11", "whole-array-vmem-staging")
 def r11_whole_array_vmem_staging(pkg: PackageIndex) -> Iterator[Finding]:
     """A Pallas ``BlockSpec`` whose block shape carries a variable (data-
@@ -1016,7 +1048,15 @@ def r11_whole_array_vmem_staging(pkg: PackageIndex) -> Iterator[Finding]:
     (ops/partition_pallas.py v2).  Grid-blocked specs (index map uses a
     grid arg) and fixed-size tiles are the NORMAL Pallas idiom and are
     not flagged; an intentionally staged small variable-size block (an
-    O(S) per-segment table) takes a pragma with its reason."""
+    O(S) per-segment table) takes a pragma with its reason.
+
+    Round 16 (the megakernel's discipline): ``pltpu.VMEM(...)`` SCRATCH
+    allocations are held to the same standard — a scratch buffer sized
+    by a data-dependent dimension is whole-array staging by another
+    name.  Literal dims, module-level int constants (``_CHUNK``), and
+    ALL-CAPS config-tile names (a budget-derived feature block like
+    ``FB``) are the normal idiom; a lowercase data name (``n``,
+    ``n_pad``) is flagged."""
     hint = ("stage per-chunk, not per-array: give the operand "
             "memory_space=pltpu.ANY (HBM ref) and DMA fixed-size chunks "
             "into a VMEM scratch with pltpu.make_async_copy, double-"
@@ -1025,11 +1065,24 @@ def r11_whole_array_vmem_staging(pkg: PackageIndex) -> Iterator[Finding]:
     for mod in pkg.modules.values():
         if not _r11_imports_pallas(mod):
             continue
+        consts = _r11_module_int_consts(mod)
         for fi in mod.functions.values():
             for node in _own_body(fi):
                 if not isinstance(node, ast.Call):
                     continue
                 fn = dotted_name(node.func)
+                if fn and fn.split(".")[-1] == "VMEM" and node.args:
+                    shape = node.args[0]
+                    if isinstance(shape, ast.Tuple) and any(
+                            not _r11_scratch_dim_ok(e, consts)
+                            for e in shape.elts):
+                        yield _finding(
+                            fi, node, "R11",
+                            f"VMEM scratch in {fi.qualname} is sized by a "
+                            "data-dependent dimension: scratch residency "
+                            "scales with the data and the VMEM budget "
+                            "becomes a row cap", hint)
+                    continue
                 if not fn or fn.split(".")[-1] != "BlockSpec":
                     continue
                 block_shape = node.args[0] if node.args else None

@@ -801,7 +801,17 @@ class GBDT:
         windowed_growth=true until the fused round is re-benched on chip
         (docs/PERF_NOTES.md round 7).  Its v1 feature envelope excludes
         the rarer options below; anything outside falls back to the
-        full-pass rounds grower, which supports everything."""
+        full-pass rounds grower, which supports everything.
+
+        Round 16: inside the windowed envelope, the round MEGAKERNEL
+        (ops/round_pallas.py — one HBM sweep of the bin matrix per
+        round) is the default round body wherever the Pallas hot path
+        runs; the ``megakernel`` extra param / ``LGBMTPU_MEGAKERNEL``
+        env ("auto"/"1"/"interpret"/"0") select it, and configurations
+        outside ITS envelope (EFB bundles, per-node feature sampling)
+        fall back to the three-pass round loudly
+        (megakernel_envelope_fallbacks_total + a megakernel_fallback
+        event), never silently."""
         return (
             self._on_tpu
             and bool(self.cfg.extra.get("windowed_growth", False))
@@ -1356,6 +1366,7 @@ class GBDT:
                     quant_renew=bool(self.cfg.quant_train_renew_leaf),
                     merge=self._windowed_dp_merge(),
                     guard_label=f" (boosting iteration {self.iter_ + 1})",
+                    megakernel_opt=self.cfg.extra.get("megakernel"),
                 )
                 arrays, leaf_id_pad = self._localize_tree(arrays, leaf_id_pad)
                 leaf_id = leaf_id_pad[: ts.num_data()]
@@ -1454,6 +1465,7 @@ class GBDT:
                     stochastic_rounding=bool(self.cfg.stochastic_rounding),
                     quant_renew=bool(self.cfg.quant_train_renew_leaf),
                     guard_label=f" (boosting iteration {self.iter_ + 1})",
+                    megakernel_opt=self.cfg.extra.get("megakernel"),
                 )
             elif self._use_fast:
                 from ..ops.treegrow_fast import grow_tree_fast

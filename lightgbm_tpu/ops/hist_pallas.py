@@ -59,6 +59,13 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+# Bytes of VMEM accumulator headroom shared by the histogram leaf-tile
+# policy (recommended_leaf_tile below) AND the round megakernel's
+# feature-block sizing (ops/round_pallas.py::megakernel_feature_block) —
+# ONE budget so the two VMEM cost models can never drift apart.
+VMEM_ACC_BUDGET = 8_000_000
+
+
 def payload_channels(hist_precision: str, quantized: bool) -> int:
     """Payload lanes per leaf for the multi-leaf kernels: 6 for the
     bf16x2-split f32 path, 3 for rounded bf16 or int8-quantized."""
@@ -95,7 +102,7 @@ def recommended_leaf_tile(
     ncl = payload_channels(hist_precision, quantized)
     fb = min(n_features_effective if n_features_effective > 0 else 1, 128)
     fb_pad = max(_round_up(fb, 8), 8)
-    budget = 8_000_000  # bytes of VMEM accumulator headroom
+    budget = VMEM_ACC_BUDGET  # shared with the megakernel (module const)
     bpad = _round_up(max(num_bins, 8), 8)  # kernel pads B to 8
     per_leaf = fb_pad * bpad * 4 * ncl  # f32/int32 accumulator lanes
     if n_features_effective <= 128:

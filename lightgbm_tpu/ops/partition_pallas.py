@@ -35,8 +35,13 @@ lives in (ROADMAP "Uncap N").  v2 removes the cap:
   pair: the destination window is copied in, overlaid from its cursor,
   and copied back, so the fixed-size DMA's tail can never clobber
   neighbouring data (runs are cursor-contiguous; RMW makes the
-  overhang idempotent).  HBM traffic is ~4 reads + 2 writes per
-  segment chunk — segment-proportional, never O(N).
+  overhang idempotent).  Round 16: INTERIOR chunks — whose fixed-size
+  destination window provably stays inside the final run — skip the
+  read half (their transient write tail is rewritten by the next
+  chunk's window before any read); only boundary chunks, which can
+  reach a neighbouring run/segment, keep the pair.  HBM traffic on the
+  bulk of a big segment drops to ~2 reads + 2 writes per chunk —
+  segment-proportional, never O(N).
 * positions outside every segment are untouched in the raw output —
   the caller merges them back with the ``seg_id`` mask it already has
   (ops/partition.py does), same contract as v1.
@@ -51,10 +56,8 @@ is pinned in ``tests/test_partition.py`` through Mosaic INTERPRET mode —
 this container has no TPU — including a slow-marked >650k-row case that
 v1 could not reach.  The DMA constructs follow the accelerator guide's
 double-buffering pattern; on-chip the expected ceiling is the scalar
-compaction stores plus the sequential RMW DMA chain (4 serialized DMAs
-per chunk), untuned.  The RMW pairs are correctness-first: a later chip
-session can drop the read half for full interior chunks (only boundary
-chunks need it).
+compaction stores plus the serialized RMW DMA chain (4 DMAs on boundary
+chunks, 2 on interior ones since the round-16 read-half skip), untuned.
 """
 
 from __future__ import annotations
@@ -70,17 +73,19 @@ _CHUNK = 512  # rows per DMA chunk; VPU-wide for the count phase, and the
 # move phase's compaction loop stays short enough per chunk
 
 
-def _partition_kernel(seg_start_ref, seg_len_ref, order_hbm, go_hbm,
-                      out_hbm, lc_ref, obuf, gbuf, dbuf, sems):
-    """Grid (S,): one sequential step per segment.
+def emit_move_sweep(order_hbm, go_hbm, out_hbm, obuf, gbuf, dbuf, sems,
+                    start, seg_len, n_left):
+    """One segment's MOVE sweep: stream order+go chunks (double-buffered),
+    compact into the left/right runs, write back with boundary-RMW.
 
-    Scratch: ``obuf``/``gbuf`` (2, 1, _CHUNK) double-buffered input
-    chunks (order / go_left), ``dbuf`` (2, 1, _CHUNK) destination RMW
-    windows (left / right run), ``sems`` 6 DMA semaphores (order x2,
-    go x2, left dst, right dst)."""
-    s = pl.program_id(0)
-    start = seg_start_ref[s]
-    seg_len = seg_len_ref[s]
+    THE shared routine between :func:`_partition_kernel` (which computes
+    ``n_left`` with its count sweep first) and the round megakernel's
+    partition phase (ops/round_pallas.py, where ``n_left`` arrives as a
+    prefetched scalar) — one copy of the cursor/RMW logic, so a boundary
+    fix or DMA tuning can never drift between the two kernels.  Expects
+    the partition semaphore layout: ``sems[0:2]`` order chunks,
+    ``sems[2:4]`` go chunks, ``sems[4]`` left run, ``sems[5]`` right run.
+    """
     nc = pl.cdiv(seg_len, _CHUNK)
 
     def go_copy(c, slot):
@@ -93,27 +98,6 @@ def _partition_kernel(seg_start_ref, seg_len_ref, order_hbm, go_hbm,
             order_hbm.at[:, pl.ds(start + c * _CHUNK, _CHUNK)],
             obuf.at[slot], sems.at[slot])
 
-    # ---- COUNT: stream go chunks (double-buffered), masked vector sum ----
-    @pl.when(nc > 0)
-    def _warm_count():
-        go_copy(0, 0).start()
-
-    def count_body(c, acc):
-        slot = jax.lax.rem(c, 2)
-
-        @pl.when(c + 1 < nc)
-        def _prefetch():  # copy-in chunk c+1 while summing chunk c
-            go_copy(c + 1, 1 - slot).start()
-
-        go_copy(c, slot).wait()
-        m = jnp.minimum(seg_len - c * _CHUNK, _CHUNK)
-        iota = jax.lax.broadcasted_iota(jnp.int32, (1, _CHUNK), 1)
-        return acc + jnp.sum(jnp.where(iota < m, gbuf[slot], 0))
-
-    n_left = jax.lax.fori_loop(0, nc, count_body, jnp.int32(0))
-    lc_ref[0, s] = n_left
-
-    # ---- MOVE: stream order+go chunks, compact, RMW the two runs ----
     @pl.when(nc > 0)
     def _warm_move():
         order_copy(0, 0).start()
@@ -135,12 +119,21 @@ def _partition_kernel(seg_start_ref, seg_len_ref, order_hbm, go_hbm,
         # left run RMW: read the destination window, overlay this chunk's
         # left rows from the cursor, write back (the tail past the overlay
         # is restored bit-for-bit, so the fixed-size DMA cannot clobber
-        # the right run or a neighbouring segment)
-        left_rd = pltpu.make_async_copy(
-            out_hbm.at[:, pl.ds(start + lcur, _CHUNK)], dbuf.at[0],
-            sems.at[4])
-        left_rd.start()
-        left_rd.wait()
+        # the right run or a neighbouring segment).  INTERIOR chunks —
+        # whose whole fixed-size window stays inside the final left run —
+        # skip the read half (the round-12 queued follow-up): their write
+        # tail is transient garbage that the NEXT chunk's window (which
+        # starts exactly at this chunk's cursor frontier) fully rewrites
+        # before anything reads it; only a window that can escape the run
+        # (the boundary chunk) keeps the RMW pair.  Halves the serialized
+        # DMA chain on the bulk of a big segment's chunks.
+        @pl.when(lcur + _CHUNK > n_left)
+        def _left_rd():
+            left_rd = pltpu.make_async_copy(
+                out_hbm.at[:, pl.ds(start + lcur, _CHUNK)], dbuf.at[0],
+                sems.at[4])
+            left_rd.start()
+            left_rd.wait()
 
         def place_left(i, k):
             g = gbuf[slot, 0, i]
@@ -160,12 +153,17 @@ def _partition_kernel(seg_start_ref, seg_len_ref, order_hbm, go_hbm,
 
         # right run RMW (reads AFTER the left write retired: where the two
         # fixed-size windows overlap, the read sees the left run's final
-        # bytes and the overlay/tail preserves them)
-        right_rd = pltpu.make_async_copy(
-            out_hbm.at[:, pl.ds(start + n_left + rcur, _CHUNK)], dbuf.at[1],
-            sems.at[5])
-        right_rd.start()
-        right_rd.wait()
+        # bytes and the overlay/tail preserves them).  Same interior-chunk
+        # skip, relative to the segment end: only the right window that
+        # can reach past the segment (into a neighbour or untouched
+        # positions) pays the read.
+        @pl.when(n_left + rcur + _CHUNK > seg_len)
+        def _right_rd():
+            right_rd = pltpu.make_async_copy(
+                out_hbm.at[:, pl.ds(start + n_left + rcur, _CHUNK)],
+                dbuf.at[1], sems.at[5])
+            right_rd.start()
+            right_rd.wait()
 
         def place_right(i, k):
             g = gbuf[slot, 0, i]
@@ -185,6 +183,49 @@ def _partition_kernel(seg_start_ref, seg_len_ref, order_hbm, go_hbm,
         return (lcur + m_left, rcur + m_right)
 
     jax.lax.fori_loop(0, nc, move_body, (jnp.int32(0), jnp.int32(0)))
+
+
+def _partition_kernel(seg_start_ref, seg_len_ref, order_hbm, go_hbm,
+                      out_hbm, lc_ref, obuf, gbuf, dbuf, sems):
+    """Grid (S,): one sequential step per segment.
+
+    Scratch: ``obuf``/``gbuf`` (2, 1, _CHUNK) double-buffered input
+    chunks (order / go_left), ``dbuf`` (2, 1, _CHUNK) destination RMW
+    windows (left / right run), ``sems`` 6 DMA semaphores (order x2,
+    go x2, left dst, right dst)."""
+    s = pl.program_id(0)
+    start = seg_start_ref[s]
+    seg_len = seg_len_ref[s]
+    nc = pl.cdiv(seg_len, _CHUNK)
+
+    def go_copy(c, slot):
+        return pltpu.make_async_copy(
+            go_hbm.at[:, pl.ds(start + c * _CHUNK, _CHUNK)],
+            gbuf.at[slot], sems.at[2 + slot])
+
+    # ---- COUNT: stream go chunks (double-buffered), masked vector sum ----
+    @pl.when(nc > 0)
+    def _warm_count():
+        go_copy(0, 0).start()
+
+    def count_body(c, acc):
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < nc)
+        def _prefetch():  # copy-in chunk c+1 while summing chunk c
+            go_copy(c + 1, 1 - slot).start()
+
+        go_copy(c, slot).wait()
+        m = jnp.minimum(seg_len - c * _CHUNK, _CHUNK)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, _CHUNK), 1)
+        return acc + jnp.sum(jnp.where(iota < m, gbuf[slot], 0))
+
+    n_left = jax.lax.fori_loop(0, nc, count_body, jnp.int32(0))
+    lc_ref[0, s] = n_left
+
+    # ---- MOVE: the shared sweep (emit_move_sweep) ----
+    emit_move_sweep(order_hbm, go_hbm, out_hbm, obuf, gbuf, dbuf, sems,
+                    start, seg_len, n_left)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

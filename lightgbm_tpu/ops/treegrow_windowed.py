@@ -97,7 +97,8 @@ from ..utils.guards import NonFiniteError
 from .histogram import (histogram, histogram_multi,
                         histogram_multi_quantized, unbundle_hists)
 from .partition import partition_rows
-from .split import BestSplit, SplitParams, leaf_output, KMIN_SCORE
+from .split import (BestSplit, SplitParams, leaf_output, KMIN_SCORE,
+                    select_from_feature_best)
 from .treegrow import TreeArrays, _empty_best, _set_best
 from .treegrow_fast import _batched_best
 
@@ -212,7 +213,7 @@ def _merge_best(bb: BestSplit, axis_name, f0) -> BestSplit:
     static_argnames=("num_leaves", "num_bins", "max_depth", "params",
                      "leaf_tile", "W", "use_pallas", "quantize_bins",
                      "hist_precision", "has_cat", "pallas_partition",
-                     "axis_name", "merge"),
+                     "axis_name", "merge", "megakernel", "mk_interpret"),
     donate_argnums=(0,),  # the 1.5 GB-at-Epsilon hist state threads
     # linearly through the host round loop; donation lets XLA update it in
     # place instead of alloc+copy per call (benchmarks/probe_r5_fixed.py)
@@ -249,6 +250,8 @@ def _round_fused(
     pallas_partition: bool = False,
     axis_name: Optional[str] = None,
     merge: str = "psum",
+    megakernel: bool = False,
+    mk_interpret: bool = False,
 ):
     """One whole boosting round in one traced body: gain admission,
     segment partition, bookkeeping, window gather, multi-leaf pass,
@@ -379,25 +382,138 @@ def _round_fused(
     seg_len_eff = jnp.where(ok, seg_len, 0)
     n_left_seg = jnp.where(live_rk, left_counts, 0)
 
-    # ---- partition the physical row order at segment boundaries ----
-    new_order, _ = partition_rows(
-        ord_rows, seg_id, seg_start, seg_len_eff, go_left,
-        use_pallas=pallas_partition)
+    # ---- order-independent bookkeeping (leaf stats, slot maps, leaf
+    # ranges, this round's windows), hoisted AHEAD of the partition: the
+    # megakernel consumes the window geometry and — single-device — the
+    # candidate stats inside the SAME kernel that partitions the rows.
+    # Pure statement reordering for the legacy path (same value graph).
+    right_pos = jnp.where(accept, right_of, 2 * L)
 
-    # ---- leaf ranges + per-row leaf ids ----
+    def upd(arr, left_val, right_val):
+        arr = jnp.where(accept, left_val, arr)
+        return arr.at[right_pos].set(right_val, mode="drop")
+
+    leaf_sum_g = upd(state.leaf_sum_g, s.left_sum_g, s.right_sum_g)
+    leaf_sum_h = upd(state.leaf_sum_h, s.left_sum_h, s.right_sum_h)
+    leaf_count = upd(state.leaf_count, s.left_count, s.right_count)
+    depth_child = state.leaf_depth + 1
+    leaf_depth = jnp.where(accept, depth_child, state.leaf_depth)
+    leaf_depth = leaf_depth.at[right_pos].set(depth_child, mode="drop")
+    leaf_parent = jnp.where(accept, node_of, state.leaf_parent)
+    leaf_parent = leaf_parent.at[right_pos].set(
+        jnp.where(accept, node_of, 0), mode="drop")
+    leaf_side = jnp.where(accept, 0, state.leaf_side)
+    leaf_side = leaf_side.at[right_pos].set(1, mode="drop")
+    out_l = leaf_output(s.left_sum_g, s.left_sum_h, params)
+    out_r = leaf_output(s.right_sum_g, s.right_sum_h, params)
+    leaf_out = jnp.where(accept, out_l, state.leaf_out)
+    leaf_out = leaf_out.at[right_pos].set(out_r, mode="drop")
+    num_leaves_new = state.num_leaves_cur + k_acc
+
+    # per-slot child maps stay LOCAL to the fused body (rounds 1-6 carried
+    # them in WState to hand admit's result to the separate pass dispatch;
+    # the fusion is what lets them die here).
+    # The window child is chosen by PHYSICAL row counts — the same
+    # quantity the gather pays for, the `ok` check verified against W,
+    # and the whint bound promises about (rounds 1-6 chose by in-bag
+    # counts, which under bagging can pick the physically BIGGER child
+    # and desynchronize the window sum from the verified total; which
+    # child is histogrammed directly vs recovered by subtraction does
+    # not change the children's histograms).  Under SPMD the choice is
+    # by GLOBAL counts (left_small above) so every rank windows the same
+    # child and the collective merge sums one child's rows.
+    left_smaller_rk = left_small  # (tile,) per slot, rank-consistent
+    fresh = jnp.where(accept, True, jnp.zeros((L,), bool))
+    fresh = fresh.at[right_pos].set(True, mode="drop")
+    pos_r = jnp.where(accept, acc_rank, leaf_tile)
+    slot_left = jnp.full((leaf_tile,), -1, jnp.int32).at[pos_r].set(
+        idx, mode="drop")
+    slot_right = jnp.full((leaf_tile,), -1, jnp.int32).at[pos_r].set(
+        right_of, mode="drop")
+    slot_small_left = live_rk & left_smaller_rk  # slot r == rank r
+
+    # leaf ranges (the order-independent half of the range bookkeeping;
+    # the per-row leaf ids need the partitioned order and follow it)
     leaf_start, leaf_cnt = state.leaf_start, state.leaf_cnt
-    lid_pos = state.leaf_id[new_order]  # leaf per POSITION (pre-split)
     for r in range(leaf_tile):
         leaf_r = srt[r]
         live_r = accept[leaf_r]
-        st = state.leaf_start[leaf_r]
+        st, ct = state.leaf_start[leaf_r], state.leaf_cnt[leaf_r]
         lc = n_left_seg[r]
-        ct = state.leaf_cnt[leaf_r]
         rp = jnp.clip(right_of[leaf_r], 0, L - 1)
         leaf_start = jnp.where(
             live_r, leaf_start.at[rp].set(st + lc), leaf_start)
         leaf_cnt = jnp.where(
             live_r, leaf_cnt.at[leaf_r].set(lc).at[rp].set(ct - lc), leaf_cnt)
+
+    # windows: per admission rank, the SMALL child's [start, cnt)
+    win_start = jnp.zeros((leaf_tile,), jnp.int32)
+    win_cnt = jnp.zeros((leaf_tile,), jnp.int32)
+    for r in range(leaf_tile):
+        leaf_r = srt[r]
+        live_r = accept[leaf_r]
+        sm = jnp.where(left_smaller_rk[r], leaf_r,
+                       jnp.clip(right_of[leaf_r], 0, L - 1))
+        win_start = win_start.at[r].set(jnp.where(live_r, leaf_start[sm], 0))
+        win_cnt = win_cnt.at[r].set(jnp.where(live_r, leaf_cnt[sm], 0))
+
+    # candidate slot maps (shared by the sibling recovery below and the
+    # megakernel's fused tail)
+    active = slot_left >= 0  # (tile,)
+    sl = jnp.clip(slot_left, 0, L - 1)
+    sr = jnp.clip(slot_right, 0, L - 1)
+    parent_hists = state.hist[sl]  # (tile, 3, F, B)
+    cand = jnp.concatenate([sl, sr])
+    cand_ok = jnp.concatenate([active, active])
+    ci = jnp.where(cand_ok, cand, 0)
+
+    # ---- partition the physical row order at segment boundaries ----
+    mk_tail = megakernel and axis_name is None
+    if megakernel:
+        # THE round megakernel (ops/round_pallas.py): partition movements,
+        # the one-sweep window histogram, and (single-device) the on-core
+        # split-gain reduction, all in ONE Pallas call.  Same raw-order
+        # contract as the partition kernel: merge untouched positions
+        # back.  Under SPMD the kernel stops after the histograms so the
+        # single in-dispatch collective merge below stays UNCHANGED.
+        from .round_pallas import round_megakernel
+
+        if efb_bins_t is not None or rng_key is not None:
+            raise ValueError(
+                "megakernel round outside its envelope (EFB bundles / "
+                "per-node rng) — the entry gate must fall back to the "
+                "three-pass round")
+        cand_tab = (jnp.stack([
+            leaf_sum_g[ci], leaf_sum_h[ci], leaf_count[ci],
+            leaf_depth[ci].astype(jnp.float32), leaf_out[ci]])
+            if mk_tail else None)
+        mk_out = round_megakernel(
+            bins_t, ord_rows, go_left, grad, hess, row_mask,
+            seg_start, seg_len_eff, n_left_seg, win_start, win_cnt,
+            slot_small_left.astype(jnp.int32),
+            parent_hists if mk_tail else None,
+            cand_tab,
+            num_bins_pf if mk_tail else None,
+            missing_bin_pf if mk_tail else None,
+            feature_mask if mk_tail else None,
+            categorical_mask if mk_tail else None,
+            feature_contri if mk_tail else None,
+            num_bins=num_bins, leaf_tile=leaf_tile, params=params,
+            fuse_tail=mk_tail, has_cat=has_cat, interpret=mk_interpret)
+        new_order = jnp.where(seg_id >= 0, mk_out[0], ord_rows)
+    else:
+        mk_out = None
+        new_order, _ = partition_rows(
+            ord_rows, seg_id, seg_start, seg_len_eff, go_left,
+            use_pallas=pallas_partition)
+
+    # ---- per-row leaf ids (needs the partitioned order) ----
+    lid_pos = state.leaf_id[new_order]  # leaf per POSITION (pre-split)
+    for r in range(leaf_tile):
+        leaf_r = srt[r]
+        live_r = accept[leaf_r]
+        st, ct = state.leaf_start[leaf_r], state.leaf_cnt[leaf_r]
+        lc = n_left_seg[r]
         in_right = live_r & (pos >= st + lc) & (pos < st + ct)
         lid_pos = jnp.where(in_right, right_of[leaf_r], lid_pos)
     leaf_id = jnp.zeros_like(state.leaf_id).at[new_order].set(lid_pos)
@@ -431,142 +547,99 @@ def _round_fused(
         cat_mask=t.cat_mask.at[node_pos].set(s.cat_mask, mode="drop"),
     )
 
-    right_pos = jnp.where(accept, right_of, 2 * L)
-
-    def upd(arr, left_val, right_val):
-        arr = jnp.where(accept, left_val, arr)
-        return arr.at[right_pos].set(right_val, mode="drop")
-
-    leaf_sum_g = upd(state.leaf_sum_g, s.left_sum_g, s.right_sum_g)
-    leaf_sum_h = upd(state.leaf_sum_h, s.left_sum_h, s.right_sum_h)
-    leaf_count = upd(state.leaf_count, s.left_count, s.right_count)
-    depth_child = state.leaf_depth + 1
-    leaf_depth = jnp.where(accept, depth_child, state.leaf_depth)
-    leaf_depth = leaf_depth.at[right_pos].set(depth_child, mode="drop")
-    leaf_parent = jnp.where(accept, node_of, state.leaf_parent)
-    leaf_parent = leaf_parent.at[right_pos].set(
-        jnp.where(accept, node_of, 0), mode="drop")
-    leaf_side = jnp.where(accept, 0, state.leaf_side)
-    leaf_side = leaf_side.at[right_pos].set(1, mode="drop")
-    out_l = leaf_output(s.left_sum_g, s.left_sum_h, params)
-    out_r = leaf_output(s.right_sum_g, s.right_sum_h, params)
-    leaf_out = jnp.where(accept, out_l, state.leaf_out)
-    leaf_out = leaf_out.at[right_pos].set(out_r, mode="drop")
-    num_leaves_new = state.num_leaves_cur + k_acc
-
-    # ---- fresh/small bookkeeping + this round's windows ----
-    # per-slot child maps stay LOCAL to the fused body (rounds 1-6 carried
-    # them in WState to hand admit's result to the separate pass dispatch;
-    # the fusion is what lets them die here).
-    # The window child is chosen by PHYSICAL row counts — the same
-    # quantity the gather pays for, the `ok` check verified against W,
-    # and the whint bound promises about (rounds 1-6 chose by in-bag
-    # counts, which under bagging can pick the physically BIGGER child
-    # and desynchronize the window sum from the verified total; which
-    # child is histogrammed directly vs recovered by subtraction does
-    # not change the children's histograms).  Under SPMD the choice is
-    # by GLOBAL counts (left_small above) so every rank windows the same
-    # child and the collective merge sums one child's rows.
-    left_smaller_rk = left_small  # (tile,) per slot, rank-consistent
-    fresh = jnp.where(accept, True, jnp.zeros((L,), bool))
-    fresh = fresh.at[right_pos].set(True, mode="drop")
-    pos_r = jnp.where(accept, acc_rank, leaf_tile)
-    slot_left = jnp.full((leaf_tile,), -1, jnp.int32).at[pos_r].set(
-        idx, mode="drop")
-    slot_right = jnp.full((leaf_tile,), -1, jnp.int32).at[pos_r].set(
-        right_of, mode="drop")
-    slot_small_left = live_rk & left_smaller_rk  # slot r == rank r
-
-    # windows: per admission rank, the SMALL child's [start, cnt)
-    win_start = jnp.zeros((leaf_tile,), jnp.int32)
-    win_cnt = jnp.zeros((leaf_tile,), jnp.int32)
-    for r in range(leaf_tile):
-        leaf_r = srt[r]
-        live_r = accept[leaf_r]
-        sm = jnp.where(left_smaller_rk[r], leaf_r,
-                       jnp.clip(right_of[leaf_r], 0, L - 1))
-        win_start = win_start.at[r].set(jnp.where(live_r, leaf_start[sm], 0))
-        win_cnt = win_cnt.at[r].set(jnp.where(live_r, leaf_cnt[sm], 0))
-
     best = state.best._replace(
         gain=jnp.where(fresh, jnp.full((L,), KMIN_SCORE, jnp.float32),
                        state.best.gain))
 
-    # ---- pass: window gather -> one multi-leaf pass -> sibling
-    # subtraction -> fresh-leaf split search (same trace, no dispatch) ----
-    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                            jnp.cumsum(win_cnt).astype(jnp.int32)])
-    w_total = offs[-1]
-    aw = jnp.arange(W, dtype=jnp.int32)
-    # slot per window element: number of window boundaries <= position
-    slot_of = jnp.sum((aw[:, None] >= offs[1:][None, :]).astype(jnp.int32),
-                      axis=1)
-    slot_of = jnp.clip(slot_of, 0, leaf_tile - 1)
-    wpos = win_start[slot_of] + (aw - offs[slot_of])
-    valid = aw < w_total
-    wpos = jnp.where(valid, wpos, 0)
-    rows = new_order[wpos]  # (W,) row ids
-
-    # feature-major window gather (a row gather on the (N, F) layout
-    # measured ~909 ms at 1M x 28; column slices of (F, N) are ~20x
-    # cheaper), then ONE contiguous transpose for the row-major kernel —
-    # a lane->sublane reshape per feature inside a feature-major kernel
-    # blew the 16M scoped-VMEM budget (measured 19.6M)
-    hist_src = bins_t if efb_bins_t is None else efb_bins_t
-    sub_bins = hist_src[:, rows].T  # (W, F) or (W, F_b)
-    mask_w = row_mask[rows] & valid
-
-    def unbundle(h):
-        if efb_gather is None:
-            return h
-        return unbundle_hists(h, efb_gather, efb_default, f, num_bins)
-
-    if quantize_bins and use_pallas:
-        hi = histogram_multi_quantized(
-            sub_bins, gq[rows], hq[rows], mask_w, slot_of, 0, leaf_tile,
-            num_bins)
-        fresh_hists = unbundle(hi).astype(jnp.float32) * quant_scale[:, None, None]
-    elif use_pallas:
-        fresh_hists = unbundle(histogram_multi(
-            sub_bins, grad[rows], hess[rows], mask_w, slot_of, 0, leaf_tile,
-            num_bins, precision=hist_precision))
+    # ---- pass: window histograms -> sibling subtraction -> fresh-leaf
+    # split search (same trace, no dispatch).  Three sources for the
+    # child histograms: the megakernel's fused tail (everything already
+    # computed in-kernel), the megakernel's histogram-only output (the
+    # SPMD case: the collective merge below must stay the round's single
+    # large in-dispatch collective), or the legacy gather + multi-leaf
+    # pass (three bin sweeps — docs/PERF_NOTES.md round 16).
+    mk_bests = None
+    if megakernel and mk_tail:
+        _, left_hists, right_hists, mk_bests = mk_out
     else:
-        # CPU/test fallback: masked scatter per slot over the window
-        g_w, h_w = grad[rows], hess[rows]
-
-        def one(sl):
-            m = (mask_w & (slot_of == sl)).astype(jnp.float32)
-            return histogram(sub_bins, g_w, h_w, m, num_bins,
-                             strategy="scatter")
-        fresh_hists = unbundle(
-            jax.vmap(one)(jnp.arange(leaf_tile, dtype=jnp.int32)))
-
-    # ---- in-dispatch cross-rank histogram merge (the tentpole) ----
-    # each rank histogrammed ONLY its local shard of the window; the merge
-    # is one collective INSIDE the already-donated dispatch — no host-loop
-    # collective, no second dispatch (reference: DataParallelTreeLearner's
-    # per-split ReduceScatter, paid here once per ROUND).  "psum" leaves
-    # every rank with the global (tile, 3, F, B) block; "scatter" leaves
-    # each rank the global block for its OWNED F/R feature slice only
-    # (half the merge bytes, split search parallelized over F).
-    if axis_name is not None:
-        if merge == "scatter":
-            fresh_hists = jax.lax.psum_scatter(
-                fresh_hists, axis_name, scatter_dimension=2, tiled=True)
+        if megakernel:
+            fresh_hists = mk_out[1]
         else:
-            fresh_hists = jax.lax.psum(fresh_hists, axis_name)
+            offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                    jnp.cumsum(win_cnt).astype(jnp.int32)])
+            w_total = offs[-1]
+            aw = jnp.arange(W, dtype=jnp.int32)
+            # slot per window element: number of boundaries <= position
+            slot_of = jnp.sum(
+                (aw[:, None] >= offs[1:][None, :]).astype(jnp.int32), axis=1)
+            slot_of = jnp.clip(slot_of, 0, leaf_tile - 1)
+            wpos = win_start[slot_of] + (aw - offs[slot_of])
+            valid = aw < w_total
+            wpos = jnp.where(valid, wpos, 0)
+            rows = new_order[wpos]  # (W,) row ids
 
-    # COMPACT sibling recovery (round 5, mirrors treegrow_fast): gather the
-    # <= tile parent hists from the left-child slots, subtract, scatter
-    # both children once — O(tile) state traffic instead of full-(L,...)
-    active = slot_left >= 0  # (tile,)
-    sl = jnp.clip(slot_left, 0, L - 1)
-    sr = jnp.clip(slot_right, 0, L - 1)
-    parent_hists = state.hist[sl]  # (tile, 3, F, B)
-    big_hists = parent_hists - fresh_hists
-    sml = slot_small_left[:, None, None, None]
-    left_hists = jnp.where(sml, fresh_hists, big_hists)
-    right_hists = jnp.where(sml, big_hists, fresh_hists)
+            # feature-major window gather (a row gather on the (N, F)
+            # layout measured ~909 ms at 1M x 28; column slices of (F, N)
+            # are ~20x cheaper), then ONE contiguous transpose for the
+            # row-major kernel — a lane->sublane reshape per feature
+            # inside a feature-major kernel blew the 16M scoped-VMEM
+            # budget (measured 19.6M)
+            hist_src = bins_t if efb_bins_t is None else efb_bins_t
+            sub_bins = hist_src[:, rows].T  # (W, F) or (W, F_b)
+            mask_w = row_mask[rows] & valid
+
+            def unbundle(h):
+                if efb_gather is None:
+                    return h
+                return unbundle_hists(h, efb_gather, efb_default, f,
+                                      num_bins)
+
+            if quantize_bins and use_pallas:
+                hi = histogram_multi_quantized(
+                    sub_bins, gq[rows], hq[rows], mask_w, slot_of, 0,
+                    leaf_tile, num_bins)
+                fresh_hists = unbundle(hi).astype(
+                    jnp.float32) * quant_scale[:, None, None]
+            elif use_pallas:
+                fresh_hists = unbundle(histogram_multi(
+                    sub_bins, grad[rows], hess[rows], mask_w, slot_of, 0,
+                    leaf_tile, num_bins, precision=hist_precision))
+            else:
+                # CPU/test fallback: masked scatter per slot over the window
+                g_w, h_w = grad[rows], hess[rows]
+
+                def one(sl_):
+                    m = (mask_w & (slot_of == sl_)).astype(jnp.float32)
+                    return histogram(sub_bins, g_w, h_w, m, num_bins,
+                                     strategy="scatter")
+                fresh_hists = unbundle(
+                    jax.vmap(one)(jnp.arange(leaf_tile, dtype=jnp.int32)))
+
+        # ---- in-dispatch cross-rank histogram merge ----
+        # each rank histogrammed ONLY its local shard of the window; the
+        # merge is one collective INSIDE the already-donated dispatch — no
+        # host-loop collective, no second dispatch (reference:
+        # DataParallelTreeLearner's per-split ReduceScatter, paid here
+        # once per ROUND).  "psum" leaves every rank with the global
+        # (tile, 3, F, B) block; "scatter" leaves each rank the global
+        # block for its OWNED F/R feature slice only (half the merge
+        # bytes, split search parallelized over F).  The megakernel path
+        # feeds its local histograms through this SAME merge unchanged.
+        if axis_name is not None:
+            if merge == "scatter":
+                fresh_hists = jax.lax.psum_scatter(
+                    fresh_hists, axis_name, scatter_dimension=2, tiled=True)
+            else:
+                fresh_hists = jax.lax.psum(fresh_hists, axis_name)
+
+        # COMPACT sibling recovery (round 5, mirrors treegrow_fast):
+        # gather the <= tile parent hists from the left-child slots,
+        # subtract, scatter both children once — O(tile) state traffic
+        big_hists = parent_hists - fresh_hists
+        sml = slot_small_left[:, None, None, None]
+        left_hists = jnp.where(sml, fresh_hists, big_hists)
+        right_hists = jnp.where(sml, big_hists, fresh_hists)
+
     lpos = jnp.where(active, sl, 2 * L)
     rpos = jnp.where(active, sr, 2 * L)
     hist = state.hist.at[lpos].set(left_hists, mode="drop").at[rpos].set(
@@ -574,26 +647,36 @@ def _round_fused(
 
     # fresh-leaf split search directly on the compact child hists; under
     # merge="scatter" each rank searches its owned feature block and the
-    # winner is elected + broadcast in-dispatch (_merge_best)
+    # winner is elected + broadcast in-dispatch (_merge_best).  With the
+    # megakernel tail the per-feature reduction already happened ON-CORE
+    # (ops/split.py::reduce_plane_per_feature inside the kernel); only
+    # the O(F) cross-feature selection runs here.
     node_ids = jnp.clip(leaf_parent, 0, None) * 2 + leaf_side + 1
-    cand = jnp.concatenate([sl, sr])
-    cand_ok = jnp.concatenate([active, active])
     cand_hists = jnp.concatenate([left_hists, right_hists], axis=0)
-    ci = jnp.where(cand_ok, cand, 0)
-    nb_l, mb_l, fm_l, cm_l, fc_l, f0 = _split_tables(
-        axis_name, merge, state.hist.shape[2], num_bins_pf, missing_bin_pf,
-        feature_mask, categorical_mask, feature_contri)
-    bb = _batched_best(
-        cand_hists, leaf_sum_g[ci], leaf_sum_h[ci],
-        leaf_count[ci], nb_l, mb_l, params,
-        fm_l, cm_l, None, None,
-        jnp.full((2 * leaf_tile,), -jnp.inf, jnp.float32),
-        jnp.full((2 * leaf_tile,), jnp.inf, jnp.float32),
-        None, node_ids[ci], rng_key,
-        depth=leaf_depth[ci], parent_out=leaf_out[ci],
-        feature_contri=fc_l,
-    )
-    bb = _merge_best(bb, axis_name, f0)
+    if mk_bests is not None:
+        def _sel(fbx, ch, pg, ph, pc):
+            return select_from_feature_best(
+                fbx, pg, ph, pc, categorical_mask=categorical_mask,
+                cand_hist=ch, missing_bin_per_feature=missing_bin_pf,
+                params=params, num_bins=num_bins)
+
+        bb = jax.vmap(_sel)(mk_bests, cand_hists, leaf_sum_g[ci],
+                            leaf_sum_h[ci], leaf_count[ci])
+    else:
+        nb_l, mb_l, fm_l, cm_l, fc_l, f0 = _split_tables(
+            axis_name, merge, state.hist.shape[2], num_bins_pf,
+            missing_bin_pf, feature_mask, categorical_mask, feature_contri)
+        bb = _batched_best(
+            cand_hists, leaf_sum_g[ci], leaf_sum_h[ci],
+            leaf_count[ci], nb_l, mb_l, params,
+            fm_l, cm_l, None, None,
+            jnp.full((2 * leaf_tile,), -jnp.inf, jnp.float32),
+            jnp.full((2 * leaf_tile,), jnp.inf, jnp.float32),
+            None, node_ids[ci], rng_key,
+            depth=leaf_depth[ci], parent_out=leaf_out[ci],
+            feature_contri=fc_l,
+        )
+        bb = _merge_best(bb, axis_name, f0)
     scatter_pos = jnp.where(cand_ok, cand, 2 * L)
 
     def merge(old, new):
@@ -872,6 +955,8 @@ def _grow_windowed_impl(
     quant_renew: bool = False,
     stats: Optional[dict] = None,
     guard_label: str = "",
+    megakernel: bool = False,
+    mk_interpret: bool = False,
 ) -> tuple[TreeArrays, jnp.ndarray]:
     """Host-driven windowed growth; returns (tree, leaf_id per row).
 
@@ -898,6 +983,10 @@ def _grow_windowed_impl(
     pallas_partition = use_pallas and (
         os.environ.get("LGBMTPU_PARTITION_PALLAS", "1") != "0") and (
         _degrade.available(_degrade.PARTITION))
+    if megakernel and _obs.enabled():
+        # host-side static — zero extra dispatches/syncs (the budget pin
+        # in tests/test_retrace.py runs with the megakernel ON)
+        _obs.counter("train_megakernel_trees_total").inc()
 
     def round_fn(st, W):
         st, info = _round_fused(
@@ -908,7 +997,8 @@ def _grow_windowed_impl(
             max_depth=max_depth, W=W, use_pallas=use_pallas,
             quantize_bins=quantize_bins, hist_precision=hist_precision,
             has_cat=categorical_mask is not None,
-            pallas_partition=pallas_partition, **common)
+            pallas_partition=pallas_partition, megakernel=megakernel,
+            mk_interpret=mk_interpret, **common)
         return st, info
 
     # round 1 needs no feedback: a round's window (the small children)
@@ -1116,7 +1206,61 @@ def _run_fused_rounds(round_fn, state, *, n_ladder: int, w_first: int,
     return state
 
 
-def grow_tree_windowed(*args, use_pallas: bool = True, **kwargs):
+def megakernel_mode(use_pallas_eff: bool, *, rng_key=None, efb_bins_t=None,
+                    quantize_bins: int = 0, mode: Optional[str] = None,
+                    loud: bool = True) -> tuple[bool, bool]:
+    """The round-megakernel gate, shared by the single-device entry below
+    and the SPMD entry (parallel/data_parallel.py): returns
+    ``(megakernel, mk_interpret)`` statics for :func:`_round_fused`.
+
+    ``mode`` (the Booster's ``megakernel`` extra param, models/gbdt.py)
+    overrides ``LGBMTPU_MEGAKERNEL``; both select: ``auto`` (default —
+    ON wherever the Pallas hot path runs), ``1`` (forced ON),
+    ``interpret`` (ON through the Mosaic interpreter — the off-chip
+    correctness harness, which IGNORES the degradation registry exactly
+    like the partition kernel's interpret path: a degraded process must
+    re-run the kernel and surface, never silently grow three-pass
+    trees), ``0`` (OFF).
+
+    The megakernel envelope excludes EFB bundles, per-node feature
+    sampling (the rng-keyed scan cannot run on-core), and — on the
+    Pallas hot path — int8-quantized training: the three-pass round
+    accumulates quantized histograms exactly on the int8 MXU while the
+    committed megakernel folds the DEQUANTIZED f32 values (bitwise with
+    the XLA round, NOT with the int8 kernel), so until the int8 MXU
+    accumulate variant lands (docs/NEXT.md) a quantized+Pallas config
+    must not silently change numerics.  Every excluded-but-requested
+    configuration falls back to the three-pass round LOUDLY — counter +
+    event, never a silent divergence — exactly like the degradation
+    registry's kernel-failure fallback."""
+    if mode is None:
+        mode = os.environ.get("LGBMTPU_MEGAKERNEL", "auto")
+    mode = str(mode).lower()
+    if mode in ("0", "off"):
+        return False, False
+    if mode != "interpret" and not _degrade.available(_degrade.ROUND):
+        return False, False
+    requested = mode in ("1", "interpret") or (mode == "auto"
+                                               and use_pallas_eff)
+    if not requested:
+        return False, False
+    reason = None
+    if efb_bins_t is not None:
+        reason = "efb"
+    elif rng_key is not None:
+        reason = "node_rng"
+    elif quantize_bins and use_pallas_eff:
+        reason = "quantized_mxu"
+    if reason is not None:
+        if loud:
+            _obs.counter("megakernel_envelope_fallbacks_total").inc()
+            _obs.event("megakernel_fallback", reason=reason)
+        return False, False
+    return True, mode == "interpret"
+
+
+def grow_tree_windowed(*args, use_pallas: bool = True,
+                       megakernel_opt: Optional[str] = None, **kwargs):
     """Public entry: :func:`_grow_windowed_impl` behind the graceful
     kernel-degradation net (utils/degrade.py).
 
@@ -1127,10 +1271,43 @@ def grow_tree_windowed(*args, use_pallas: bool = True, **kwargs):
     trace-time dispatchers — it is caught here once, logged, recorded,
     and the whole tree is regrown from the ORIGINAL inputs on the XLA
     path (only internal WState buffers were donated to the failed
-    dispatch; the grower inputs are intact)."""
-    if not (use_pallas and _degrade.available(_degrade.HIST)):
-        return _grow_windowed_impl(*args, use_pallas=False, **kwargs)
+    dispatch; the grower inputs are intact).
+
+    The net is LAYERED for the round megakernel: a megakernel failure
+    disables only :data:`~..utils.degrade.ROUND` and regrows on the
+    three-pass round (which may still use the Pallas hist + partition
+    kernels); a histogram-kernel failure there degrades HIST as before.
+    In ``LGBMTPU_MEGAKERNEL=interpret`` mode failures SURFACE (the
+    correctness harness must never silently fall back, mirroring the
+    partition kernel's interpret contract)."""
+    use_p = use_pallas and _degrade.available(_degrade.HIST)
+    rng_key = args[8] if len(args) > 8 else kwargs.get("rng_key")
+    efb_bins_t = args[12] if len(args) > 12 else kwargs.get("efb_bins_t")
+    mk, mk_interp = megakernel_mode(
+        use_p, rng_key=rng_key, efb_bins_t=efb_bins_t,
+        quantize_bins=kwargs.get("quantize_bins", 0), mode=megakernel_opt)
+
+    def three_pass():
+        if not use_p:
+            return _grow_windowed_impl(*args, use_pallas=False, **kwargs)
+        return _degrade.run_with_fallback(
+            _degrade.HIST,
+            lambda: _grow_windowed_impl(*args, use_pallas=True, **kwargs),
+            lambda: _grow_windowed_impl(*args, use_pallas=False, **kwargs))
+
+    if not mk:
+        return three_pass()
+    if mk_interp:
+        # correctness harness: always run the kernel (the degradation
+        # registry is ignored by megakernel_mode) and surface every
+        # failure — the partition kernel's interpret contract
+        from ..utils import faults as _faults
+
+        _faults.maybe_fail("pallas_round")
+        return _grow_windowed_impl(*args, use_pallas=use_p, megakernel=True,
+                                   mk_interpret=True, **kwargs)
     return _degrade.run_with_fallback(
-        _degrade.HIST,
-        lambda: _grow_windowed_impl(*args, use_pallas=True, **kwargs),
-        lambda: _grow_windowed_impl(*args, use_pallas=False, **kwargs))
+        _degrade.ROUND,
+        lambda: _grow_windowed_impl(*args, use_pallas=use_p, megakernel=True,
+                                    mk_interpret=False, **kwargs),
+        three_pass, fault_site="pallas_round")

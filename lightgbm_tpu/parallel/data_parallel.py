@@ -476,6 +476,7 @@ def grow_tree_windowed_data_parallel(
     merge: str = "psum",  # "psum" | "scatter" (owned-feature ReduceScatter)
     stats: Optional[dict] = None,
     guard_label: str = "",
+    megakernel_opt: Optional[str] = None,
 ) -> Tuple[TreeArrays, jnp.ndarray]:
     """SPMD fused windowed growth: the flagship one-dispatch round over the
     ICI mesh.  Each steady-state round is ONE donated dispatch and ZERO
@@ -519,47 +520,80 @@ def grow_tree_windowed_data_parallel(
     pallas_partition = use_pallas and (
         _os.environ.get("LGBMTPU_PARTITION_PALLAS", "1") != "0") and (
         _degrade.available(_degrade.PARTITION))
+    # round megakernel (ops/round_pallas.py) under SPMD: each rank's
+    # partition + window histogram is one fused kernel; the leaf-histogram
+    # merge stays the round's single in-dispatch collective (psum /
+    # psum_scatter below, UNCHANGED), so the split search runs post-merge
+    # exactly as before.  Same envelope gate as the single-device entry.
+    mk, mk_interp = _tw.megakernel_mode(use_pallas, rng_key=rng_key,
+                                        efb_bins_t=None,
+                                        quantize_bins=quantize_bins,
+                                        mode=megakernel_opt)
     common = dict(num_leaves=num_leaves, num_bins=num_bins, params=params,
                   leaf_tile=leaf_tile)
-    init_statics = tuple(sorted(dict(
-        common, use_pallas=use_pallas, quantize_bins=quantize_bins,
-        hist_precision=hist_precision,
-        stochastic_rounding=stochastic_rounding).items()))
-    init_opt = {"rng_key": rng_key, "quant_key": quant_key,
-                "feature_contri": fcontri, "categorical_mask": cmask}
-    init_names = tuple(k for k, v in init_opt.items() if v is not None)
-    init_fn = _windowed_init_sharded(mesh, merge, init_names, init_statics)
-    state, g_d, h_d, gq, hq, qs, g_true, h_true = init_fn(
-        bins_t, grad, hess, row_mask, sample_weight, nbpf, mbpf, fmask,
-        *(init_opt[k] for k in init_names))
 
-    round_statics = tuple(sorted(dict(
-        common, max_depth=max_depth, use_pallas=use_pallas,
-        quantize_bins=quantize_bins, hist_precision=hist_precision,
-        has_cat=categorical_mask is not None,
-        pallas_partition=pallas_partition).items()))
-    round_opt = {"gq": gq, "hq": hq, "quant_scale": qs, "rng_key": rng_key,
-                 "feature_contri": fcontri, "categorical_mask": cmask}
-    round_names = tuple(k for k, v in round_opt.items() if v is not None)
-    round_vals = tuple(round_opt[k] for k in round_names)
+    def _grow(megakernel: bool, mk_interpret: bool):
+        init_statics = tuple(sorted(dict(
+            common, use_pallas=use_pallas, quantize_bins=quantize_bins,
+            hist_precision=hist_precision,
+            stochastic_rounding=stochastic_rounding).items()))
+        init_opt = {"rng_key": rng_key, "quant_key": quant_key,
+                    "feature_contri": fcontri, "categorical_mask": cmask}
+        init_names = tuple(k for k, v in init_opt.items() if v is not None)
+        init_fn = _windowed_init_sharded(mesh, merge, init_names,
+                                         init_statics)
+        state, g_d, h_d, gq, hq, qs, g_true, h_true = init_fn(
+            bins_t, grad, hess, row_mask, sample_weight, nbpf, mbpf, fmask,
+            *(init_opt[k] for k in init_names))
 
-    def round_fn(st, W):
-        fn = _windowed_round_sharded(mesh, W, merge, round_names,
-                                     round_statics)
-        return fn(st, bins_t, g_d, h_d, row_mask, nbpf, mbpf, fmask,
-                  *round_vals)
+        round_statics = tuple(sorted(dict(
+            common, max_depth=max_depth, use_pallas=use_pallas,
+            quantize_bins=quantize_bins, hist_precision=hist_precision,
+            has_cat=categorical_mask is not None,
+            pallas_partition=pallas_partition,
+            megakernel=megakernel, mk_interpret=mk_interpret).items()))
+        round_opt = {"gq": gq, "hq": hq, "quant_scale": qs,
+                     "rng_key": rng_key, "feature_contri": fcontri,
+                     "categorical_mask": cmask}
+        round_names = tuple(k for k, v in round_opt.items()
+                            if v is not None)
+        round_vals = tuple(round_opt[k] for k in round_names)
 
-    # each rank's window is bounded by its LOCAL rows (the globally-small
-    # child can hold all of one rank's rows of its ancestor — the halving
-    # argument is global, so the local ladder starts at the full shard)
-    n_loc = sharded.padded // n_dev
-    state = _tw._run_fused_rounds(
-        round_fn, state, n_ladder=n_loc,
-        w_first=_tw._window_size(max(n_loc, 1), n_loc),
-        num_leaves=num_leaves, stats=stats, guard_label=guard_label)
+        def round_fn(st, W):
+            fn = _windowed_round_sharded(mesh, W, merge, round_names,
+                                         round_statics)
+            return fn(st, bins_t, g_d, h_d, row_mask, nbpf, mbpf, fmask,
+                      *round_vals)
 
-    fin_statics = tuple(sorted(dict(
-        params=params,
-        quant_renew=bool(quant_renew and quantize_bins)).items()))
-    fin = _windowed_finalize_sharded(mesh, merge, fin_statics)
-    return fin(state, g_true, h_true, row_mask)
+        # each rank's window is bounded by its LOCAL rows (the globally-
+        # small child can hold all of one rank's rows of its ancestor —
+        # the halving argument is global, so the local ladder starts at
+        # the full shard)
+        n_loc = sharded.padded // n_dev
+        state = _tw._run_fused_rounds(
+            round_fn, state, n_ladder=n_loc,
+            w_first=_tw._window_size(max(n_loc, 1), n_loc),
+            num_leaves=num_leaves, stats=stats, guard_label=guard_label)
+
+        fin_statics = tuple(sorted(dict(
+            params=params,
+            quant_renew=bool(quant_renew and quantize_bins)).items()))
+        fin = _windowed_finalize_sharded(mesh, merge, fin_statics)
+        return fin(state, g_true, h_true, row_mask)
+
+    if not mk:
+        return _grow(False, False)
+    if mk_interp:
+        # correctness harness: registry ignored, failures surface (the
+        # single-device entry's interpret contract)
+        from ..utils import faults as _faults
+
+        _faults.maybe_fail("pallas_round")
+        return _grow(True, True)
+    # the LAYERED degrade net, sharded edition: a megakernel failure at
+    # compile/execute time disables ROUND and regrows this tree on the
+    # three-pass sharded round from the ORIGINAL inputs (only internal
+    # WState buffers were donated to the failed dispatch)
+    return _degrade.run_with_fallback(
+        _degrade.ROUND, lambda: _grow(True, False),
+        lambda: _grow(False, False), fault_site="pallas_round")

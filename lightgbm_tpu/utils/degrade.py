@@ -34,6 +34,8 @@ from .log import log_warning
 # registry keys
 HIST = "hist_pallas"
 PARTITION = "partition_pallas"
+ROUND = "round_pallas"  # the round megakernel (ops/round_pallas.py); its
+# fallback is the three-pass fused round, which may still use HIST/PARTITION
 
 _lock = threading.Lock()
 _disabled: Dict[str, str] = {}

@@ -41,6 +41,10 @@ Sites (see docs/ROBUSTNESS.md for the exact trigger points):
                     remote Mosaic kernel-compile failure.  <round> counts
                     dispatcher CALLS (0 = first).
 ``pallas_partition``ops/partition.py::partition_rows — same semantics.
+``pallas_round``    ops/treegrow_windowed.py::grow_tree_windowed's round-
+                    megakernel attempt — same semantics; exercises the
+                    ROUND layer of the degradation net (fallback = the
+                    three-pass fused round).
 ``nonfinite_grad``  models/gbdt.py — poisons gradient element 0 with NaN at
                     1-based boosting iteration <round>.
 ``nonfinite_hess``  same, for the hessian.
@@ -75,7 +79,7 @@ _RANK_GATED_SITES = ("worker_death", "worker_hang")
 
 # sites whose <round> is a per-site CALL counter rather than an explicit
 # round number passed by the caller (trace-time sites have no round)
-_CALL_COUNTED_SITES = ("pallas_hist", "pallas_partition")
+_CALL_COUNTED_SITES = ("pallas_hist", "pallas_partition", "pallas_round")
 
 
 class InjectedFault(RuntimeError):

@@ -1447,6 +1447,57 @@ def test_r11_negative_no_pallas_import_not_scanned(tmp_path):
     assert not rep.findings, rep.findings
 
 
+def test_r11_positive_data_sized_vmem_scratch(tmp_path):
+    """Round-16 extension: a pltpu.VMEM SCRATCH allocation sized by a
+    data-dependent dimension is whole-array staging by another name."""
+    rep = _scan(tmp_path, {"mod.py": """
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        import jax.numpy as jnp
+
+        def scratches(n, n_pad):
+            a = pltpu.VMEM((2, n), jnp.int32)
+            b = pltpu.VMEM((1, n_pad), jnp.float32)
+            return a, b
+    """}, rules=["R11"])
+    assert len(rep.findings) == 2, rep.findings
+    assert all("scratch" in f.message for f in rep.findings)
+
+
+def test_r11_negative_const_and_caps_vmem_scratch(tmp_path):
+    """Fixed tiles stay clean: literal dims, module-level int constants
+    (the partition kernel's _CHUNK), and ALL-CAPS config-tile names (the
+    megakernel's budget-derived FB) are the normal idiom."""
+    rep = _scan(tmp_path, {"mod.py": """
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        import jax.numpy as jnp
+
+        _CHUNK = 512
+
+        def scratches(T, FB, B):
+            a = pltpu.VMEM((2, 1, _CHUNK), jnp.int32)
+            b = pltpu.VMEM((T, 3, FB, B), jnp.float32)
+            c = pltpu.VMEM((4, 128), jnp.float32)
+            return a, b, c
+    """}, rules=["R11"])
+    assert not rep.findings, rep.findings
+
+
+def test_r11_vmem_scratch_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        import jax.numpy as jnp
+
+        def scratch(n_seg):
+            # jaxlint: disable=R11 (fixture: O(S) per-segment table)
+            return pltpu.VMEM((1, n_seg), jnp.int32)
+    """}, rules=["R11"])
+    assert not rep.findings
+    assert len(rep.suppressed) == 1
+
+
 def test_r11_pragma_suppression(tmp_path):
     """An intentionally staged SMALL variable-size block (O(S) segment
     table) documents itself with the pragma + reason."""

@@ -322,3 +322,68 @@ def test_cli_jaxpr_selection_and_exit_codes():
     assert main(["--jaxpr", "--contract", "ooc_root_chunk",
                  "--no-runtime"]) == 0
     assert main(["--jaxpr", "--contract", "no_such_contract"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# J7: hbm-sweep-bound (ISSUE 11 — the megakernel's 3->1 claim, pinned)
+# ---------------------------------------------------------------------------
+
+def test_j7_megakernel_vs_three_pass_sweep_pins(report):
+    """The headline: at the W=N sweep fixture, the megakernel round reads
+    the bin matrix ONCE (+ the tile/f decisions-gather epsilon) where the
+    legacy three-pass round reads it three times — pinned on the traced
+    IR, not hoped."""
+    detail = {r.name: r.detail for r in report.results}
+    mk = detail["windowed_round_megakernel"]["bin_sweeps"]
+    legacy = detail["windowed_round_three_pass_sweeps"]["bin_sweeps"]
+    assert 1.0 <= mk <= 1.1, mk
+    assert 3.0 <= legacy <= 3.2, legacy
+    assert legacy / mk > 2.5  # the 3->1 fusion, as an IR-level ratio
+
+
+def test_j7_sharded_megakernel_keeps_merge_protocol(report):
+    """The sharded megakernel round's collective sequence is IDENTICAL to
+    the legacy sharded round's — the single in-dispatch histogram merge
+    unchanged (the ISSUE's sharded constraint)."""
+    detail = {r.name: r.detail for r in report.results}
+    assert (detail["windowed_round_sharded_megakernel_psum"]["collectives"]
+            == detail["windowed_round_sharded_psum"]["collectives"])
+    assert detail["windowed_round_sharded_megakernel_psum"][
+        "large_collectives"] == 1
+
+
+def test_j7_extra_sweep_fails():
+    """A deliberately second full read of the bin matrix (the regression
+    class: a new bin consumer added OUTSIDE the kernel) breaks the
+    1-sweep budget."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    def round_body(bins, rows):
+        w = bins[:, rows].T            # sweep 1: the window gather
+        again = bins[:, rows].T        # sweep 2: the smuggled re-read
+        return (w.astype(jnp.int32).sum()
+                + again.astype(jnp.int32).sum())
+
+    n, f = 1024, 16
+    c = dataclasses.replace(
+        _fixture_contract(
+            "fixture_extra_sweep",
+            lambda: Target(jax.jit(round_body),
+                           (jax.ShapeDtypeStruct((f, n), jnp.int16),
+                            jax.ShapeDtypeStruct((n,), jnp.int32)), {})),
+        bin_arg=0, max_bin_sweeps=2.5)
+    res = jaxpr_audit.audit_contract(c)
+    assert any(f.rule == "J7" for f in res.findings), res.findings
+    assert res.detail["bin_sweeps"] > 2.5
+
+
+def test_j7_detail_rides_the_artifact_verdict():
+    """bench.py embeds verdict(); the J7-pinned contracts must appear in
+    it so chip artifact rows carry the sweep proof next to J1-J6."""
+    from lightgbm_tpu.analysis.contracts import CONTRACTS
+    pinned = [n for n, c in CONTRACTS.items() if c.max_bin_sweeps]
+    assert "windowed_round_megakernel" in pinned
+    assert "windowed_round_three_pass_sweeps" in pinned

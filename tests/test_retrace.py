@@ -188,6 +188,37 @@ def test_windowed_budget_gate_enforces(monkeypatch):
         grow_tree_windowed(bins_t, grads[1], hess, **kw, **static)
 
 
+def test_windowed_megakernel_one_dispatch_zero_syncs_no_retrace(monkeypatch):
+    """ISSUE 11 acceptance: the MEGAKERNEL round (ops/round_pallas.py,
+    interpret mode off-chip) holds the same steady-state budget as the
+    three-pass round — 1 dispatch, 0 blocking syncs, 0 retraces per
+    round, telemetry + span tracing default-ON.  The kernel rides INSIDE
+    the donated round dispatch; window sizes are data-dependent loop
+    bounds in-kernel, so the W ladder cannot force retraces either."""
+    from lightgbm_tpu.obs import metrics as obs_metrics
+    from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+    from lightgbm_tpu.utils.sanitizer import DispatchCounter
+
+    assert obs_metrics.enabled()
+    monkeypatch.setenv("LGBMTPU_MEGAKERNEL", "interpret")
+    bins_t, grads, hess, kw, static = _windowed_inputs(seed=8)
+    tree, leaf = grow_tree_windowed(bins_t, grads[0], hess, **kw, **static)
+    jax.block_until_ready(leaf)
+    assert int(tree.num_leaves) > 1
+
+    stats = {}
+    with DispatchCounter() as d:
+        tree, leaf = grow_tree_windowed(bins_t, grads[1], hess, **kw,
+                                        **static, stats=stats)
+        jax.block_until_ready(leaf)
+    assert stats["rounds"] >= 3, stats
+    d.assert_round_budget(stats["rounds"], what="megakernel windowed rounds")
+    assert stats["dispatches"] == stats["rounds"], stats
+    assert stats["host_syncs"] == 0, stats
+    assert stats["retries"] == 0, stats
+    d.assert_no_recompile("3+ megakernel windowed rounds at fixed shape")
+
+
 def test_sharded_windowed_one_dispatch_zero_syncs_per_rank_telemetry_on():
     """ISSUE 9 acceptance: the SHARDED fused windowed round (8-device
     loopback mesh, in-dispatch psum merge) keeps the 1-dispatch/0-sync/
