@@ -49,6 +49,12 @@ Out-of-core mode (round 12): BENCH_MODE=ooc runs the data-path levers
 spill-training rows/s with bitwise parity asserted, and the partition
 move-phase timing at segment fractions that the HBM-resident DMA kernel
 must flatten on chip); knobs OOC_BENCH_*.
+
+Serve mode (round 18): BENCH_MODE=serve runs the serving-LOOP benchmark
+(benchmarks/serve_bench.py — K concurrent callers coalesced onto one
+warm executable vs per-request serial predicts, closed + open loop,
+bitwise parity and the jaxpr-audit verdict asserted in-artifact);
+knobs SERVE_BENCH_*.
 """
 
 import json
@@ -334,6 +340,15 @@ def main():
         from benchmarks.predict_bench import main as predict_main
 
         return predict_main()
+    if os.environ.get("BENCH_MODE") == "serve":
+        # serving-loop benchmark (round 18): coalesced concurrent
+        # requests vs per-request serial predicts, closed + open loop,
+        # parity + audit verdict in-artifact (BENCH_serve_* row)
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.serve_bench import main as serve_main
+
+        return serve_main()
     if os.environ.get("BENCH_MODE") == "ooc":
         # out-of-core/partition data-path levers (BENCH_ooc_* artifact)
         import sys as _sys

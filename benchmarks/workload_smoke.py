@@ -232,11 +232,64 @@ def bench_megakernel(n_rows=2000, n_feat=10):
     return int(t0.num_leaves), dt
 
 
+def bench_serve(n_rows=600, n_feat=8, n_trees=12):
+    """Round-18 serving-loop smoke: concurrent requests through the
+    coalescing runtime must come back BITWISE equal to individual
+    predicts, the queued set must coalesce into fewer batches than
+    requests, and the snapshot must carry the serve keys — so an
+    off-chip CI run catches serving-loop regressions in the artifact
+    path, not just in tier-1."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import metrics as _obs
+    from lightgbm_tpu.serve import ServingRuntime
+
+    rng = np.random.RandomState(11)
+    X = rng.randn(n_rows, n_feat)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(float)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                              "max_bin": 63, "verbosity": -1},
+                      train_set=lgb.Dataset(X, label=y))
+    for _ in range(n_trees):
+        bst.update()
+
+    parts = [X[i * 16:(i + 1) * 16] for i in range(8)]
+    want = [bst.predict(p, raw_score=True) for p in parts]
+    batches0 = _obs.counter("serve_batches_total").value
+    rt = ServingRuntime(bst, max_wait_ms=100, start=False,
+                        shed_unhealthy=False)
+    handles = [rt.submit(p, raw_score=True) for p in parts]
+    t0 = time.perf_counter()
+    rt.start()
+    got = [rt.result(h, timeout=120) for h in handles]
+    dt = time.perf_counter() - t0
+    rt.stop()
+    for w, g in zip(want, got):
+        assert np.array_equal(w, g), "coalesced response diverged"
+    batches = _obs.counter("serve_batches_total").value - batches0
+    assert batches < len(parts), (
+        f"8 queued requests dispatched as {batches} batches — no "
+        "coalescing happened")
+
+    snap = _obs.snapshot()
+    _obs.validate_snapshot(snap)
+    for key in ("serve_requests_total", "serve_batches_total",
+                "serve_coalesced_rows_total"):
+        assert key in snap["counters"], f"metrics snapshot missing {key}"
+    assert "serve_queue_depth" in snap["gauges"]
+    assert snap["histograms"]["serve_batch_occupancy"]["count"] >= 1
+    assert any(k.startswith('serve_request_latency_ms{tenant="')
+               for k in snap["histograms"]), (
+        "per-tenant serve latency labels missing from the snapshot")
+    return len(parts), batches, sum(p.shape[0] for p in parts) / dt
+
+
 def main():
     n = int(os.environ.get("SMOKE_ROWS", 1_000_000))
     iters = int(os.environ.get("SMOKE_ITERS", 10))
     which = (sys.argv[1].split(",") if len(sys.argv) > 1
-             else ["rank", "multiclass", "predict", "ooc", "megakernel"])
+             else ["rank", "multiclass", "predict", "serve", "ooc",
+                   "megakernel"])
     if "rank" in which:
         ips = bench_rank(n, q_len=128, iters=iters)
         print(f"lambdarank {n//1000}k rows x64f q128 63bins: {ips:.2f} iters/sec", flush=True)
@@ -247,6 +300,10 @@ def main():
         rps, err = bench_predict()
         print(f"predict 2k rows x16f T24: {rps:.0f} rows/sec warm "
               f"(1 dispatch/call, host-walk parity {err:.1e})", flush=True)
+    if "serve" in which:
+        reqs, batches, rps = bench_serve()
+        print(f"serve 8x16-row concurrent requests: {batches} coalesced "
+              f"batch(es), bitwise parity, {rps:.0f} rows/sec", flush=True)
     if "ooc" in which:
         rps, passes = bench_ooc()
         print(f"out_of_core 3k rows x8f: {rps:.0f} streamed rows/sec spill "

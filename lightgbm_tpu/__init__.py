@@ -12,9 +12,27 @@ Public API mirrors python-package/lightgbm/__init__.py.
 
 from .basic import Booster, CorruptModelError, Dataset, LightGBMError, Sequence_ as Sequence
 from .callback import EarlyStopException, early_stopping, log_evaluation, record_evaluation, reset_parameter
-from .engine import CVBooster, cv, train
+from . import serve as _serve_pkg
+from .serve import Overloaded, ServingRuntime
+from .serve import runtime as _serve_runtime_mod
+
+# NOTE: imported AFTER the serve package so the package attribute
+# `lightgbm_tpu.serve` resolves to the entry-point FUNCTION (engine.serve);
+# the module itself stays importable as `from lightgbm_tpu.serve import ...`
+# (sys.modules resolution is unaffected by the attribute shadowing).
+from .engine import CVBooster, cv, serve, train
 from .utils.guards import NonFiniteError
 from .utils.log import register_logger
+
+# graft EVERY public name of the subpackage onto the shadowing function —
+# driven by its __all__, so a name added there can never be missed here —
+# making `import lightgbm_tpu; lightgbm_tpu.serve.ServingRuntime` work
+# alongside `lgb.serve(booster)` and `from lightgbm_tpu.serve import ...`
+# (both spellings pinned in tests/test_serve.py)
+for _name in _serve_pkg.__all__:
+    setattr(serve, _name, getattr(_serve_pkg, _name))
+serve.runtime = _serve_runtime_mod
+del _name, _serve_pkg, _serve_runtime_mod
 
 __all__ = [
     "Dataset",
@@ -27,6 +45,9 @@ __all__ = [
     "register_logger",
     "train",
     "cv",
+    "serve",
+    "ServingRuntime",
+    "Overloaded",
     "early_stopping",
     "log_evaluation",
     "record_evaluation",

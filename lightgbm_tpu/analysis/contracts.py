@@ -539,6 +539,36 @@ def _build_predict_warm_converted() -> Target:
     return Target(run, args, dict(k=1))
 
 
+@contract(
+    "predict_coalesced_bucket",
+    description="the serving runtime's coalesced batch dispatch "
+                "(lightgbm_tpu/serve/runtime.py -> GBDT.predict_coalesced): "
+                "K concurrent requests packed into one bucket rung must "
+                "dispatch the SAME traced executable family as warm "
+                "single-caller predict — the fn is resolved through the "
+                "runtime's own selector (serve.runtime.audit_dispatch_fn "
+                "-> GBDT._coalesced_raw_fn), so a serve-owned second "
+                "entry, a collective, or an in-trace transfer appearing "
+                "in the serving loop fails the audit statically",
+    collectives=(),
+    max_live_bytes=1 << 20,
+)
+def _build_predict_coalesced_bucket() -> Target:
+    import jax.numpy as jnp
+
+    from ..serve.runtime import audit_dispatch_fn
+    s = _packed_sds()
+    fn = audit_dispatch_fn(1)
+    args = (_sds((_PN, _PF), jnp.float32), s["split_feature"],
+            s["threshold"], s["default_left"], s["missing_type"],
+            s["left_child"], s["right_child"], s["num_leaves"],
+            s["leaf_value"])
+    return Target(fn, args, dict(active=_sds((_PN,), jnp.bool_)),
+                  note="same fixture shape as predict_warm_single — the "
+                       "coalesced dispatch IS that executable family by "
+                       "construction, and this contract pins it")
+
+
 # ---------------------------------------------------------------------------
 # spill grower chunk steps (ops/treegrow_ooc.py)
 # ---------------------------------------------------------------------------

@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R14.
+"""jaxlint built-in rules R1-R15.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -1347,3 +1347,99 @@ def r14_metadata_via_device_pull(pkg: PackageIndex) -> Iterator[Finding]:
                         f".shape[...].item() in {fi.qualname}: shape "
                         "entries are Python ints already — .item() here "
                         "signals a device round-trip habit", hint)
+
+
+# ---------------------------------------------------------------------------
+# R15 — staging-alloc-in-serve-loop
+# ---------------------------------------------------------------------------
+
+_R15_FRESH_ALLOCS = ("empty", "zeros", "ones", "full")
+_R15_HOST_SOURCES = _R15_FRESH_ALLOCS + ("asarray", "array", "empty_like",
+                                         "zeros_like", "ones_like",
+                                         "full_like")
+_R15_UPLOADS = ("asarray", "array", "device_put")
+_R15_JNP_ALIASES = ("jnp", "jax")
+
+
+def _r15_is_fresh_alloc(node: ast.AST) -> bool:
+    """np.empty/zeros/ones/full — a fresh host buffer per call."""
+    return isinstance(node, ast.Call) and _is_np_attr(node.func,
+                                                      _R15_FRESH_ALLOCS)
+
+
+def _r15_is_upload_of_fresh_host(node: ast.AST) -> bool:
+    """jnp.asarray / jnp.array / jax.device_put whose operand is itself a
+    fresh host-array construction (np.zeros(...)/np.asarray(...)/...): a
+    per-call allocate-then-upload.  Uploads of a NAMED buffer are clean —
+    reusing a pinned buffer is exactly the sanctioned pattern."""
+    if not (isinstance(node, ast.Call) and node.args
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _R15_UPLOADS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in _R15_JNP_ALIASES):
+        return False
+    arg = node.args[0]
+    return (isinstance(arg, ast.Call)
+            and _is_np_attr(arg.func, _R15_HOST_SOURCES))
+
+
+def _r15_is_predict_entry(node: ast.AST) -> bool:
+    """An accounted serving dispatch: a call whose final name is a
+    predict entry (predict / predict_raw / predict_coalesced / the
+    predict_ops kernels) or the accounted ``sync_pull`` itself."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = dotted_name(node.func) or ""
+    last = fn.split(".")[-1]
+    return last.startswith("predict") or last == "sync_pull"
+
+
+@register_rule("R15", "staging-alloc-in-serve-loop")
+def r15_staging_alloc_in_serve_loop(pkg: PackageIndex) -> Iterator[Finding]:
+    """A fresh host staging allocation INSIDE a loop that also drives an
+    accounted predict entry: per-iteration ``np.empty``/``np.zeros`` (a
+    new batch buffer every request) or ``jnp.asarray``/``jax.device_put``
+    of a freshly constructed host array (allocate-then-upload per call).
+    A serving loop runs forever at request cadence, so a per-iteration
+    staging buffer is allocator pressure + a page-faulting copy on every
+    batch — the exact cost the pinned double-buffered staging in
+    lightgbm_tpu/serve/runtime.py exists to remove (one buffer pair per
+    bucket rung, one ``readinto``-style copy per request, reused across
+    batches; the round-12 out-of-core reused-buffer discipline applied to
+    serving).  Uploading a NAMED (hoisted, reused) buffer inside the loop
+    is clean — that upload is the design.  Loops with no predict entry
+    (setup, training drivers) are out of scope: R1/R14 own those."""
+    hint = ("hoist the staging buffer out of the loop and reuse it "
+            "(lightgbm_tpu/serve/runtime.py::_next_staging is the "
+            "pattern: one pinned pair per bucket rung, filled per "
+            "request, uploaded by name); see docs/ANALYSIS.md R15")
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if pkg.is_hot(fi):
+                continue  # traced bodies: allocation is R1/R11's domain
+            for loop in _own_body(fi):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                nodes = list(ast.walk(loop))
+                if not any(_r15_is_predict_entry(n) for n in nodes):
+                    continue
+                # an alloc wrapped directly in a flagged upload reports
+                # ONCE (as the allocate-then-upload form), not twice
+                wrapped = {id(n.args[0]) for n in nodes
+                           if _r15_is_upload_of_fresh_host(n)}
+                for n in nodes:
+                    if _r15_is_fresh_alloc(n) and id(n) not in wrapped:
+                        yield _finding(
+                            fi, n, "R15",
+                            f"per-iteration host staging allocation "
+                            f"np.{n.func.attr}(...) in {fi.qualname}'s "
+                            "serving loop — a fresh batch buffer every "
+                            "request instead of a pinned reused one",
+                            hint)
+                    elif _r15_is_upload_of_fresh_host(n):
+                        yield _finding(
+                            fi, n, "R15",
+                            f"{dotted_name(n.func)}(np.{n.args[0].func.attr}"
+                            f"(...)) in {fi.qualname}'s serving loop — "
+                            "allocate-then-upload of a fresh host array "
+                            "per iteration", hint)

@@ -1180,7 +1180,8 @@ class Booster:
         seg = models[start_iteration:end]
         np.random.shuffle(seg)
         self._gbdt.models[start_iteration:end] = seg
-        self._gbdt._invalidate_pred_cache("shuffle_models")  # order changed
+        self._gbdt._invalidate_pred_cache("shuffle_models")  # order changed:
+        # bump-on-mutate — the pre-shuffle pack stays servable one version back
         return self
 
     def _init_score_offset(self) -> float:
@@ -1380,6 +1381,7 @@ class Booster:
             )
             score += tree.predict(X)
         gbdt._invalidate_pred_cache("refit")  # leaf values renewed in place
+        # (bump-on-mutate: in-flight serving readers keep the old pack)
         return new_booster
 
     # -- serialization ----------------------------------------------------
@@ -1482,6 +1484,7 @@ class Booster:
     def set_leaf_output(self, tree_id: int, leaf_id: int, value: float) -> "Booster":
         self._gbdt.models[tree_id].leaf_value[leaf_id] = value
         self._gbdt._invalidate_pred_cache("set_leaf_output")  # in-place edit
+        # (bump-on-mutate: in-flight serving readers keep the old pack)
         return self
 
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:

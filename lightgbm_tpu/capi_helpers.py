@@ -1001,6 +1001,7 @@ def booster_refit_leaf_preds(bst: Booster, leaf_addr: int, nrow: int,
         else:
             score += pred
     gbdt._invalidate_pred_cache("capi_refit_leaf")  # renewed in place
+    # (bump-on-mutate: in-flight serving readers keep the old pack)
     return True
 
 

@@ -495,6 +495,32 @@ class Config:
     # `python -m lightgbm_tpu.obs trace`).
     trace_file: str = ""
 
+    # --- serving runtime (ours; README "Serving", lightgbm_tpu/serve) ---
+    # serve_max_wait_ms: the coalescer's admission window — after the
+    # first queued request, up to this many milliseconds of later arrivals
+    # coalesce into the same bucket-rung batch (flushed EARLY the moment a
+    # pow-2 rung fills).  Smaller = lower added latency, larger = fuller
+    # batches under bursty load.
+    serve_max_wait_ms: float = 2.0
+    # serve_max_queue: admission bound on queued requests across the
+    # runtime; submissions past it are SHED with a typed Overloaded error
+    # (counted in serve_shed_total, evented, /healthz-visible) instead of
+    # queuing unboundedly — a hang is never the failure mode.
+    serve_max_queue: int = 1024
+    # serve_slo_p99_ms: p99 latency SLO driving load shedding off the
+    # existing predict_warm_latency_ms reservoirs — when the observed p99
+    # exceeds this and requests are already queued, new submissions shed.
+    # The reservoir is process-cumulative, so size the SLO for steady
+    # state, not cold compiles (which never enter the warm reservoirs).
+    # 0 (default) = no SLO shedding (queue bound + health shedding only).
+    serve_slo_p99_ms: float = 0.0
+    # serve_tenant_quota: per-tenant bound on queued requests (each served
+    # model name is a tenant; per-tenant latency is labeled
+    # serve_request_latency_ms{tenant="..."}).  A tenant at its quota
+    # sheds with Overloaded while other tenants keep serving — one noisy
+    # caller cannot monopolize the chip.  0 (default) = unlimited.
+    serve_tenant_quota: int = 0
+
     # unknown/passthrough params preserved here
     extra: Dict[str, Any] = field(default_factory=dict)
     # names the user explicitly set (vs defaults) — lets device-specific

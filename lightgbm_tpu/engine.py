@@ -388,6 +388,53 @@ def train(
     return booster
 
 
+def serve(model=None, params: Optional[Dict[str, Any]] = None, *,
+          models=None, start: bool = True):
+    """Serving entry point (README "Serving"): build — and by default
+    START — an in-process :class:`~lightgbm_tpu.serve.ServingRuntime`
+    over one or more trained models, with the live ``/metrics`` +
+    ``/healthz`` endpoint brought up exactly as ``train`` does.
+
+    ``model`` is a :class:`Booster` or a model-file path (single-model,
+    served as ``"default"``); ``models`` is a ``{name: Booster|path}``
+    table for multi-tenant serving.  ``params`` carries the serve knobs
+    (``serve_max_wait_ms``, ``serve_max_queue``, ``serve_slo_p99_ms``,
+    ``serve_tenant_quota``) plus ``metrics_port=``/``telemetry=`` — the
+    same Config names as everywhere else (docs/Parameters.md).
+
+    >>> rt = lgb.serve(booster, {"serve_max_wait_ms": 2})
+    >>> y = rt.predict(X); rt.stop()
+    """
+    from .serve.runtime import ServingRuntime
+
+    cfg = Config.from_dict(dict(params or {}))
+    set_verbosity(cfg.verbosity)
+    telemetry_on = (bool(cfg.telemetry) if cfg.is_set("telemetry")
+                    else _obs.DEFAULT_ENABLED)
+    _obs.set_enabled(telemetry_on)
+    if telemetry_on:
+        try:
+            _obs_server.maybe_start(
+                cfg.metrics_port if cfg.is_set("metrics_port") else None)
+        except OSError as e:
+            log_warning(f"metrics endpoint could not start: {e}")
+
+    def _load(m):
+        return m if isinstance(m, Booster) else Booster(model_file=m)
+
+    table = None if models is None else {n: _load(m)
+                                         for n, m in models.items()}
+    single = None if model is None else _load(model)
+    kw = {}
+    for name, param in (("max_wait_ms", "serve_max_wait_ms"),
+                        ("max_queue", "serve_max_queue"),
+                        ("slo_p99_ms", "serve_slo_p99_ms"),
+                        ("tenant_quota", "serve_tenant_quota")):
+        if cfg.is_set(param):
+            kw[name] = getattr(cfg, param)
+    return ServingRuntime(single, models=table, start=start, **kw)
+
+
 def _finish_run_report(cfg: Config) -> None:
     """End-of-run observability (docs/OBSERVABILITY.md): the reference-style
     "Time for X / counter = v" report through the logger (debug verbosity —

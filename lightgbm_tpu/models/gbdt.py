@@ -142,6 +142,7 @@ class GBDT:
         self.valid_names: List[str] = []
         self._valid_scores: List[jnp.ndarray] = []
         self._pred_cache = None
+        self._pack_version = 0  # bumped by _invalidate_pred_cache
         self.binner = None
         self.rng = np.random.RandomState(cfg.seed)
         # non-finite guard rail (docs/ROBUSTNESS.md): first boosting
@@ -175,14 +176,35 @@ class GBDT:
         self._invalidate_pred_cache("models_setter")
 
     def _invalidate_pred_cache(self, reason: str) -> None:
-        """Null the packed-ensemble serving cache, counting REAL
-        invalidations (a populated cache dropped) so serving dashboards can
-        see churn — training every round vs an occasional leaf edit look
-        very different here."""
+        """VERSION the packed-ensemble serving cache instead of nulling it
+        (round 18, lightgbm_tpu/serve): a model mutation bumps
+        ``_pack_version`` — the leading component of every ``_packed``
+        key — so the next predict packs fresh under the new version while
+        entries of the PREVIOUS version stay resident and servable.  A
+        hot swap (refit / set_leaf_output / continued training under a
+        live serving runtime) therefore never cools the cache for
+        in-flight predicts: a reader that grabbed the pre-mutation pack
+        keeps its device arrays, and a reader racing the bump still finds
+        the old entry instead of rebuilding mid-request.  Versions older
+        than ``_PACKED_KEEP_VERSIONS`` are evicted here, counted in
+        ``predict_stale_pack_evictions_total``.  Real invalidations (a
+        populated cache bumped) are counted so serving dashboards can see
+        churn — training every round vs an occasional leaf edit look very
+        different here."""
         if getattr(self, "_pred_cache", None):
             _obs.counter("predict_cache_invalidations_total").inc()
-            _obs.event("pred_cache_invalidate", reason=reason)
-        self._pred_cache = None
+            _obs.event("pred_cache_invalidate", reason=reason,
+                       version=self._pack_version + 1)
+        self._pack_version = getattr(self, "_pack_version", 0) + 1
+        cache = getattr(self, "_pred_cache", None)
+        if cache:
+            floor = self._pack_version - self._PACKED_KEEP_VERSIONS
+            stale = [key for key in cache if key[0] <= floor]
+            for key in stale:
+                del cache[key]
+            if stale:
+                _obs.counter(
+                    "predict_stale_pack_evictions_total").inc(len(stale))
 
     def _flush_pending(self) -> None:
         if self._pending:
@@ -1956,20 +1978,29 @@ class GBDT:
                 np.concatenate(words) if off else np.zeros(1, np.uint32))
         return out
 
-    # -- packed-ensemble serving cache (round 9) -----------------------
+    # -- packed-ensemble serving cache (round 9; versioned round 18) ---
     _PACKED_CACHE_CAP = 32  # bounds early-stop chunk windows etc.
+    # versions retained after a mutation: the current one plus the
+    # previous (in-flight serving readers of the pre-mutation pack) —
+    # older versions are evicted by _invalidate_pred_cache, counted in
+    # predict_stale_pack_evictions_total
+    _PACKED_KEEP_VERSIONS = 2
 
     def _packed(self, start: int = 0, num_iteration: int = -1, *,
                 pad_trees_to: int = 0):
         """Device-resident packed ensemble for serving: the `_stacked` SoA
-        arrays built once per (tree range, model state) and cached, so a
-        warm ``predict`` performs ZERO host-side re-pack and re-upload.
+        arrays built once per (version, tree range, model state) and
+        cached, so a warm ``predict`` performs ZERO host-side re-pack and
+        re-upload.
 
-        The cache lives in ``self._pred_cache`` (None = empty), which every
-        model mutation already nulls (train_one_iter, rollback_one_iter,
-        the ``models`` setter, Booster.refit/shuffle_models) — and the key
-        carries ``len(self.models)`` so even an unnulled stale entry can
-        never be served after training grows the ensemble.
+        The cache lives in ``self._pred_cache`` (None = empty).  Every
+        model mutation (train_one_iter, rollback_one_iter, the ``models``
+        setter, Booster.refit/shuffle_models, the C-API leaf refits)
+        BUMPS ``_pack_version`` instead of nulling the dict
+        (_invalidate_pred_cache), so the key's leading version component
+        makes stale entries unreachable while the previous version stays
+        servable for in-flight serving readers — and the key additionally
+        carries ``len(self.models)`` as a belt-and-braces guard.
 
         ``pad_trees_to`` pads the tree axis with single-leaf zero-value
         trees to a multiple of that window so the early-stop chunk op runs
@@ -1983,7 +2014,7 @@ class GBDT:
         lo = start * k
         hi = n_models if num_iteration < 0 else min(
             (start + num_iteration) * k, n_models)
-        key = (lo, hi, n_models, pad_trees_to)
+        key = (self._pack_version, lo, hi, n_models, pad_trees_to)
         if self._pred_cache is None:
             self._pred_cache = {}
         hit = self._pred_cache.get(key)
@@ -2037,7 +2068,12 @@ class GBDT:
         if warm:
             _obs.counter("predict_bucket_hits_total").inc()
             _obs.histogram("predict_warm_latency_ms").observe(dt_ms)
-            _obs.histogram(f"predict_warm_latency_ms.{entry}").observe(dt_ms)
+            # per-entry reservoirs are LABEL SETS on the one family
+            # (predict_warm_latency_ms{entry="raw"}), not dotted-suffix
+            # names — the dotted form rendered as a separate Prometheus
+            # family per entry (round-11 infra note, retired round 18)
+            _obs.histogram(_obs.labeled(
+                "predict_warm_latency_ms", entry=entry)).observe(dt_ms)
             if bucket is not None:
                 _obs.histogram(_obs.labeled(
                     "predict_warm_latency_ms", bucket=bucket)).observe(dt_ms)
@@ -2190,6 +2226,99 @@ class GBDT:
                   active, k=k)
         res = np.asarray(_san.sync_pull(out)[:n])
         self._serve_note("converted", n, t0c0, bucket=nb)
+        return res
+
+    # -- coalesced serving dispatch (round 18, lightgbm_tpu/serve) ------
+    @staticmethod
+    def _coalesced_raw_fn(k: int):
+        """The raw-path executable a coalesced batch dispatches: the SAME
+        module-level jitted traversal the single-caller warm entries use
+        (``predict_ops.predict_raw_values`` / ``predict_raw_multiclass``)
+        — never a serve-owned jit.  The serving loop therefore reuses the
+        bucket ladder's already-compiled executables (zero retraces by
+        construction), and the ``predict_coalesced_bucket`` audit
+        contract (analysis/contracts.py) traces exactly this function, so
+        the coalescer can never silently grow a second executable
+        family."""
+        return (predict_ops.predict_raw_values if k == 1
+                else predict_ops.predict_raw_multiclass)
+
+    def _coalescible(self, raw_score: bool) -> bool:
+        """Whether a ``predict(raw_score=)`` call can ride the coalesced
+        batch path BITWISE — the same envelope as the single-caller fast
+        entries: a packed non-linear ensemble, no prediction
+        early-stopping (its per-row tree count is margin-dependent), and
+        for converted output the fused-convert conditions (a real
+        objective, no RF host-side averaging, escape hatch honored).
+        Ineligible models are served per-request through the full
+        ``predict`` path by the runtime (still correct, not coalesced)."""
+        early = (
+            self.cfg.pred_early_stop
+            and not self.average_output
+            and self.objective is not None
+            and getattr(self.objective, "name", "") in (
+                "binary", "multiclass", "multiclassova")
+        )
+        if early:
+            return False
+        s = self._packed(0, -1)
+        if s is None or s["_linear"]:
+            return False
+        if raw_score or self.objective is None:
+            return True
+        return (not self.average_output
+                and os.environ.get("LGBMTPU_FUSED_CONVERT", "1") != "0")
+
+    def predict_coalesced(self, x, active, n, *, convert: bool):
+        """One coalesced serving batch (lightgbm_tpu/serve/runtime.py):
+        ``x`` is an ALREADY-STAGED (nb, F) f32 device batch — the
+        runtime's pinned-buffer upload, enqueued while the previous batch
+        executes — and ``active`` its row mask (None at exact rung fill,
+        mirroring ``_active_mask``).  ONE dispatch + ONE accounted sync
+        for the whole batch; rows slice back out per request BITWISE
+        equal to individual ``predict`` calls (rows traverse
+        independently, conversions are rowwise, and the padded result is
+        pinned bit-identical to the unpadded one).
+
+        ``convert=False`` returns raw margins ((n,) or (n, k), f64 with
+        the RF scale applied exactly as ``predict_raw``); ``convert=True``
+        dispatches the SAME fused instance-cached entry as
+        ``_predict_converted``.  The caller checks :meth:`_coalescible`
+        first; serving an ineligible model here would silently change
+        semantics, so it raises instead."""
+        s = self._packed(0, -1)
+        if s is None or s["_linear"]:
+            raise ValueError(
+                "predict_coalesced: model is not coalescible (empty or "
+                "linear-leaf ensemble) — route through predict()")
+        k = self.num_tree_per_iteration
+        t0c0 = self._serve_t0()
+        nb = x.shape[0]
+        _san.record_dispatch()
+        if convert:
+            run = self._get_convert_entry()
+            out = run(x, s["split_feature"], s["threshold"],
+                      s["default_left"], s["missing_type"], s["left_child"],
+                      s["right_child"], s["num_leaves"], s["leaf_value"],
+                      s.get("is_cat"), s.get("cat_base"), s.get("cat_nwords"),
+                      s.get("cat_words"), active, k=k)
+            res = np.asarray(_san.sync_pull(out)[:n])
+        else:
+            cat_kw = {}
+            if "is_cat" in s:
+                cat_kw = dict(cat_words=s["cat_words"])
+            fn = self._coalesced_raw_fn(k)
+            kkw = {} if k == 1 else dict(k=k)
+            out = fn(x, s["split_feature"], s["threshold"],
+                     s["default_left"], s["missing_type"], s["left_child"],
+                     s["right_child"], s["num_leaves"], s["leaf_value"],
+                     is_cat=s.get("is_cat"), cat_base=s.get("cat_base"),
+                     cat_nwords=s.get("cat_nwords"), active=active,
+                     **kkw, **cat_kw)
+            n_per_class = max(s["T"] // k, 1)
+            scale = (1.0 / n_per_class) if self.average_output else 1.0
+            res = np.asarray(_san.sync_pull(out)[:n], dtype=np.float64) * scale
+        self._serve_note("coalesced", n, t0c0, bucket=nb)
         return res
 
     def predict(self, X, raw_score=False, start_iteration=0, num_iteration=-1,

@@ -65,6 +65,14 @@ DEGRADED_COUNTERS = (
     ("fleet_resumes_total", "fleet resumed from a checkpoint round"),
     ("faults_injected_total", "injected faults fired (test harness armed)"),
 )
+# gauge-driven degraded states: unlike the cumulative counters above these
+# are CURRENT conditions — the serving runtime sets serve_shedding to 1
+# while it refuses submissions (queue bound / tenant quota / p99 SLO /
+# unhealthy process, lightgbm_tpu/serve) and back to 0 when admissions
+# resume, so /healthz flips degraded exactly for the shedding interval
+DEGRADED_GAUGES = (
+    ("serve_shedding", "serving runtime is shedding load (Overloaded)"),
+)
 
 
 def health(snap: Optional[Dict[str, Any]] = None) -> Tuple[int, Dict[str, Any]]:
@@ -74,6 +82,7 @@ def health(snap: Optional[Dict[str, Any]] = None) -> Tuple[int, Dict[str, Any]]:
     if snap is None:
         snap = _metrics.snapshot()
     counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
     problems: List[Dict[str, Any]] = []
     status = "ok"
     for table, severity in ((UNHEALTHY_COUNTERS, "unhealthy"),
@@ -89,9 +98,20 @@ def health(snap: Optional[Dict[str, Any]] = None) -> Tuple[int, Dict[str, Any]]:
                     status = "unhealthy"
                 elif status == "ok":
                     status = "degraded"
+    shedding = False
+    for name, why in DEGRADED_GAUGES:
+        v = float(gauges.get(name, 0.0))
+        if v:
+            problems.append({"gauge": name, "value": v, "why": why,
+                             "severity": "degraded"})
+            if status == "ok":
+                status = "degraded"
+            if name == "serve_shedding":
+                shedding = True
     body = {
         "status": status,
         "problems": problems,
+        "shedding": shedding,
         "telemetry_enabled": bool(snap.get("enabled", True)),
         "rank": snap.get("rank"),
         "ts": snap.get("ts"),

@@ -41,6 +41,14 @@ contracts (analysis/contracts.py, tests/test_jaxpr_audit.py) —
 collective-free, callback-free, f64-free bodies with no oversized baked
 constants and a bounded live set; a per-class host loop or an in-trace
 transfer reappearing here fails the audit statically.
+
+Serving-loop contract (round 18): the continuous-batching runtime
+(lightgbm_tpu/serve) dispatches coalesced batches through THESE SAME
+functions — ``GBDT._coalesced_raw_fn`` selects them, and the
+``predict_coalesced_bucket`` contract traces that selection — so the
+serving loop shares the bucket ladder's compiled executables and can
+never silently grow a second dispatch family.  Adding a serve-only
+entry here (or in serve/) breaks that contract's audit.
 """
 
 from __future__ import annotations

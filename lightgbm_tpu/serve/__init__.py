@@ -1,0 +1,15 @@
+"""In-process async serving runtime (README "Serving").
+
+Continuous micro-batching of concurrent predicts onto one warm
+executable: a request queue + coalescer packs concurrent requests into
+the smallest covering pow-2 bucket rung (responses bitwise equal to
+individual ``Booster.predict`` calls), pinned double-buffered host
+staging feeds the device one batch ahead, and p99-SLO / queue-bound load
+shedding turns overload into a typed :class:`Overloaded` error instead
+of a hang.  Multi-model multi-tenant: N packed ensembles resident behind
+one bucket ladder, hot-swappable without cooling the cache.
+"""
+
+from .runtime import MAX_BATCH_ROWS, Overloaded, ServingRuntime
+
+__all__ = ["ServingRuntime", "Overloaded", "MAX_BATCH_ROWS"]

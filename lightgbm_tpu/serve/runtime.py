@@ -1,0 +1,621 @@
+"""The serving loop: continuous micro-batching of concurrent predicts
+onto one warm executable (round 18; README "Serving").
+
+Every serving PRIMITIVE predates this module — packed device-resident
+ensembles (``GBDT._packed``), the pow-2 bucket ladder
+(``_predict_bucket``), warm predict pinned at 1 dispatch + 1 accounted
+sync, per-bucket latency reservoirs, ``/metrics`` + ``/healthz`` — but
+each caller used to drive its own blocking predict, so K concurrent
+requests cost K dispatches, K syncs and K host staging allocations.
+This module is the PROCESS tying the primitives together, the
+continuous-batching insight from LLM serving applied to tree ensembles:
+
+* **Coalescing** — a request queue + coalescer thread packs concurrent
+  requests for the same (model, raw/converted) group into the smallest
+  covering bucket rung, with a ``serve_max_wait_ms`` admission window
+  and an IMMEDIATE flush the moment a rung fills.  Rows are sliced back
+  out per request; because rows traverse independently, conversions are
+  rowwise, and bucket padding is pinned bit-identical, every coalesced
+  response is BITWISE equal to the individual ``Booster.predict`` call
+  it replaces (tests/test_serve.py).  The coalesced batch reuses an
+  already-compiled bucket executable — zero retraces by construction.
+* **Pinned, double-buffered staging** — one reused host buffer PAIR per
+  bucket rung (the round-12 out-of-core reused-buffer discipline applied
+  to serving: one copy per request into the shared batch buffer, never a
+  fresh per-batch allocation — jaxlint R15 bans the anti-pattern), and a
+  one-deep dispatch handoff so batch k+1 stages + uploads while batch k
+  executes.  The dispatch itself goes through
+  ``GBDT.predict_coalesced`` — the SAME jitted entries as the
+  single-caller warm path (pinned by the ``predict_coalesced_bucket``
+  jaxpr-audit contract), joining the accounted ``sync_pull`` protocol:
+  ONE dispatch + ONE blocking sync per coalesced batch, telemetry and
+  tracing on (tests/test_predict_budget.py).
+* **Load shedding** — submissions past ``serve_max_queue``, past a
+  tenant's ``serve_tenant_quota``, past the ``serve_slo_p99_ms`` SLO
+  (driven off the existing warm-latency reservoirs, only under queue
+  pressure), or while ``/healthz`` reports unhealthy are SHED with a
+  typed :class:`Overloaded` error — counted, evented, ``/healthz``
+  visible via the ``serve_shedding`` gauge, and never a hang.
+* **Multi-model multi-tenant** — N packed ensembles resident behind one
+  bucket ladder; each model name is a tenant (quota + latency labels).
+  :meth:`ServingRuntime.swap_model` builds the replacement's pack BEFORE
+  publishing it, and ``GBDT._packed``'s version key (bump-on-mutate, not
+  null-on-mutate) keeps the previous pack servable for in-flight
+  predicts — a hot swap never cools the cache.
+
+This module owns NO jitted code: it may only stage, enqueue and dispatch
+the existing accounted entries (pinned by tests/test_serve.py's AST
+check) — the whole point is that the serving loop cannot grow a second
+executable family.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from queue import Queue
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..basic import Booster, LightGBMError
+from ..models.gbdt import _predict_bucket
+from ..obs import metrics as _obs
+from ..obs import server as _obs_server
+from ..obs import trace as _trace
+
+# one coalesced batch never exceeds this many rows (the top rung the
+# coalescer will fill; single requests larger than this still serve, as
+# their own batch through the ordinary ladder)
+MAX_BATCH_ROWS = 4096
+# SLO/health shed-state recompute cadence: percentile + health derivation
+# sort reservoirs and walk counters, so the verdict is cached briefly
+# instead of recomputed per request
+_SHED_REFRESH_S = 0.05
+
+
+class Overloaded(LightGBMError):
+    """A submission the runtime REFUSED (queue bound, tenant quota, p99
+    SLO, or unhealthy process) — the typed, immediate alternative to an
+    unbounded queue.  ``reason`` is the shed cause
+    (``queue_full`` / ``tenant_quota`` / ``slo_p99`` / ``unhealthy``)."""
+
+    def __init__(self, reason: str, tenant: str):
+        super().__init__(
+            f"serving runtime shed the request (reason={reason}, "
+            f"tenant={tenant}) — see serve_shed_total / the serve_shed "
+            "event stream")
+        self.reason = reason
+        self.tenant = tenant
+
+
+class _Request:
+    """One queued predict: host rows + completion event.  ``x`` is
+    already cast to f64 (mirroring ``Booster.predict``'s intake cast, so
+    the staged f32 batch holds the same bits an individual call would)."""
+
+    __slots__ = ("x", "n", "model", "raw", "serial", "event", "result",
+                 "error", "t0", "t_done")
+
+    def __init__(self, x: np.ndarray, model: str, raw: bool):
+        self.x = x
+        self.n = int(x.shape[0])
+        self.model = model
+        self.raw = raw
+        self.serial = False
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.t0 = time.perf_counter()
+        self.t_done: Optional[float] = None  # stamped at completion —
+        # open-loop harnesses read t_done - t0 for true request latency
+
+
+def _unwrap(model) -> Any:
+    """Booster -> its GBDT; a GBDT passes through (the bench harness
+    builds synthetic GBDTs directly)."""
+    return model._gbdt if isinstance(model, Booster) else model
+
+
+class ServingRuntime:
+    """In-process async serving over one or more trained models.
+
+    >>> rt = ServingRuntime(booster, max_wait_ms=2.0)
+    >>> with rt:
+    ...     y = rt.predict(X)                  # blocking, coalesced
+    ...     h = rt.submit(X2); y2 = rt.result(h)   # async pair
+
+    Construction does not start threads unless ``start=True`` (the
+    default); an unstarted runtime still queues submissions, which drain
+    on :meth:`start` — the deterministic harness tests and the open-loop
+    bench build on.  Defaults for the knobs come from the first model's
+    Config (``serve_max_wait_ms`` / ``serve_max_queue`` /
+    ``serve_slo_p99_ms`` / ``serve_tenant_quota``); explicit kwargs win.
+    ``shed_unhealthy=False`` opts out of health-driven shedding (the
+    process-cumulative health counters may reflect unrelated earlier
+    work, e.g. in a shared test process).
+    """
+
+    def __init__(self, model=None, *, models: Optional[Dict[str, Any]] = None,
+                 max_wait_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 slo_p99_ms: Optional[float] = None,
+                 tenant_quota: Optional[int] = None,
+                 shed_unhealthy: bool = True,
+                 start: bool = True):
+        if (model is None) == (models is None):
+            raise LightGBMError(
+                "ServingRuntime needs exactly one of model= (single) or "
+                "models= (a {name: Booster} table)")
+        table = {"default": model} if models is None else dict(models)
+        if not table:
+            raise LightGBMError("ServingRuntime needs at least one model")
+        self._models: Dict[str, Any] = {n: _unwrap(m)
+                                        for n, m in table.items()}
+        cfg = next(iter(self._models.values())).cfg
+        self._max_wait_s = (float(cfg.serve_max_wait_ms) if max_wait_ms is None
+                            else float(max_wait_ms)) / 1e3
+        self._max_queue = (int(cfg.serve_max_queue) if max_queue is None
+                           else int(max_queue))
+        self._slo_p99_ms = (float(cfg.serve_slo_p99_ms) if slo_p99_ms is None
+                            else float(slo_p99_ms))
+        self._tenant_quota = (int(cfg.serve_tenant_quota)
+                              if tenant_quota is None else int(tenant_quota))
+        self._shed_unhealthy = bool(shed_unhealthy)
+
+        self._cv = threading.Condition()
+        self._queue: List[_Request] = []
+        self._queued_per_tenant: Dict[str, int] = {}
+        # depth-1 handoff: the coalescer blocks here while the dispatcher
+        # is one batch behind — the one-deep double-buffered device feed
+        self._hand: Queue = Queue(maxsize=1)
+        # (nb, f) -> free-list of pinned (rows, mask) pairs (two per
+        # rung).  A pair is checked OUT at staging and returned by the
+        # dispatcher only after the batch's accounted sync retired —
+        # this is what makes reuse safe even where jax.device_put
+        # zero-copy ALIASES the host buffer (the CPU backend does:
+        # mutating the numpy source after device_put mutates the device
+        # array), so a toggle scheme keyed on batch parity would corrupt
+        # an in-flight batch under sustained load
+        self._staging: Dict[Tuple[int, int], Queue] = {}
+        self._shed_cache: Tuple[float, Optional[str]] = (-1e9, None)
+        self._running = False
+        self._started = False
+        self._closed = False
+        self._coalescer: Optional[threading.Thread] = None
+        self._dispatcher: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ServingRuntime":
+        if self._closed:
+            raise LightGBMError("ServingRuntime is stopped")
+        if self._started:
+            return self
+        self._started = True
+        self._running = True
+        self._coalescer = threading.Thread(
+            target=self._coalesce_loop, daemon=True, name="lgbmtpu-coalescer")
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="lgbmtpu-dispatch")
+        self._dispatcher.start()
+        self._coalescer.start()
+        _obs.event("serve_start", models=sorted(self._models),
+                   max_wait_ms=self._max_wait_s * 1e3,
+                   max_queue=self._max_queue)
+        return self
+
+    def stop(self) -> None:
+        """Drain the queue, then stop both threads.  Idempotent; never
+        abandons an accepted request (each either completes or carries
+        an error)."""
+        with self._cv:
+            if self._closed:
+                return
+            # closed + drained under ONE lock section: a submit racing
+            # this either raised on the under-lock _closed check or its
+            # request is already visible to the draining coalescer
+            self._closed = True
+            self._running = False
+            self._cv.notify_all()
+        if self._started:
+            self._coalescer.join(timeout=30)
+            self._dispatcher.join(timeout=30)
+            if self._coalescer.is_alive() or self._dispatcher.is_alive():
+                # a wedged worker must not let stop() silently abandon
+                # accepted requests: fail everything still queued loudly
+                # (in-flight batch requests stay with the wedged thread,
+                # but their callers' result(timeout=) bounds the wait)
+                with self._cv:
+                    pending, self._queue = self._queue, []
+                for r in pending:
+                    r.error = LightGBMError(
+                        "ServingRuntime stopped with a wedged worker "
+                        "thread; request was never dispatched")
+                    r.event.set()
+                _obs.event("serve_stop_wedged",
+                           failed_requests=len(pending))
+        else:
+            # never-started runtime: fail whatever was queued, loudly
+            with self._cv:
+                pending, self._queue = self._queue, []
+            for r in pending:
+                r.error = LightGBMError(
+                    "ServingRuntime stopped before starting")
+                r.event.set()
+        _obs.gauge("serve_queue_depth").set(0.0)
+        _obs.event("serve_stop")
+
+    def __enter__(self) -> "ServingRuntime":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- model table -----------------------------------------------------
+    def models(self) -> List[str]:
+        with self._cv:
+            return sorted(self._models)
+
+    def add_model(self, name: str, model) -> None:
+        g = _unwrap(model)
+        g._packed(0, -1)  # resident before the first request hits it
+        with self._cv:
+            if name in self._models:
+                raise LightGBMError(
+                    f"model {name!r} already served — use swap_model")
+            self._models[name] = g
+
+    def swap_model(self, name: str, model) -> None:
+        """Hot-swap a served ensemble: the replacement's pack is built
+        device-resident BEFORE publication, and in-flight batches keep
+        the old GBDT's (versioned) pack — no request ever observes a
+        cold cache (tests/test_serve.py pins this)."""
+        g = _unwrap(model)
+        if name not in self._models:
+            raise LightGBMError(f"model {name!r} is not served")
+        g._packed(0, -1)  # warm the new pack outside the serving path
+        with self._cv:
+            self._models[name] = g
+        _obs.counter("serve_model_swaps_total").inc()
+        _obs.event("serve_model_swap", model=name)
+
+    # -- client API ------------------------------------------------------
+    def predict(self, X, *, model: str = "default", raw_score: bool = False,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking coalesced predict — semantics (and bits) of
+        ``Booster.predict(X, raw_score=raw_score)``.  Raises
+        :class:`Overloaded` when shed, ``TimeoutError`` past
+        ``timeout`` seconds."""
+        return self.result(self.submit(X, model=model, raw_score=raw_score),
+                           timeout=timeout)
+
+    def submit(self, X, *, model: str = "default",
+               raw_score: bool = False) -> _Request:
+        """Enqueue one request (admission control happens HERE — a shed
+        raises immediately, an accepted request always resolves).
+        Returns a handle for :meth:`result`."""
+        g = self._models.get(model)
+        if g is None:
+            raise LightGBMError(f"model {model!r} is not served "
+                                f"(have {sorted(self._models)})")
+        X = np.asarray(X, dtype=np.float64)  # Booster.predict's intake cast
+        if X.ndim == 1:
+            X = X[None, :]
+        # the SLO/health verdict refresh snapshots the registry (sorts
+        # reservoirs, runs collectors) — computed OUTSIDE the condition
+        # lock so a refresh never stalls the coalescer's bookkeeping or
+        # concurrent submits; the cached tuple is read under the lock
+        self._refresh_shed_state()
+        shed: Optional[str] = None
+        req: Optional[_Request] = None
+        with self._cv:
+            # _closed re-checked UNDER the lock: a submit racing stop()
+            # must either be failed here or be visible to the draining
+            # coalescer — never appended after the drain finished
+            if self._closed:
+                raise LightGBMError("ServingRuntime is stopped")
+            if len(self._queue) >= self._max_queue:
+                shed = "queue_full"
+            elif (self._tenant_quota > 0 and self._queued_per_tenant.get(
+                    model, 0) >= self._tenant_quota):
+                shed = "tenant_quota"
+            else:
+                shed = self._shed_cache[1]
+                if shed == "slo_p99" and not self._queue:
+                    # SLO shedding only under queue pressure — a lone
+                    # request after a slow spell must serve, or the
+                    # cumulative p99 could latch the runtime shut
+                    shed = None
+            if shed is None:
+                req = _Request(X, model, bool(raw_score))
+                self._queue.append(req)
+                self._queued_per_tenant[model] = (
+                    self._queued_per_tenant.get(model, 0) + 1)
+                _obs.gauge("serve_queue_depth").set(len(self._queue))
+                self._cv.notify_all()
+            self._publish_shed_gauge()
+        if shed is not None:
+            _obs.counter("serve_shed_total").inc()
+            _obs.counter(_obs.labeled("serve_shed_total",
+                                      tenant=model)).inc()
+            _obs.event("serve_shed", reason=shed, tenant=model,
+                       rows=int(X.shape[0]))
+            raise Overloaded(shed, model)
+        _obs.counter("serve_requests_total").inc()
+        _obs.counter(_obs.labeled("serve_requests_total",
+                                  tenant=model)).inc()
+        return req
+
+    def result(self, req: _Request,
+               timeout: Optional[float] = None) -> np.ndarray:
+        if not req.event.wait(timeout):
+            raise TimeoutError("serving request did not complete in "
+                               f"{timeout}s (queue depth "
+                               f"{len(self._queue)})")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            return {"queue_depth": len(self._queue),
+                    "models": sorted(self._models),
+                    "staging_rungs": sorted(k[0] for k in self._staging),
+                    "running": self._running}
+
+    # -- shedding --------------------------------------------------------
+    def _refresh_shed_state(self) -> None:
+        """Recompute the cached SLO/health shed verdict at most every
+        _SHED_REFRESH_S.  Runs WITHOUT self._cv (the registry snapshot
+        and reservoir percentile are the expensive part); the cache is a
+        single tuple publish, safe to read under the lock.  Concurrent
+        refreshes are harmless (same verdict, last write wins)."""
+        now = time.monotonic()
+        if now - self._shed_cache[0] < _SHED_REFRESH_S:
+            return
+        reason = None
+        if self._slo_p99_ms > 0:
+            p99 = _obs.histogram("predict_warm_latency_ms").percentile(99)
+            if p99 is not None and p99 > self._slo_p99_ms:
+                reason = "slo_p99"
+        if reason is None and self._shed_unhealthy:
+            code, _body = _obs_server.health()
+            if code == 503:
+                reason = "unhealthy"
+        self._shed_cache = (now, reason)
+
+    def _shedding_now(self) -> bool:
+        """CURRENT shed state, derived from live queue/tenant/SLO state
+        (under self._cv) — not a latch toggled per submission, so an
+        idle drained runtime reads healthy and a tenant still at quota
+        keeps /healthz degraded even while other tenants serve."""
+        if len(self._queue) >= self._max_queue:
+            return True
+        if self._tenant_quota > 0 and any(
+                v >= self._tenant_quota
+                for v in self._queued_per_tenant.values()):
+            return True
+        reason = self._shed_cache[1]
+        if reason == "unhealthy":
+            return True
+        return reason == "slo_p99" and bool(self._queue)
+
+    def _publish_shed_gauge(self) -> None:
+        """Under self._cv: recompute the /healthz-driving gauge from
+        current state (obs/server.py DEGRADED_GAUGES)."""
+        _obs.gauge("serve_shedding").set(
+            1.0 if self._shedding_now() else 0.0)
+
+    # -- coalescer -------------------------------------------------------
+    def _coalesce_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and self._running:
+                    self._cv.wait(0.1)
+                if not self._queue:
+                    break  # stopped and drained
+                first = self._queue.pop(0)
+                self._note_dequeued(first)
+            # the caller owns the batch list: if ANYTHING below raises
+            # (a pack build in _coalescible, a device OOM in device_put),
+            # every already-popped request is failed loudly and the
+            # thread keeps serving — a dead coalescer would turn every
+            # future predict() into the unbounded hang the Overloaded
+            # machinery exists to prevent
+            batch: List[_Request] = [first]
+            try:
+                g = self._build_batch(first, batch)
+                self._stage_and_hand(g, batch)
+            except BaseException as e:  # noqa: BLE001
+                for r in batch:
+                    r.error = e
+                    r.event.set()
+        self._hand.put(None)  # dispatcher stop sentinel
+
+    def _note_dequeued(self, req: _Request) -> None:
+        """Under self._cv: tenant + depth bookkeeping for one pop."""
+        left = self._queued_per_tenant.get(req.model, 1) - 1
+        self._queued_per_tenant[req.model] = max(left, 0)
+        _obs.gauge("serve_queue_depth").set(len(self._queue))
+        # draining clears the shed state without waiting for a submit
+        self._publish_shed_gauge()
+
+    def _build_batch(self, first: _Request, batch: List[_Request]):
+        """Admission: gather requests compatible with ``first`` (same
+        model, same raw/converted group, same feature width).  The batch
+        flushes the moment a pow-2 rung fills exactly, MAX_BATCH_ROWS is
+        reached, or — the continuous-batching rule — the dispatch
+        pipeline is IDLE: waiting for companions while the device sits
+        empty only adds latency, whereas a busy pipeline grows the batch
+        for free (new arrivals queue while batch k executes).  The
+        ``serve_max_wait_ms`` window bounds the busy-pipeline wait.
+
+        Fills the caller-owned ``batch`` list (so an exception cannot
+        strand a popped request) and returns the resolved model — it
+        rides along so a concurrent ``swap_model`` between eligibility
+        check and staging cannot hand the batch a model it was not
+        built against."""
+        g = self._models.get(first.model)
+        if g is None or not g._coalescible(first.raw):
+            first.serial = True
+            _obs.counter("serve_uncoalesced_total").inc()
+            return g
+        total = first.n
+        f = first.x.shape[1]
+        deadline = time.monotonic() + self._max_wait_s
+        with self._cv:
+            while True:
+                took = True
+                while took and total < MAX_BATCH_ROWS:
+                    took = False
+                    for i, r in enumerate(self._queue):
+                        if (r.model == first.model and r.raw == first.raw
+                                and r.x.shape[1] == f
+                                and total + r.n <= MAX_BATCH_ROWS):
+                            batch.append(self._queue.pop(i))
+                            self._note_dequeued(r)
+                            total += r.n
+                            took = True
+                            break
+                if (total >= MAX_BATCH_ROWS
+                        or total == _predict_bucket(total)
+                        or self._hand.unfinished_tasks == 0):
+                    break  # rung filled, cap reached, or idle pipeline
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._running:
+                    break
+                self._cv.wait(remaining)
+        return g
+
+    def _checkout_staging(self, nb: int, f: int):
+        """Check a pinned (rows, mask) pair OUT of rung ``nb``'s
+        free-list — allocated once (two pairs per rung, the double
+        buffer), then recycled through :meth:`_return_staging` when the
+        owning batch's accounted sync has retired.  Blocks when both
+        pairs are in flight (a >2-deep pipeline cannot form anyway: the
+        depth-1 handoff bounds it), which is precisely the discipline
+        that keeps reuse safe under zero-copy ``device_put`` aliasing."""
+        key = (nb, f)
+        pool = self._staging.get(key)
+        if pool is None:
+            pool = Queue()
+            for _ in range(2):
+                pool.put((np.zeros((nb, f), np.float32),
+                          np.zeros(nb, bool)))
+            self._staging[key] = pool
+        return key, pool.get()
+
+    def _return_staging(self, key, pair) -> None:
+        self._staging[key].put(pair)
+
+    def _stage_and_hand(self, g, batch: List[_Request]) -> None:
+        """Pack the batch into the rung's pinned buffer (ONE copy per
+        request), upload, and hand to the dispatcher.  The blocking
+        depth-1 put is the pipeline: this upload overlaps the previous
+        batch's device execution."""
+        if batch[0].serial:
+            self._hand.put(("serial", batch, g))
+            return
+        total = sum(r.n for r in batch)
+        nb = _predict_bucket(total)
+        skey, pair = self._checkout_staging(nb, batch[0].x.shape[1])
+        try:
+            buf, mask = pair
+            off = 0
+            for r in batch:
+                buf[off:off + r.n] = r.x  # f64->f32, same bits as _pad_rows
+                off += r.n
+            buf[off:] = 0.0
+            mask[:off] = True
+            mask[off:] = False
+            x_dev = jax.device_put(buf)
+            active = None if off == nb else jax.device_put(mask)
+            self._hand.put(("batch", batch,
+                            (g, x_dev, active, total, nb, skey, pair)))
+        except BaseException:
+            # a failed stage (device OOM in device_put, ...) must return
+            # the pair: leaking it would shrink the rung's 2-pair pool
+            # and eventually block _checkout_staging forever — wedging
+            # the coalescer, the hang this module exists to prevent.
+            # (After a successful put the DISPATCHER owns the return.)
+            self._return_staging(skey, pair)
+            raise
+
+    # -- dispatcher ------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._hand.get()
+            if item is None:
+                self._hand.task_done()
+                return
+            kind, batch, payload = item
+            t_batch = time.perf_counter()
+            staging = None
+            try:
+                if kind == "serial":
+                    (r,) = batch
+                    g = payload if payload is not None \
+                        else self._models[r.model]
+                    r.result = g.predict(r.x, raw_score=r.raw)
+                else:
+                    g, x_dev, active, total, nb, skey, pair = payload
+                    staging = (skey, pair)
+                    convert = ((not batch[0].raw)
+                               and g.objective is not None)
+                    res = g.predict_coalesced(x_dev, active, total,
+                                              convert=convert)
+                    off = 0
+                    for r in batch:
+                        r.result = res[off:off + r.n]
+                        off += r.n
+                    _obs.counter("serve_batches_total").inc()
+                    _obs.counter("serve_coalesced_rows_total").inc(total)
+                    _obs.histogram("serve_batch_occupancy").observe(
+                        total / nb)
+            except BaseException as e:  # noqa: BLE001 — a failed batch
+                for r in batch:  # must fail its requests, not the thread
+                    r.error = e
+            finally:
+                # the batch's sync has retired (or it failed): its
+                # pinned pair may be reused — only now is mutation safe
+                # under zero-copy device_put aliasing
+                if staging is not None:
+                    self._return_staging(*staging)
+                # latency closes AFTER predict_coalesced's accounted
+                # sync_pull — the device queue has provably drained, so
+                # the reservoir is honest (the jaxlint-R9 contract)
+                now = time.perf_counter()
+                for r in batch:
+                    r.t_done = now
+                    dt_ms = (now - r.t0) * 1e3
+                    _obs.histogram("serve_request_latency_ms").observe(dt_ms)
+                    _obs.histogram(_obs.labeled(
+                        "serve_request_latency_ms",
+                        tenant=r.model)).observe(dt_ms)
+                    r.event.set()
+                _trace.record_span(
+                    "serve.batch", now - t_batch, requests=len(batch),
+                    rows=sum(r.n for r in batch), model=batch[0].model,
+                    coalesced=kind == "batch")
+                # unfinished_tasks drops to 0 only here: the coalescer's
+                # idle-pipeline flush reads it, so "idle" honestly means
+                # the previous batch has fully retired (sync included) —
+                # and the notify wakes a window-waiting coalescer so the
+                # admission window stays a busy-pipeline-only cost
+                self._hand.task_done()
+                with self._cv:
+                    self._cv.notify_all()
+
+
+# -- audit hook (analysis/contracts.py predict_coalesced_bucket) --------
+def audit_dispatch_fn(k: int = 1):
+    """The jitted callable one coalesced raw batch dispatches — resolved
+    through the SAME selector the dispatch path uses
+    (``GBDT._coalesced_raw_fn``), so the jaxpr-audit contract traces the
+    serving loop's real executable family and a runtime that grew its own
+    entry would change what gets audited."""
+    from ..models.gbdt import GBDT
+    return GBDT._coalesced_raw_fn(k)

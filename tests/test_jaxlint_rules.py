@@ -1760,6 +1760,97 @@ def test_r14_pragma_suppression(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# R15 staging-alloc-in-serve-loop
+# ---------------------------------------------------------------------------
+
+def test_r15_positive_fresh_alloc_in_serve_loop(tmp_path):
+    """The anti-pattern the pinned-buffer serving design exists to
+    prevent: a fresh staging buffer allocated per request iteration."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        def serve_loop(g, requests):
+            outs = []
+            for X in requests:
+                buf = np.zeros((128, X.shape[1]), np.float32)
+                buf[: X.shape[0]] = X
+                outs.append(g.predict_raw(buf))
+            return outs
+    """}, rules=["R15"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "R15"
+    assert rep.findings[0].line == 7
+
+
+def test_r15_positive_upload_of_fresh_host_array(tmp_path):
+    """jnp.asarray over a freshly constructed host array inside the loop:
+    allocate-then-upload per call — ONE finding, not two (the wrapped
+    alloc reports as the upload form)."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax.numpy as jnp
+        import numpy as np
+        from .san import sync_pull
+
+        def drive(entry, reqs):
+            for X in reqs:
+                out = entry(jnp.asarray(np.empty((8, 4), np.float32)))
+                sync_pull(out)
+    """, "san.py": """
+        def sync_pull(x):
+            return x
+    """}, rules=["R15"])
+    assert len(rep.findings) == 1, rep.findings
+    assert "allocate-then-upload" in rep.findings[0].message
+
+
+def test_r15_negative_pinned_buffer_reused_across_iterations(tmp_path):
+    """The sanctioned pattern: the buffer hoisted out of the loop, filled
+    per request, uploaded BY NAME — exactly serve/runtime.py's staging."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def serve_loop(g, requests, f):
+            buf = np.zeros((128, f), np.float32)  # pinned, reused
+            outs = []
+            for X in requests:
+                buf[: X.shape[0]] = X
+                outs.append(g.predict_raw(jnp.asarray(buf)))
+            return outs
+    """}, rules=["R15"])
+    assert rep.findings == [], rep.findings
+
+
+def test_r15_negative_alloc_in_non_predict_loop(tmp_path):
+    """Loops with no accounted predict entry (setup, training drivers)
+    are out of scope — R1/R14 own their allocation hygiene."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        def build_tables(sizes):
+            tables = []
+            for n in sizes:
+                tables.append(np.zeros((n, 4), np.float32))
+            return tables
+    """}, rules=["R15"])
+    assert rep.findings == [], rep.findings
+
+
+def test_r15_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import numpy as np
+
+        def replay(g, reqs):
+            for X in reqs:
+                pad = np.zeros((64, 4), np.float32)  # jaxlint: disable=R15 (fixture: one-shot replay tool, not a serving loop)
+                pad[: X.shape[0]] = X
+                g.predict_raw(pad)
+    """}, rules=["R15"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
 # stale-pragma detection (P1)
 # ---------------------------------------------------------------------------
 

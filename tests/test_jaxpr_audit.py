@@ -38,7 +38,8 @@ def test_contract_catalogue_pins_the_flagships():
         "windowed_round_float", "windowed_round_quantized",
         "windowed_round_sharded_psum", "windowed_round_sharded_scatter",
         "predict_warm_single", "predict_warm_multiclass",
-        "predict_warm_converted", "ooc_root_chunk", "ooc_split_chunk",
+        "predict_warm_converted", "predict_coalesced_bucket",
+        "ooc_root_chunk", "ooc_split_chunk",
     } <= set(CONTRACTS)
 
 
@@ -62,9 +63,26 @@ def test_single_device_bodies_are_collective_free(report):
     for r in report.results:
         if r.name in ("windowed_round_float", "windowed_round_quantized",
                       "predict_warm_single", "predict_warm_multiclass",
-                      "predict_warm_converted", "ooc_root_chunk",
-                      "ooc_split_chunk"):
+                      "predict_warm_converted", "predict_coalesced_bucket",
+                      "ooc_root_chunk", "ooc_split_chunk"):
             assert r.detail.get("collectives") == [], (r.name, r.detail)
+
+
+def test_coalesced_dispatch_is_the_warm_predict_family():
+    """ISSUE 13: the serving runtime's coalesced dispatch must be the
+    SAME traced executable family as warm predict — pinned two ways: the
+    runtime's selector resolves to the very predict_ops functions the
+    warm contracts audit (identity, so the contract traces the serving
+    loop's real dispatch), and the audited body is collective-free /
+    transfer-free like its warm siblings (the report gate above)."""
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.ops import predict as predict_ops
+    from lightgbm_tpu.serve.runtime import audit_dispatch_fn
+
+    assert audit_dispatch_fn(1) is predict_ops.predict_raw_values
+    assert audit_dispatch_fn(4) is predict_ops.predict_raw_multiclass
+    assert GBDT._coalesced_raw_fn(1) is predict_ops.predict_raw_values
+    assert GBDT._coalesced_raw_fn(3) is predict_ops.predict_raw_multiclass
 
 
 def test_donations_all_consumable(report):

@@ -203,6 +203,46 @@ def test_snapshot_roundtrip_and_renderers(tmp_path):
         obs.validate_snapshot({"schema": "bogus"})
 
 
+def test_serve_reservoirs_render_as_label_sets_one_family():
+    """Round 18 (ISSUE 13 satellite): the per-entry warm-latency
+    reservoirs are LABEL SETS on the one ``predict_warm_latency_ms``
+    family — ``{entry="raw"}`` next to the round-11 ``{bucket="..."}``
+    labels — not the deprecated dotted-suffix names, which rendered as a
+    separate Prometheus family per entry.  Pins the rendered label sets
+    and the stable family count."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(120, 5)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 7,
+                              "verbosity": -1},
+                      train_set=lgb.Dataset(X, label=y))
+    for _ in range(2):
+        bst.update()
+    for _ in range(2):  # first call cold (compiles), second warm (records)
+        bst.predict(X, raw_score=True)
+        bst.predict(X)
+
+    snap = obs.snapshot()
+    hists = snap["histograms"]
+    nb = 128  # the bucket X pads to
+    assert 'predict_warm_latency_ms{entry="raw"}' in hists
+    assert 'predict_warm_latency_ms{entry="converted"}' in hists
+    assert f'predict_warm_latency_ms{{bucket="{nb}"}}' in hists
+    assert not any("." in name and name.startswith("predict_warm_latency_ms")
+                   for name in hists), "dotted-suffix reservoir names back"
+
+    prom = obs.render_prometheus(snap)
+    # ONE summary family, every variant a label set on it
+    assert prom.count("# TYPE lgbmtpu_predict_warm_latency_ms summary") == 1
+    assert "lgbmtpu_predict_warm_latency_ms_raw" not in prom
+    assert 'lgbmtpu_predict_warm_latency_ms{entry="raw",quantile="0.5"}' \
+        in prom
+    assert ('lgbmtpu_predict_warm_latency_ms{entry="converted",'
+            'quantile="0.99"}') in prom
+    assert f'lgbmtpu_predict_warm_latency_ms{{bucket="{nb}",quantile=' \
+        in prom
+
+
 def test_obs_cli_dumps_snapshot(tmp_path, capsys):
     from lightgbm_tpu.obs.__main__ import main as obs_main
 

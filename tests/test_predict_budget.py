@@ -317,14 +317,110 @@ def test_refit_and_leaf_edit_invalidate_packed_cache():
 
 def test_shuffle_models_invalidates_packed_cache():
     """Order changes the early-stop chunking but not the full sum; the
-    cache must repack either way — pin via the packed arrays changing."""
+    cache must repack either way.  Round 18: mutation BUMPS the pack
+    version instead of nulling the dict — the pre-shuffle entries stay
+    resident (hot-swap friendliness) but are unreachable by the new
+    version-keyed lookup, so the next predict packs fresh."""
     bst, X, _ = _binary_booster(rounds=4)
     bst.predict(X, raw_score=True)
     g = bst._gbdt
     assert g._pred_cache  # populated
+    v0 = g._pack_version
     np.random.seed(0)
     bst.shuffle_models()
-    assert not g._pred_cache, "shuffle left a stale packed ensemble cached"
+    assert g._pack_version == v0 + 1
+    assert all(key[0] <= v0 for key in g._pred_cache), \
+        "shuffle left a current-version packed ensemble cached"
+    from lightgbm_tpu.obs import metrics as _obs
+    misses0 = _obs.counter("predict_packed_cache_misses_total").value
+    bst.predict(X, raw_score=True)
+    assert _obs.counter("predict_packed_cache_misses_total").value \
+        == misses0 + 1, "post-shuffle predict served a stale pack"
+
+
+def test_packed_versioning_keeps_previous_pack_servable_during_swap():
+    """The hot-swap mechanism (round 18, lightgbm_tpu/serve + the
+    continuous-training roadmap item): a mutation bumps the version, and
+    the PREVIOUS version's pack stays resident and servable — an
+    in-flight serving reader that grabbed the pre-mutation pack keeps
+    working, bitwise, while new predicts see the new trees."""
+    bst, X, _ = _binary_booster(rounds=3)
+    old_clone = lgb.Booster(model_str=bst.model_to_string())
+    before = bst.predict(X[:40], raw_score=True)
+    g = bst._gbdt
+    s_old = g._packed(0, -1)
+    v0 = g._pack_version
+    bst.update()  # the swap: in-place mutation under a live serving loop
+    # the old pack is retained one version back...
+    assert any(key[0] == v0 for key in g._pred_cache), \
+        "mutation evicted the in-flight pack"
+    # ...and its device arrays still serve the OLD model's bits
+    nb = _predict_bucket(40)
+    x = g._pad_rows(np.asarray(X[:40], np.float64), nb)
+    active = g._active_mask(40, nb)
+    out = predict_ops.predict_raw_values(
+        x, s_old["split_feature"], s_old["threshold"],
+        s_old["default_left"], s_old["missing_type"], s_old["left_child"],
+        s_old["right_child"], s_old["num_leaves"], s_old["leaf_value"],
+        active=active)
+    got_old = np.asarray(out, np.float64)[:40]
+    assert np.array_equal(got_old, before)
+    assert np.array_equal(before, old_clone.predict(X[:40], raw_score=True))
+    # new predicts use the new version (fresh trees included)
+    after = bst.predict(X[:40], raw_score=True)
+    assert not np.array_equal(before, after)
+
+
+def test_stale_pack_versions_evicted_and_counted():
+    """Retention is LRU-bounded (default: current + previous version);
+    older versions evict with a counter, so a long-lived serving process
+    training every round cannot leak packs."""
+    from lightgbm_tpu.obs import metrics as _obs
+
+    bst, X, _ = _binary_booster(rounds=2)
+    g = bst._gbdt
+    evict0 = _obs.counter("predict_stale_pack_evictions_total").value
+    versions = []
+    for _ in range(3):
+        bst.predict(X[:16], raw_score=True)  # populate this version's pack
+        versions.append(g._pack_version)
+        bst.update()  # bump
+    assert _obs.counter("predict_stale_pack_evictions_total").value \
+        > evict0
+    live = {key[0] for key in g._pred_cache}
+    keep = g._PACKED_KEEP_VERSIONS
+    assert all(v > g._pack_version - keep for v in live), (live,
+                                                          g._pack_version)
+    assert versions[0] not in live  # the oldest version is gone
+
+
+def test_coalesced_batch_budget_and_parity():
+    """The serving loop's dispatch entry (GBDT.predict_coalesced): one
+    coalesced batch of K requests is ONE dispatch + ONE accounted sync,
+    reusing the SAME executables as warm predict (zero retraces), and
+    the packed rows slice back out bitwise equal to the individual
+    calls.  The runtime-level version (threads + staging + server ON)
+    lives in tests/test_serve.py; this is the entry-level pin."""
+    import jax
+
+    bst, X, _ = _binary_booster()
+    g = bst._gbdt
+    parts = [X[0:10], X[10:17], X[17:32]]  # 32 rows: exact rung fill
+    want = [bst.predict(p, raw_score=True) for p in parts]
+    batch = np.concatenate(parts, axis=0)
+    x = jax.device_put(np.asarray(batch, np.float64).astype(np.float32))
+    g.predict_coalesced(x, None, 32, convert=False)  # warm the 32 bucket
+
+    with DispatchCounter() as d:
+        out = g.predict_coalesced(x, None, 32, convert=False)
+    assert d.dispatches == 1, d.dispatches
+    assert d.host_syncs == 1, d.host_syncs
+    d.assert_no_recompile("warm coalesced batch")
+    off = 0
+    for w in want:
+        assert np.array_equal(w, out[off:off + len(w)]), \
+            "coalesced rows diverged from the individual predict"
+        off += len(w)
 
 
 def test_no_trees_and_single_row_paths():

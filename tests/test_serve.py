@@ -1,0 +1,422 @@
+"""Serving-runtime pins (round 18, ISSUE 13 — lightgbm_tpu/serve).
+
+The continuous micro-batching contract: coalesced responses are BITWISE
+equal to individual ``Booster.predict`` calls (single, multiclass,
+converted), one coalesced batch costs ONE dispatch + ONE accounted sync
+with telemetry, span tracing and the HTTP server ON, overload sheds with
+a typed ``Overloaded`` error (never a hang), hot-swapping a model never
+cools the cache, tenants are quota-bounded and label-attributed — and
+the serve module owns NO jitted code, so the serving loop can only
+dispatch the already-audited warm-predict executables.
+"""
+
+import ast
+import json
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import metrics as obs
+from lightgbm_tpu.serve import MAX_BATCH_ROWS, Overloaded, ServingRuntime
+from lightgbm_tpu.utils.sanitizer import DispatchCounter
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    from lightgbm_tpu.obs import server as _srv
+    from lightgbm_tpu.obs import trace as _trc
+
+    obs.reset()
+    _trc.reset_trace()
+    yield
+    _srv.stop_server()
+    obs.reset()
+    _trc.reset_trace()
+
+
+def _binary_booster(n=400, f=6, rounds=4, seed=0, **extra):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    params.update(extra)
+    bst = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+    for _ in range(rounds):
+        bst.update()
+    return bst, X
+
+
+def _multiclass_booster(n=300, f=5, k=3, rounds=3, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = rng.randint(0, k, n).astype(float)
+    bst = lgb.Booster(params={"objective": "multiclass", "num_class": k,
+                              "num_leaves": 7, "verbosity": -1},
+                      train_set=lgb.Dataset(X, label=y))
+    for _ in range(rounds):
+        bst.update()
+    return bst, X
+
+
+def _queue_then_start(rt, parts, **kw):
+    """Deterministic coalescing harness: enqueue every request on the
+    UNSTARTED runtime, then start — the coalescer finds them all queued
+    and packs maximally, no wall-clock races."""
+    handles = [rt.submit(p, **kw) for p in parts]
+    rt.start()
+    return [rt.result(h, timeout=60) for h in handles]
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity: coalesced == individual (the acceptance headline)
+# ---------------------------------------------------------------------------
+
+def test_coalesced_bitwise_parity_single_and_converted():
+    bst, X = _binary_booster()
+    parts = [X[0:10], X[10:17], X[17:40], X[40:41]]
+    want_raw = [bst.predict(p, raw_score=True) for p in parts]
+    want_cvt = [bst.predict(p) for p in parts]
+
+    rt = ServingRuntime(bst, max_wait_ms=200, start=False,
+                        shed_unhealthy=False)
+    got_raw = _queue_then_start(rt, parts, raw_score=True)
+    got_cvt = [rt.result(h, timeout=60)
+               for h in [rt.submit(p) for p in parts]]
+    rt.stop()
+    for w, g in zip(want_raw, got_raw):
+        assert np.array_equal(w, g), "coalesced raw diverged"
+    for w, g in zip(want_cvt, got_cvt):
+        assert np.array_equal(w, g), "coalesced converted diverged"
+    # the raw group really coalesced: 4 requests, 1 batch
+    assert obs.counter("serve_batches_total").value >= 1
+    assert obs.counter("serve_requests_total").value == 8
+
+
+def test_coalesced_bitwise_parity_multiclass():
+    bst, X = _multiclass_booster()
+    parts = [X[0:9], X[9:30], X[30:32]]
+    want_raw = [bst.predict(p, raw_score=True) for p in parts]
+    want_cvt = [bst.predict(p) for p in parts]
+    rt = ServingRuntime(bst, max_wait_ms=200, start=False,
+                        shed_unhealthy=False)
+    got_raw = _queue_then_start(rt, parts, raw_score=True)
+    got_cvt = [rt.result(h, timeout=60)
+               for h in [rt.submit(p) for p in parts]]
+    rt.stop()
+    for w, g in zip(want_raw + want_cvt, got_raw + got_cvt):
+        assert np.array_equal(w, g), "coalesced multiclass diverged"
+
+
+def test_concurrent_callers_parity():
+    """C concurrent blocking callers through a LIVE runtime: every
+    response equals its individual predict, and the queue drains."""
+    bst, X = _binary_booster()
+    slices = [X[i * 16:(i + 1) * 16] for i in range(8)]
+    want = [bst.predict(s, raw_score=True) for s in slices]
+    errs = []
+
+    with ServingRuntime(bst, max_wait_ms=20,
+                        shed_unhealthy=False) as rt:
+        def call(i):
+            try:
+                got = rt.predict(slices[i], raw_score=True, timeout=60)
+                assert np.array_equal(got, want[i]), f"caller {i} diverged"
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert rt.stats()["queue_depth"] == 0
+    assert not errs, errs
+
+
+# ---------------------------------------------------------------------------
+# the budget: 1 dispatch + 1 accounted sync per coalesced batch, with
+# telemetry + span tracing + the HTTP server ON
+# ---------------------------------------------------------------------------
+
+def test_coalesced_batch_budget_with_server_and_tracing_on():
+    from lightgbm_tpu.obs import server as _srv
+    from lightgbm_tpu.obs import trace as _trc
+
+    srv = _srv.start_server(0)
+    bst, X = _binary_booster()
+    parts = [X[0:8], X[8:16], X[16:24], X[24:32]]  # 32 rows: exact rung
+
+    def run_once():
+        rt = ServingRuntime(bst, max_wait_ms=200, start=False,
+                            shed_unhealthy=False)
+        out = _queue_then_start(rt, parts, raw_score=True)
+        rt.stop()
+        return out
+
+    batches0 = obs.counter("serve_batches_total").value
+    run_once()  # warm: compiles the 32-row coalesced bucket once
+    assert obs.counter("serve_batches_total").value == batches0 + 1
+
+    with DispatchCounter() as d:
+        got = run_once()
+    assert obs.counter("serve_batches_total").value == batches0 + 2
+    assert d.dispatches == 1, d.dispatches
+    assert d.host_syncs == 1, d.host_syncs
+    d.assert_no_recompile("warm coalesced batch (telemetry+tracing+server)")
+    for w, g in zip([bst.predict(p, raw_score=True) for p in parts], got):
+        assert np.array_equal(w, g)
+
+    # the serving loop left its telemetry through the LIVE endpoint
+    prom = urllib.request.urlopen(srv.url("/metrics"),
+                                  timeout=10).read().decode()
+    assert "lgbmtpu_serve_batches_total" in prom
+    assert "lgbmtpu_serve_queue_depth" in prom
+    assert "lgbmtpu_serve_batch_occupancy" in prom
+    assert 'lgbmtpu_serve_request_latency_ms{quantile=' in prom.replace(
+        '{tenant="default",quantile=', '{quantile=') or \
+        'lgbmtpu_serve_request_latency_ms' in prom
+    assert _trc.spans("serve.batch"), "no serve.batch spans"
+    assert _trc.spans("predict.coalesced"), "no coalesced predict spans"
+    occ = obs.histogram("serve_batch_occupancy")
+    assert occ.count >= 2 and occ.max <= 1.0
+
+
+def test_rung_fill_flushes_before_the_admission_window():
+    """32 queued rows fill the 32-rung exactly: the batch must flush
+    immediately, not after the (deliberately huge) admission window."""
+    bst, X = _binary_booster()
+    rt = ServingRuntime(bst, max_wait_ms=30_000, start=False,
+                        shed_unhealthy=False)
+    t0 = time.monotonic()
+    _queue_then_start(rt, [X[0:16], X[16:32]], raw_score=True)
+    elapsed = time.monotonic() - t0
+    rt.stop()
+    assert elapsed < 10, f"rung-fill flush waited {elapsed:.1f}s"
+
+
+# ---------------------------------------------------------------------------
+# load shedding: typed, counted, evented, /healthz-visible — never a hang
+# ---------------------------------------------------------------------------
+
+def test_queue_bound_sheds_with_typed_error_and_healthz_state():
+    from lightgbm_tpu.obs import server as _srv
+
+    bst, X = _binary_booster()
+    rt = ServingRuntime(bst, max_queue=2, start=False, shed_unhealthy=False)
+    rt.submit(X[:4])
+    rt.submit(X[:4])
+    with pytest.raises(Overloaded) as ei:
+        rt.submit(X[:4])
+    assert ei.value.reason == "queue_full"
+    assert ei.value.tenant == "default"
+    assert obs.counter("serve_shed_total").value == 1
+    assert obs.counter(
+        obs.labeled("serve_shed_total", tenant="default")).value == 1
+    assert [e for e in obs.events("serve_shed")
+            if e["reason"] == "queue_full"]
+    # /healthz: degraded + shedding while the gauge is up
+    assert obs.gauge("serve_shedding").value == 1.0
+    code, body = _srv.health()
+    assert code == 200 and body["status"] == "degraded"
+    assert body["shedding"] is True
+    # draining clears the state: accepted submissions reset the gauge
+    rt.start()
+    out = rt.predict(X[:4], timeout=60)
+    assert out.shape == (4,)
+    assert obs.gauge("serve_shedding").value == 0.0
+    assert _srv.health()[1]["shedding"] is False
+    rt.stop()
+
+
+def test_slo_p99_sheds_under_queue_pressure_only():
+    bst, X = _binary_booster()
+    bst.predict(X[:8], raw_score=True)  # cold compile
+    bst.predict(X[:8], raw_score=True)  # warm: populates the reservoir
+    assert obs.histogram("predict_warm_latency_ms").count >= 1
+    rt = ServingRuntime(bst, slo_p99_ms=1e-6, start=False,
+                        shed_unhealthy=False)
+    rt.submit(X[:4])  # empty queue: the SLO alone must NOT shed
+    with pytest.raises(Overloaded) as ei:
+        rt.submit(X[:4])  # queued + p99 over SLO: shed
+    assert ei.value.reason == "slo_p99"
+    rt.start()
+    rt.stop()
+
+
+def test_unhealthy_process_sheds_when_enabled():
+    bst, X = _binary_booster()
+    obs.counter("train_nonfinite_errors_total").inc()  # unhealthy state
+    rt = ServingRuntime(bst, start=False)  # shed_unhealthy defaults True
+    with pytest.raises(Overloaded) as ei:
+        rt.submit(X[:4])
+    assert ei.value.reason == "unhealthy"
+    # opting out serves anyway (the test-suite escape the docstring notes)
+    rt2 = ServingRuntime(bst, start=False, shed_unhealthy=False)
+    rt2.submit(X[:4])
+    rt2.start()
+    rt2.stop()
+    rt.stop()
+
+
+def test_result_timeout_never_hangs():
+    bst, X = _binary_booster()
+    rt = ServingRuntime(bst, start=False, shed_unhealthy=False)
+    h = rt.submit(X[:4])
+    with pytest.raises(TimeoutError):
+        rt.result(h, timeout=0.05)  # runtime never started: must not hang
+    rt.stop()
+    with pytest.raises(lgb.LightGBMError):
+        rt.result(h, timeout=5)  # stop() failed the pending request loudly
+
+
+# ---------------------------------------------------------------------------
+# multi-model, tenants, hot swap
+# ---------------------------------------------------------------------------
+
+def test_multi_model_routing_and_tenant_labels():
+    b1, X = _binary_booster(rounds=2, seed=3)
+    b2, _ = _binary_booster(rounds=6, seed=4)
+    rt = ServingRuntime(models={"a": b1, "b": b2}, max_wait_ms=100,
+                        start=False, shed_unhealthy=False)
+    ha = rt.submit(X[:12], model="a", raw_score=True)
+    hb = rt.submit(X[:12], model="b", raw_score=True)
+    rt.start()
+    got_a, got_b = rt.result(ha, timeout=60), rt.result(hb, timeout=60)
+    rt.stop()
+    assert np.array_equal(got_a, b1.predict(X[:12], raw_score=True))
+    assert np.array_equal(got_b, b2.predict(X[:12], raw_score=True))
+    assert not np.array_equal(got_a, got_b)
+    assert obs.counter(
+        obs.labeled("serve_requests_total", tenant="a")).value == 1
+    assert obs.counter(
+        obs.labeled("serve_requests_total", tenant="b")).value == 1
+    assert obs.histogram(
+        obs.labeled("serve_request_latency_ms", tenant="a")).count == 1
+
+
+def test_tenant_quota_sheds_one_tenant_not_the_other():
+    b1, X = _binary_booster(rounds=2, seed=3)
+    b2, _ = _binary_booster(rounds=3, seed=4)
+    rt = ServingRuntime(models={"a": b1, "b": b2}, tenant_quota=1,
+                        start=False, shed_unhealthy=False)
+    rt.submit(X[:4], model="a")
+    with pytest.raises(Overloaded) as ei:
+        rt.submit(X[:4], model="a")
+    assert ei.value.reason == "tenant_quota" and ei.value.tenant == "a"
+    rt.submit(X[:4], model="b")  # the other tenant keeps serving
+    rt.start()
+    rt.stop()
+
+
+def test_hot_swap_serves_new_model_and_never_cools_the_cache():
+    b1, X = _binary_booster(rounds=2, seed=5)
+    b2, _ = _binary_booster(rounds=7, seed=6)
+    with ServingRuntime(b1, max_wait_ms=20,
+                        shed_unhealthy=False) as rt:
+        got1 = rt.predict(X[:16], raw_score=True, timeout=60)
+        assert np.array_equal(got1, b1.predict(X[:16], raw_score=True))
+        # swap builds the replacement's pack BEFORE publishing it
+        assert not b2._gbdt._pred_cache
+        rt.swap_model("default", b2)
+        assert b2._gbdt._pred_cache, "swap published a cold pack"
+        got2 = rt.predict(X[:16], raw_score=True, timeout=60)
+        assert np.array_equal(got2, b2.predict(X[:16], raw_score=True))
+        # the OLD model's pack was never invalidated by the swap: an
+        # in-flight predict against b1 would still be a cache hit
+        assert b1._gbdt._pred_cache
+    assert obs.counter("serve_model_swaps_total").value == 1
+    assert obs.events("serve_model_swap")
+
+
+# ---------------------------------------------------------------------------
+# serial fallback: ineligible models still serve, uncoalesced
+# ---------------------------------------------------------------------------
+
+def test_early_stop_model_serves_serially_and_matches_predict():
+    bst, X = _binary_booster(rounds=8, pred_early_stop=True,
+                             pred_early_stop_freq=2,
+                             pred_early_stop_margin=0.5)
+    want = bst.predict(X[:64])
+    with ServingRuntime(bst, max_wait_ms=20,
+                        shed_unhealthy=False) as rt:
+        got = rt.predict(X[:64], timeout=60)
+    assert np.array_equal(want, got)
+    assert obs.counter("serve_uncoalesced_total").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# engine entry + structural pins
+# ---------------------------------------------------------------------------
+
+def test_engine_serve_entry_starts_runtime_and_endpoint():
+    from lightgbm_tpu.obs import server as _srv
+
+    bst, X = _binary_booster()
+    rt = lgb.serve(bst, {"serve_max_wait_ms": 1, "metrics_port": 0})
+    try:
+        assert isinstance(rt, ServingRuntime)
+        got = rt.predict(X[:8], raw_score=True, timeout=60)
+        assert np.array_equal(got, bst.predict(X[:8], raw_score=True))
+        srv = _srv.get_server()
+        assert srv is not None
+        hz = json.load(urllib.request.urlopen(srv.url("/healthz"),
+                                              timeout=10))
+        assert hz["status"] in ("ok", "degraded")
+    finally:
+        rt.stop()
+
+
+def test_serve_module_owns_no_jitted_code():
+    """The serving loop may only STAGE and DISPATCH the existing audited
+    entries — a serve-owned jit/pjit/pallas_call would open a second
+    executable family the predict_coalesced_bucket contract cannot see."""
+    from lightgbm_tpu.serve import runtime as serve_rt
+
+    serve_dir = Path(serve_rt.__file__).resolve().parent
+    banned = {"jit", "pjit", "pallas_call", "shard_map"}
+    for py in serve_dir.glob("*.py"):
+        tree = ast.parse(py.read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and node.attr in banned:
+                raise AssertionError(
+                    f"{py.name}:{node.lineno} uses {node.attr} — the serve "
+                    "module must not own jitted code")
+            if isinstance(node, ast.Name) and node.id in banned:
+                raise AssertionError(
+                    f"{py.name}:{node.lineno} references {node.id}")
+
+
+def test_serve_name_is_both_entry_point_and_namespace():
+    """`lgb.serve` is the entry-point FUNCTION (engine.serve), and the
+    subpackage's public names are grafted onto it so every import
+    spelling works — the attribute-shadowing trap is closed."""
+    import importlib
+
+    assert callable(lgb.serve)
+    assert lgb.serve.ServingRuntime is ServingRuntime
+    assert lgb.serve.Overloaded is Overloaded
+    assert lgb.serve.MAX_BATCH_ROWS == MAX_BATCH_ROWS
+    mod = importlib.import_module("lightgbm_tpu.serve")
+    assert mod.ServingRuntime is ServingRuntime
+    from lightgbm_tpu.serve.runtime import ServingRuntime as SR2
+    assert SR2 is ServingRuntime
+    assert lgb.serve.runtime.ServingRuntime is ServingRuntime
+
+
+def test_max_batch_rows_caps_one_batch():
+    assert MAX_BATCH_ROWS >= 8
+    bst, X = _binary_booster(n=64)
+    # a single request larger than the cap still serves (its own batch)
+    big = np.tile(X, (MAX_BATCH_ROWS // 64 + 1, 1))
+    want = bst.predict(big, raw_score=True)
+    rt = ServingRuntime(bst, max_wait_ms=5, start=False,
+                        shed_unhealthy=False)
+    got = _queue_then_start(rt, [big], raw_score=True)[0]
+    rt.stop()
+    assert np.array_equal(want, got)
