@@ -55,6 +55,13 @@ Serve mode (round 18): BENCH_MODE=serve runs the serving-LOOP benchmark
 warm executable vs per-request serial predicts, closed + open loop,
 bitwise parity and the jaxpr-audit verdict asserted in-artifact);
 knobs SERVE_BENCH_*.
+
+Continual mode (round 19): BENCH_MODE=continual runs the train-while-
+serving loop benchmark (benchmarks/continual_bench.py — streaming
+ingest rows/s incl. the durable CRC'd cache append, refit vs
+append-trees update latency, and serve p50/p99 ACROSS zero-downtime
+rollovers vs the BENCH_serve_r01 baseline, rollover parity + audit
+verdict asserted in-artifact); knobs CONTINUAL_BENCH_*.
 """
 
 import json
@@ -349,6 +356,16 @@ def main():
         from benchmarks.serve_bench import main as serve_main
 
         return serve_main()
+    if os.environ.get("BENCH_MODE") == "continual":
+        # continual-training loop (round 19): streaming ingest rows/s,
+        # refit vs append update latency, serve p50/p99 ACROSS rollovers
+        # vs the BENCH_serve_r01 baseline, with in-artifact parity +
+        # audit verdict (BENCH_continual_* row)
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.continual_bench import main as continual_main
+
+        return continual_main()
     if os.environ.get("BENCH_MODE") == "ooc":
         # out-of-core/partition data-path levers (BENCH_ooc_* artifact)
         import sys as _sys

@@ -284,12 +284,64 @@ def bench_serve(n_rows=600, n_feat=8, n_trees=12):
     return len(parts), batches, sum(p.shape[0] for p in parts) / dt
 
 
+def bench_continual(n_rows=600, n_feat=6, n_trees=6):
+    """Round-19 continual smoke: a refit + an append rollover through a
+    live ServingRuntime must keep every response bitwise equal to a
+    published ensemble's cold predict, drop the staleness gauge to zero,
+    and leave the continual snapshot keys — so an off-chip CI run
+    catches train-while-serving regressions in the artifact path."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import metrics as _obs
+    from lightgbm_tpu.serve import ServingRuntime
+
+    rng = np.random.RandomState(19)
+    X = rng.randn(n_rows, n_feat)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                              "max_bin": 63, "verbosity": -1},
+                      train_set=ds)
+    for _ in range(n_trees):
+        bst.update()
+    rt = ServingRuntime(bst, max_wait_ms=2, shed_unhealthy=False)
+    cr = lgb.continual_train(bst, {"append_trees": 2}, runtime=rt,
+                             reference=ds, start=False)
+    Q = rng.randn(32, n_feat)
+    t0 = time.perf_counter()
+    for kind in ("refit", "append"):
+        Xc = rng.randn(200, n_feat)
+        yc = (Xc[:, 0] + 0.4 * Xc[:, 1] > 0).astype(float)
+        cr.ingest(Xc, yc)
+        assert _obs.gauge("model_staleness_rows").value >= 200
+        done = cr.update(kind)
+        assert done == kind
+        assert _obs.gauge("model_staleness_rows").value == 0.0
+        got = rt.predict(Q, raw_score=True, timeout=120)
+        assert np.array_equal(
+            got, cr.booster.predict(Q, raw_score=True)), (
+            f"served response diverged from the {kind}-published ensemble")
+    dt = time.perf_counter() - t0
+    rt.stop()
+    assert cr.booster.num_trees() == n_trees + 2
+
+    snap = _obs.snapshot()
+    _obs.validate_snapshot(snap)
+    for key in ("continual_rollovers_total", "continual_refits_total",
+                "continual_appends_total", "continual_ingested_rows_total"):
+        assert key in snap["counters"], f"metrics snapshot missing {key}"
+    for key in ("model_staleness_rows", "model_staleness_s"):
+        assert key in snap["gauges"], f"metrics snapshot missing {key}"
+    assert len(_obs.events("continual_rollover")) == 2
+    return 2, cr.booster.num_trees(), dt
+
+
 def main():
     n = int(os.environ.get("SMOKE_ROWS", 1_000_000))
     iters = int(os.environ.get("SMOKE_ITERS", 10))
     which = (sys.argv[1].split(",") if len(sys.argv) > 1
              else ["rank", "multiclass", "predict", "serve", "ooc",
-                   "megakernel"])
+                   "megakernel", "continual"])
     if "rank" in which:
         ips = bench_rank(n, q_len=128, iters=iters)
         print(f"lambdarank {n//1000}k rows x64f q128 63bins: {ips:.2f} iters/sec", flush=True)
@@ -313,6 +365,12 @@ def main():
         leaves, dt = bench_megakernel()
         print(f"megakernel 2k rows x10f: {leaves}-leaf tree bitwise == "
               f"three-pass round ({dt:.1f}s interpret, snapshot keys ok)",
+              flush=True)
+    if "continual" in which:
+        rollovers, trees, dt = bench_continual()
+        print(f"continual 600 rows x6f: {rollovers} zero-downtime "
+              f"rollovers (refit+append) -> {trees} trees, served "
+              f"bitwise, staleness drops, snapshot keys ok ({dt:.1f}s)",
               flush=True)
 
 

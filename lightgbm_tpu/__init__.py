@@ -13,6 +13,7 @@ Public API mirrors python-package/lightgbm/__init__.py.
 from .basic import Booster, CorruptModelError, Dataset, LightGBMError, Sequence_ as Sequence
 from .callback import EarlyStopException, early_stopping, log_evaluation, record_evaluation, reset_parameter
 from . import serve as _serve_pkg
+from .continual import ContinualError, ContinualRunner
 from .serve import Overloaded, ServingRuntime
 from .serve import runtime as _serve_runtime_mod
 
@@ -20,7 +21,7 @@ from .serve import runtime as _serve_runtime_mod
 # `lightgbm_tpu.serve` resolves to the entry-point FUNCTION (engine.serve);
 # the module itself stays importable as `from lightgbm_tpu.serve import ...`
 # (sys.modules resolution is unaffected by the attribute shadowing).
-from .engine import CVBooster, cv, serve, train
+from .engine import CVBooster, continual_train, cv, serve, train
 from .utils.guards import NonFiniteError
 from .utils.log import register_logger
 
@@ -48,6 +49,9 @@ __all__ = [
     "serve",
     "ServingRuntime",
     "Overloaded",
+    "continual_train",
+    "ContinualRunner",
+    "ContinualError",
     "early_stopping",
     "log_evaluation",
     "record_evaluation",

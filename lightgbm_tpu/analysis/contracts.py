@@ -569,6 +569,48 @@ def _build_predict_coalesced_bucket() -> Target:
                        "construction, and this contract pins it")
 
 
+@contract(
+    "continual_refit_leaves",
+    description="the continual runner's leaf-refit dispatch (lightgbm_tpu/"
+                "continual/refit.py::make_refit_entry): the stacked leaf-"
+                "index traversal + per-tree gradient/segment-sum/renewal "
+                "scan + score accumulation, fused into ONE donated "
+                "executable — the update that runs at ingest cadence "
+                "beside live serving, so it must stay collective-free, "
+                "transfer-free, and consume its donated leaf table (the "
+                "caller uploads a FRESH table, never the serving pack's "
+                "buffer).  Resolved through the runtime's own builder "
+                "(continual.refit.audit_refit_fn), so a refit path that "
+                "grew a second executable family fails here statically",
+    collectives=(),
+    donated_args=(0,),
+    # the scan carries (N,) score + per-tree (L,) sums; measured peak is
+    # well under 1 MB at the 128x8/T8/L8 fixture — 2 MB headroom catches
+    # an accidental (T, N) or (N, L) materialization
+    max_live_bytes=2 << 20,
+)
+def _build_continual_refit_leaves() -> Target:
+    import jax.numpy as jnp
+
+    from ..continual.refit import audit_refit_fn
+
+    s = _packed_sds()
+    fn = audit_refit_fn()
+    args = (s["leaf_value"],                    # donated (T, L) leaf table
+            _sds((_PT,), jnp.float32),          # per-tree shrinkage
+            _sds((_PN, _PF), jnp.float32),      # bucket-padded window rows
+            s["split_feature"], s["threshold"], s["default_left"],
+            s["missing_type"], s["left_child"], s["right_child"],
+            s["num_leaves"],
+            None, None, None, None,             # non-categorical pack
+            _sds((_PN,), jnp.float32),          # padded labels
+            _sds((_PN,), jnp.bool_))            # active mask
+    return Target(fn, args, {},
+                  note="regression objective (the binary/other single-"
+                       "output entries share the same trace shape: "
+                       "gradients are elementwise over the score)")
+
+
 # ---------------------------------------------------------------------------
 # spill grower chunk steps (ops/treegrow_ooc.py)
 # ---------------------------------------------------------------------------

@@ -1443,3 +1443,95 @@ def r15_staging_alloc_in_serve_loop(pkg: PackageIndex) -> Iterator[Finding]:
                             f"(...)) in {fi.qualname}'s serving loop — "
                             "allocate-then-upload of a fresh host array "
                             "per iteration", hint)
+
+
+# ---------------------------------------------------------------------------
+# R16 — mutation-outside-version-bump
+# ---------------------------------------------------------------------------
+
+# the ensemble state whose mutation MUST route through the versioned
+# pack invalidation: the tree list and the per-tree leaf tables
+_R16_ENSEMBLE_ATTRS = ("models", "_models", "leaf_value")
+_R16_LIST_MUTATORS = ("append", "extend", "insert", "pop", "remove",
+                      "clear", "sort", "reverse")
+_R16_BUMP = "_invalidate_pred_cache"
+# only serve/continual code paths are in scope: they run BESIDE live
+# serving readers, where an unbumped mutation hands an in-flight predict
+# a pack that no longer matches the trees (docs/ANALYSIS.md static-limits
+# note covers the rest of the tree)
+_R16_SCOPED_DIRS = ("serve", "continual")
+
+
+def _r16_ensemble_attr(node: ast.AST) -> Optional[str]:
+    """The ensemble attribute an expression touches: ``x.models`` /
+    ``x._models`` / ``tree.leaf_value`` (as an Attribute), or a Subscript
+    over one (``x.models[i]``, ``tree.leaf_value[k]``)."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in _R16_ENSEMBLE_ATTRS:
+        return node.attr
+    return None
+
+
+def _r16_has_bump(fi: FuncInfo) -> bool:
+    for node in _own_body(fi):
+        if isinstance(node, ast.Call):
+            fn = dotted_name(node.func) or ""
+            if fn.split(".")[-1] == _R16_BUMP:
+                return True
+    return False
+
+
+@register_rule("R16", "mutation-outside-version-bump")
+def r16_mutation_outside_version_bump(pkg: PackageIndex) -> Iterator[Finding]:
+    """An ensemble-mutating write in serve/continual code that does not
+    route through ``_invalidate_pred_cache``: assigning to ``.models`` /
+    ``._models`` / ``.leaf_value`` (whole, element, or slice) or calling
+    a list mutator on them, in a function whose own body never bumps the
+    pack version.  The round-18 ``_packed`` cache is keyed on
+    ``_pack_version``; a mutation that skips the bump leaves the CURRENT
+    version's device pack describing trees that no longer exist — a
+    live serving reader then returns predictions from the pre-mutation
+    ensemble indefinitely (stale, not just racy), and the round-19 lock
+    making bump+lookup atomic cannot help a bump that never happens.
+    Scoped to modules under ``serve/`` and ``continual/`` directories —
+    the code that runs beside live serving readers; trainer-side
+    mutations elsewhere are covered by the versioned key's belt-and-
+    braces components and the runtime budget pins (static-limits note in
+    docs/ANALYSIS.md)."""
+    hint = ("mutate, then call gbdt._invalidate_pred_cache('<reason>') in "
+            "the SAME function (continual/refit.py::refit_leaves is the "
+            "pattern) — or mutate a private clone and publish it through "
+            "ServingRuntime.swap_model")
+    for mod in pkg.modules.values():
+        parts = getattr(mod.path, "parts", ())
+        if not any(d in parts for d in _R16_SCOPED_DIRS):
+            continue
+        for fi in mod.functions.values():
+            if _r16_has_bump(fi):
+                continue
+            for node in _own_body(fi):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        attr = _r16_ensemble_attr(t)
+                        if attr is not None:
+                            yield _finding(
+                                fi, node, "R16",
+                                f"write to .{attr} in {fi.qualname} "
+                                "without a _pack_version bump — the "
+                                "serving pack cache now describes trees "
+                                "that no longer exist", hint)
+                elif (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _R16_LIST_MUTATORS):
+                    attr = _r16_ensemble_attr(node.func.value)
+                    if attr is not None:
+                        yield _finding(
+                            fi, node, "R16",
+                            f".{attr}.{node.func.attr}(...) in "
+                            f"{fi.qualname} without a _pack_version bump "
+                            "— an in-place ensemble edit invisible to "
+                            "the versioned pack cache", hint)

@@ -900,42 +900,21 @@ class Dataset:
         return self
 
     def _savez_binary(self, fh) -> None:
-        from .io.stream import DEFAULT_CRC_ROWS, bin_crc32s
+        # one writer for every save_binary cache (io/stream.py): the
+        # per-chunk CRC32 trailer table BinCacheStream re-verifies on
+        # every streamed sweep rides along, so a torn or bit-rotted cache
+        # fails row-ranged instead of training on garbage bins
+        # (docs/ROBUSTNESS.md); the continual runner creates and APPENDS
+        # to the same format through write_bin_cache/append_rows
+        from .io.stream import write_bin_cache
 
-        ms = self.binner.mappers
-        # per-chunk CRC32 trailer table (io/stream.py): BinCacheStream
-        # re-verifies every streamed sweep against it, so a torn or
-        # bit-rotted cache fails row-ranged instead of training on
-        # garbage bins (docs/ROBUSTNESS.md).  Computed over the C-order
-        # bytes np.save writes.
-        bins_c = np.ascontiguousarray(self.bins)
-        np.savez_compressed(
-            fh,
-            bins=bins_c,
-            bins_crc32=bin_crc32s(bins_c),
-            bins_crc_rows=np.asarray(DEFAULT_CRC_ROWS, np.int64),
-            label=self.label if self.label is not None else np.zeros(0),
-            weight=self.weight if self.weight is not None else np.zeros(0),
-            group=self.group if self.group is not None else np.zeros(0, np.int64),
+        write_bin_cache(
+            fh, self.bins, self.binner.mappers,
+            label=self.label, weight=self.weight, group=self.group,
             # reference Metadata persists init_score and positions too
             # (SaveBinaryFile/LoadFromBinFile round-trip)
-            init_score=(self.init_score if self.init_score is not None
-                        else np.zeros(0)),
-            position=(self.position if self.position is not None
-                      else np.zeros(0, np.int64)),
-            uppers=np.concatenate([np.asarray(m.upper_bounds, np.float64)
-                                   for m in ms]),
-            upper_sizes=np.asarray([len(m.upper_bounds) for m in ms]),
-            missing_types=np.asarray([m.missing_type for m in ms]),
-            cats=np.concatenate([
-                np.asarray(m.categories, np.float64)
-                if m.categories is not None else np.zeros(0) for m in ms]),
-            cat_sizes=np.asarray([
-                len(m.categories) if m.categories is not None else 0
-                for m in ms]),
-            min_values=np.asarray([m.min_value for m in ms], np.float64),
-            max_values=np.asarray([m.max_value for m in ms], np.float64),
-            feature_names=np.asarray(self.feature_names),
+            init_score=self.init_score, position=self.position,
+            feature_names=self.feature_names,
         )
 
     # -- tree traversal on binned data ----------------------------------
@@ -1179,9 +1158,13 @@ class Booster:
         end = len(models) if end_iteration < 0 else min(end_iteration, len(models))
         seg = models[start_iteration:end]
         np.random.shuffle(seg)
-        self._gbdt.models[start_iteration:end] = seg
-        self._gbdt._invalidate_pred_cache("shuffle_models")  # order changed:
-        # bump-on-mutate — the pre-shuffle pack stays servable one version back
+        # mutation + version bump in ONE pack-lock section (round 19): a
+        # concurrent serving pack build either completes before this and
+        # stays consistent, or observes the bump at insert time and
+        # rebuilds — it can never cache a half-shuffled pack
+        with self._gbdt._plock():
+            self._gbdt.models[start_iteration:end] = seg
+            self._gbdt._invalidate_pred_cache("shuffle_models")
         return self
 
     def _init_score_offset(self) -> float:
@@ -1482,9 +1465,12 @@ class Booster:
         return self
 
     def set_leaf_output(self, tree_id: int, leaf_id: int, value: float) -> "Booster":
-        self._gbdt.models[tree_id].leaf_value[leaf_id] = value
-        self._gbdt._invalidate_pred_cache("set_leaf_output")  # in-place edit
-        # (bump-on-mutate: in-flight serving readers keep the old pack)
+        # in-place edit + version bump atomically under the pack lock
+        # (round 19): in-flight serving readers keep the old pack, and a
+        # pack build racing this edit retries instead of caching a torn one
+        with self._gbdt._plock():
+            self._gbdt.models[tree_id].leaf_value[leaf_id] = value
+            self._gbdt._invalidate_pred_cache("set_leaf_output")
         return self
 
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:

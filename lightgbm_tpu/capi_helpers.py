@@ -977,13 +977,22 @@ def booster_refit_leaf_preds(bst: Booster, leaf_addr: int, nrow: int,
     # below aggregate weighted gradients exactly as training did
     w_j = (None if dsc.weight is None
            else _jnp.asarray(np.asarray(dsc.weight), _jnp.float32))
+    # compute every renewed leaf table WITHOUT touching the live trees
+    # (the loop pays per-iteration device gradient pulls — holding the
+    # pack lock through it would stall concurrent serving lookups for
+    # the whole refit, exactly what the round-19 _packed redesign keeps
+    # off the lock); the sequential score uses the renewed local table,
+    # so the math is unchanged
+    renewed = []
+    v0 = gbdt._pack_version  # structural-mutation guard for the write-back
     for t_i, tree in enumerate(gbdt.models):
         if t_i >= ncol:
             break
         c = t_i % k
         if c == 0:  # gradients refresh once per boosting iteration
             g, h = obj.get_gradients(_jnp.asarray(score, _jnp.float32),
-                                     _jnp.asarray(label, _jnp.float32), w_j)
+                                     _jnp.asarray(label, _jnp.float32),
+                                     w_j)
             g, h = np.asarray(g, np.float64), np.asarray(h, np.float64)
             if g.ndim == 1 and k > 1:
                 g, h = g.reshape(k, nrow).T, h.reshape(k, nrow).T
@@ -993,15 +1002,27 @@ def booster_refit_leaf_preds(bst: Booster, leaf_addr: int, nrow: int,
         sum_g = np.bincount(li, weights=gc, minlength=tree.num_leaves)
         sum_h = np.bincount(li, weights=hc, minlength=tree.num_leaves)
         new_vals = -sum_g / (sum_h + cfg.lambda_l2 + 1e-15) * tree.shrinkage
-        tree.leaf_value = decay * tree.leaf_value + (1.0 - decay) * np.where(
+        lv_new = decay * tree.leaf_value + (1.0 - decay) * np.where(
             sum_h > 0, new_vals, tree.leaf_value)
-        pred = tree.leaf_value[li]
+        renewed.append(lv_new)
+        pred = lv_new[li]
         if k > 1:
             score[:, c] += pred
         else:
             score += pred
-    gbdt._invalidate_pred_cache("capi_refit_leaf")  # renewed in place
-    # (bump-on-mutate: in-flight serving readers keep the old pack)
+    # write-back + version bump in ONE pack-lock section (round 19): a
+    # serving pack build racing this either completes before (consistent
+    # pre-refit state) or observes the bump at insert time and rebuilds —
+    # it can never cache a half-renewed ensemble under the old version
+    with gbdt._plock():
+        if gbdt._pack_version != v0:
+            raise RuntimeError(
+                "the ensemble mutated while LGBM_BoosterRefit ran — the "
+                "renewed leaf tables no longer map onto the current "
+                "trees; refit aborted, model unchanged")
+        for tree, lv_new in zip(gbdt.models, renewed):
+            tree.leaf_value = lv_new
+        gbdt._invalidate_pred_cache("capi_refit_leaf")  # renewed in place
     return True
 
 

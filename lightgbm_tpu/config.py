@@ -521,6 +521,30 @@ class Config:
     # caller cannot monopolize the chip.  0 (default) = unlimited.
     serve_tenant_quota: int = 0
 
+    # --- continual training (ours; README "Continuous training",
+    # lightgbm_tpu/continual) ---
+    # update_every_rows: the continual runner triggers an update
+    # (leaf-value refit, escalating to appended trees) once this many
+    # fresh rows have been ingested since the last rollover.  0 = no
+    # row-driven updates (update_every_s or explicit update() calls
+    # drive them).
+    update_every_rows: int = 0
+    # update_every_s: time-driven update trigger — an update fires when
+    # the OLDEST un-incorporated ingested row is this many seconds old,
+    # so a trickle of rows still reaches the model on a deadline.  0 =
+    # no time-driven updates.
+    update_every_s: float = 0.0
+    # append_trees: trees appended per escalated continual update, seeded
+    # init_model-style from the live ensemble (same growers, budgets and
+    # bitwise semantics as offline continued training).  0 (default) =
+    # refit-only: updates renew leaf values of the existing structure.
+    append_trees: int = 0
+    # drift_window: rows of recent ingest forming the rolling baseline
+    # the per-chunk label-drift gauge (continual_label_drift) compares
+    # against — the cheap covariate/label-shift signal riding the
+    # continual_chunk event stream.
+    drift_window: int = 8192
+
     # unknown/passthrough params preserved here
     extra: Dict[str, Any] = field(default_factory=dict)
     # names the user explicitly set (vs defaults) — lets device-specific

@@ -1,0 +1,9 @@
+"""Continuous training: streaming refit + zero-downtime rollover
+(README "Continuous training"; the train-while-serving loop beside
+``lightgbm_tpu/serve``)."""
+
+from .refit import ContinualError, make_refit_entry, refit_leaves
+from .runtime import ContinualRunner
+
+__all__ = ["ContinualRunner", "ContinualError", "refit_leaves",
+           "make_refit_entry"]

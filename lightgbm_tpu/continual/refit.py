@@ -1,0 +1,225 @@
+"""The continual leaf-refit executable (round 19; README "Continuous
+training").
+
+``Booster.refit`` is the reference's continued-training primitive
+(GBDT::RefitTree via LGBM_BoosterRefit): keep every tree's STRUCTURE,
+renew its leaf values on fresh data as
+``new = decay * old + (1 - decay) * (-G_leaf / (H_leaf + lambda_l2))``,
+with the per-tree gradients taken at the score accumulated from the
+already-renewed earlier trees.  The host implementation walks trees one
+at a time — T host traversals, T gradient pulls, T bincounts.  A
+continual runner refits at ingest cadence beside a live serving loop, so
+the update must cost like a predict, not like a training epoch: this
+module fuses the WHOLE refit — the stacked leaf-index traversal, the
+per-tree gradient/segment-sum/renewal scan, and the score accumulation —
+into ONE donated jitted dispatch (the ``continual_refit_leaves`` jaxpr
+contract pins it: zero collectives, donation consumed, transfer-free).
+
+Semantics notes (deliberate, documented deviations are none — this IS
+``Booster.refit``'s recipe, in f32 on device):
+
+* the score starts at 0 over the EXPORT-form trees (init score folded
+  into tree 0), exactly as ``Booster.refit`` runs on a
+  ``model_to_string`` round-trip;
+* a leaf no fresh row reaches (``sum_h == 0``) keeps its old value;
+* weights are not consulted (``Booster.refit`` passes ``weight=None``
+  to the objective too).
+
+Envelope: single-output objectives (``num_tree_per_iteration == 1``),
+non-linear leaves, no RF averaging — the same class of eligibility the
+coalesced serving path checks.  Ineligible models refuse loudly
+(``ContinualError``): silently refitting half a linear model would be a
+correctness bug wearing a latency win.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..basic import LightGBMError
+from ..ops import predict as predict_ops
+from ..utils import sanitizer as _san
+
+
+class ContinualError(LightGBMError):
+    """An operation outside the continual runtime's envelope (linear
+    leaves, multiclass device refit, missing mappers, ...)."""
+
+
+@functools.lru_cache(maxsize=8)
+def make_refit_entry(objective, decay: float, lam2: float):
+    """Build the jitted refit executable for one (objective, decay,
+    lambda_l2) configuration — memoized, so a runner (or repeated offline
+    refits over the same objective instance) reuses ONE trace cache:
+    every rollover reuses the compiled entry, zero retraces across
+    rollovers, one compile per window bucket rung (the
+    ``GBDT._get_convert_entry`` discipline, keyed on the factory args
+    instead of the instance).
+
+    Signature of the returned callable::
+
+        new_leaf = run(leaf_value, shrinkage, x, sf, th, dl, mt, lc, rc,
+                       nl, is_cat, cat_base, cat_nwords, cat_words,
+                       label, active)
+
+    ``leaf_value`` (T, L) f32 is DONATED (callers pass a fresh upload,
+    never the serving pack's cached buffer); ``x`` is a bucket-padded
+    (nb, F) f32 batch with ``active`` masking the tail (None at exact
+    fill), ``label`` the f32 targets padded alongside.  Returns the
+    renewed (T, L) f32 leaf table.
+    """
+    decay_f = jnp.float32(decay)
+    keep_f = jnp.float32(1.0 - float(decay))
+    lam2_f = jnp.float32(lam2)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(leaf_value, shrinkage, x, sf, th, dl, mt, lc, rc, nl,
+            is_cat, cat_base, cat_nwords, cat_words, label, active):
+        # stacked leaf-index traversal: (N, T) -> (T, N), the same
+        # vmapped walk the pred_leaf serving entry uses
+        leaves = predict_ops.predict_leaf_values(
+            x, sf, th, dl, mt, lc, rc, nl, is_cat=is_cat,
+            cat_base=cat_base, cat_nwords=cat_nwords, cat_words=cat_words)
+        leaves_t = leaves.T.astype(jnp.int32)  # (T, N)
+        n_leaf = leaf_value.shape[1]
+        actb = (jnp.ones(label.shape, jnp.bool_) if active is None
+                else active)
+
+        def step(score, per_tree):
+            lv, leaf, shrink = per_tree
+            g, h = objective.get_gradients(score, label, None)
+            g = jnp.where(actb, g.astype(jnp.float32), jnp.float32(0.0))
+            h = jnp.where(actb, h.astype(jnp.float32), jnp.float32(0.0))
+            sum_g = jnp.zeros((n_leaf,), jnp.float32).at[leaf].add(g)
+            sum_h = jnp.zeros((n_leaf,), jnp.float32).at[leaf].add(h)
+            new = -sum_g / (sum_h + lam2_f + jnp.float32(1e-15)) * shrink
+            lv_new = jnp.where(sum_h > 0, decay_f * lv + keep_f * new, lv)
+            # the renewed tree feeds the NEXT tree's gradients — the
+            # reference's sequential RefitTree order, kept exactly
+            score = score + jnp.where(actb, lv_new[leaf], jnp.float32(0.0))
+            return score, lv_new
+
+        score0 = jnp.zeros(label.shape, jnp.float32)
+        _, new_leaf = jax.lax.scan(
+            step, score0, (leaf_value, leaves_t, shrinkage))
+        return new_leaf
+
+    return run
+
+
+def refit_eligible(gbdt) -> Optional[str]:
+    """None when the device refit applies, else the human reason it
+    does not (the runner surfaces it in the ContinualError)."""
+    if gbdt.num_tree_per_iteration != 1:
+        return ("multiclass ensembles refit per-class scores the device "
+                "scan does not model yet")
+    if gbdt.average_output:
+        return "RF-averaged ensembles renew against scaled scores"
+    s = gbdt._packed(0, -1)
+    if s is None:
+        return "the ensemble is empty"
+    if s["_linear"]:
+        return ("linear leaves carry per-leaf linear terms a leaf-value "
+                "refit would silently drop")
+    return None
+
+
+def refit_leaves(gbdt, X: np.ndarray, label: np.ndarray, *,
+                 entry=None) -> int:
+    """Refit ``gbdt``'s leaf values on ``(X, label)`` in ONE donated
+    dispatch + ONE accounted sync, writing the renewed values back into
+    the host trees and version-bumping the packed cache.  Returns the
+    number of rows used.
+
+    ``entry`` is a prebuilt :func:`make_refit_entry` executable (the
+    runner's cached one); None builds a throwaway (tests, one-shot
+    offline use).  The donated leaf table is a FRESH upload — the cached
+    serving pack's buffer is never donated, so in-flight readers of the
+    current pack version are untouched until the version bump."""
+    from ..models.gbdt import _predict_bucket
+
+    why = refit_eligible(gbdt)
+    if why is not None:
+        raise ContinualError(f"device refit does not apply: {why} "
+                             "(lightgbm_tpu/continual/refit.py envelope)")
+    if entry is None:
+        entry = make_refit_entry(
+            gbdt.objective, float(gbdt.cfg.refit_decay_rate),
+            float(gbdt.cfg.lambda_l2))
+    s = gbdt._packed(0, -1)
+    trees = s["_trees"]
+    # structural-mutation guard: the renewed tables are computed from
+    # THIS pack snapshot and written back positionally — any concurrent
+    # mutation (shuffle/rollback/leaf edit, all of which bump the pack
+    # version) would silently attach them to the wrong trees, so the
+    # write-back below verifies the version is unchanged and aborts loudly
+    v0 = gbdt._pack_version
+    X = np.asarray(X, np.float64)
+    label = np.asarray(label, np.float64).ravel()
+    if X.shape[0] != len(label):
+        raise ValueError(f"refit_leaves: {X.shape[0]} rows but "
+                         f"{len(label)} labels")
+    n = X.shape[0]
+    nb = _predict_bucket(n)
+    x = gbdt._pad_rows(X, nb)
+    active = gbdt._active_mask(n, nb)
+    yb = np.zeros(nb, np.float32)
+    yb[:n] = label
+    # fresh donated leaf table + the tiny per-tree shrinkage vector; the
+    # pack's structure arrays ride along read-only
+    lv0 = jnp.asarray(np.stack(
+        [np.pad(np.asarray(t.leaf_value, np.float32),
+                (0, s["leaf_value"].shape[1] - t.num_leaves))
+         for t in trees]))
+    shrink = jnp.asarray(np.asarray([t.shrinkage for t in trees],
+                                    np.float32))
+    _san.record_dispatch()
+    out = entry(lv0, shrink, x, s["split_feature"], s["threshold"],
+                s["default_left"], s["missing_type"], s["left_child"],
+                s["right_child"], s["num_leaves"], s.get("is_cat"),
+                s.get("cat_base"), s.get("cat_nwords"), s.get("cat_words"),
+                jnp.asarray(yb), active)
+    new_lv = np.asarray(_san.sync_pull(out), np.float64)
+    # write back; export-form tree 0 carries the folded init score, so a
+    # delta-form model (init_scores separate) re-separates it here —
+    # predict (init + sum of deltas) stays exactly the renewed folded sum.
+    # Mutation + version bump in ONE pack-lock section: a pack build
+    # racing this (the model may already be serving) retries at insert
+    # time, never caching a half-renewed pack under the old version
+    init = float(gbdt.init_scores[0]) if gbdt.init_scores else 0.0
+    with gbdt._plock():
+        if gbdt._pack_version != v0:
+            raise ContinualError(
+                "the ensemble mutated while the refit dispatch ran "
+                f"(pack version {v0} -> {gbdt._pack_version}) — the "
+                "renewed leaf tables no longer map onto the current "
+                "trees; the write-back was aborted and the model is "
+                "unchanged.  Serialize mutations with refits (the "
+                "ContinualRunner's update lock does)")
+        for i, t in enumerate(gbdt.models):
+            vals = new_lv[i, : t.num_leaves].copy()
+            if i == 0 and init:
+                vals -= init
+            t.leaf_value = vals
+        gbdt._invalidate_pred_cache("continual_refit")
+    return n
+
+
+def audit_refit_fn(objective=None):
+    """The jitted callable one continual refit dispatches — the
+    ``continual_refit_leaves`` jaxpr-audit contract traces THIS builder
+    (analysis/contracts.py), so a refit path that grew a second
+    executable, a collective, or an in-trace transfer fails the audit
+    statically rather than burning a chip session."""
+    if objective is None:
+        from ..config import Config
+        from ..objectives import create_objective
+
+        objective = create_objective(Config.from_dict(
+            {"objective": "regression"}))
+    return make_refit_entry(objective, decay=0.9, lam2=0.0)

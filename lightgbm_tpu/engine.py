@@ -435,6 +435,57 @@ def serve(model=None, params: Optional[Dict[str, Any]] = None, *,
     return ServingRuntime(single, models=table, start=start, **kw)
 
 
+def continual_train(model=None, params: Optional[Dict[str, Any]] = None, *,
+                    runtime=None, model_name: str = "default",
+                    reference=None, state_dir: Optional[str] = None,
+                    cache_path: Optional[str] = None,
+                    start: bool = True, **runner_kwargs):
+    """Continual-training entry point (README "Continuous training"):
+    build — and by default START — a
+    :class:`~lightgbm_tpu.continual.ContinualRunner` that ingests fresh
+    data beside a live :class:`~lightgbm_tpu.serve.ServingRuntime`,
+    periodically refits/appends on-device, and hot-swaps the serving
+    ensemble with zero downtime.  The live ``/metrics`` + ``/healthz``
+    endpoint comes up exactly as ``train``/``serve`` bring it up.
+
+    ``model`` is a :class:`Booster` or model-file path; ``runtime`` an
+    optional ServingRuntime already serving it under ``model_name``;
+    ``reference`` the training Dataset (or its ``save_binary`` cache
+    path) carrying the FROZEN bin mappers; ``params`` the policy knobs
+    (``update_every_rows``, ``update_every_s``, ``append_trees``,
+    ``drift_window``) plus the usual ``metrics_port=``/``telemetry=``.
+    ``state_dir`` arms durable rollover checkpoints (+ ``resume=True``
+    in ``runner_kwargs`` to pick the newest fleet-valid one up);
+    ``cache_path`` arms the durable CRC'd ingest cache.
+
+    >>> rt = lgb.serve(booster)
+    >>> cr = lgb.continual_train(booster, {"update_every_rows": 4096},
+    ...                          runtime=rt, reference=train_ds)
+    """
+    from .continual.runtime import ContinualRunner
+
+    cfg = Config.from_dict(dict(params or {}))
+    set_verbosity(cfg.verbosity)
+    telemetry_on = (bool(cfg.telemetry) if cfg.is_set("telemetry")
+                    else _obs.DEFAULT_ENABLED)
+    _obs.set_enabled(telemetry_on)
+    if telemetry_on:
+        try:
+            _obs_server.maybe_start(
+                cfg.metrics_port if cfg.is_set("metrics_port") else None)
+        except OSError as e:
+            log_warning(f"metrics endpoint could not start: {e}")
+    bst = model if isinstance(model, Booster) else Booster(model_file=model)
+    for name in ("update_every_rows", "update_every_s", "append_trees",
+                 "drift_window"):
+        if cfg.is_set(name):
+            runner_kwargs.setdefault(name, getattr(cfg, name))
+    return ContinualRunner(bst, runtime=runtime, model_name=model_name,
+                           reference=reference, state_dir=state_dir,
+                           cache_path=cache_path, start=start,
+                           **runner_kwargs)
+
+
 def _finish_run_report(cfg: Config) -> None:
     """End-of-run observability (docs/OBSERVABILITY.md): the reference-style
     "Time for X / counter = v" report through the logger (debug verbosity —

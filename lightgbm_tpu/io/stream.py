@@ -33,9 +33,12 @@ pipelining discipline applied to the data feed).
 from __future__ import annotations
 
 import ast
+import io as _io
+import os
+import tempfile
 import zipfile
 import zlib
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -122,8 +125,16 @@ class BinCacheStream:
                  shard: Optional[Tuple[int, int]] = None) -> None:
         self.path = path
         self.member = member + ".npy"
-        with zipfile.ZipFile(path) as zf, zf.open(self.member) as fh:
-            shape, dtype, fortran = _read_npy_header(fh)
+        try:
+            with zipfile.ZipFile(path) as zf, zf.open(self.member) as fh:
+                shape, dtype, fortran = _read_npy_header(fh)
+        except (zipfile.BadZipFile, zlib.error) as e:
+            # small stored members are CRC-checked whole by zipfile on the
+            # very first read: surface the same typed row-ranged error the
+            # sweep path raises instead of a raw BadZipFile
+            raise CorruptBinCacheError(
+                path, self.member, 0, 0, 0,
+                f"{type(e).__name__}: {e}") from None
         if fortran or len(shape) != 2:
             raise ValueError(
                 f"{path}:{self.member} must be a C-order 2-D array for row "
@@ -144,12 +155,21 @@ class BinCacheStream:
         # nothing can vouch for their bytes.
         self.crc_rows: Optional[int] = None
         self.crcs: Optional[np.ndarray] = None
+        # append-origin log (round 19, continual ingest): global row
+        # offsets where each append_rows() call began, so a row-ranged
+        # corruption error can NAME the appended chunk it falls in
+        self.append_log: Optional[np.ndarray] = None
         try:
             with np.load(path, allow_pickle=False) as z:
                 if (f"{member}_crc32" in z.files
                         and f"{member}_crc_rows" in z.files):
                     self.crcs = np.asarray(z[f"{member}_crc32"], np.uint32)
-                    self.crc_rows = max(int(z[f"{member}_crc_rows"]), 1)
+                    self.crc_rows = max(
+                        int(np.asarray(z[f"{member}_crc_rows"]).reshape(-1)[0]),
+                        1)
+                if f"{member}_append_rows" in z.files:
+                    self.append_log = np.asarray(
+                        z[f"{member}_append_rows"], np.int64)
         except (OSError, ValueError, zipfile.BadZipFile):
             pass  # chunk reads will surface real corruption row-ranged
         if self.crcs is not None:
@@ -186,6 +206,17 @@ class BinCacheStream:
     def _corrupt(self, row: int, reason: str) -> CorruptBinCacheError:
         crc_rows = self.crc_rows or DEFAULT_CRC_ROWS
         chunk = row // crc_rows
+        if self.append_log is not None and len(self.append_log):
+            # name the appended chunk the bad row falls in: the newest
+            # append whose start row is <= the failing row (rows before
+            # the first append are the original save_binary payload)
+            starts = np.asarray(self.append_log, np.int64)
+            k = int(np.searchsorted(starts, row, side="right")) - 1
+            if k >= 0:
+                reason += (f" (inside appended chunk {k} — append_rows() "
+                           f"call starting at row {int(starts[k])})")
+            else:
+                reason += " (inside the original pre-append payload)"
         return CorruptBinCacheError(
             self.path, self.member, chunk, chunk * crc_rows,
             min((chunk + 1) * crc_rows, self.shape[0]), reason)
@@ -270,6 +301,309 @@ class BinCacheStream:
                             # block, every block starts from its true head
                 yield lo, buf[:m]
                 lo += m
+
+
+# ---------------------------------------------------------------------------
+# append-able caches (round 19, continual ingest — docs/README "Continuous
+# training"): save_binary caches grow in place through append_rows(), so a
+# live trainer can keep CRC-verified durable ingest without ever holding
+# the whole matrix.  The write is a streamed REWRITE (zip members cannot
+# be extended in place): the old payload is swept once through the same
+# verified BinCacheStream path every training sweep uses — so appending to
+# a corrupt cache fails row-ranged BEFORE the atomic replace, and the old
+# file survives intact — and the fresh CRC table covers every row, old and
+# new.  Appending to a LEGACY (trailerless) cache UPGRADES it: the sweep
+# is the one moment every old byte passes through host memory anyway, so
+# the new file always carries a full table instead of silently mixing
+# verified new blocks with unverifiable old ones.
+# ---------------------------------------------------------------------------
+
+
+class _CrcTableBuilder:
+    """Rolling per-block CRC32 over a row stream (the bin_crc32s layout,
+    fed incrementally so the appended cache's table is computed in the
+    same single sweep that writes the payload)."""
+
+    def __init__(self, crc_rows: int, row_bytes: int):
+        self.crc_rows = max(int(crc_rows), 1)
+        self.row_bytes = int(row_bytes)
+        self._crc = 0
+        self._rows_in_block = 0
+        self._table: List[int] = []
+
+    def feed(self, data, n_rows: int) -> None:
+        mv = memoryview(data)
+        pos = 0
+        while n_rows:
+            take = min(self.crc_rows - self._rows_in_block, n_rows)
+            self._crc = zlib.crc32(mv[pos:pos + take * self.row_bytes],
+                                   self._crc)
+            pos += take * self.row_bytes
+            self._rows_in_block += take
+            n_rows -= take
+            if self._rows_in_block == self.crc_rows:
+                self._table.append(self._crc & 0xFFFFFFFF)
+                self._crc = 0
+                self._rows_in_block = 0
+
+    def finish(self) -> np.ndarray:
+        if self._rows_in_block:
+            self._table.append(self._crc & 0xFFFFFFFF)
+            self._crc = 0
+            self._rows_in_block = 0
+        return np.asarray(self._table, np.uint32)
+
+
+def _npy_member_bytes(arr: np.ndarray) -> bytes:
+    """Full .npy byte payload for a small array member."""
+    bio = _io.BytesIO()
+    np.save(bio, np.ascontiguousarray(arr), allow_pickle=False)
+    return bio.getvalue()
+
+
+def _write_streamed_bins(zf: zipfile.ZipFile, member: str,
+                         n_rows: int, n_cols: int, dtype: np.dtype,
+                         chunks: Iterator[Tuple[int, np.ndarray]],
+                         crc: _CrcTableBuilder) -> None:
+    """Write ``member`` (an .npy of (n_rows, n_cols) ``dtype``) into an
+    open zip by streaming row chunks — the matrix is never materialized
+    whole, the out-of-core contract this module exists for.  ZIP_STORED,
+    so shard seeks on the result stay O(1)."""
+    zinfo = zipfile.ZipInfo(member)
+    zinfo.compress_type = zipfile.ZIP_STORED
+    header = _io.BytesIO()
+    np.lib.format.write_array_header_1_0(
+        header, {"descr": np.lib.format.dtype_to_descr(np.dtype(dtype)),
+                 "fortran_order": False, "shape": (int(n_rows), int(n_cols))})
+    with zf.open(zinfo, "w", force_zip64=True) as out:
+        out.write(header.getvalue())
+        for _lo, view in chunks:
+            block = np.ascontiguousarray(view, dtype=dtype)
+            data = block.reshape(-1).view(np.uint8).data
+            out.write(data)
+            crc.feed(data, block.shape[0])
+
+
+def write_bin_cache(fh, bins: np.ndarray, mappers, *,
+                    label=None, weight=None, group=None, init_score=None,
+                    position=None, feature_names=(),
+                    crc_rows: int = DEFAULT_CRC_ROWS) -> None:
+    """The save_binary npz payload (Dataset._savez_binary delegates here;
+    the continual runner also creates fresh ingest caches through it
+    without needing a Dataset).  ``mappers`` is a DatasetBinner-style
+    mapper list; the per-chunk CRC32 trailer table always rides along."""
+    bins_c = np.ascontiguousarray(bins)
+    np.savez_compressed(
+        fh,
+        bins=bins_c,
+        bins_crc32=bin_crc32s(bins_c, crc_rows),
+        bins_crc_rows=np.asarray(crc_rows, np.int64),
+        label=label if label is not None else np.zeros(0),
+        weight=weight if weight is not None else np.zeros(0),
+        group=group if group is not None else np.zeros(0, np.int64),
+        init_score=init_score if init_score is not None else np.zeros(0),
+        position=position if position is not None else np.zeros(0, np.int64),
+        uppers=np.concatenate([np.asarray(m.upper_bounds, np.float64)
+                               for m in mappers]),
+        upper_sizes=np.asarray([len(m.upper_bounds) for m in mappers]),
+        missing_types=np.asarray([m.missing_type for m in mappers]),
+        cats=np.concatenate([
+            np.asarray(m.categories, np.float64)
+            if m.categories is not None else np.zeros(0) for m in mappers]),
+        cat_sizes=np.asarray([
+            len(m.categories) if m.categories is not None else 0
+            for m in mappers]),
+        min_values=np.asarray([m.min_value for m in mappers], np.float64),
+        max_values=np.asarray([m.max_value for m in mappers], np.float64),
+        feature_names=np.asarray(feature_names),
+    )
+
+
+def _atomic_replace(path: str, write_fn, mode: int) -> None:
+    """The ONE binary crash-safety scaffold (same-dir temp + explicit
+    permissions + fsync AFTER ``write_fn`` returns + ``os.replace``):
+    :func:`create_bin_cache` and :func:`append_rows` both ride it, so
+    the recipe cannot drift between the create and append halves
+    (utils/checkpoint.py owns the separate text+trailer variant).
+    ``write_fn(fh)`` must fully CLOSE any framing it opens (e.g. a
+    ZipFile's central directory) before returning — the fsync here is
+    the last write barrier before publication."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".tmp.",
+                               dir=d)
+    try:
+        os.fchmod(fd, mode)
+        with os.fdopen(fd, "wb") as fh:
+            write_fn(fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _umask_mode() -> int:
+    """0o666 under the current umask — what a plain open()-write would
+    create (shared dirs, serving processes under another uid; the same
+    rule utils/checkpoint.py's atomic writer applies)."""
+    umask = os.umask(0)
+    os.umask(umask)
+    return 0o666 & ~umask
+
+
+def create_bin_cache(path: str, bins: np.ndarray, mappers, **kw) -> None:
+    """Atomically CREATE a save_binary cache at ``path``: the
+    creation-side counterpart of :func:`append_rows`'s crash contract —
+    a crash mid-write must not leave a torn cache that poisons every
+    later append.  ``kw`` forwards to :func:`write_bin_cache`."""
+    _atomic_replace(path, lambda fh: write_bin_cache(fh, bins, mappers,
+                                                     **kw),
+                    _umask_mode())
+
+
+# members append_rows recomputes; everything else (mappers, group,
+# init_score, position, names) is byte-copied verbatim from the old zip
+_APPEND_REWRITTEN = ("bins.npy", "bins_crc32.npy", "bins_crc_rows.npy",
+                     "bins_append_rows.npy", "label.npy", "weight.npy")
+
+
+def append_rows(path: str, bins_new: np.ndarray, *,
+                label=None, weight=None,
+                chunk_rows: int = DEFAULT_CHUNK_ROWS) -> int:
+    """Append binned rows (already transformed by the cache's FROZEN
+    mappers) to a save_binary cache, atomically.
+
+    The old payload streams through the CRC-verified
+    :class:`BinCacheStream` path into a same-directory temp file, the new
+    rows follow, and ``os.replace`` publishes — a crash anywhere leaves
+    the previous cache intact, and a corrupt old cache raises the
+    row-ranged :class:`CorruptBinCacheError` before anything is replaced.
+    A legacy trailerless cache is UPGRADED to a full CRC table on the way
+    through (never a mixed verified/unverified file); the append-origin
+    log (``bins_append_rows``) records where each append began so later
+    corruption errors can name the appended chunk.  Returns the new total
+    row count.
+
+    Labels must ride along when the cache carries them (training data and
+    targets may never go out of step); ranking caches (non-empty
+    ``group``) and init_score/position-carrying caches refuse appends."""
+    stream = BinCacheStream(path)
+    n_old, f = stream.shape
+    bins_new = np.ascontiguousarray(bins_new)
+    if bins_new.ndim != 2 or bins_new.shape[1] != f:
+        raise ValueError(
+            f"append_rows: appended chunk has shape {bins_new.shape}, "
+            f"cache {path} holds {f}-feature rows")
+    info = np.iinfo(stream.dtype) if np.issubdtype(stream.dtype, np.integer) \
+        else None
+    if info is not None and bins_new.size and (
+            int(bins_new.max()) > info.max or int(bins_new.min()) < info.min):
+        raise ValueError(
+            f"append_rows: bin values outside the cache dtype "
+            f"{stream.dtype} — the chunk was not binned by this cache's "
+            "mappers")
+    with np.load(path, allow_pickle=False) as z:
+        old_label = z["label"] if "label" in z.files else np.zeros(0)
+        old_weight = z["weight"] if "weight" in z.files else np.zeros(0)
+        old_group = z["group"] if "group" in z.files else np.zeros(0)
+        old_init = z["init_score"] if "init_score" in z.files else np.zeros(0)
+        old_pos = z["position"] if "position" in z.files else np.zeros(0)
+    if old_group.size or old_init.size or old_pos.size:
+        raise ValueError(
+            "append_rows: caches carrying group/init_score/position rows "
+            "cannot be appended to (per-row metadata would go out of step)")
+    n_new = int(bins_new.shape[0])
+    if old_label.size:
+        if label is None:
+            raise ValueError(
+                f"append_rows: cache {path} carries labels; the appended "
+                "chunk must bring labels too")
+        label = np.asarray(label, np.float64).ravel()
+        if len(label) != n_new:
+            raise ValueError(
+                f"append_rows: {n_new} rows but {len(label)} labels")
+        new_label = np.concatenate([np.asarray(old_label, np.float64), label])
+    elif label is not None:
+        raise ValueError(
+            f"append_rows: cache {path} carries no labels; appending "
+            "labeled rows would leave the original rows unlabeled")
+    else:
+        new_label = np.zeros(0)
+    if old_weight.size:
+        if weight is None:
+            raise ValueError(
+                f"append_rows: cache {path} carries weights; the appended "
+                "chunk must bring weights too")
+        weight = np.asarray(weight, np.float64).ravel()
+        if len(weight) != n_new:
+            raise ValueError(
+                f"append_rows: {n_new} rows but {len(weight)} weights")
+        new_weight = np.concatenate([np.asarray(old_weight, np.float64),
+                                     weight])
+    else:
+        if weight is not None:
+            raise ValueError(
+                f"append_rows: cache {path} carries no weights; appending "
+                "weighted rows would leave the original rows unweighted")
+        new_weight = np.zeros(0)
+    upgraded = stream.crcs is None
+    crc_rows = stream.crc_rows or DEFAULT_CRC_ROWS
+    append_log = (np.asarray(stream.append_log, np.int64)
+                  if stream.append_log is not None
+                  else np.zeros(0, np.int64))
+    append_log = np.concatenate([append_log,
+                                 np.asarray([n_old], np.int64)])
+    crc = _CrcTableBuilder(crc_rows, f * stream.dtype.itemsize)
+
+    def _write(fh):
+        # closing the ZipFile INSIDE the writer is what makes the
+        # scaffold's post-writer fsync cover the central directory
+        with zipfile.ZipFile(fh, "w", zipfile.ZIP_STORED) as zf:
+            # the old payload sweeps through the VERIFIED stream
+            # (chunks() raises row-ranged on corruption — before the
+            # replace ever runs), chained with the new rows; one CRC
+            # table covers both sides of the seam
+            def _all_chunks():
+                yield from stream.chunks(chunk_rows)
+                yield from array_chunks(bins_new, chunk_rows)
+
+            _write_streamed_bins(zf, "bins.npy", n_old + n_new, f,
+                                 stream.dtype, _all_chunks(), crc)
+            zf.writestr("bins_crc32.npy", _npy_member_bytes(crc.finish()))
+            zf.writestr("bins_crc_rows.npy",
+                        _npy_member_bytes(np.asarray(crc_rows, np.int64)))
+            zf.writestr("bins_append_rows.npy",
+                        _npy_member_bytes(append_log))
+            zf.writestr("label.npy", _npy_member_bytes(new_label))
+            zf.writestr("weight.npy", _npy_member_bytes(new_weight))
+            with zipfile.ZipFile(path) as zf_old:
+                for name in zf_old.namelist():
+                    if name not in _APPEND_REWRITTEN:
+                        zf.writestr(name, zf_old.read(name))
+
+    # keep the original cache's permissions: a shared (e.g. 0644,
+    # serving process under another uid) cache stays readable after
+    # its first append
+    _atomic_replace(path, _write, os.stat(path).st_mode & 0o7777)
+    from ..obs import metrics as _obs
+
+    _obs.counter("bin_cache_appends_total").inc()
+    _obs.counter("bin_cache_appended_rows_total").inc(n_new)
+    if upgraded:
+        _obs.counter("bin_cache_crc_upgrades_total").inc()
+        from ..utils.log import log_warning
+
+        log_warning(
+            f"bin cache {path} carried no CRC trailer table (pre-round-13 "
+            "format); the append upgraded it — every block of the new "
+            "file, old rows included, is now verifiable")
+    _obs.event("bin_cache_append", path=os.fspath(path), rows=n_new,
+               total_rows=n_old + n_new, upgraded=upgraded)
+    return n_old + n_new
 
 
 def array_chunks(arr: np.ndarray,

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -117,6 +118,11 @@ def _quantized_wide_default(*, on_tpu: bool, n_features: int,
             and rounds_grower and not explicitly_set and not has_monotone)
 
 
+# guards lazy _pack_lock creation on instances that predate the lock
+# (unpickled state, legacy deepcopies) — see GBDT._plock
+_PACK_LOCK_INIT = threading.Lock()
+
+
 class GBDT:
     """reference: class GBDT in src/boosting/gbdt.h."""
 
@@ -143,6 +149,13 @@ class GBDT:
         self._valid_scores: List[jnp.ndarray] = []
         self._pred_cache = None
         self._pack_version = 0  # bumped by _invalidate_pred_cache
+        # pack lock (round 19, lightgbm_tpu/continual): trainer-thread
+        # mutations (refit/append under a live ServingRuntime) bump
+        # _pack_version and evict stale entries UNDER THE SAME LOCK the
+        # serving threads' _packed lookup/insert holds — an unlocked
+        # bump racing a lookup could evict a dict entry mid-iteration or
+        # publish a pack under a version it no longer belongs to
+        self._pack_lock = threading.RLock()
         self.binner = None
         self.rng = np.random.RandomState(cfg.seed)
         # non-finite guard rail (docs/ROBUSTNESS.md): first boosting
@@ -175,6 +188,28 @@ class GBDT:
         self._models = value
         self._invalidate_pred_cache("models_setter")
 
+    def _plock(self) -> threading.RLock:
+        """The pack lock, lazily recreated for instances that predate it
+        (unpickled/legacy state); creation races are excluded by the
+        module-level init lock."""
+        lock = getattr(self, "_pack_lock", None)
+        if lock is None:
+            with _PACK_LOCK_INIT:
+                lock = getattr(self, "_pack_lock", None)
+                if lock is None:
+                    lock = self._pack_lock = threading.RLock()
+        return lock
+
+    def __getstate__(self):
+        # locks cannot be pickled/deepcopied; _plock recreates on demand
+        d = dict(self.__dict__)
+        d.pop("_pack_lock", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._pack_lock = threading.RLock()
+
     def _invalidate_pred_cache(self, reason: str) -> None:
         """VERSION the packed-ensemble serving cache instead of nulling it
         (round 18, lightgbm_tpu/serve): a model mutation bumps
@@ -190,21 +225,29 @@ class GBDT:
         ``predict_stale_pack_evictions_total``.  Real invalidations (a
         populated cache bumped) are counted so serving dashboards can see
         churn — training every round vs an occasional leaf edit look very
-        different here."""
-        if getattr(self, "_pred_cache", None):
-            _obs.counter("predict_cache_invalidations_total").inc()
-            _obs.event("pred_cache_invalidate", reason=reason,
-                       version=self._pack_version + 1)
-        self._pack_version = getattr(self, "_pack_version", 0) + 1
-        cache = getattr(self, "_pred_cache", None)
-        if cache:
-            floor = self._pack_version - self._PACKED_KEEP_VERSIONS
-            stale = [key for key in cache if key[0] <= floor]
-            for key in stale:
-                del cache[key]
-            if stale:
-                _obs.counter(
-                    "predict_stale_pack_evictions_total").inc(len(stale))
+        different here.
+
+        Round 19 (continual training) made the bump+evict ATOMIC with the
+        serving threads' ``_packed`` lookup by sharing ``_pack_lock``: a
+        trainer-thread refit/append racing a coalesced predict could
+        otherwise evict dict entries mid-lookup or let a pack built
+        against the pre-mutation trees publish under the post-mutation
+        version (tests/test_continual.py hammers exactly this)."""
+        with self._plock():
+            if getattr(self, "_pred_cache", None):
+                _obs.counter("predict_cache_invalidations_total").inc()
+                _obs.event("pred_cache_invalidate", reason=reason,
+                           version=self._pack_version + 1)
+            self._pack_version = getattr(self, "_pack_version", 0) + 1
+            cache = getattr(self, "_pred_cache", None)
+            if cache:
+                floor = self._pack_version - self._PACKED_KEEP_VERSIONS
+                stale = [key for key in cache if key[0] <= floor]
+                for key in stale:
+                    del cache[key]
+                if stale:
+                    _obs.counter(
+                        "predict_stale_pack_evictions_total").inc(len(stale))
 
     def _flush_pending(self) -> None:
         if self._pending:
@@ -1716,9 +1759,16 @@ class GBDT:
         return all_const
 
     def rollback_one_iter(self) -> None:
-        """reference: GBDT::RollbackOneIter."""
+        """reference: GBDT::RollbackOneIter.  The tree-list pops and the
+        version bump share one pack-lock section (round 19): a serving
+        pack build racing the rollback retries at insert time instead of
+        caching a half-popped ensemble under the pre-rollback version."""
         if self.iter_ <= 0:
             return
+        with self._plock():
+            self._rollback_one_iter_locked()
+
+    def _rollback_one_iter_locked(self) -> None:
         k = self.num_tree_per_iteration
         for c in reversed(range(k)):
             tree = self.models.pop()
@@ -2010,7 +2060,67 @@ class GBDT:
         * ``_linear``: True when any tree has linear leaves (host walk)
         """
         k = self.num_tree_per_iteration
-        n_models = len(self.models)  # property: flushes pending device trees
+        races = 0
+        while True:
+            # lookup UNDER the pack lock (shared with
+            # _invalidate_pred_cache — round 19): a trainer-thread bump
+            # cannot evict entries mid-lookup or race the key's version
+            # component
+            if races >= 3:
+                # a sustained mutation cadence (e.g. a set_leaf_output
+                # loop) must not starve a serving build forever: after a
+                # few lost races, build UNDER the lock — mutators wait
+                # one build instead of the reader retrying unboundedly
+                with self._plock():
+                    return self._packed_build_locked(start, num_iteration,
+                                                     pad_trees_to)
+            with self._plock():
+                v0 = self._pack_version
+                n_models = len(self.models)  # flushes pending device trees
+                lo = start * k
+                hi = n_models if num_iteration < 0 else min(
+                    (start + num_iteration) * k, n_models)
+                key = (v0, lo, hi, n_models, pad_trees_to)
+                if self._pred_cache is None:
+                    self._pred_cache = {}
+                hit = self._pred_cache.get(key)
+                if hit is not None:
+                    _obs.counter("predict_packed_cache_hits_total").inc()
+                    return hit
+                _obs.counter("predict_packed_cache_misses_total").inc()
+            # build OUTSIDE the lock (host re-pack + device uploads must
+            # not stall concurrent serving lookups of resident versions)
+            trees = self._trees_for_export(start, num_iteration)
+            pack_trees = trees
+            if pad_trees_to and trees:
+                pad = (-len(trees)) % pad_trees_to
+                pack_trees = trees + [_dummy_tree()] * pad
+            s = self._stacked(trees=pack_trees) if pack_trees else None
+            if s is not None:
+                s["_trees"] = trees
+                s["_linear"] = any(t.is_linear for t in trees)
+            with self._plock():
+                if self._pack_version != v0:
+                    # a mutation landed mid-build: the freshly packed
+                    # arrays may reflect post-mutation trees, so caching
+                    # them under the pre-mutation version would hand
+                    # in-flight readers a torn pack — rebuild under the
+                    # new version instead
+                    _obs.counter("predict_pack_build_races_total").inc()
+                    races += 1
+                    continue
+                if len(self._pred_cache) >= self._PACKED_CACHE_CAP:
+                    self._pred_cache.pop(next(iter(self._pred_cache)))
+                self._pred_cache[key] = s
+                return s
+
+    def _packed_build_locked(self, start: int, num_iteration: int,
+                             pad_trees_to: int):
+        """The starvation fallback: one full lookup+build+insert with the
+        pack lock HELD — no mutation can interleave, so progress is
+        guaranteed after repeated build races (callers: _packed only)."""
+        k = self.num_tree_per_iteration
+        n_models = len(self.models)
         lo = start * k
         hi = n_models if num_iteration < 0 else min(
             (start + num_iteration) * k, n_models)

@@ -64,6 +64,8 @@ DEGRADED_COUNTERS = (
     ("checkpoint_fallbacks_total", "resume fell back to an older snapshot"),
     ("fleet_resumes_total", "fleet resumed from a checkpoint round"),
     ("faults_injected_total", "injected faults fired (test harness armed)"),
+    ("continual_update_failures_total",
+     "continual update failed; serving continues on the previous ensemble"),
 )
 # gauge-driven degraded states: unlike the cumulative counters above these
 # are CURRENT conditions — the serving runtime sets serve_shedding to 1
@@ -72,6 +74,11 @@ DEGRADED_COUNTERS = (
 # resume, so /healthz flips degraded exactly for the shedding interval
 DEGRADED_GAUGES = (
     ("serve_shedding", "serving runtime is shedding load (Overloaded)"),
+    # armed by the continual runner's staleness_slo_s: the serving
+    # ensemble has un-incorporated ingest older than the SLO — stale
+    # predictions, still correct ones (lightgbm_tpu/continual)
+    ("continual_staleness_exceeded",
+     "serving model is stale past the continual staleness SLO"),
 )
 
 

@@ -151,9 +151,12 @@ class ServingRuntime:
         table = {"default": model} if models is None else dict(models)
         if not table:
             raise LightGBMError("ServingRuntime needs at least one model")
-        self._models: Dict[str, Any] = {n: _unwrap(m)
-                                        for n, m in table.items()}
-        cfg = next(iter(self._models.values())).cfg
+        # the model TABLE (name -> GBDT) — deliberately not "_models",
+        # which names the per-ensemble TREE LIST whose in-place mutation
+        # jaxlint R16 polices in serve/continual code
+        self._table: Dict[str, Any] = {n: _unwrap(m)
+                                       for n, m in table.items()}
+        cfg = next(iter(self._table.values())).cfg
         self._max_wait_s = (float(cfg.serve_max_wait_ms) if max_wait_ms is None
                             else float(max_wait_ms)) / 1e3
         self._max_queue = (int(cfg.serve_max_queue) if max_queue is None
@@ -202,7 +205,7 @@ class ServingRuntime:
             target=self._dispatch_loop, daemon=True, name="lgbmtpu-dispatch")
         self._dispatcher.start()
         self._coalescer.start()
-        _obs.event("serve_start", models=sorted(self._models),
+        _obs.event("serve_start", models=sorted(self._table),
                    max_wait_ms=self._max_wait_s * 1e3,
                    max_queue=self._max_queue)
         return self
@@ -257,16 +260,16 @@ class ServingRuntime:
     # -- model table -----------------------------------------------------
     def models(self) -> List[str]:
         with self._cv:
-            return sorted(self._models)
+            return sorted(self._table)
 
     def add_model(self, name: str, model) -> None:
         g = _unwrap(model)
         g._packed(0, -1)  # resident before the first request hits it
         with self._cv:
-            if name in self._models:
+            if name in self._table:
                 raise LightGBMError(
                     f"model {name!r} already served — use swap_model")
-            self._models[name] = g
+            self._table[name] = g
 
     def swap_model(self, name: str, model) -> None:
         """Hot-swap a served ensemble: the replacement's pack is built
@@ -274,11 +277,11 @@ class ServingRuntime:
         the old GBDT's (versioned) pack — no request ever observes a
         cold cache (tests/test_serve.py pins this)."""
         g = _unwrap(model)
-        if name not in self._models:
+        if name not in self._table:
             raise LightGBMError(f"model {name!r} is not served")
         g._packed(0, -1)  # warm the new pack outside the serving path
         with self._cv:
-            self._models[name] = g
+            self._table[name] = g
         _obs.counter("serve_model_swaps_total").inc()
         _obs.event("serve_model_swap", model=name)
 
@@ -297,10 +300,10 @@ class ServingRuntime:
         """Enqueue one request (admission control happens HERE — a shed
         raises immediately, an accepted request always resolves).
         Returns a handle for :meth:`result`."""
-        g = self._models.get(model)
+        g = self._table.get(model)
         if g is None:
             raise LightGBMError(f"model {model!r} is not served "
-                                f"(have {sorted(self._models)})")
+                                f"(have {sorted(self._table)})")
         X = np.asarray(X, dtype=np.float64)  # Booster.predict's intake cast
         if X.ndim == 1:
             X = X[None, :]
@@ -362,7 +365,7 @@ class ServingRuntime:
     def stats(self) -> Dict[str, Any]:
         with self._cv:
             return {"queue_depth": len(self._queue),
-                    "models": sorted(self._models),
+                    "models": sorted(self._table),
                     "staging_rungs": sorted(k[0] for k in self._staging),
                     "running": self._running}
 
@@ -458,7 +461,7 @@ class ServingRuntime:
         rides along so a concurrent ``swap_model`` between eligibility
         check and staging cannot hand the batch a model it was not
         built against."""
-        g = self._models.get(first.model)
+        g = self._table.get(first.model)
         if g is None or not g._coalescible(first.raw):
             first.serial = True
             _obs.counter("serve_uncoalesced_total").inc()
@@ -558,7 +561,7 @@ class ServingRuntime:
                 if kind == "serial":
                     (r,) = batch
                     g = payload if payload is not None \
-                        else self._models[r.model]
+                        else self._table[r.model]
                     r.result = g.predict(r.x, raw_score=r.raw)
                 else:
                     g, x_dev, active, total, nb, skey, pair = payload

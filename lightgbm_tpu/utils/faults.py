@@ -32,6 +32,14 @@ Sites (see docs/ROBUSTNESS.md for the exact trigger points):
                     process exit BETWEEN the rank-0 snapshot landing and
                     the fleet manifest publish: the torn-fleet-state
                     window the manifest protocol exists to exclude.
+``continual_swap``  lightgbm_tpu/continual/runtime.py rollover — hard
+                    process exit BETWEEN the update's durable checkpoint
+                    (raw-delta snapshot + manifest) and its publication
+                    through ``ServingRuntime.swap_model``: the previous
+                    ensemble keeps serving, no torn pack is ever
+                    published, and a resumed runner picks the update up
+                    from the manifest.  <round> is the rollover sequence
+                    number (1-based).
 ``worker_death``    parallel/launcher.py worker body — hard process exit at
                     the start of iteration <round>, gated to one rank via
                     ``LGBMTPU_FAULT_RANK`` (compared against the worker's

@@ -1917,3 +1917,71 @@ def test_pragma_inside_docstring_is_ignored(tmp_path):
     '''})
     assert rep.stale == []
     assert rep.suppressed == []
+
+
+# ---------------------------------------------------------------------------
+# R16 mutation-outside-version-bump
+# ---------------------------------------------------------------------------
+
+def _scan_tree(tmp_path, sources, rules=None):
+    """Like _scan, but filenames may carry subdirectories — R16 is scoped
+    to serve/ and continual/ paths."""
+    root = tmp_path / "fixture_pkg"
+    for name, code in sources.items():
+        p = root / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(code))
+    return run([root], rules)
+
+
+def test_r16_positive_models_subscript_write_in_serve(tmp_path):
+    rep = _scan_tree(tmp_path, {"serve/swap.py": """
+        def hot_patch(g, i, tree):
+            g.models[i] = tree
+            return g
+    """}, rules=["R16"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].rule == "R16"
+    assert ".models" in rep.findings[0].message
+
+
+def test_r16_positive_leaf_write_and_list_mutator_in_continual(tmp_path):
+    rep = _scan_tree(tmp_path, {"continual/refitlike.py": """
+        def renew(g, new_lv, extra_tree):
+            for i, t in enumerate(g.models):
+                t.leaf_value = new_lv[i]
+            g._models.append(extra_tree)
+    """}, rules=["R16"])
+    assert len(rep.findings) == 2, rep.findings
+    assert {f.rule for f in rep.findings} == {"R16"}
+
+
+def test_r16_negative_mutation_routed_through_bump(tmp_path):
+    rep = _scan_tree(tmp_path, {"continual/refitlike.py": """
+        def renew(g, new_lv):
+            for i, t in enumerate(g.models):
+                t.leaf_value = new_lv[i]
+            g._invalidate_pred_cache("renew")
+    """}, rules=["R16"])
+    assert rep.findings == []
+
+
+def test_r16_negative_outside_scoped_dirs(tmp_path):
+    """The identical mutation OUTSIDE serve/continual paths is out of
+    scope (the versioned key's n_models component and the runtime pins
+    own it — docs/ANALYSIS.md static-limits note)."""
+    rep = _scan_tree(tmp_path, {"models/trainer.py": """
+        def grow(g, tree):
+            g._models.append(tree)
+    """}, rules=["R16"])
+    assert rep.findings == []
+
+
+def test_r16_pragma_suppression(tmp_path):
+    rep = _scan_tree(tmp_path, {"serve/swap.py": """
+        def hot_patch(g, i, tree):
+            g.models[i] = tree  # jaxlint: disable=R16 (fixture: caller holds the pack lock and bumps)
+            return g
+    """}, rules=["R16"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1

@@ -39,7 +39,7 @@ def test_contract_catalogue_pins_the_flagships():
         "windowed_round_sharded_psum", "windowed_round_sharded_scatter",
         "predict_warm_single", "predict_warm_multiclass",
         "predict_warm_converted", "predict_coalesced_bucket",
-        "ooc_root_chunk", "ooc_split_chunk",
+        "ooc_root_chunk", "ooc_split_chunk", "continual_refit_leaves",
     } <= set(CONTRACTS)
 
 
@@ -64,7 +64,8 @@ def test_single_device_bodies_are_collective_free(report):
         if r.name in ("windowed_round_float", "windowed_round_quantized",
                       "predict_warm_single", "predict_warm_multiclass",
                       "predict_warm_converted", "predict_coalesced_bucket",
-                      "ooc_root_chunk", "ooc_split_chunk"):
+                      "ooc_root_chunk", "ooc_split_chunk",
+                      "continual_refit_leaves"):
             assert r.detail.get("collectives") == [], (r.name, r.detail)
 
 
@@ -83,6 +84,18 @@ def test_coalesced_dispatch_is_the_warm_predict_family():
     assert audit_dispatch_fn(4) is predict_ops.predict_raw_multiclass
     assert GBDT._coalesced_raw_fn(1) is predict_ops.predict_raw_values
     assert GBDT._coalesced_raw_fn(3) is predict_ops.predict_raw_multiclass
+
+
+def test_continual_refit_is_one_donated_collective_free_dispatch(report):
+    """ISSUE 14: the continual refit dispatch — resolved through the
+    runner's own builder (continual.refit.audit_refit_fn) — is ONE
+    donated executable: zero collectives (J1, single-device), the
+    donated leaf table consumed and aliased in the lowering (J2), and
+    transfer-free (J5, the report gate above)."""
+    r = {x.name: x for x in report.results}["continual_refit_leaves"]
+    assert r.detail.get("collectives") == []
+    assert r.detail.get("live_donated_leaves") == 1
+    assert r.detail.get("aliased_in_lowering") == 1
 
 
 def test_donations_all_consumable(report):

@@ -694,3 +694,149 @@ def test_shard_stream_verifies_fully_covered_crc_blocks(tmp_path):
     s3 = BinCacheStream(final, shard=(0, 160))
     rows = sum(v.shape[0] for _, v in s3.chunks(64))
     assert rows == 160
+
+
+# ---------------------------------------------------------------------------
+# append-able caches (round 19, ISSUE 14 — continual ingest durability)
+# ---------------------------------------------------------------------------
+
+def _bins_payload_offset(path, member="bins.npy"):
+    """Byte offset of the member's raw element data inside the zip."""
+    import zipfile
+
+    with zipfile.ZipFile(path) as zf:
+        off = zf.getinfo(member).header_offset
+    data = open(path, "rb").read()
+    idx = data.index(b"\x93NUMPY", off)
+    hlen = int.from_bytes(data[idx + 8:idx + 10], "little")
+    return idx + 10 + hlen
+
+
+def test_append_rows_round_trip_and_dataset_reload(tmp_path):
+    """Appending frozen-mapper-binned rows grows the cache in place:
+    the CRC table covers old + new rows, the append log records the
+    seam, and a Dataset reload sees the concatenation exactly."""
+    from lightgbm_tpu.io.stream import BinCacheStream, append_rows
+
+    cache, bins = _make_cache(tmp_path, n=300, f=4)
+    ds0 = lgb.Dataset(cache, params=dict(_PARAMS))
+    ds0.construct()
+    Xn, yn = _make_data(n=120, f=4, seed=9)
+    new_bins = ds0.binner.transform(Xn)
+    total = append_rows(cache, new_bins, label=yn)
+    assert total == 420
+
+    s = BinCacheStream(cache)
+    assert s.shape == (420, 4)
+    assert list(s.append_log) == [300]
+    got = np.concatenate([v.copy() for _, v in s.chunks(64)])
+    np.testing.assert_array_equal(got[:300], bins)
+    np.testing.assert_array_equal(got[300:], new_bins.astype(s.dtype))
+
+    ds = lgb.Dataset(cache, params=dict(_PARAMS))
+    ds.construct()
+    assert ds.num_data() == 420
+    np.testing.assert_array_equal(np.asarray(ds.bins)[300:],
+                                  new_bins.astype(ds.bins.dtype))
+    np.testing.assert_allclose(np.asarray(ds.label)[300:], yn)
+    # a second append extends the log
+    append_rows(cache, new_bins[:10], label=yn[:10])
+    assert list(BinCacheStream(cache).append_log) == [300, 420]
+
+
+def test_append_rows_validation(tmp_path):
+    from lightgbm_tpu.io.stream import append_rows
+
+    cache, bins = _make_cache(tmp_path, n=300, f=4)
+    with pytest.raises(ValueError, match="labels"):
+        append_rows(cache, bins[:5])  # cache carries labels; chunk must too
+    with pytest.raises(ValueError, match="shape"):
+        append_rows(cache, np.zeros((5, 9), np.uint8), label=np.zeros(5))
+    with pytest.raises(ValueError, match="labels"):
+        append_rows(cache, bins[:5], label=np.zeros(4))
+
+
+def test_append_to_legacy_cache_upgrades_crc_table(tmp_path):
+    """Appending to a trailerless (pre-round-13) cache UPGRADES it: the
+    new file carries a full CRC table covering every row — old rows
+    included — instead of silently mixing verified and unverifiable
+    blocks."""
+    from lightgbm_tpu.io.stream import (BinCacheStream, append_rows,
+                                        bin_crc32s)
+
+    cache, bins = _make_cache(tmp_path, n=300, f=4)
+    legacy = str(tmp_path / "legacy.bin")
+    _rewrite_member(cache, legacy, "bins_crc32.npy", lambda b: None)
+    _rewrite_member(legacy, legacy + ".2", "bins_crc_rows.npy",
+                    lambda b: None)
+    os.replace(legacy + ".2", legacy)
+    assert BinCacheStream(legacy).crcs is None  # really trailerless
+
+    ds0 = lgb.Dataset(cache, params=dict(_PARAMS))
+    ds0.construct()
+    Xn, yn = _make_data(n=80, f=4, seed=9)
+    append_rows(legacy, ds0.binner.transform(Xn), label=yn)
+    s = BinCacheStream(legacy)
+    assert s.crcs is not None
+    got = np.concatenate([v.copy() for _, v in s.chunks(50)])  # verifies
+    np.testing.assert_array_equal(
+        s.crcs, bin_crc32s(got.astype(s.dtype), s.crc_rows))
+    from lightgbm_tpu.obs import metrics as obs
+    assert obs.counter("bin_cache_crc_upgrades_total").value >= 1
+
+
+def _make_appended_cache(tmp_path, n_base=4000, n_new=2000, crc_rows=512):
+    """A cache written with a small CRC block size, then appended once —
+    the bins member comes out ZIP_STORED, so byte offsets map 1:1 to
+    rows and the per-block table is fine-grained enough that OUR check
+    fires before zipfile's whole-member CRC at EOF."""
+    from lightgbm_tpu.io.stream import append_rows, write_bin_cache
+
+    X, y = _make_data(n=n_base, f=4)
+    ds = lgb.Dataset(X, label=y, params=dict(_PARAMS))
+    ds.construct()
+    cache = str(tmp_path / "appendable.bin")
+    with open(cache, "wb") as fh:
+        write_bin_cache(fh, ds.bins, ds.binner.mappers, label=y,
+                        feature_names=ds.feature_names, crc_rows=crc_rows)
+    Xn, yn = _make_data(n=n_new, f=4, seed=9)
+    append_rows(cache, ds.binner.transform(Xn), label=yn)
+    return cache, ds
+
+
+def test_append_corruption_error_names_the_appended_chunk(tmp_path):
+    """A corrupt byte in the appended region raises row-ranged AND names
+    which append_rows() call wrote the bad rows."""
+    from lightgbm_tpu.io.stream import BinCacheStream, CorruptBinCacheError
+
+    cache, _ds = _make_appended_cache(tmp_path)
+    data = bytearray(open(cache, "rb").read())
+    payload = _bins_payload_offset(cache)
+    data[payload + 4500 * 4 + 1] ^= 0xFF  # row 4500: inside the append
+    open(cache, "wb").write(bytes(data))
+    with pytest.raises(CorruptBinCacheError) as ei:
+        for _ in BinCacheStream(cache).chunks(256):
+            pass
+    msg = str(ei.value)
+    assert "appended chunk 0" in msg and "row 4000" in msg, msg
+    # row-ranged at the 512-row CRC block holding row 4500
+    assert ei.value.row_lo == 4096 and ei.value.row_hi == 4608, msg
+
+
+def test_append_to_corrupt_cache_refuses_before_replace(tmp_path):
+    """The old payload streams through the VERIFIED path on its way into
+    the new file: a corrupt source raises row-ranged BEFORE the atomic
+    replace, leaving the (corrupt, but unreplaced) original untouched —
+    an append can never launder bad bytes under a fresh CRC table."""
+    from lightgbm_tpu.io.stream import CorruptBinCacheError, append_rows
+
+    cache, ds = _make_appended_cache(tmp_path)
+    data = bytearray(open(cache, "rb").read())
+    payload = _bins_payload_offset(cache)
+    data[payload + 1000 * 4] ^= 0xFF
+    open(cache, "wb").write(bytes(data))
+    before = open(cache, "rb").read()
+    Xn, yn = _make_data(n=50, f=4, seed=9)
+    with pytest.raises(CorruptBinCacheError):
+        append_rows(cache, ds.binner.transform(Xn), label=yn)
+    assert open(cache, "rb").read() == before
