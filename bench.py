@@ -44,6 +44,13 @@ p50/p99 batch latency over batch sizes x ensemble sizes) and emits a
 {"metric": "predict_rows_per_sec*", ...} artifact row with the same
 incremental un-losable contract; its knobs are PREDICT_BENCH_*.
 
+Multislice mode (round 20): BENCH_MODE=multislice runs the hierarchical
+two-level-merge dryrun (2 slices x 4 ranks off-chip via the hermetic
+subprocess helper; MULTISLICE_SLICES/MULTISLICE_RANKS override): tree ==
+single-mesh sharded at full top-k coverage, per-rank round budget, and
+the statically pinned per-round DCN byte bill in-artifact
+(MULTICHIP_r07-format JSON).
+
 Out-of-core mode (round 12): BENCH_MODE=ooc runs the data-path levers
 (benchmarks/ooc_bench.py — stream-ingest rows/s vs chunk size,
 spill-training rows/s with bitwise parity asserted, and the partition
@@ -404,6 +411,60 @@ def main():
                     "tail": (buf.getvalue() + f"\n{type(e).__name__}: "
                              f"{e}")[-800:]}
                 result["ok"] = False
+        print(json.dumps(result, indent=2))
+        return 0 if result["ok"] else 1
+    if os.environ.get("BENCH_MODE") == "multislice":
+        # hierarchical two-level merge dryrun (round 20): the windowed
+        # round over a nested (dcn, ici) mesh — intra-slice
+        # psum/psum_scatter unchanged, top-k feature exchange over dcn —
+        # validated for tree equality vs the single-mesh sharded round
+        # at full top-k coverage + the per-rank round budget, with the
+        # statically pinned per-round DCN byte bill from the jaxpr audit
+        # embedded in-artifact.  Writes MULTICHIP_r07-format JSON.
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import __graft_entry__ as _ge
+
+        n_slices = int(os.environ.get("MULTISLICE_SLICES", "2"))
+        n_ranks = int(os.environ.get("MULTISLICE_RANKS", "4"))
+        result = {"num_slices": n_slices, "ranks_per_slice": n_ranks,
+                  "mode": "hierarchical_two_level_merge",
+                  "merges": {}, "ok": True}
+        for merge in ("psum", "scatter"):
+            import io
+            from contextlib import redirect_stdout
+
+            buf = io.StringIO()
+            try:
+                with redirect_stdout(buf):
+                    _ge.dryrun_multislice_windowed(n_slices, n_ranks, merge)
+                result["merges"][merge] = {
+                    "rc": 0, "ok": True,
+                    "tail": buf.getvalue()[-500:]}
+            except Exception as e:  # noqa: BLE001 — artifact robustness
+                result["merges"][merge] = {
+                    "rc": 1, "ok": False,
+                    "tail": (buf.getvalue() + f"\n{type(e).__name__}: "
+                             f"{e}")[-800:]}
+                result["ok"] = False
+        # the DCN byte budget, proven on the traced IR: per-contract
+        # dcn_bytes + the collective token sequences ride the artifact
+        try:
+            from lightgbm_tpu.analysis.jaxpr_audit import run_jaxpr_audit
+
+            rep = run_jaxpr_audit(
+                ["windowed_round_hierarchical_psum",
+                 "windowed_round_hierarchical_voting"], runtime=False)
+            result["jaxpr_audit"] = {
+                r.name: {"ok": r.ok,
+                         "dcn_bytes": r.detail.get("dcn_bytes"),
+                         "large_collectives":
+                             r.detail.get("large_collectives")}
+                for r in rep.results}
+            result["ok"] = result["ok"] and rep.ok
+        except Exception as e:  # noqa: BLE001 — artifact robustness
+            result["jaxpr_audit"] = {"error": f"{type(e).__name__}: {e}"}
+            result["ok"] = False
         print(json.dumps(result, indent=2))
         return 0 if result["ok"] else 1
     # persistent XLA compilation cache (measured r5: cuts warmups ~2.4x on

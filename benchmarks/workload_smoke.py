@@ -336,12 +336,72 @@ def bench_continual(n_rows=600, n_feat=6, n_trees=6):
     return 2, cr.booster.num_trees(), dt
 
 
+def bench_multislice(n=1600, n_feat=10):
+    """Hierarchical two-level-merge smoke (round 20): a 2-slice x 2-rank
+    nested-mesh windowed training (needs >= 4 local devices — self-skips
+    below) must equal single-device windowed growth structurally at full
+    top-k coverage with zero retries/syncs, and the per-round DCN byte
+    bill must be pinned in the metrics-facing audit detail."""
+    import jax
+
+    if jax.device_count() < 4:
+        return None
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.analysis.jaxpr_audit import run_jaxpr_audit
+    from lightgbm_tpu.binning import DatasetBinner
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+    from lightgbm_tpu.parallel.hierarchy import (
+        SlicedData, grow_tree_windowed_hierarchical)
+    from lightgbm_tpu.parallel.mesh import make_mesh_hierarchical
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(n, n_feat)
+    y = X @ rng.randn(n_feat) + 0.2 * rng.randn(n)
+    binner = DatasetBinner.fit(X, max_bin=31)
+    bins = binner.transform(X)
+    grad = jnp.asarray(0.6 * y, jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    kw = dict(num_leaves=15, num_bins=32,
+              params=SplitParams(min_data_in_leaf=5.0), leaf_tile=4,
+              use_pallas=False)
+    t0 = time.perf_counter()
+    tree_s, _ = grow_tree_windowed(
+        jnp.asarray(bins.T, jnp.int16), grad, hess, jnp.ones((n,), bool),
+        jnp.ones((n,), jnp.float32), jnp.ones((n_feat,), bool),
+        jnp.asarray(binner.num_bins_per_feature),
+        jnp.asarray(binner.missing_bin_per_feature), **kw)
+    sd = SlicedData(make_mesh_hierarchical(2, 2), bins,
+                    binner.num_bins_per_feature,
+                    binner.missing_bin_per_feature)
+    stats = {}
+    tree_h, leaf_h = grow_tree_windowed_hierarchical(
+        sd, sd.pad_rows(np.asarray(grad)), sd.pad_rows(np.asarray(hess)),
+        sd.row_valid, sd.pad_rows(np.ones(n, np.float32), fill=1.0),
+        jnp.ones((n_feat,), bool), merge="psum", top_k_features=n_feat,
+        stats=stats, **kw)
+    import jax as _jax
+    _jax.block_until_ready(leaf_h)
+    m = int(tree_s.num_leaves) - 1
+    assert int(tree_h.num_leaves) == m + 1
+    assert (np.asarray(tree_s.split_feature)[:m]
+            == np.asarray(tree_h.split_feature)[:m]).all()
+    assert stats["retries"] == 0 and stats["host_syncs"] == 0, stats
+    rep = run_jaxpr_audit(["windowed_round_hierarchical_psum"],
+                          runtime=False)
+    assert rep.ok, [f.format() for f in rep.findings]
+    dcn = rep.results[0].detail["dcn_bytes"]
+    assert 0 < dcn <= 16384
+    return int(tree_h.num_leaves), dcn, time.perf_counter() - t0
+
+
 def main():
     n = int(os.environ.get("SMOKE_ROWS", 1_000_000))
     iters = int(os.environ.get("SMOKE_ITERS", 10))
     which = (sys.argv[1].split(",") if len(sys.argv) > 1
              else ["rank", "multiclass", "predict", "serve", "ooc",
-                   "megakernel", "continual"])
+                   "megakernel", "continual", "multislice"])
     if "rank" in which:
         ips = bench_rank(n, q_len=128, iters=iters)
         print(f"lambdarank {n//1000}k rows x64f q128 63bins: {ips:.2f} iters/sec", flush=True)
@@ -372,6 +432,15 @@ def main():
               f"rollovers (refit+append) -> {trees} trees, served "
               f"bitwise, staleness drops, snapshot keys ok ({dt:.1f}s)",
               flush=True)
+    if "multislice" in which:
+        got = bench_multislice()
+        if got is None:
+            print("multislice: skipped (< 4 local devices)", flush=True)
+        else:
+            leaves, dcn, dt = got
+            print(f"multislice 1.6k rows x10f on 2x2 nested mesh: "
+                  f"{leaves}-leaf tree == single-device at full top-k, "
+                  f"dcn_bytes/round={dcn} pinned ({dt:.1f}s)", flush=True)
 
 
 if __name__ == "__main__":

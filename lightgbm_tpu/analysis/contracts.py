@@ -105,6 +105,11 @@ class Contract:
     # the *_sweeps contracts below).
     bin_arg: Optional[int] = None
     max_bin_sweeps: Optional[float] = None
+    # per-axis J1 accounting (the hierarchical merge's byte pin,
+    # analogous to J7's sweep bound): total operand bytes of collectives
+    # whose axes include the dcn axis must stay under this — ≤ top-k
+    # histograms' worth per round.  None = no dcn traffic declared.
+    dcn_max_bytes: Optional[int] = None
 
 
 CONTRACTS: Dict[str, Contract] = {}
@@ -120,7 +125,8 @@ def contract(name: str, *, description: str,
              waivers: Optional[Mapping[str, str]] = None,
              executes: bool = False,
              bin_arg: Optional[int] = None,
-             max_bin_sweeps: Optional[float] = None):
+             max_bin_sweeps: Optional[float] = None,
+             dcn_max_bytes: Optional[int] = None):
     """Register a contract; the decorated function is its builder."""
 
     def deco(build: Callable[[], Target]) -> Callable[[], Target]:
@@ -135,7 +141,8 @@ def contract(name: str, *, description: str,
             max_live_bytes=max_live_bytes, family=family, spine=spine,
             waivers=dict(waivers or {}), file=frame.filename,
             line=frame.lineno, executes=executes,
-            bin_arg=bin_arg, max_bin_sweeps=max_bin_sweeps)
+            bin_arg=bin_arg, max_bin_sweeps=max_bin_sweeps,
+            dcn_max_bytes=dcn_max_bytes)
         return build
 
     return deco
@@ -348,6 +355,123 @@ def _build_windowed_round_sharded_psum() -> Target:
 )
 def _build_windowed_round_sharded_scatter() -> Target:
     return _windowed_sharded_target("scatter")
+
+
+# ---------------------------------------------------------------------------
+# hierarchical two-level merge (parallel/hierarchy.py) — the multi-slice
+# round.  The intra-slice (ici) sequence must equal the legacy sharded
+# round's (tests/test_jaxpr_audit.py asserts the axis-mapped identity),
+# and the dcn-axis byte bill is pinned at ≤ top-k histograms' worth.
+# ---------------------------------------------------------------------------
+
+_HIER_TOPK = 4  # the fixture election width (k < F: a real sub-election)
+
+# scalar protocol merges span BOTH axes (window election + info vector
+# are global agreements); the histogram merge stays per-slice on ici
+_HIER_PREFIX = tuple(t.replace("@data", "@ici,dcn") for t in _ROUND_PREFIX)
+_HIER_SUFFIX = tuple(t.replace("@data", "@ici,dcn") for t in _ROUND_SUFFIX)
+# the dcn election: k gain scalars + k feature ids all_gathered, then the
+# elected k features' histogram columns psummed — the ONLY
+# histogram-shaped dcn operand (jaxlint R17's clean shape)
+_HIER_ELECTION = ("all_gather@dcn", "all_gather@dcn", "psum@dcn")
+_HIER_SCATTER_ELECTION = tuple(
+    t.replace("@data", "@ici") for t in _SCATTER_ELECTION)
+
+# the fixture's per-round dcn bill: C=2*tile candidates x 3 channels x
+# k features x B bins x 4 bytes for the elected-histogram psum, plus the
+# two (S, C, k) vote all_gathers and the 4-byte both-axes scalars — the
+# "top-k histograms' worth" promise, with ~1 KB scalar slack
+_HIER_DCN_BUDGET = 2 * _TILE * 3 * _HIER_TOPK * _BINS * 4 + 1024
+
+
+def _audit_mesh_hier():
+    """Loopback nested (dcn, ici) mesh: 2 slices x 2 ranks on the
+    virtual 8-device host (axis size only changes the lowering, not the
+    jaxpr — see audit_mesh)."""
+    import jax
+
+    from ..parallel.mesh import make_mesh_hierarchical
+    n = len(jax.devices())
+    if n >= 4:
+        return make_mesh_hierarchical(2, 2)
+    return make_mesh_hierarchical(min(n, 2), 1)
+
+
+def _windowed_hier_target(merge: str) -> Target:
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import hierarchy as hy
+    from ..parallel.mesh import slice_axis_sizes
+
+    mesh = _audit_mesh_hier()
+    _, n_ici = slice_axis_sizes(mesh)
+    f_pad = (-(-_F // n_ici) * n_ici) if merge == "scatter" else _F
+    row = lambda dt: _sds((_N,), dt)  # noqa: E731
+    bt = _sds((f_pad, _N), jnp.int16)
+    pf = _sds((f_pad,), jnp.int32)
+    fm = _sds((f_pad,), jnp.bool_)
+    init_statics = tuple(sorted(dict(
+        _round_common(), use_pallas=False, quantize_bins=0,
+        hist_precision="f32", stochastic_rounding=False).items()))
+    init_fn = hy._windowed_init_hier(mesh, merge, _HIER_TOPK, (),
+                                     init_statics)
+    state = jax.eval_shape(init_fn, bt, row(jnp.float32), row(jnp.float32),
+                           row(jnp.bool_), row(jnp.float32), pf, pf, fm)[0]
+    round_statics = tuple(sorted(dict(
+        _round_common(), max_depth=-1, use_pallas=False, quantize_bins=0,
+        hist_precision="f32", has_cat=False, pallas_partition=False,
+        megakernel=False, mk_interpret=False).items()))
+    fn = hy._windowed_round_hier(mesh, _W, merge, _HIER_TOPK, (),
+                                 round_statics)
+    args = (state, bt, row(jnp.float32), row(jnp.float32), row(jnp.bool_),
+            pf, pf, fm)
+    return Target(fn, args, {},
+                  note=f"jit(shard_map) hierarchical round, intra-slice "
+                       f"merge={merge!r}, top_k={_HIER_TOPK}, nested "
+                       f"{mesh.devices.shape} loopback mesh")
+
+
+@contract(
+    "windowed_round_hierarchical_psum",
+    description="two-level fused windowed round over the nested "
+                "(dcn, ici) mesh, intra-slice merge='psum' "
+                "(tree_learner=data x num_slices>1): the slice-local "
+                "histogram psum rides ici UNCHANGED vs the single-level "
+                "round, the scalar protocol spans both axes, and the "
+                "only histogram-shaped dcn operand is the elected "
+                "top-k feature exchange — byte bill pinned",
+    collectives=(_HIER_PREFIX + ("psum@ici",) + _HIER_ELECTION
+                 + _HIER_SUFFIX),
+    donated_args=(0,),
+    max_live_bytes=10 << 20,  # measured ≈ 4.15 MB at the fixture shape
+    family="windowed_hierarchical",
+    spine=(len(_HIER_PREFIX), len(_HIER_SUFFIX)),
+    dcn_max_bytes=_HIER_DCN_BUDGET,
+)
+def _build_windowed_round_hierarchical_psum() -> Target:
+    return _windowed_hier_target("psum")
+
+
+@contract(
+    "windowed_round_hierarchical_voting",
+    description="two-level fused windowed round, intra-slice "
+                "merge='scatter' (tree_learner=voting x num_slices>1): "
+                "psum_scatter + owned-feature election over ici exactly "
+                "as the single-level scatter round, the dcn top-k "
+                "exchange inside each rank's owned feature block — the "
+                "full PV-Tree route, byte bill pinned",
+    collectives=(_HIER_PREFIX + ("psum_scatter@ici", "axis_index@ici")
+                 + _HIER_ELECTION + _HIER_SCATTER_ELECTION[1:]
+                 + _HIER_SUFFIX),
+    donated_args=(0,),
+    max_live_bytes=10 << 20,  # measured ≈ 4.13 MB at the fixture shape
+    family="windowed_hierarchical",
+    spine=(len(_HIER_PREFIX), len(_HIER_SUFFIX)),
+    dcn_max_bytes=_HIER_DCN_BUDGET,
+)
+def _build_windowed_round_hierarchical_voting() -> Target:
+    return _windowed_hier_target("scatter")
 
 
 # ---------------------------------------------------------------------------

@@ -58,7 +58,8 @@ from .core import Finding
 # J-rule catalogue for --list-rules-style output
 JAXPR_RULES: Dict[str, str] = {
     "J1": "collective-count/axis-name — exact declared sequence, declared "
-          "mesh axes, family-consistent protocol spine",
+          "mesh axes, family-consistent protocol spine, per-axis byte "
+          "accounting (dcn_max_bytes pins the cross-slice bill)",
     "J2": "donation-consumed — every live donated invar aliasable (and "
           "aliased where the platform lowers aliasing)",
     "J3": "no-f64-promotion — no f64 cast or aval in the body",
@@ -194,8 +195,42 @@ def _finding(c: Contract, rule: str, msg: str, hint: str) -> Finding:
 
 
 def _declared_axes() -> set:
-    from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS
-    return {DATA_AXIS, FEATURE_AXIS}
+    from ..parallel.mesh import DATA_AXIS, DCN_AXIS, FEATURE_AXIS, ICI_AXIS
+    return {DATA_AXIS, FEATURE_AXIS, ICI_AXIS, DCN_AXIS}
+
+
+def dcn_axis_bytes(found) -> int:
+    """Total operand bytes of every collective whose axes include the
+    DCN axis — the per-round cross-slice byte bill the hierarchical
+    contracts pin statically (``dcn_max_bytes``).  Scalar protocol
+    merges that span both axes count too (they cross DCN); intra-slice
+    merges on the ici axis alone do not."""
+    from ..parallel.mesh import DCN_AXIS
+    return sum(nb for _name, axes, nb in found if DCN_AXIS in axes)
+
+
+def _check_dcn_bytes(c: Contract, found
+                     ) -> Tuple[List[Finding], Dict[str, object]]:
+    """The per-axis half of J1 (analogous to J7's sweep bound): the
+    statically summed DCN-axis operand bytes per round body must stay
+    under the contract's ``dcn_max_bytes`` — ≤ top-k histograms' worth.
+    A full-F histogram merge smuggled onto the dcn axis fails here (and
+    jaxlint R17 flags the source form)."""
+    if c.dcn_max_bytes is None:
+        return [], {}
+    got = dcn_axis_bytes(found)
+    findings = []
+    if got > c.dcn_max_bytes:
+        findings.append(_finding(
+            c, "J1",
+            f"{got} bytes of collective operands cross the dcn axis per "
+            f"round, exceeding the {c.dcn_max_bytes}-byte contract pin",
+            "the hierarchical merge's whole point is that only "
+            "top-k-shaped or scalar operands cross DCN — route new "
+            "cross-slice traffic through the top-k election "
+            "(parallel/hierarchy.py::dcn_topk_best) or raise the budget "
+            "consciously (docs/ANALYSIS.md, jaxlint R17)"))
+    return findings, {"dcn_bytes": got}
 
 
 def _check_j1(c: Contract, found) -> Tuple[List[Finding], List[str]]:
@@ -635,6 +670,9 @@ def audit_contract(c: Contract) -> ContractResult:
     detail["collectives"] = tokens
     detail["large_collectives"] = sum(
         1 for _n, _ax, nb in found if nb >= _LARGE_COLLECTIVE_BYTES)
+    jdcn, ddcn = _check_dcn_bytes(c, found)
+    raw += jdcn
+    detail.update(ddcn)
     j2, d2 = _check_j2(c, target, jaxpr, lowered_text)
     raw += j2
     detail.update(d2)
@@ -796,6 +834,13 @@ def verdict(runtime: bool = False, exec_contracts: bool = True) -> dict:
               if "bin_sweeps" in r.detail}
     if sweeps:
         out["bin_sweeps"] = sweeps
+    # per-round DCN byte bills of the hierarchical contracts ride the
+    # artifact too — a multislice bench row carries the cross-slice
+    # budget proof next to the pass/fail rows
+    dcn = {r.name: r.detail["dcn_bytes"] for r in rep.results
+           if "dcn_bytes" in r.detail}
+    if dcn:
+        out["dcn_bytes"] = dcn
     if skipped:
         out["skipped_exec_contracts"] = skipped
     return out

@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R15.
+"""jaxlint built-in rules R1-R17.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -1535,3 +1535,122 @@ def r16_mutation_outside_version_bump(pkg: PackageIndex) -> Iterator[Finding]:
                             f"{fi.qualname} without a _pack_version bump "
                             "— an in-place ensemble edit invisible to "
                             "the versioned pack cache", hint)
+
+
+# ---------------------------------------------------------------------------
+# R17 — full-histogram-over-dcn
+# ---------------------------------------------------------------------------
+
+_R17_COLLECTIVES = ("psum", "psum_scatter", "all_gather", "pmean",
+                    "all_to_all", "ppermute", "pmax", "pmin")
+# gather-style calls whose result is top-k-shaped by construction: an
+# operand assigned from one of these is an elected subset, not the
+# full-F plane
+_R17_TOPK_GATHERS = ("take_along_axis", "top_k", "dynamic_slice",
+                     "dynamic_slice_in_dim")
+
+
+def _r17_axis_mentions_dcn(axis_arg: ast.AST) -> bool:
+    """The collective's axis expression references the DCN axis: the
+    'dcn' string literal, the DCN_AXIS constant, or any dcn-named
+    variable — including tuple axes like (ICI_AXIS, DCN_AXIS)."""
+    for sub in ast.walk(axis_arg):
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and "dcn" in sub.value.lower()):
+            return True
+        if isinstance(sub, ast.Name) and "dcn" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "dcn" in sub.attr.lower():
+            return True
+    return False
+
+
+def _r17_hist_name(expr: ast.AST) -> Optional[str]:
+    """The operand's name when it reads as a histogram buffer."""
+    if isinstance(expr, ast.Subscript):
+        return _r17_hist_name(expr.value)
+    if isinstance(expr, ast.Name):
+        nm = expr.id
+    elif isinstance(expr, ast.Attribute):
+        nm = expr.attr
+    else:
+        return None
+    return nm if "hist" in nm.lower() else None
+
+
+def _r17_topk_shaped(fi: FuncInfo, name: str) -> bool:
+    """True when ``name`` is assigned (anywhere in the function) from a
+    top-k gather — take_along_axis / top_k / dynamic_slice family — so a
+    hist-named operand is actually an elected feature subset."""
+    for node in _own_body(fi, include_nested=True):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if name not in targets:
+            continue
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Call):
+                fn = dotted_name(sub.func) or ""
+                if fn.split(".")[-1] in _R17_TOPK_GATHERS:
+                    return True
+    return False
+
+
+@register_rule("R17", "full-histogram-over-dcn")
+def r17_full_histogram_over_dcn(pkg: PackageIndex) -> Iterator[Finding]:
+    """A collective whose axis set includes the DCN axis moving a FULL
+    histogram operand.  The hierarchical two-level merge's contract
+    (docs/DISTRIBUTED.md "Hierarchical merge") is that full (…, F, B)
+    histogram planes merge only INSIDE a slice's ICI axis — crossing
+    DCN is reserved for top-k-shaped payloads (elected feature columns,
+    gathered by the vote's indices) and scalars, because DCN bandwidth
+    is an order of magnitude below ICI and a full-F merge there erases
+    the multi-slice speedup at exactly the scale it was bought for.
+    Statically: any ``jax.lax`` collective whose axis expression
+    references the dcn axis and whose operand NAMES a histogram
+    (``*hist*``) is flagged, unless that operand is assigned from a
+    top-k gather (``take_along_axis``/``top_k``/``dynamic_slice``) in
+    the same function — the elected-subset shape
+    ``parallel/hierarchy.py::dcn_topk_best`` ships.  Name-heuristic by
+    necessity (the AST has no avals); the jaxpr-audit ``dcn_max_bytes``
+    contract pin is the sound byte-level half (docs/ANALYSIS.md)."""
+    hint = ("merge full histograms over the ici axis only; cross dcn "
+            "with the elected top-k feature columns "
+            "(parallel/hierarchy.py::dcn_topk_best) or scalars — see "
+            "docs/DISTRIBUTED.md 'Hierarchical merge' and the "
+            "jaxpr-audit dcn_max_bytes pin")
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if fi.parent is not None:
+                # nested defs are walked through their ENCLOSING function
+                # (include_nested below) — visiting them again would both
+                # duplicate findings and lose sight of a top-k gather
+                # assigned in the enclosing scope (the R3 discipline)
+                continue
+            for node in _own_body(fi, include_nested=True):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = dotted_name(node.func)
+                if fn is None or fn.split(".")[-1] not in _R17_COLLECTIVES:
+                    continue
+                if not node.args:
+                    continue
+                axis_arg = None
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis"):
+                        axis_arg = kw.value
+                if axis_arg is None and len(node.args) > 1:
+                    axis_arg = node.args[1]
+                if axis_arg is None or not _r17_axis_mentions_dcn(axis_arg):
+                    continue
+                hist_nm = _r17_hist_name(node.args[0])
+                if hist_nm is None:
+                    continue
+                if _r17_topk_shaped(fi, hist_nm):
+                    continue
+                yield _finding(
+                    fi, node, "R17",
+                    f"{fn}({hist_nm}, …) in {fi.qualname} moves a full "
+                    "histogram operand across the dcn axis — the "
+                    "cross-slice merge must be top-k-shaped or scalar",
+                    hint)

@@ -281,7 +281,14 @@ class Dataset:
                 # max_rows_in_hbm (docs round 12)
                 from .binning import BinMapper
 
+                shard_spec = self.params.get("bin_cache_shard")
                 if cfg.out_of_core:
+                    if shard_spec is not None:
+                        raise ValueError(
+                            "bin_cache_shard and out_of_core are not "
+                            "combinable yet: the shard feed materializes "
+                            "its rows (pass the shard to BinCacheStream "
+                            "directly for streamed sweeps)")
                     from .io.stream import BinCacheStream
 
                     self._ooc_stream = BinCacheStream(path)
@@ -311,8 +318,10 @@ class Dataset:
                         off += s
                         coff += cs
                     pre_binner = DatasetBinner(mappers=mappers)
-                    pre_bins = (None if getattr(self, "_ooc_stream", None)
-                                is not None else np.asarray(z["bins"]))
+                    pre_bins = (None if (
+                        getattr(self, "_ooc_stream", None) is not None
+                        or shard_spec is not None)
+                        else np.asarray(z["bins"]))
                     loaded = {
                         "label": (z["label"] if z["label"].size else None),
                         "weight": (z["weight"] if z["weight"].size else None),
@@ -327,6 +336,60 @@ class Dataset:
                             else None),
                         "feature_names": [str(x) for x in z["feature_names"]],
                     }
+                if shard_spec is not None:
+                    # rank-sharded cache feed (docs/DISTRIBUTED.md): this
+                    # worker materializes ONLY its [lo, hi) rows of the
+                    # shared cache — streamed through BinCacheStream's
+                    # shard form with CRC verification of every fully
+                    # covered block — plus optional weight-0 padding to
+                    # the fleet's equal-shard size (pre_partition needs
+                    # equal shards; pad rows can never contribute)
+                    from .io.stream import read_cache_shard
+
+                    s_lo, s_hi = int(shard_spec[0]), int(shard_spec[1])
+                    pad_to = (int(shard_spec[2]) if len(shard_spec) > 2
+                              else s_hi - s_lo)
+                    if pad_to < s_hi - s_lo:
+                        raise ValueError(
+                            f"bin_cache_shard pad size {pad_to} is below "
+                            f"the shard's {s_hi - s_lo} rows")
+                    if loaded.get("group") is not None:
+                        raise ValueError(
+                            "bin_cache_shard does not support grouped "
+                            "(ranking) caches: shard boundaries would cut "
+                            "queries")
+                    pre_bins = read_cache_shard(path, s_lo, s_hi)
+                    n_pad = pad_to - (s_hi - s_lo)
+                    if n_pad:
+                        pre_bins = np.concatenate([
+                            pre_bins,
+                            np.zeros((n_pad, pre_bins.shape[1]),
+                                     pre_bins.dtype)])
+
+                    def _slice_pad(v, fill):
+                        if v is None:
+                            return None
+                        v = np.asarray(v)[s_lo:s_hi]
+                        if n_pad:
+                            v = np.concatenate([
+                                v, np.full((n_pad,) + v.shape[1:], fill,
+                                           v.dtype)])
+                        return v
+
+                    w = loaded.get("weight")
+                    if w is None and n_pad:
+                        # padding must carry weight 0; synthesize unit
+                        # weights for the real rows
+                        w = np.ones(s_hi - s_lo, np.float64)
+                        loaded["weight"] = np.concatenate(
+                            [w, np.zeros(n_pad)])
+                    else:
+                        loaded["weight"] = _slice_pad(w, 0.0)
+                    loaded["label"] = _slice_pad(loaded.get("label"), 0.0)
+                    loaded["init_score"] = _slice_pad(
+                        loaded.get("init_score"), 0.0)
+                    loaded["position"] = _slice_pad(
+                        loaded.get("position"), 0)
             elif cfg.two_round:
                 import jax as _jax
 

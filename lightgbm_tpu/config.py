@@ -398,6 +398,26 @@ class Config:
     time_out: int = 120
     machine_list_filename: str = ""
     machines: str = ""
+    # num_slices (ours; docs/DISTRIBUTED.md "Hierarchical merge"): slice
+    # count of the nested (dcn, ici) mesh for multi-slice scale-out.
+    # With num_slices > 1 and tree_learner=data|voting, the fused
+    # windowed round runs the two-level merge: full psum/psum_scatter
+    # histogram collectives stay INSIDE each slice's ici axis, and only
+    # top_k_features features' histograms + gain scalars per split
+    # candidate cross the dcn axis (the PV-Tree/voting-parallel route).
+    # Devices must divide evenly into slices.  1 (default) = the
+    # single-level sharded round.
+    num_slices: int = 1
+    # top_k_features (ours; docs/DISTRIBUTED.md "Hierarchical merge"):
+    # per-slice feature election width of the hierarchical merge — how
+    # many features' histograms each slice may ship over DCN per split
+    # candidate.  k >= num_features makes the election exhaustive
+    # (trees structurally exact vs the single-mesh sharded round, at
+    # full-merge byte cost over DCN); smaller k is the PV-Tree
+    # approximation with a statically pinned DCN byte budget
+    # (jaxpr-audit dcn_max_bytes, jaxlint R17).  Distinct from top_k,
+    # which parameterizes the strict voting-parallel grower.
+    top_k_features: int = 32
 
     # --- GPU-compat (accepted, translated to mesh semantics) ---
     gpu_platform_id: int = -1

@@ -144,14 +144,23 @@ def train(
                     f"resume={resume!r} is not supported: pass 'auto', a "
                     "fleet manifest path (lgbmtpu-fleet-ckpt-v1), or "
                     "init_model=<snapshot> for a specific file")
-            manifest = _checkpoint.fleet_manifest_valid(resume)
+            # slice-granular recovery (docs/ROBUSTNESS.md): the launcher
+            # respawning ONE lost slice names its dead ranks here, so a
+            # round every SURVIVING rank confirmed is resumable even
+            # though the lost slice's own acks are missing
+            excl = tuple(
+                int(r) for r in os.environ.get(
+                    "LGBMTPU_RESUME_EXCLUDE_RANKS", "").split(",") if r)
+            manifest = _checkpoint.fleet_manifest_valid(
+                resume, exclude_ranks=excl)
             if manifest is None:
                 raise LightGBMError(
                     f"resume manifest {resume} is not fleet-valid (torn, "
                     "unconfirmed by some rank, or its snapshot fails "
                     "verification) — refusing to resume into inconsistent "
                     "fleet state (docs/ROBUSTNESS.md)")
-            rank = os.environ.get("LIGHTGBM_TPU_RANK", "0")
+            rank = os.environ.get("LGBM_TPU_WORKER_ID",
+                                  os.environ.get("LIGHTGBM_TPU_RANK", "0"))
             shard_fp = os.environ.get("LGBMTPU_SHARD_FINGERPRINT")
             want_fp = (manifest.get("shards") or {}).get(rank)
             if shard_fp and want_fp and shard_fp != want_fp:

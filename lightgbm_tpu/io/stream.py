@@ -303,6 +303,46 @@ class BinCacheStream:
                 lo += m
 
 
+def read_cache_shard(path: str, row_lo: int, row_hi: int,
+                     chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                     member: str = "bins") -> np.ndarray:
+    """Materialize rows [row_lo, row_hi) of a save_binary cache through
+    the shard-restricted stream: one reused read buffer, every CRC block
+    the shard fully covers verified row-ranged — the launcher's
+    pre-partition worker feed (docs/DISTRIBUTED.md "Hierarchical
+    merge"): each rank reads ONLY its shard of one shared cache instead
+    of every rank decompressing the full matrix."""
+    st = BinCacheStream(path, member=member, shard=(int(row_lo),
+                                                    int(row_hi)))
+    out = np.empty((st.shard_rows, st.n_cols), st.dtype)
+    base = int(row_lo)
+    for lo, view in st.chunks(chunk_rows):
+        out[lo - base: lo - base + view.shape[0]] = view
+    return out
+
+
+def cache_shard_fingerprint(path: str, row_lo: int, row_hi: int,
+                            member: str = "bins") -> str:
+    """Stable sha256 identity of rows [row_lo, row_hi) of a cache,
+    derived from the header + the CRC trailer table entries overlapping
+    the range — cheap (no payload read) and byte-change-sensitive, the
+    per-rank data fingerprint the fleet manifests stamp for the cache
+    worker feed.  Legacy trailerless caches return "" (nothing can vouch
+    for their bytes; the resume guard skips empty fingerprints)."""
+    import hashlib
+
+    st = BinCacheStream(path, member=member)
+    if st.crcs is None:
+        return ""
+    lo_b = int(row_lo) // st.crc_rows
+    hi_b = -(-int(row_hi) // st.crc_rows)
+    h = hashlib.sha256()
+    h.update(repr((st.shape, str(st.dtype), int(row_lo),
+                   int(row_hi))).encode())
+    h.update(np.ascontiguousarray(st.crcs[lo_b:hi_b]).tobytes())
+    return h.hexdigest()
+
+
 # ---------------------------------------------------------------------------
 # append-able caches (round 19, continual ingest — docs/README "Continuous
 # training"): save_binary caches grow in place through append_rows(), so a

@@ -662,6 +662,7 @@ class GBDT:
         # TreeLearner::CreateTreeLearner picking {serial,data,feature,voting})
         self._dp = None
         self._fp = None
+        self._dp_hier = None
         if self.cfg.tree_learner in ("data", "feature", "voting"):
             import jax as _jax
 
@@ -696,6 +697,34 @@ class GBDT:
                         np.asarray(train_set.binner.missing_bin_per_feature),
                         process_local=self._pre_partition,
                     )
+                    # nested (dcn, ici) mesh for multi-slice scale-out
+                    # (docs/DISTRIBUTED.md "Hierarchical merge"): built
+                    # NEXT TO the flat mesh — the hierarchical two-level
+                    # merge serves the windowed fused round; every other
+                    # grower keeps the single-level path above
+                    ns = int(self.cfg.num_slices)
+                    if ns > 1:
+                        if self._pre_partition:
+                            log_warning(
+                                "num_slices > 1 is not wired through the "
+                                "multi-controller pre_partition path yet; "
+                                "training on the single-level mesh")
+                        elif _jax.device_count() % ns:
+                            log_warning(
+                                f"num_slices={ns} does not divide "
+                                f"{_jax.device_count()} devices; training "
+                                "on the single-level mesh")
+                        else:
+                            from ..parallel.hierarchy import SlicedData
+                            from ..parallel.mesh import (
+                                make_mesh_hierarchical)
+
+                            # reshard the flat layout's device buffers —
+                            # the nested row layout places the same
+                            # per-device blocks, so the bin matrix stays
+                            # ONE device copy
+                            self._dp_hier = SlicedData.from_sharded(
+                                make_mesh_hierarchical(ns), self._dp)
 
     def reset_split_params(self) -> None:
         """Refresh jit-static split hyperparams after a config mutation
@@ -917,6 +946,19 @@ class GBDT:
             and self._cegb_lazy is None
             and self._cegb_coupled is None
             and not self._linear
+        )
+
+    def _use_windowed_hier(self, ts) -> bool:
+        """Multi-slice hierarchical merge gate (docs/DISTRIBUTED.md
+        "Hierarchical merge"): the two-level windowed round over the
+        nested (dcn, ici) mesh — intra-slice psum/psum_scatter, top-k
+        feature exchange over dcn.  Rides :meth:`_use_windowed_dp`'s
+        envelope, minus per-node feature sampling (the slice-local vote
+        must be deterministic and slice-consistent)."""
+        return (
+            self._dp_hier is not None
+            and not self._needs_node_rng
+            and self._use_windowed_dp(ts)
         )
 
     def _windowed_dp_merge(self) -> str:
@@ -1398,6 +1440,48 @@ class GBDT:
                     monotone_method=self._monotone_method,
                 )
                 arrays, leaf_id = self._localize_tree(arrays, leaf_id)
+            elif self._dp_hier is not None and self._use_windowed_hier(ts):
+                # multi-slice scale-out (docs/DISTRIBUTED.md "Hierarchical
+                # merge"): the two-level windowed round — intra-slice
+                # psum/psum_scatter over ici unchanged, top-k feature
+                # exchange over dcn, all inside the one donated dispatch
+                from ..parallel.hierarchy import (
+                    grow_tree_windowed_hierarchical)
+
+                dph = self._dp_hier
+                quant = self.cfg.use_quantized_grad
+                arrays, leaf_id_pad = grow_tree_windowed_hierarchical(
+                    dph,
+                    dph.pad_rows_device(gc, jnp.float32),
+                    dph.pad_rows_device(hc, jnp.float32),
+                    dph.pad_rows_device(row_mask, bool, fill=False),
+                    dph.pad_rows_device(sample_weight, jnp.float32,
+                                        fill=1.0),
+                    feature_mask,
+                    self._categorical_mask,
+                    (jax.random.PRNGKey(
+                        self.cfg.seed * 1000003 + self.iter_ * 31 + c)
+                     if quant else None),
+                    self._feature_contri,
+                    num_leaves=self.cfg.num_leaves,
+                    num_bins=ts.max_num_bins,
+                    max_depth=self.cfg.max_depth,
+                    params=self._split_params,
+                    leaf_tile=self._leaf_tile(ts, use_efb=False),
+                    hist_precision=self.cfg.hist_precision,
+                    use_pallas=self._on_tpu,
+                    quantize_bins=(self.cfg.num_grad_quant_bins
+                                   if quant else 0),
+                    stochastic_rounding=bool(self.cfg.stochastic_rounding),
+                    quant_renew=bool(self.cfg.quant_train_renew_leaf),
+                    merge=self._windowed_dp_merge(),
+                    top_k_features=int(self.cfg.top_k_features),
+                    guard_label=(
+                        f" (boosting iteration {self.iter_ + 1})"),
+                )
+                arrays, leaf_id_pad = self._localize_tree(
+                    arrays, leaf_id_pad)
+                leaf_id = leaf_id_pad[: ts.num_data()]
             elif self._dp is not None and self._use_windowed_dp(ts):
                 # the tentpole path: sharded one-dispatch windowed rounds —
                 # histogram merge is one psum/psum_scatter INSIDE the

@@ -256,6 +256,17 @@ class Registry:
         with _lock:
             self._collectors[name] = fn
 
+    def unregister_collector(self, name: str) -> None:
+        """Drop a registered collector (no-op when absent).  For
+        launch-scoped collectors like the launcher's ``fleet_live``
+        (which deliberately outlives its run for post-mortem scrapes of
+        the LAUNCHER's endpoint): tests probing process health after a
+        faulted launch must drop it, or the dead fleet's on-disk
+        counters keep flipping /healthz degraded — ``reset()`` cannot,
+        since the sanitizer-ledger collectors must survive it."""
+        with _lock:
+            self._collectors.pop(name, None)
+
     # -- events ----------------------------------------------------------
     def set_events_file(self, path: Optional[str]) -> None:
         """Explicit sink path; ``None`` reverts to env-var resolution
@@ -358,7 +369,13 @@ def _percentile_of(sorted_samples: List[float], p: float) -> Optional[float]:
 
 
 def _rank_from_env() -> Optional[int]:
-    r = os.environ.get("LIGHTGBM_TPU_RANK")
+    # events/snapshots stamp the fleet-GLOBAL worker id when the launcher
+    # set one: multi-slice fleets reuse slice-local rendezvous ranks per
+    # slice (parallel/launcher.py), so LIGHTGBM_TPU_RANK alone would
+    # attribute two different processes' records to one rank in the
+    # merged fleet flight recorder
+    r = os.environ.get("LGBM_TPU_WORKER_ID",
+                       os.environ.get("LIGHTGBM_TPU_RANK"))
     try:
         return int(r) if r is not None else None
     except ValueError:
@@ -376,6 +393,7 @@ events = REGISTRY.events
 snapshot = REGISTRY.snapshot
 reset = REGISTRY.reset
 register_collector = REGISTRY.register_collector
+unregister_collector = REGISTRY.unregister_collector
 set_events_file = REGISTRY.set_events_file
 histogram_items = REGISTRY.histogram_items
 clear_prefix = REGISTRY.clear_prefix

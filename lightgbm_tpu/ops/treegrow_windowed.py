@@ -67,6 +67,16 @@ live in parallel/data_parallel.py::grow_tree_windowed_data_parallel.
 The 1-dispatch/0-sync budget pin holds PER RANK (single-controller: one
 host dispatch fans out over the mesh; tests/test_retrace.py).
 
+Round 20 (docs/DISTRIBUTED.md "Hierarchical merge"): with
+``dcn_axis_name`` the round runs the TWO-LEVEL multi-slice merge — the
+intra-slice histogram merge above rides ``axis_name`` (the ici axis)
+UNCHANGED, the split search goes through the top-k feature election
+(parallel/hierarchy.py::dcn_topk_best: slice-local vote, k-feature
+histogram exchange, global election — the only histogram-shaped dcn
+traffic), and the scalar protocol merges span both axes.  The nested
+shard_map plumbing and the SPMD entry live in parallel/hierarchy.py::
+grow_tree_windowed_hierarchical.
+
 Round 15: the round executable's IR is ALSO pinned statically — the
 jaxpr audit contracts ``windowed_round_float`` / ``_quantized`` /
 ``_sharded_psum`` / ``_sharded_scatter`` (analysis/contracts.py) trace
@@ -213,7 +223,8 @@ def _merge_best(bb: BestSplit, axis_name, f0) -> BestSplit:
     static_argnames=("num_leaves", "num_bins", "max_depth", "params",
                      "leaf_tile", "W", "use_pallas", "quantize_bins",
                      "hist_precision", "has_cat", "pallas_partition",
-                     "axis_name", "merge", "megakernel", "mk_interpret"),
+                     "axis_name", "merge", "megakernel", "mk_interpret",
+                     "dcn_axis_name", "dcn_top_k"),
     donate_argnums=(0,),  # the 1.5 GB-at-Epsilon hist state threads
     # linearly through the host round loop; donation lets XLA update it in
     # place instead of alloc+copy per call (benchmarks/probe_r5_fixed.py)
@@ -252,6 +263,8 @@ def _round_fused(
     merge: str = "psum",
     megakernel: bool = False,
     mk_interpret: bool = False,
+    dcn_axis_name: Optional[str] = None,
+    dcn_top_k: int = 0,
 ):
     """One whole boosting round in one traced body: gain admission,
     segment partition, bookkeeping, window gather, multi-leaf pass,
@@ -276,13 +289,31 @@ def _round_fused(
     identical values.  Physical row bookkeeping (order, leaf ranges,
     partition) stays rank-local; split decisions and tree arrays are
     replicated.
+
+    With ``dcn_axis_name`` the body runs the TWO-LEVEL hierarchical merge
+    (docs/DISTRIBUTED.md "Hierarchical merge"): ``axis_name`` is the
+    intra-slice ICI axis — the histogram merge above runs UNCHANGED
+    there, per slice — and the split search crosses slices DCN-frugally:
+    each slice elects its ``dcn_top_k`` best features per candidate
+    locally, only those k features' histograms + gain scalars travel the
+    ``dcn`` axis (parallel/hierarchy.py::dcn_topk_best), and a global
+    election picks the winner.  ``state.hist`` then holds SLICE-domain
+    histograms (sibling subtraction works per slice), the scalar
+    protocol merges (window election, info vector) span BOTH axes, and
+    NO full-F histogram ever crosses DCN — pinned statically by jaxlint
+    R17 and the jaxpr-audit ``dcn_max_bytes`` contract pin.
     """
     L = num_leaves
     f = bins_t.shape[0]
     n = state.order.shape[0]
+    # every-rank axes for the scalar protocol merges: under the two-level
+    # merge, window-child election and the info vector are GLOBAL
+    # agreements (all slices, all ranks) while the histogram merge stays
+    # per-slice on axis_name alone
+    all_axes = tuple(a for a in (axis_name, dcn_axis_name) if a is not None)
 
-    def pall(x):  # cross-rank sum; identity single-device
-        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+    def pall(x):  # cross-rank sum over every mesh axis; identity 1-device
+        return jax.lax.psum(x, all_axes) if all_axes else x
     eps = KMIN_SCORE / 2
     idx = jnp.arange(L, dtype=jnp.int32)
     pos = jnp.arange(n, dtype=jnp.int32)
@@ -362,12 +393,12 @@ def _round_fused(
         jnp.where(left_small, left_counts, seg_len - left_counts), 0)
     total = jnp.sum(win_cnt_rk)  # LOCAL rows this rank must window
     ok = total <= W  # guaranteed by the whint bound; verified anyway
-    if axis_name is not None:
+    if all_axes:
         # one rank breaching skips the round EVERYWHERE (the no-op must be
         # fleet-consistent), and the host's corrected W must cover the
         # worst rank — merged here so the async info vector is replicated
-        ok = jax.lax.pmin(ok.astype(jnp.int32), axis_name) > 0
-        total = jax.lax.pmax(total, axis_name)
+        ok = jax.lax.pmin(ok.astype(jnp.int32), all_axes) > 0
+        total = jax.lax.pmax(total, all_axes)
 
     # everything applied below is gated on `ok`: a breached prediction
     # makes the whole round a bitwise no-op (state threads through
@@ -666,16 +697,31 @@ def _round_fused(
         nb_l, mb_l, fm_l, cm_l, fc_l, f0 = _split_tables(
             axis_name, merge, state.hist.shape[2], num_bins_pf,
             missing_bin_pf, feature_mask, categorical_mask, feature_contri)
-        bb = _batched_best(
-            cand_hists, leaf_sum_g[ci], leaf_sum_h[ci],
-            leaf_count[ci], nb_l, mb_l, params,
-            fm_l, cm_l, None, None,
-            jnp.full((2 * leaf_tile,), -jnp.inf, jnp.float32),
-            jnp.full((2 * leaf_tile,), jnp.inf, jnp.float32),
-            None, node_ids[ci], rng_key,
-            depth=leaf_depth[ci], parent_out=leaf_out[ci],
-            feature_contri=fc_l,
-        )
+        if dcn_axis_name is not None:
+            # two-level split search (parallel/hierarchy.py): the cand
+            # hists above are SLICE-domain (merged over axis_name only);
+            # each slice votes its top-k features per candidate, only k
+            # features' histograms + gain scalars cross the dcn axis, and
+            # the winner is elected on the k-feature GLOBAL histograms —
+            # the PV-Tree/voting-parallel route, in-dispatch
+            from ..parallel.hierarchy import dcn_topk_best
+
+            bb = dcn_topk_best(
+                cand_hists, leaf_sum_g[ci], leaf_sum_h[ci], leaf_count[ci],
+                nb_l, mb_l, fm_l, cm_l, fc_l,
+                params=params, top_k=dcn_top_k, dcn_axis=dcn_axis_name,
+                depth=leaf_depth[ci], parent_out=leaf_out[ci])
+        else:
+            bb = _batched_best(
+                cand_hists, leaf_sum_g[ci], leaf_sum_h[ci],
+                leaf_count[ci], nb_l, mb_l, params,
+                fm_l, cm_l, None, None,
+                jnp.full((2 * leaf_tile,), -jnp.inf, jnp.float32),
+                jnp.full((2 * leaf_tile,), jnp.inf, jnp.float32),
+                None, node_ids[ci], rng_key,
+                depth=leaf_depth[ci], parent_out=leaf_out[ci],
+                feature_contri=fc_l,
+            )
         bb = _merge_best(bb, axis_name, f0)
     scatter_pos = jnp.where(cand_ok, cand, 2 * L)
 
@@ -711,8 +757,8 @@ def _round_fused(
         jnp.arange(k_top, dtype=jnp.int32) < jnp.minimum(
             budget_next, leaf_tile),
         top_halves, 0))
-    if axis_name is not None:
-        whint = jax.lax.pmax(whint, axis_name)
+    if all_axes:
+        whint = jax.lax.pmax(whint, all_axes)
 
     state = WState(
         order=new_order, leaf_start=leaf_start, leaf_cnt=leaf_cnt,
@@ -731,11 +777,11 @@ def _round_fused(
               & jnp.isfinite(leaf_sum_h).all()
               & jnp.isfinite(leaf_out).all()
               & ~jnp.isnan(best.gain).any())
-    if axis_name is not None:
+    if all_axes:
         # replicated by construction (split stats come from the merged
         # histograms), but pmin pins rank consistency as an invariant —
         # the host's one-round-behind guard must never see ranks disagree
-        finite = jax.lax.pmin(finite.astype(jnp.int32), axis_name) > 0
+        finite = jax.lax.pmin(finite.astype(jnp.int32), all_axes) > 0
     info = jnp.stack([
         k_acc, total, ok.astype(jnp.int32), whint.astype(jnp.int32),
         finite.astype(jnp.int32),
@@ -747,7 +793,8 @@ def _round_fused(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "params", "leaf_tile",
                      "use_pallas", "quantize_bins", "hist_precision",
-                     "stochastic_rounding", "axis_name", "merge"),
+                     "stochastic_rounding", "axis_name", "merge",
+                     "dcn_axis_name", "dcn_top_k"),
 )
 def _w_init(
     bins_t, grad, hess, row_mask, sample_weight, num_bins_pf,
@@ -765,21 +812,27 @@ def _w_init(
     stochastic_rounding: bool,
     axis_name: Optional[str] = None,
     merge: str = "psum",
+    dcn_axis_name: Optional[str] = None,
+    dcn_top_k: int = 0,
 ):
     """Root state: quantize gradients, run the one full-N pass, seed best.
 
     Under ``axis_name`` (SPMD, see :func:`_round_fused`): rows are this
     rank's shard, quantization scales are pmaxed so every rank encodes
     int8 gradients on the same grid, and the root histogram is merged
-    with the same collective the rounds use."""
+    with the same collective the rounds use.  With ``dcn_axis_name`` the
+    histogram merge stays per-slice (axis_name only) and the root split
+    election goes through the same two-level top-k exchange the rounds
+    use; scalar totals and quant scales merge across BOTH axes."""
     f, n = bins_t.shape
     L = num_leaves
     grad = grad.astype(jnp.float32) * sample_weight
     hess = hess.astype(jnp.float32) * sample_weight
     grad_true, hess_true = grad, hess
+    all_axes = tuple(a for a in (axis_name, dcn_axis_name) if a is not None)
 
     def pmaxg(x):
-        return jax.lax.pmax(x, axis_name) if axis_name is not None else x
+        return jax.lax.pmax(x, all_axes) if all_axes else x
 
     gq = hq = quant_scale = None
     if quantize_bins:
@@ -826,8 +879,9 @@ def _w_init(
     # 3-scalar psum); the histogram itself merges with the round's
     # collective — psum (replicated) or psum_scatter (owned F/R slice)
     sum0 = jnp.sum(hist0[:, 0, :], axis=1)  # totals from feature 0: (3,)
+    if all_axes:
+        sum0 = jax.lax.psum(sum0, all_axes)
     if axis_name is not None:
-        sum0 = jax.lax.psum(sum0, axis_name)
         if merge == "scatter":
             hist0 = jax.lax.psum_scatter(
                 hist0, axis_name, scatter_dimension=1, tiled=True)
@@ -858,22 +912,30 @@ def _w_init(
     nb_l, mb_l, fm_l, cm_l, fc_l, f0_off = _split_tables(
         axis_name, merge, hist0.shape[1], num_bins_pf, missing_bin_pf,
         feature_mask, categorical_mask, feature_contri)
+    if dcn_axis_name is not None:
+        from ..parallel.hierarchy import dcn_topk_best
+
+        bb0 = dcn_topk_best(
+            hist0[None], jnp.asarray([g0]), jnp.asarray([h0]),
+            jnp.asarray([c0]), nb_l, mb_l, fm_l, cm_l, fc_l,
+            params=params, top_k=dcn_top_k, dcn_axis=dcn_axis_name,
+            depth=jnp.asarray([0.0], jnp.float32),
+            parent_out=jnp.asarray([leaf_out0]))
+    else:
+        bb0 = _batched_best(
+            hist0[None], jnp.asarray([g0]), jnp.asarray([h0]),
+            jnp.asarray([c0]), nb_l, mb_l, params,
+            fm_l, cm_l, None, None,
+            jnp.asarray([-jnp.inf], jnp.float32),
+            jnp.asarray([jnp.inf], jnp.float32),
+            None, jnp.asarray([0], jnp.int32), rng_key,
+            depth=jnp.asarray([0.0], jnp.float32),
+            parent_out=jnp.asarray([leaf_out0]),
+            feature_contri=fc_l,
+        )
     best0 = _set_best(
         _empty_best(L, num_bins), jnp.asarray(0),
-        jax.tree.map(
-            lambda a: a[0],
-            _merge_best(_batched_best(
-                hist0[None], jnp.asarray([g0]), jnp.asarray([h0]),
-                jnp.asarray([c0]), nb_l, mb_l, params,
-                fm_l, cm_l, None, None,
-                jnp.asarray([-jnp.inf], jnp.float32),
-                jnp.asarray([jnp.inf], jnp.float32),
-                None, jnp.asarray([0], jnp.int32), rng_key,
-                depth=jnp.asarray([0.0], jnp.float32),
-                parent_out=jnp.asarray([leaf_out0]),
-                feature_contri=fc_l,
-            ), axis_name, f0_off),
-        ),
+        jax.tree.map(lambda a: a[0], _merge_best(bb0, axis_name, f0_off)),
     )
     state = WState(
         order=jnp.arange(n, dtype=jnp.int32),
@@ -897,20 +959,22 @@ def _w_init(
 
 
 @functools.partial(jax.jit, static_argnames=("params", "quant_renew",
-                                             "axis_name"))
+                                             "axis_name", "dcn_axis_name"))
 def _w_finalize(state: WState, grad_true, hess_true, row_mask,
                 *, params: SplitParams, quant_renew: bool,
-                axis_name: Optional[str] = None):
+                axis_name: Optional[str] = None,
+                dcn_axis_name: Optional[str] = None):
     L = state.leaf_out.shape[0]
+    all_axes = tuple(a for a in (axis_name, dcn_axis_name) if a is not None)
     if quant_renew:
         mrow = row_mask.astype(jnp.float32)
         Gt = jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(
             grad_true * mrow)
         Ht = jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(
             hess_true * mrow)
-        if axis_name is not None:  # true-gradient renewal is a global sum
-            Gt = jax.lax.psum(Gt, axis_name)
-            Ht = jax.lax.psum(Ht, axis_name)
+        if all_axes:  # true-gradient renewal is a global sum
+            Gt = jax.lax.psum(Gt, all_axes)
+            Ht = jax.lax.psum(Ht, all_axes)
         leaf_value = leaf_output(Gt, Ht, params)
     else:
         leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)

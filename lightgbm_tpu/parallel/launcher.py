@@ -57,6 +57,11 @@ shard = np.load(os.environ["LGBM_TPU_SHARD"], allow_pickle=True)
 net = {k: shard[k].item() for k in ("num_machines", "machines",
                                     "local_listen_port", "time_out")}
 rank = os.environ["LIGHTGBM_TPU_RANK"]
+# multi-slice fleets (docs/ROBUSTNESS.md "Slice-granular recovery"): the
+# rendezvous rank is slice-LOCAL (each slice is its own collective
+# world) while the worker id is fleet-GLOBAL — model outputs, acks and
+# shard fingerprints key on the global id
+wid = os.environ.get("LGBM_TPU_WORKER_ID", rank)
 
 # per-rank metrics flight recorder (docs/OBSERVABILITY.md "Fleet
 # metrics"): atomic snapshot writes start BEFORE the rendezvous and
@@ -84,12 +89,23 @@ params.update(net)
 params["pre_partition"] = int(net["num_machines"]) > 1
 if int(net["num_machines"]) > 1:
     params.setdefault("tree_learner", "data")
-ds = lgb.Dataset(
-    shard["X"],
-    label=shard["y"],
-    weight=(shard["w"] if shard["w"].size > 0 else None),
-    group=(shard["g"] if "g" in shard and shard["g"].size > 0 else None),
-)
+_cache = os.environ.get("LGBM_TPU_CACHE")
+if _cache:
+    # rank-sharded cache feed (docs/DISTRIBUTED.md): this worker reads
+    # ONLY its row shard of one shared save_binary cache through
+    # BinCacheStream(shard=) — ingest scales with the fleet instead of
+    # every rank decompressing the full matrix
+    _lo, _hi, _pad = (int(t) for t in
+                      os.environ["LGBM_TPU_CACHE_SHARD"].split(","))
+    ds = lgb.Dataset(
+        _cache, params=dict(params, bin_cache_shard=(_lo, _hi, _pad)))
+else:
+    ds = lgb.Dataset(
+        shard["X"],
+        label=shard["y"],
+        weight=(shard["w"] if shard["w"].size > 0 else None),
+        group=(shard["g"] if "g" in shard and shard["g"].size > 0 else None),
+    )
 valid_sets, valid_names = [], []
 n_eval = int(shard["n_eval"].item()) if "n_eval" in shard else 0
 for i in range(n_eval):
@@ -131,9 +147,11 @@ _ckpt_freq = int(os.environ.get("LGBMTPU_FLEET_SNAPSHOT_FREQ", "0") or 0)
 if _ckpt_dir and _ckpt_freq > 0:
     from lightgbm_tpu.utils import checkpoint as _ckpt
 
-    _world = int(net["num_machines"])
+    _world = int(os.environ.get("LGBMTPU_FLEET_WORLD",
+                                str(net["num_machines"])))
     _keep = int(os.environ.get("LGBMTPU_FLEET_SNAPSHOT_KEEP", "0") or 0)
-    _rank_i = int(rank)
+    _rank_i = int(wid)  # manifest roles/acks key on the GLOBAL id
+    _slices = json.loads(os.environ.get("LGBMTPU_FLEET_SLICES", "{}")) or None
     _shards = {}
     _shards_json = os.environ.get("LGBMTPU_FLEET_SHARDS_JSON")
     if _shards_json and os.path.exists(_shards_json):
@@ -147,7 +165,8 @@ if _ckpt_dir and _ckpt_freq > 0:
         text = env.model.model_to_string(raw_deltas=True)
         if _rank_i == 0:
             _ckpt.write_fleet_checkpoint(_ckpt_dir, text, it, _world,
-                                         _shards, keep=_keep)
+                                         _shards, keep=_keep,
+                                         slices=_slices)
         else:
             _ckpt.confirm_fleet_checkpoint(_ckpt_dir, it, _rank_i, text)
     _fleet_ckpt_cb.order = 100
@@ -163,8 +182,8 @@ bst = lgb.train(params, ds, int(os.environ["LGBM_TPU_ROUNDS"]),
                 # trains only the remaining rounds
                 resume=os.environ.get("LGBMTPU_RESUME_MANIFEST"))
 out = os.environ["LGBM_TPU_MODEL_OUT"]
-bst.save_model(out + f".rank{rank}")
-if rank == "0":
+bst.save_model(out + f".rank{wid}")
+if wid == "0":
     meta = {"best_iteration": bst.best_iteration,
             "best_score": {d: dict(m) for d, m in bst.best_score.items()},
             "evals_result": {d: {k: list(map(float, v))
@@ -176,7 +195,7 @@ if _snap_path:
     # stop the writer and flush one exact final snapshot — a clean exit's
     # fleet entry must not be a period stale
     _obs_metrics.stop_periodic_snapshots()
-print("LAUNCHER_RANK_OK", rank, flush=True)
+print("LAUNCHER_RANK_OK", wid, flush=True)
 """
 
 
@@ -190,14 +209,19 @@ class WorkerFailure(RuntimeError):
     """A launcher worker died (non-zero exit), HUNG (heartbeat went stale
     past the timeout), or the launch timed out.  Carries the failing rank
     (or None for timeouts) so retry logic and tests can tell the cases
-    apart."""
+    apart.  ``slice_id`` is set when the failure was handled
+    slice-granularly (docs/ROBUSTNESS.md "Slice-granular recovery"):
+    only that slice's process group was killed, the survivors are STILL
+    RUNNING, and the caller owns respawning the slice."""
 
     def __init__(self, msg: str, rank: Optional[int] = None,
-                 timed_out: bool = False, hung: bool = False):
+                 timed_out: bool = False, hung: bool = False,
+                 slice_id: Optional[int] = None):
         super().__init__(msg)
         self.rank = rank
         self.timed_out = timed_out
         self.hung = hung
+        self.slice_id = slice_id
 
 
 def _kill_worker_group(proc: subprocess.Popen) -> None:
@@ -270,7 +294,10 @@ def _watch_workers(workers, timeout_s: float,
                    heartbeat_timeout_s: Optional[float] = None,
                    heartbeat_paths: Optional[Dict[int, str]] = None,
                    slow_rank_factor: float = 0.0,
-                   hb_ages: Optional[Dict[int, float]] = None) -> None:
+                   hb_ages: Optional[Dict[int, float]] = None,
+                   slice_of: Optional[Dict[int, int]] = None,
+                   slice_granular: bool = False,
+                   done: Optional[set] = None) -> None:
     """Per-worker liveness watchdog: poll + exit-code harvest, plus
     HEARTBEAT staleness (docs/ROBUSTNESS.md "Elastic fleet recovery").
 
@@ -299,15 +326,40 @@ def _watch_workers(workers, timeout_s: float,
     exceeds factor x the fleet median (and a 1 s floor) emits one
     ``fleet_slow_rank`` event + ``fleet_slow_ranks_total`` bump per slow
     episode — the class where a rank still makes rounds but k x slower
-    than its peers, which the full-stall watchdog can never see.
-    ``hb_ages``, when given, is kept updated with each rank's current
-    heartbeat age — the launcher's live /metrics collector reads it for
-    the per-rank ``fleet_heartbeat_age_s`` labeled gauge.
+    than its peers, which the full-stall watchdog can never see.  With
+    ``slice_of`` the median is computed WITHIN each rank's slice, not
+    fleet-wide: slices make rounds at different cadences (DCN phase
+    skew, per-slice data skew), so one slow SLICE would otherwise drag
+    the fleet median up and mask a genuine straggler rank inside
+    another slice.  ``hb_ages``, when given, is kept updated with each
+    rank's current heartbeat age — the launcher's live /metrics
+    collector reads it for the per-rank ``fleet_heartbeat_age_s``
+    labeled gauge.
 
     On failure or timeout the WHOLE process group of every worker is
-    killed and every tail is harvested (docs/ROBUSTNESS.md)."""
+    killed and every tail is harvested (docs/ROBUSTNESS.md) — UNLESS
+    ``slice_granular`` is set and the failure is attributable to one
+    rank's slice: then only THAT slice's process groups are killed, the
+    raised :class:`WorkerFailure` carries ``slice_id``, and the
+    surviving slices keep running for the caller to rejoin a
+    replacement slice against (docs/ROBUSTNESS.md "Slice-granular
+    recovery")."""
     deadline = time.monotonic() + timeout_s
-    done = set()
+    # `done` may be threaded across calls (the slice-respawn loop
+    # re-enters this watch): a rank that already exited 0 must not
+    # re-emit its worker_exit event into the fleet flight recorder
+    done = set() if done is None else done
+
+    def _scoped_failure(rank, msg, hung=False):
+        """Kill the blast radius and build the failure: the failing
+        rank's slice alone under slice-granular handling (survivors keep
+        running), the cleanup handler's whole-fleet kill otherwise."""
+        sid = (slice_of.get(rank) if slice_granular and slice_of else None)
+        if sid is not None:
+            for r2, p2, _ in workers:
+                if slice_of.get(r2) == sid and p2.poll() is None:
+                    _kill_worker_group(p2)
+        return WorkerFailure(msg, rank=rank, hung=hung, slice_id=sid)
     # rank -> (value, t_change, changed_once): staleness is armed only
     # after the heartbeat has been seen to CHANGE (see below)
     hb_seen: Dict[int, Tuple[float, float, bool]] = {}
@@ -330,11 +382,11 @@ def _watch_workers(workers, timeout_s: float,
                 _obs.counter("launcher_worker_deaths_total").inc()
                 _obs.event("worker_death", worker_rank=rank, exit_code=rc,
                            log=log_path)
-                raise WorkerFailure(
+                raise _scoped_failure(
+                    rank,
                     f"launcher worker rank {rank} died with exit code {rc}; "
-                    f"remaining workers killed. Tail of rank {rank}'s log "
-                    f"({log_path}):\n{_log_tail(log_path)}",
-                    rank=rank)
+                    f"its failure scope killed. Tail of rank {rank}'s log "
+                    f"({log_path}):\n{_log_tail(log_path)}")
             now = time.monotonic()
             if watch_hb and now >= hb_next:
                 # re-read the small per-rank JSONs at most ~1 Hz (and at
@@ -381,21 +433,36 @@ def _watch_workers(workers, timeout_s: float,
                     hb_ages.update(ages)
                 if slow_rank_factor and len(ages) >= 2:
                     # straggler detection on the SAME reads: slow = this
-                    # rank's heartbeat age is factor x the fleet median
-                    # (and past the absolute floor — an idle fleet's
-                    # read-phase jitter must not trip it).  Emitted once
-                    # per episode; the rank clears when it catches up.
-                    # LOWER-middle median: the upper pick would let one
-                    # straggler inflate its own threshold — on a 2-rank
-                    # fleet a 60x-slow rank would BE the "median" and
-                    # never trip.  Floor sized over the snapshot-write
-                    # period + the 1 Hz read cadence: a healthy rank
-                    # whose write phase lands just after our read shows
-                    # age ~(period + read tick) without being slow.
-                    med = sorted(ages.values())[(len(ages) - 1) // 2]
+                    # rank's heartbeat age is factor x the median of its
+                    # COMPARISON GROUP (and past the absolute floor — an
+                    # idle fleet's read-phase jitter must not trip it).
+                    # The group is the rank's SLICE when slice_of is
+                    # given — slices make rounds at different cadences,
+                    # so a slow slice would inflate a fleet-wide median
+                    # and mask a straggler inside a healthy slice —
+                    # else the whole fleet.  Emitted once per episode;
+                    # the rank clears when it catches up.  LOWER-middle
+                    # median: the upper pick would let one straggler
+                    # inflate its own threshold — in a 2-rank group a
+                    # 60x-slow rank would BE the "median" and never
+                    # trip.  Floor sized over the snapshot-write period
+                    # + the 1 Hz read cadence: a healthy rank whose
+                    # write phase lands just after our read shows age
+                    # ~(period + read tick) without being slow.
+                    groups: Dict[Optional[int], list] = {}
+                    for rank, age in ages.items():
+                        gid = slice_of.get(rank) if slice_of else None
+                        groups.setdefault(gid, []).append(age)
+                    med_of = {
+                        gid: sorted(v)[(len(v) - 1) // 2]
+                        for gid, v in groups.items()}
                     slow_floor = max(_SLOW_RANK_FLOOR_S,
                                      2.0 * _snapshot_period() + 1.0)
                     for rank, age in ages.items():
+                        gid = slice_of.get(rank) if slice_of else None
+                        if len(groups[gid]) < 2:
+                            continue  # a lone rank has no peer cadence
+                        med = med_of[gid]
                         slow = age > max(slow_rank_factor * med, slow_floor)
                         if slow and rank not in slow_active:
                             slow_active.add(rank)
@@ -404,7 +471,8 @@ def _watch_workers(workers, timeout_s: float,
                                 "fleet_slow_rank", worker_rank=rank,
                                 age_s=round(age, 3),
                                 fleet_median_s=round(med, 3),
-                                factor=slow_rank_factor)
+                                factor=slow_rank_factor,
+                                slice=gid)
                         elif not slow:
                             slow_active.discard(rank)
                 if stalest is not None:
@@ -415,13 +483,14 @@ def _watch_workers(workers, timeout_s: float,
                                heartbeat_timeout_s=heartbeat_timeout_s,
                                log=log_path)
                     _kill_worker_group(proc)
-                    raise WorkerFailure(
+                    raise _scoped_failure(
+                        rank,
                         f"launcher worker rank {rank} HUNG: heartbeat "
                         f"unchanged for {stale:.1f}s "
                         f"(> {heartbeat_timeout_s:g}s); process group "
                         f"killed. Tail of rank {rank}'s log "
                         f"({log_path}):\n{_log_tail(log_path)}",
-                        rank=rank, hung=True)
+                        hung=True)
             if time.monotonic() > deadline:
                 _obs.counter("launcher_timeouts_total").inc()
                 _obs.event("launch_timeout", timeout_s=timeout_s)
@@ -433,17 +502,22 @@ def _watch_workers(workers, timeout_s: float,
                     f"process groups killed. Worker log tails:\n{tails}",
                     timed_out=True)
             time.sleep(poll_interval)
-    except BaseException:
+    except BaseException as e:
         # single cleanup path for death, timeout, and anything else:
-        # no code path may leak live workers
-        for _, p2, _ in workers:
-            if p2.poll() is None:
-                _kill_worker_group(p2)
+        # no code path may leak live workers — EXCEPT a slice-scoped
+        # failure, whose whole point is that the surviving slices stay
+        # up for the replacement slice to rejoin (the slice's own
+        # process groups were already killed at the raise site)
+        if not (isinstance(e, WorkerFailure) and e.slice_id is not None):
+            for _, p2, _ in workers:
+                if p2.poll() is None:
+                    _kill_worker_group(p2)
         raise
 
 
 def _fleet_live_collector(tmp: str, num_machines: int,
-                          hb_ages: Dict[int, float]):
+                          hb_ages: Dict[int, float],
+                          slice_of: Optional[Dict[int, int]] = None):
     """Snapshot-time collector serving the LIVE fleet view from the
     launcher's own /metrics endpoint (docs/OBSERVABILITY.md "Fleet
     metrics"): every per-rank periodic snapshot file is merged in with
@@ -476,8 +550,14 @@ def _fleet_live_collector(tmp: str, num_machines: int,
                 except (TypeError, ValueError):
                     pass
         for r, age in list(hb_ages.items()):
-            out["gauges"][_obs.labeled("fleet_heartbeat_age_s", rank=r)] = (
-                float(age))
+            labels = {"rank": r}
+            if slice_of is not None and r in slice_of:
+                # per-slice heartbeat labels (docs/OBSERVABILITY.md):
+                # dashboards aggregate cadence per slice, the unit the
+                # slow-rank detector medians over and recovery respawns
+                labels["slice"] = slice_of[r]
+            out["gauges"][_obs.labeled("fleet_heartbeat_age_s",
+                                       **labels)] = float(age)
         return out
 
     return collect
@@ -619,6 +699,8 @@ def train_distributed(
     max_restarts: int = 0,
     restart_backoff_s: float = 1.0,
     heartbeat_timeout_s: Optional[float] = None,
+    num_slices: Optional[int] = None,
+    data_cache: Optional[str] = None,
 ):
     """Shard rows over `num_machines` local worker processes, train with
     tree_learner=data under pre_partition, and return (rank 0's Booster,
@@ -641,15 +723,60 @@ def train_distributed(
     newest fleet-VALID round instead of round 0 — bitwise-identical to an
     uninterrupted run (docs/ROBUSTNESS.md "Elastic fleet recovery");
     without a valid manifest the relaunch falls back to a from-scratch
-    restart, the round-8 behavior."""
+    restart, the round-8 behavior.
+
+    ``num_slices`` > 1 (param or config) groups the ranks into slice
+    worlds of num_machines/num_slices members each — the loopback
+    control-plane form of multi-slice scale-out (docs/ROBUSTNESS.md
+    "Slice-granular recovery"; the in-dispatch two-level DCN merge
+    itself is parallel/hierarchy.py over a nested mesh).  Each slice is
+    its own rendezvous world training the shared shard plan; the fleet
+    manifests carry slice membership, the slow-rank detector compares
+    heartbeats WITHIN a slice, and a rank failure kills + respawns ONLY
+    its slice: the replacement resumes from the newest SLICE-valid
+    manifest round (every surviving rank's ack present — the lost
+    slice's own acks are not required) while the surviving slices never
+    stop or restart."""
     import lightgbm_tpu as lgb
 
-    n = X.shape[0]
+    cfg_launch = Config.from_dict(params)
+    if num_slices is None:
+        num_slices = max(int(cfg_launch.num_slices), 1)
+    num_slices = max(int(num_slices), 1)
+    ranks_per_slice = num_machines
+    slice_of: Optional[Dict[int, int]] = None
+    if num_slices > 1:
+        if num_machines % num_slices:
+            raise ValueError(
+                f"num_machines={num_machines} does not divide into "
+                f"num_slices={num_slices}")
+        ranks_per_slice = num_machines // num_slices
+        slice_of = {r: r // ranks_per_slice for r in range(num_machines)}
+
+    if data_cache is not None:
+        # rank-sharded cache feed (docs/DISTRIBUTED.md): rows come from
+        # one shared save_binary cache; each worker streams ONLY its
+        # shard via BinCacheStream(shard=) — the launcher never touches
+        # the matrix, and ingest scales with the fleet
+        from ..io.stream import BinCacheStream
+
+        if X is not None or y is not None:
+            raise ValueError("pass data_cache= XOR (X, y), not both")
+        if weight is not None or group is not None or eval_set:
+            raise ValueError(
+                "data_cache= carries label/weight inside the cache; "
+                "explicit weight/group/eval_set are not supported with "
+                "the cache feed")
+        n = BinCacheStream(data_cache).n_rows  # header read only
+    else:
+        n = X.shape[0]
     if group is not None:
         group = np.asarray(group, np.int64)
         if weight is None:
             weight = np.ones(n, np.float64)
-    shard_slices, shard_groups, per = _shard_plan(n, num_machines, group)
+    # in slice mode the shard plan covers ONE slice's ranks; every slice
+    # trains the same plan (global rank r holds shard r % ranks_per_slice)
+    shard_slices, shard_groups, per = _shard_plan(n, ranks_per_slice, group)
 
     for arg_name, arg in (("eval_names", eval_names),
                           ("eval_weight", eval_weight),
@@ -668,7 +795,7 @@ def train_distributed(
               if eval_weight is not None and eval_weight[i] is not None
               else None)
         ne = np.shape(Xe)[0]  # metadata only — no conversion (jaxlint R14)
-        sl, gr, pe = _shard_plan(ne, num_machines, ge)
+        sl, gr, pe = _shard_plan(ne, ranks_per_slice, ge)
         name = (eval_names[i] if eval_names is not None
                 else f"valid_{i}")
         eval_plans.append((np.asarray(Xe), np.asarray(ye).ravel(), we,
@@ -682,7 +809,6 @@ def train_distributed(
     # workers' engine.train sees have snapshot_freq stripped — every rank
     # writing its own local snapshot family would race on shared paths
     # and vouch for nothing fleet-wide
-    cfg_launch = Config.from_dict(params)
     fleet_freq = max(int(cfg_launch.snapshot_freq), 0)
     fleet_keep = max(int(cfg_launch.snapshot_keep), 0)
     params = {k: v for k, v in dict(params).items()
@@ -702,7 +828,8 @@ def train_distributed(
     # persist), so a post-mortem scrape still sees the last fleet state.
     hb_ages: Dict[int, float] = {}
     _obs.register_collector(
-        "fleet_live", _fleet_live_collector(tmp, num_machines, hb_ages))
+        "fleet_live",
+        _fleet_live_collector(tmp, num_machines, hb_ages, slice_of))
     from ..obs import server as _obs_server
 
     _obs_server.maybe_start(
@@ -728,10 +855,11 @@ def train_distributed(
         # fresh ports per attempt: the previous fleet's listen sockets may
         # sit in TIME_WAIT, and the machines list is baked into the shards
         ports = _free_ports(num_machines)
-        machines = ",".join(f"127.0.0.1:{p}" for p in ports)
         workers = []  # (rank, Popen, log_path)
         try:
-            _spawn_all(workers, ports, machines)
+            _write_shards(ports)
+            for rank in range(num_machines):
+                _spawn_rank(workers, rank, ports)
         except BaseException:
             # a failure while SPAWNING (disk full, fork failure on a later
             # rank) must not leak the ranks already started — the watchdog
@@ -740,33 +868,106 @@ def train_distributed(
                 if p.poll() is None:
                     _kill_worker_group(p)
             raise
-        _watch_workers(
-            workers, timeout_s,
-            heartbeat_timeout_s=heartbeat_timeout_s or None,
-            heartbeat_paths={
-                r: os.path.join(tmp, f"worker{r}.metrics.json")
-                for r in range(num_machines)},
-            slow_rank_factor=slow_rank_factor,
-            hb_ages=hb_ages)
+        slice_restarts = 0
+        done: set = set()  # threaded across re-watches (no re-emitted exits)
+        while True:
+            try:
+                _watch_workers(
+                    workers, timeout_s,
+                    heartbeat_timeout_s=heartbeat_timeout_s or None,
+                    heartbeat_paths={
+                        r: os.path.join(tmp, f"worker{r}.metrics.json")
+                        for r in range(num_machines)},
+                    slow_rank_factor=slow_rank_factor,
+                    hb_ages=hb_ages, slice_of=slice_of,
+                    slice_granular=num_slices > 1, done=done)
+                return
+            except WorkerFailure as e:
+                if e.slice_id is None or slice_restarts >= max_restarts:
+                    # not slice-scoped, or the budget is spent: kill any
+                    # survivors and hand the failure to the fleet-level
+                    # restart path
+                    for _, p, _ in workers:
+                        if p.poll() is None:
+                            _kill_worker_group(p)
+                    raise
+                slice_restarts += 1
+                _respawn_slice(workers, e.slice_id, ports, slice_restarts,
+                               done)
 
-    def _spawn_all(workers, ports, machines) -> None:
+    def _respawn_slice(workers, sid: int, ports, attempt: int,
+                       done: set) -> None:
+        # slice-granular recovery (docs/ROBUSTNESS.md): ONLY the failed
+        # slice restarts — from the newest SLICE-valid manifest round
+        # (every surviving rank's ack present; the lost slice's own acks
+        # cannot be required, its members are dead) — while the
+        # surviving slices keep training untouched.  A slice member that
+        # already EXITED 0 is not lost: its model file and acks are
+        # complete, and respawning it would run an unwatched duplicate.
+        lost = tuple(r for r in range(num_machines)
+                     if slice_of[r] == sid and r not in done)
+        resume_manifest = None
+        resumed_round = None
+        if fleet_freq > 0:
+            fm = _checkpoint.latest_slice_valid_fleet_manifest(
+                tmp, num_machines, lost)
+            if fm is not None:
+                resumed_round, resume_manifest, _ = fm
+        _obs.counter("fleet_slice_resumes_total").inc()
+        _obs.event("fleet_slice_resume", slice=sid, ranks=list(lost),
+                   round=resumed_round, attempt=attempt)
+        log_warning(
+            f"slice {sid} (ranks {list(lost)}) failed; respawning it "
+            + (f"from slice-valid manifest round {resumed_round}"
+               if resumed_round is not None else "from scratch")
+            + f" — surviving slices keep running (attempt {attempt})")
+        excl = ",".join(str(r) for r in lost)
+        for rank in lost:
+            _spawn_rank(workers, rank, ports,
+                        resume_manifest=resume_manifest,
+                        exclude_ranks=excl)
+
+    def _write_shards(ports) -> None:
         # phase 1 — write EVERY rank's shard file and publish the full
         # fingerprint table BEFORE any worker starts: rank 0 (spawned
         # first) reads fleet_shards.json once at startup, so writing it
         # while spawning the last rank would race — a manifest with no
-        # fingerprints silently disables the changed-data resume guard
+        # fingerprints silently disables the changed-data resume guard.
+        # In slice mode each slice is its own rendezvous world: global
+        # rank r holds local shard r % ranks_per_slice and talks only to
+        # its slice's machine list.
         for rank in range(num_machines):
-            Xs, ys, ws, gs = _rank_arrays(shard_slices, shard_groups, per,
-                                          rank, X, y, weight)
+            local = rank % ranks_per_slice
+            sid = rank // ranks_per_slice
+            slice_ports = ports[sid * ranks_per_slice:
+                                (sid + 1) * ranks_per_slice]
+            machines = ",".join(f"127.0.0.1:{p}" for p in slice_ports)
             shard_arrays = dict(
-                X=Xs, y=ys, w=ws,
-                g=(gs if gs is not None else np.asarray(())),
-                num_machines=num_machines, machines=machines,
+                num_machines=ranks_per_slice, machines=machines,
                 local_listen_port=ports[rank], time_out=2,
                 n_eval=len(eval_plans),
             )
+            if data_cache is not None:
+                # the cache feed ships NO arrays: the worker streams its
+                # shard straight out of the shared cache, and the
+                # fingerprint derives from the cache's CRC trailer table
+                if str(rank) not in shard_fps:
+                    from ..io.stream import cache_shard_fingerprint
+
+                    lo, hi = shard_slices[local]
+                    shard_fps[str(rank)] = cache_shard_fingerprint(
+                        data_cache, lo, hi)
+                np.savez(os.path.join(tmp, f"shard{rank}.npz"),
+                         **shard_arrays)
+                continue
+            Xs, ys, ws, gs = _rank_arrays(shard_slices, shard_groups, per,
+                                          local, X, y, weight)
+            shard_arrays.update(
+                X=Xs, y=ys, w=ws,
+                g=(gs if gs is not None else np.asarray(())),
+            )
             for i, (Xe, ye, we, sl, gr, pe, name) in enumerate(eval_plans):
-                Xv, yv, wv, gv = _rank_arrays(sl, gr, pe, rank, Xe, ye, we)
+                Xv, yv, wv, gv = _rank_arrays(sl, gr, pe, local, Xe, ye, we)
                 shard_arrays[f"ev{i}_X"] = Xv
                 shard_arrays[f"ev{i}_y"] = yv
                 shard_arrays[f"ev{i}_w"] = wv
@@ -787,69 +988,95 @@ def train_distributed(
         if not os.path.exists(shards_json):
             with open(shards_json, "w", encoding="utf-8") as fh:
                 json.dump(shard_fps, fh)
-        # phase 2 — spawn
-        for rank in range(num_machines):
-            shard_path = os.path.join(tmp, f"shard{rank}.npz")
-            env = dict(os.environ)
-            env.update(env_extra or {})
-            env["LIGHTGBM_TPU_RANK"] = str(rank)
-            env["LGBM_TPU_REPO"] = repo
-            env["LGBM_TPU_SHARD"] = shard_path
-            env["LGBM_TPU_PARAMS"] = params_path
-            env["LGBM_TPU_ROUNDS"] = str(num_boost_round)
-            env["LGBM_TPU_MODEL_OUT"] = model_out
-            env["LGBM_TPU_ES_ROUNDS"] = str(early_stopping_rounds or 0)
-            env.pop("PYTEST_CURRENT_TEST", None)
-            # per-rank structured event sink (docs/OBSERVABILITY.md): each
-            # worker's obs layer appends rank-stamped JSONL records here;
-            # the launcher merges them into one fleet-level file afterwards
-            env["LGBMTPU_EVENTS_FILE"] = os.path.join(
-                tmp, f"worker{rank}.events.jsonl")
-            # per-rank metrics flight recorder: the worker body writes
-            # atomic snapshots here periodically (and one exact final
-            # write on clean exit); aggregate_fleet_metrics merges them
-            # into fleet_metrics.json on every exit path — and the hang
-            # watchdog reads each rank's heartbeat_ts gauge out of the
-            # same file (no extra channel)
-            env["LGBMTPU_METRICS_SNAPSHOT_FILE"] = os.path.join(
-                tmp, f"worker{rank}.metrics.json")
-            # coordinated fleet checkpoints + resume-to-round relaunch
-            # (docs/ROBUSTNESS.md "Elastic fleet recovery")
-            if fleet_freq > 0:
-                env["LGBMTPU_FLEET_CKPT_DIR"] = tmp
-                env["LGBMTPU_FLEET_SNAPSHOT_FREQ"] = str(fleet_freq)
-                env["LGBMTPU_FLEET_SNAPSHOT_KEEP"] = str(fleet_keep)
-                env["LGBMTPU_FLEET_SHARDS_JSON"] = shards_json
-            env["LGBMTPU_SHARD_FINGERPRINT"] = shard_fps[str(rank)]
-            if relaunch["resume_manifest"]:
-                env["LGBMTPU_RESUME_MANIFEST"] = relaunch["resume_manifest"]
-            if env.get("LGBMTPU_FAULT"):
-                # make injected faults once-only ACROSS restarts, so a
-                # relaunched fleet runs clean (utils/faults.py)
-                env.setdefault("LGBMTPU_FAULT_ONCE_DIR", tmp)
-            # a RELAUNCH must not inherit the previous attempt's metrics
-            # snapshot: the old file's static heartbeat_ts would read as a
-            # live-but-stalled heartbeat while the new worker is still
-            # importing, and the hang watchdog would kill it before its
-            # first write
-            try:
-                os.unlink(env["LGBMTPU_METRICS_SNAPSHOT_FILE"])
-            except OSError:
-                pass
-            # log file instead of a PIPE: a chatty worker cannot deadlock
-            # on a full pipe buffer, and the watchdog can harvest tails
-            # after the process is gone
-            log_path = os.path.join(tmp, f"worker{rank}.log")
-            with open(log_path, "wb") as log_fh:
-                workers.append((rank, subprocess.Popen(
-                    [sys.executable, "-c", _WORKER_SRC], env=env,
-                    stdout=log_fh, stderr=subprocess.STDOUT,
-                    start_new_session=True,  # own process group: killable
-                    # as a unit, no zombies past a timeout
-                ), log_path))
-            _obs.counter("launcher_worker_spawns_total").inc()
-            _obs.event("worker_spawn", worker_rank=rank,
-                       pid=workers[-1][1].pid)
+
+    def _spawn_rank(workers, rank: int, ports,
+                    resume_manifest: Optional[str] = None,
+                    exclude_ranks: str = "") -> None:
+        shard_path = os.path.join(tmp, f"shard{rank}.npz")
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        # the rendezvous rank is slice-local; the worker id is global
+        env["LIGHTGBM_TPU_RANK"] = str(rank % ranks_per_slice)
+        env["LGBM_TPU_WORKER_ID"] = str(rank)
+        env["LGBM_TPU_REPO"] = repo
+        env["LGBM_TPU_SHARD"] = shard_path
+        env["LGBM_TPU_PARAMS"] = params_path
+        env["LGBM_TPU_ROUNDS"] = str(num_boost_round)
+        env["LGBM_TPU_MODEL_OUT"] = model_out
+        env["LGBM_TPU_ES_ROUNDS"] = str(early_stopping_rounds or 0)
+        if data_cache is not None:
+            lo, hi = shard_slices[rank % ranks_per_slice]
+            env["LGBM_TPU_CACHE"] = os.fspath(data_cache)
+            env["LGBM_TPU_CACHE_SHARD"] = f"{lo},{hi},{per}"
+        env.pop("PYTEST_CURRENT_TEST", None)
+        # per-rank structured event sink (docs/OBSERVABILITY.md): each
+        # worker's obs layer appends rank-stamped JSONL records here;
+        # the launcher merges them into one fleet-level file afterwards
+        env["LGBMTPU_EVENTS_FILE"] = os.path.join(
+            tmp, f"worker{rank}.events.jsonl")
+        # per-rank metrics flight recorder: the worker body writes
+        # atomic snapshots here periodically (and one exact final
+        # write on clean exit); aggregate_fleet_metrics merges them
+        # into fleet_metrics.json on every exit path — and the hang
+        # watchdog reads each rank's heartbeat_ts gauge out of the
+        # same file (no extra channel)
+        env["LGBMTPU_METRICS_SNAPSHOT_FILE"] = os.path.join(
+            tmp, f"worker{rank}.metrics.json")
+        # coordinated fleet checkpoints + resume-to-round relaunch
+        # (docs/ROBUSTNESS.md "Elastic fleet recovery")
+        if fleet_freq > 0:
+            env["LGBMTPU_FLEET_CKPT_DIR"] = tmp
+            env["LGBMTPU_FLEET_SNAPSHOT_FREQ"] = str(fleet_freq)
+            env["LGBMTPU_FLEET_SNAPSHOT_KEEP"] = str(fleet_keep)
+            env["LGBMTPU_FLEET_SHARDS_JSON"] = shards_json
+        if num_slices > 1:
+            env["LGBMTPU_FLEET_WORLD"] = str(num_machines)
+            env["LGBMTPU_FLEET_SLICES"] = json.dumps(
+                {str(r): s for r, s in slice_of.items()})
+        env["LGBMTPU_SHARD_FINGERPRINT"] = shard_fps[str(rank)]
+        if resume_manifest is None and relaunch["resume_manifest"]:
+            resume_manifest = relaunch["resume_manifest"]
+        if resume_manifest:
+            env["LGBMTPU_RESUME_MANIFEST"] = resume_manifest
+        if exclude_ranks:
+            # slice respawn: the manifest is SLICE-valid (the lost
+            # slice's acks are missing by definition); engine.train
+            # validates with the lost ranks excluded
+            env["LGBMTPU_RESUME_EXCLUDE_RANKS"] = exclude_ranks
+        if env.get("LGBMTPU_FAULT"):
+            # make injected faults once-only ACROSS restarts, so a
+            # relaunched fleet runs clean (utils/faults.py)
+            env.setdefault("LGBMTPU_FAULT_ONCE_DIR", tmp)
+        # a RELAUNCH must not inherit the previous attempt's metrics
+        # snapshot: the old file's static heartbeat_ts would read as a
+        # live-but-stalled heartbeat while the new worker is still
+        # importing, and the hang watchdog would kill it before its
+        # first write
+        try:
+            os.unlink(env["LGBMTPU_METRICS_SNAPSHOT_FILE"])
+        except OSError:
+            pass
+        # log file instead of a PIPE: a chatty worker cannot deadlock
+        # on a full pipe buffer, and the watchdog can harvest tails
+        # after the process is gone
+        log_path = os.path.join(tmp, f"worker{rank}.log")
+        with open(log_path, "wb") as log_fh:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", _WORKER_SRC], env=env,
+                stdout=log_fh, stderr=subprocess.STDOUT,
+                start_new_session=True,  # own process group: killable
+                # as a unit, no zombies past a timeout
+            )
+        # a respawned rank replaces its dead entry (the watch loop keys
+        # liveness off this list)
+        for i, (r, _p, _lp) in enumerate(workers):
+            if r == rank:
+                workers[i] = (rank, proc, log_path)
+                break
+        else:
+            workers.append((rank, proc, log_path))
+        _obs.counter("launcher_worker_spawns_total").inc()
+        _obs.event("worker_spawn", worker_rank=rank, pid=proc.pid)
 
     attempt = 0
     run_started = time.time()  # scopes the event ring to this run's fleet

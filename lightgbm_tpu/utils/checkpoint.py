@@ -309,13 +309,18 @@ def ensemble_digest(model_text: str) -> str:
 def write_fleet_checkpoint(d: str, model_text: str, round_i: int,
                            world_size: int,
                            shard_fingerprints: Optional[Dict[str, str]] = None,
-                           keep: int = 0) -> str:
+                           keep: int = 0,
+                           slices: Optional[Dict[str, int]] = None) -> str:
     """Rank 0's half of the protocol: durable snapshot FIRST, manifest
     publish SECOND (the ordering is the whole point — a manifest may never
     refer to a snapshot that might not exist).  ``shard_fingerprints``
     maps rank -> data-shard sha256 so a resumed rank can refuse to
     continue on changed data.  ``keep`` > 0 prunes old fleet rounds after
-    a successful publish (never the newest valid one).  Returns the
+    a successful publish (never the newest valid one).  ``slices`` maps
+    rank -> slice id for multi-slice fleets (docs/ROBUSTNESS.md
+    "Slice-granular recovery"): it lets :func:`
+    latest_slice_valid_fleet_manifest` answer which rounds a REPLACEMENT
+    slice can rejoin at without the lost slice's own acks.  Returns the
     manifest path."""
     snap = fleet_snapshot_path(d, round_i)
     save_snapshot(snap, model_text, round_i)
@@ -333,6 +338,9 @@ def write_fleet_checkpoint(d: str, model_text: str, round_i: int,
                    for r, fp in (shard_fingerprints or {}).items()},
         "ts": time.time(),
     }
+    if slices:
+        manifest["slices"] = {str(r): int(s) for r, s in slices.items()}
+        manifest["num_slices"] = len(set(manifest["slices"].values()))
     atomic_write_text(fleet_manifest_path(d, round_i),
                       json.dumps(manifest, indent=1) + "\n")
     from ..obs import metrics as _obs
@@ -360,7 +368,8 @@ def confirm_fleet_checkpoint(d: str, round_i: int, rank: int,
 
 
 def fleet_manifest_valid(manifest_path: str,
-                         world_size: Optional[int] = None
+                         world_size: Optional[int] = None,
+                         exclude_ranks: Tuple[int, ...] = ()
                          ) -> Optional[Dict]:
     """The fleet-validity check.  Returns the manifest dict (with
     ``snapshot`` resolved to an absolute path) when EVERY leg holds:
@@ -372,6 +381,13 @@ def fleet_manifest_valid(manifest_path: str,
     * the snapshot exists and its sha256 trailer verifies;
     * the snapshot payload hashes to the manifest's ``ensemble_sha256``;
     * every rank 1..W-1 has an ack, and every sha-carrying ack matches.
+
+    ``exclude_ranks`` drops the ack requirement for the named ranks —
+    the slice-granular recovery form (docs/ROBUSTNESS.md): a LOST
+    slice's members cannot ack any more, and the round the replacement
+    slice rejoins at needs only the SURVIVING ranks' confirmation.  An
+    excluded rank's ack, when present, must still MATCH (a diverged ack
+    proves inconsistent state whoever wrote it).
 
     Anything else returns None — an unconfirmed or torn round is never
     resumed into."""
@@ -398,12 +414,15 @@ def fleet_manifest_valid(manifest_path: str,
     payload, ok = read_and_verify(snap)
     if ok is not True or _digest(payload) != want_sha:
         return None
+    excluded = {int(r) for r in exclude_ranks}
     for r in range(1, w):
         try:
             with open(fleet_ack_path(d, round_i, r),
                       encoding="utf-8") as fh:
                 ack_sha = fh.read().strip()
         except OSError:
+            if r in excluded:
+                continue  # a lost slice's member cannot ack any more
             return None  # unconfirmed rank: not fleet-valid
         if ack_sha and ack_sha != want_sha:
             return None  # rank diverged from rank 0's ensemble
@@ -431,6 +450,35 @@ def latest_valid_fleet_manifest(d: str,
     for round_i in sorted(rounds, reverse=True):
         path = fleet_manifest_path(d, round_i)
         manifest = fleet_manifest_valid(path, world_size)
+        if manifest is not None:
+            return round_i, path, manifest
+    return None
+
+
+def latest_slice_valid_fleet_manifest(
+        d: str, world_size: Optional[int], lost_ranks: Tuple[int, ...]
+) -> Optional[Tuple[int, str, Dict]]:
+    """Newest SLICE-valid round in directory ``d`` for a replacement of
+    the ranks in ``lost_ranks`` (docs/ROBUSTNESS.md "Slice-granular
+    recovery"): the manifest must parse, its snapshot verify, and every
+    SURVIVING rank's ack be present and matching — the lost slice's own
+    acks are not required (its members died, possibly before acking the
+    newest round the survivors confirmed).  Returns
+    ``(round, manifest_path, manifest)`` or None."""
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        return None
+    rounds = []
+    for name in entries:
+        m = _FLEET_MANIFEST_RE.match(name)
+        if m is not None:
+            rounds.append(int(m.group("it")))
+    lost = tuple(int(r) for r in lost_ranks)
+    for round_i in sorted(rounds, reverse=True):
+        path = fleet_manifest_path(d, round_i)
+        manifest = fleet_manifest_valid(path, world_size,
+                                        exclude_ranks=lost)
         if manifest is not None:
             return round_i, path, manifest
     return None

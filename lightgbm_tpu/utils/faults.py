@@ -159,17 +159,26 @@ def _spec_ranks() -> Dict[str, str]:
     return _spec_cache[2]
 
 
+def _this_rank() -> str:
+    """The rank a fault gate compares against: the fleet-GLOBAL worker
+    id when the launcher set one (multi-slice fleets reuse slice-LOCAL
+    rendezvous ranks per slice, so LIGHTGBM_TPU_RANK alone would fire
+    the fault in every slice at once), else LIGHTGBM_TPU_RANK."""
+    return os.environ.get("LGBM_TPU_WORKER_ID",
+                          os.environ.get("LIGHTGBM_TPU_RANK", ""))
+
+
 def _rank_allows(site: str) -> bool:
     inline = _spec_ranks().get(site)
     if inline is not None:
         # inline <site>:<rank>:<round> form wins over the env gate
-        return os.environ.get("LIGHTGBM_TPU_RANK", "") == inline
+        return _this_rank() == inline
     if site not in _RANK_GATED_SITES:
         return True
     want = os.environ.get("LGBMTPU_FAULT_RANK")
     if want is None:
         return True
-    return os.environ.get("LIGHTGBM_TPU_RANK", "") == want
+    return _this_rank() == want
 
 
 def _once_marker(site: str, round_i: int) -> Optional[str]:

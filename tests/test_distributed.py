@@ -102,6 +102,17 @@ def test_dryrun_multichip_entry():
     ge.dryrun_multichip(8)
 
 
+def test_dryrun_multislice_entry():
+    """The BENCH_MODE=multislice lever's hermetic subprocess dryrun: the
+    hierarchical round over a 2x2 nested mesh equals the single-mesh
+    sharded round at full top-k coverage (ISSUE 15)."""
+    import sys
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as ge
+
+    ge.dryrun_multislice_windowed(2, 2, "psum")
+
+
 def test_entry_compiles():
     import sys
     sys.path.insert(0, "/root/repo")

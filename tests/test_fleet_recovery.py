@@ -449,3 +449,89 @@ def test_launcher_live_fleet_metrics_endpoint(monkeypatch):
         from lightgbm_tpu.obs import metrics as _obs
         _obs.REGISTRY.register_collector("fleet_live", lambda: {})
         obs_server.stop_server()
+
+
+# ---------------------------------------------------------------------------
+# slice-granular recovery (ISSUE 15 — docs/ROBUSTNESS.md "Slice-granular
+# recovery"): manifests carry slice membership, a lost slice resumes from
+# the newest SLICE-valid round, survivors never restart
+# ---------------------------------------------------------------------------
+
+def test_slice_valid_manifest_excludes_lost_ranks(tmp_path):
+    """Simulated 2-slice x 2-rank fleet: round 4 is acked only by the
+    SURVIVORS (slice 1's ranks died before acking), so it is not
+    fleet-valid — but it IS slice-valid for slice 1's replacement, whose
+    dead members' acks cannot be required.  A diverged ack from an
+    excluded rank still poisons the round."""
+    d = str(tmp_path)
+    text2, text4 = _model_text(2), _model_text(4)
+    slices = {"0": 0, "1": 0, "2": 1, "3": 1}
+    ckpt.write_fleet_checkpoint(d, text2, 2, 4, {}, slices=slices)
+    for r in (1, 2, 3):
+        ckpt.confirm_fleet_checkpoint(d, 2, r, text2)
+    mpath4 = ckpt.write_fleet_checkpoint(d, text4, 4, 4, {}, slices=slices)
+    ckpt.confirm_fleet_checkpoint(d, 4, 1, text4)  # slice-0 survivor only
+
+    raw = json.load(open(mpath4))
+    assert raw["slices"] == slices and raw["num_slices"] == 2
+
+    # fleet-valid scan: round 4 unconfirmed (ranks 2, 3 silent) -> 2
+    assert ckpt.latest_valid_fleet_manifest(d, 4)[0] == 2
+    # slice-valid for the LOST slice {2, 3}: round 4 qualifies
+    got = ckpt.latest_slice_valid_fleet_manifest(d, 4, (2, 3))
+    assert got is not None and got[0] == 4
+    # but a rank OUTSIDE the lost slice missing its ack still disqualifies
+    assert ckpt.latest_slice_valid_fleet_manifest(d, 4, (3,))[0] == 2
+    # a diverged ack from an EXCLUDED rank proves forked state: refused
+    ckpt.confirm_fleet_checkpoint(d, 4, 3, text4 + "# fork\n")
+    assert ckpt.latest_slice_valid_fleet_manifest(d, 4, (2, 3))[0] == 2
+
+
+def test_slice_granular_recovery_survivors_never_restart(
+        monkeypatch, uninterrupted_ref_text):
+    """THE ISSUE 15 recovery acceptance, loopback form: a 2-slice fleet
+    (1 rank per slice — each slice its own rendezvous world training the
+    shared plan) loses slice 1 at round 5.  ONLY slice 1 is killed and
+    respawned — from the newest SLICE-valid manifest round, not round 0
+    — while slice 0 keeps running untouched (exactly one spawn for rank
+    0, no fleet_relaunch), and every final model file is byte-identical
+    to an uninterrupted run's."""
+    from lightgbm_tpu.obs import metrics as _obs
+    from lightgbm_tpu.parallel import launcher
+
+    X, y = _data()
+    params = _e2e_params(X)
+    monkeypatch.setenv("LGBMTPU_FAULT", "worker_death:1:5")
+    c0 = _obs.counter("fleet_slice_resumes_total").value
+    # the launch-scoped fleet_live collector outlives the run by design
+    # (post-mortem scrapes of the LAUNCHER's endpoint); drop it after so
+    # this faulted fleet's on-disk counters cannot flip later tests'
+    # /healthz probes (obs.reset() deliberately keeps collectors)
+    try:
+        bst, files = launcher.train_distributed(
+            params, X, y, num_boost_round=6, num_machines=2, num_slices=2,
+            max_restarts=1, restart_backoff_s=0.1, env_extra=dict(_CPU_ENV))
+    finally:
+        _obs.unregister_collector("fleet_live")
+    tmp = launcher._LAST_LAUNCH_DIR
+    texts = [open(f).read() for f in files]
+    assert texts[0] == texts[1] == uninterrupted_ref_text
+
+    assert _obs.counter("fleet_slice_resumes_total").value == c0 + 1
+    ev = _fleet_events(tmp)
+    kinds = [e["kind"] for e in ev]
+    assert "fleet_relaunch" not in kinds  # the fleet never restarted
+    deaths = [e for e in ev if e["kind"] == "worker_death"]
+    assert [e["worker_rank"] for e in deaths] == [1]
+    resumes = [e for e in ev if e["kind"] == "fleet_slice_resume"]
+    assert len(resumes) == 1 and resumes[0]["slice"] == 1
+    assert resumes[0]["ranks"] == [1]
+    # resumed from a slice-valid ROUND (>= the last round slice 1 acked
+    # before dying; the survivors may have confirmed further) — never 0
+    assert resumes[0]["round"] is not None and resumes[0]["round"] >= 4
+    # the survivor was spawned exactly once; the lost rank exactly twice
+    spawns = [e["worker_rank"] for e in ev if e["kind"] == "worker_spawn"]
+    assert spawns.count(0) == 1 and spawns.count(1) == 2
+    # the manifests on disk carry slice membership
+    found = ckpt.latest_valid_fleet_manifest(tmp, 2)
+    assert found is not None and found[2]["slices"] == {"0": 0, "1": 1}

@@ -1,0 +1,269 @@
+"""Hierarchical two-level merge on the loopback nested mesh (ISSUE 15,
+docs/DISTRIBUTED.md "Hierarchical merge").
+
+Acceptance under test: a 2-slice x 2-rank nested (dcn, ici) mesh training
+through the fused windowed round — intra-slice psum AND psum_scatter
+merges — produces trees structurally EXACT vs single-device windowed
+growth when ``top_k_features`` covers every candidate feature, with the
+per-rank 1-dispatch/0-sync/0-retrace steady-state budget pinned with
+telemetry + span tracing ON.  Smaller top-k is the PV-Tree
+approximation: it must still train a usable model under a statically
+bounded DCN byte bill (the jaxpr-audit side lives in
+tests/test_jaxpr_audit.py).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.binning import DatasetBinner
+from lightgbm_tpu.ops.split import SplitParams
+from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+from lightgbm_tpu.parallel.hierarchy import (SlicedData,
+                                             grow_tree_windowed_hierarchical)
+from lightgbm_tpu.parallel.mesh import (DCN_AXIS, ICI_AXIS,
+                                        make_mesh_hierarchical,
+                                        slice_axis_sizes)
+
+
+@pytest.fixture(scope="module")
+def case():
+    rng = np.random.RandomState(5)
+    n, f = 1600, 10
+    X = rng.randn(n, f)
+    y = X @ rng.randn(f) + 0.2 * rng.randn(n)
+    binner = DatasetBinner.fit(X, max_bin=31)
+    bins = binner.transform(X)
+    grad = jnp.asarray(0.6 * y, jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    kw = dict(num_leaves=15, num_bins=32,
+              params=SplitParams(min_data_in_leaf=5.0), leaf_tile=4,
+              use_pallas=False)
+    tree_s, leaf_s = grow_tree_windowed(
+        jnp.asarray(bins.T, jnp.int16), grad, hess,
+        jnp.ones((n,), bool), jnp.ones((n,), jnp.float32),
+        jnp.ones((f,), bool),
+        jnp.asarray(binner.num_bins_per_feature),
+        jnp.asarray(binner.missing_bin_per_feature), **kw)
+    return dict(n=n, f=f, bins=bins, binner=binner, grad=grad, hess=hess,
+                kw=kw, tree_s=tree_s, leaf_s=leaf_s)
+
+
+def _sliced(case):
+    mesh = make_mesh_hierarchical(2, 2)
+    assert slice_axis_sizes(mesh) == (2, 2)
+    return SlicedData(mesh, case["bins"],
+                      case["binner"].num_bins_per_feature,
+                      case["binner"].missing_bin_per_feature)
+
+
+def _grow_hier(case, sd, merge, top_k, stats=None):
+    n = case["n"]
+    return grow_tree_windowed_hierarchical(
+        sd, sd.pad_rows(np.asarray(case["grad"])),
+        sd.pad_rows(np.asarray(case["hess"])), sd.row_valid,
+        sd.pad_rows(np.ones(n, np.float32), fill=1.0),
+        jnp.ones((case["f"],), bool), merge=merge,
+        top_k_features=top_k, stats=stats, **case["kw"])
+
+
+def _assert_same_tree(tree_s, tree_h, leaf_s, leaf_h, n):
+    assert int(tree_s.num_leaves) == int(tree_h.num_leaves)
+    m = int(tree_s.num_leaves) - 1
+    for name in ("split_feature", "threshold_bin", "left_child",
+                 "right_child", "default_left"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(tree_s, name))[:m],
+            np.asarray(getattr(tree_h, name))[:m], err_msg=name)
+    np.testing.assert_allclose(
+        np.asarray(tree_s.leaf_value)[:m + 1],
+        np.asarray(tree_h.leaf_value)[:m + 1], rtol=2e-3, atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(leaf_s),
+                                  np.asarray(leaf_h)[:n])
+
+
+@pytest.mark.parametrize("merge", ["psum", "scatter"])
+def test_hierarchical_full_topk_equals_single_device(case, merge):
+    """ISSUE 15 acceptance: 2-slice x 2-rank nested-mesh training with
+    top_k covering all candidate features is structurally EXACT vs
+    single-device windowed growth — both intra-slice merges — with zero
+    retries and zero blocking syncs."""
+    sd = _sliced(case)
+    stats = {}
+    tree_h, leaf_h = _grow_hier(case, sd, merge, case["f"], stats)
+    assert stats["retries"] == 0 and stats["host_syncs"] == 0, stats
+    _assert_same_tree(case["tree_s"], tree_h, case["leaf_s"], leaf_h,
+                      case["n"])
+
+
+def test_hierarchical_budget_one_dispatch_per_round_telemetry_on(case):
+    """The per-rank round budget on the nested mesh: 1 donated dispatch,
+    0 blocking syncs, 0 retraces per steady-state round — pinned by the
+    same DispatchCounter the single-level rounds use, with telemetry AND
+    span tracing default-ON (both the intra-slice merge and the dcn
+    election ride inside the one dispatch)."""
+    from lightgbm_tpu.obs import metrics as obs_metrics
+    from lightgbm_tpu.obs import trace as obs_trace
+    from lightgbm_tpu.utils.sanitizer import DispatchCounter
+
+    assert obs_metrics.enabled()
+    sd = _sliced(case)
+    # warmup: compiles init, the round at this shard's ladder rung(s),
+    # finalize
+    tree, leaf = _grow_hier(case, sd, "psum", 4)
+    jax.block_until_ready(leaf)
+    assert int(tree.num_leaves) > 1
+    sd2 = _sliced(case)
+    spans_before = len(obs_trace.spans("windowed_round"))
+    stats = {}
+    with DispatchCounter() as d:
+        tree, leaf = _grow_hier(case, sd2, "psum", 4, stats)
+        jax.block_until_ready(leaf)
+    assert stats["rounds"] >= 3, stats
+    d.assert_round_budget(stats["rounds"], what="hierarchical rounds")
+    assert stats["host_syncs"] == 0 and stats["retries"] == 0, stats
+    assert stats["async_resolves"] <= stats["rounds"], stats
+    d.assert_no_recompile("hierarchical windowed steady state")
+    assert (len(obs_trace.spans("windowed_round")) - spans_before
+            == stats["rounds"])
+
+
+def test_hierarchical_small_topk_trains_valid_tree(case):
+    """top_k < F is the PV-Tree approximation: the election may pick a
+    different split than the exhaustive search, but the tree must be
+    valid, grown, and the round budget intact."""
+    sd = _sliced(case)
+    stats = {}
+    tree_h, leaf_h = _grow_hier(case, sd, "psum", 3, stats)
+    assert int(tree_h.num_leaves) > 1
+    assert stats["retries"] == 0 and stats["host_syncs"] == 0, stats
+    lid = np.asarray(leaf_h)[: case["n"]]
+    assert lid.min() >= 0 and lid.max() < int(tree_h.num_leaves)
+
+
+def test_hierarchical_categorical_splits_same_partition(case):
+    """Categorical hierarchy training: split features/thresholds/gains
+    match the single-device round, and every categorical node's bin
+    mask describes the SAME partition — exactly equal, or the
+    complement (sides swapped): the many-vs-many asc/desc ratio scans
+    evaluate one partition from both ends at the (used+1)//2 cap, so
+    collective summation order may flip which direction wins a
+    float-tie.  The partition itself — which bins separate from which —
+    is invariant."""
+    rng = np.random.RandomState(7)
+    n = 1200
+    Xc = rng.randint(0, 6, size=(n, 2)).astype(np.float64)
+    Xn = rng.randn(n, 3)
+    X = np.concatenate([Xn, Xc], axis=1)
+    f = X.shape[1]
+    y = (Xn[:, 0] + (Xc[:, 0] > 2) + 0.3 * rng.randn(n) > 0.5)
+    binner = DatasetBinner.fit(X, max_bin=31, categorical_features=[3, 4])
+    bins = binner.transform(X)
+    grad = jnp.asarray(0.6 * (y - 0.5), jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    cmask = jnp.asarray(np.asarray(binner.categorical_mask))
+    kw = dict(num_leaves=11, num_bins=32,
+              params=SplitParams(min_data_in_leaf=5.0), leaf_tile=4,
+              use_pallas=False)
+    tree_s, _ = grow_tree_windowed(
+        jnp.asarray(bins.T, jnp.int16), grad, hess, jnp.ones((n,), bool),
+        jnp.ones((n,), jnp.float32), jnp.ones((f,), bool),
+        jnp.asarray(binner.num_bins_per_feature),
+        jnp.asarray(binner.missing_bin_per_feature),
+        categorical_mask=cmask, **kw)
+    sd = SlicedData(make_mesh_hierarchical(2, 2), bins,
+                    binner.num_bins_per_feature,
+                    binner.missing_bin_per_feature)
+    tree_h, _ = grow_tree_windowed_hierarchical(
+        sd, sd.pad_rows(np.asarray(grad)), sd.pad_rows(np.asarray(hess)),
+        sd.row_valid, sd.pad_rows(np.ones(n, np.float32), fill=1.0),
+        jnp.ones((f,), bool), categorical_mask=cmask, merge="psum",
+        top_k_features=f, **kw)
+    assert int(tree_s.num_leaves) == int(tree_h.num_leaves)
+    m = int(tree_s.num_leaves) - 1
+    np.testing.assert_array_equal(
+        np.asarray(tree_s.split_feature)[:m],
+        np.asarray(tree_h.split_feature)[:m])
+    np.testing.assert_array_equal(
+        np.asarray(tree_s.is_cat)[:m], np.asarray(tree_h.is_cat)[:m])
+    np.testing.assert_allclose(
+        np.asarray(tree_s.split_gain)[:m],
+        np.asarray(tree_h.split_gain)[:m], rtol=1e-4, atol=1e-5)
+    ms = np.asarray(tree_s.cat_mask)[:m]
+    mh = np.asarray(tree_h.cat_mask)[:m]
+    for i in np.nonzero(np.asarray(tree_s.is_cat)[:m])[0]:
+        same = (ms[i] == mh[i]).all()
+        complement = not (ms[i] & mh[i]).any() and ms[i].any() and mh[i].any()
+        assert same or complement, (i, ms[i], mh[i])
+
+
+def test_hierarchical_refuses_per_node_sampling(case):
+    sd = _sliced(case)
+    kw = dict(case["kw"])
+    kw["params"] = SplitParams(min_data_in_leaf=5.0,
+                               feature_fraction_bynode=0.5)
+    n = case["n"]
+    with pytest.raises(ValueError, match="per-node feature sampling"):
+        grow_tree_windowed_hierarchical(
+            sd, sd.pad_rows(np.asarray(case["grad"])),
+            sd.pad_rows(np.asarray(case["hess"])), sd.row_valid,
+            sd.pad_rows(np.ones(n, np.float32), fill=1.0),
+            jnp.ones((case["f"],), bool), **kw)
+
+
+def test_mesh_axes_and_divisibility():
+    mesh = make_mesh_hierarchical(2, 2)
+    assert mesh.axis_names == (DCN_AXIS, ICI_AXIS)
+    with pytest.raises(ValueError, match="divide"):
+        make_mesh_hierarchical(3)  # 8 devices / 3 slices
+    with pytest.raises(ValueError, match=">= 1"):
+        make_mesh_hierarchical(0)
+
+
+def test_booster_routes_num_slices_to_hierarchical(monkeypatch):
+    """Booster-level routing: num_slices=2 with tree_learner=data|voting
+    (windowed gate forced — the real gate needs a TPU + wide shape)
+    builds the nested mesh, dispatches through the hierarchical path,
+    and trains an accurate model; voting maps to the owned-feature
+    scatter merge intra-slice."""
+    from lightgbm_tpu.models.gbdt import GBDT
+
+    rng = np.random.RandomState(12)
+    X = rng.randn(2000, 6).astype(np.float32)
+    y = ((X @ rng.randn(6)) > 0).astype(np.float64)
+    monkeypatch.setattr(GBDT, "_use_windowed_dp",
+                        lambda self, ts: self._dp is not None)
+    for tl, want_merge in (("data", "psum"), ("voting", "scatter")):
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.Booster(
+            params={"objective": "binary", "num_leaves": 15,
+                    "verbosity": -1, "tree_learner": tl,
+                    "tree_growth_mode": "rounds", "num_slices": 2,
+                    "top_k_features": 6}, train_set=ds)
+        g = bst._gbdt
+        assert g._dp_hier is not None and g._dp_hier.num_slices == 2
+        assert g._use_windowed_hier(g.train_set)
+        assert g._windowed_dp_merge() == want_merge
+        for _ in range(5):
+            bst.update()
+        p = bst.predict(X)
+        acc = np.mean((p > 0.5) == (y > 0))
+        assert acc > 0.85, (tl, acc)
+
+
+def test_booster_num_slices_indivisible_falls_back(monkeypatch):
+    """num_slices that does not divide the device count warns and trains
+    on the single-level mesh instead of failing."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 5).astype(np.float32)
+    y = ((X @ rng.randn(5)) > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(
+        params={"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                "tree_learner": "data", "num_slices": 3}, train_set=ds)
+    assert bst._gbdt._dp_hier is None
+    assert bst._gbdt._dp is not None
+    bst.update()
+    assert bst.num_trees() == 1

@@ -1985,3 +1985,112 @@ def test_r16_pragma_suppression(tmp_path):
     """}, rules=["R16"])
     assert rep.findings == []
     assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R17 full-histogram-over-dcn
+# ---------------------------------------------------------------------------
+
+def test_r17_positive_full_hist_psum_over_dcn(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        def merge(fresh_hists):
+            return jax.lax.psum(fresh_hists, "dcn")
+    """}, rules=["R17"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].rule == "R17"
+    assert "dcn" in rep.findings[0].message
+
+
+def test_r17_positive_all_gather_hist_via_axis_constant(tmp_path):
+    """The DCN axis referenced through the mesh constant (incl. a
+    both-axes tuple) is still the dcn axis."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        ICI_AXIS = "ici"
+        DCN_AXIS = "dcn"
+
+        def gather(hist0):
+            return jax.lax.all_gather(hist0, DCN_AXIS)
+
+        def both(cand_hist):
+            return jax.lax.psum(cand_hist, (ICI_AXIS, DCN_AXIS))
+    """}, rules=["R17"])
+    assert len(rep.findings) == 2
+    assert all(f.rule == "R17" for f in rep.findings)
+
+
+def test_r17_negative_topk_shaped_and_scalar_operands(tmp_path):
+    """The sanctioned shapes: an elected top-k histogram subset
+    (take_along_axis by the vote's indices) and scalar/gain traffic
+    cross dcn clean; the full merge stays on ici."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def election(cand_hists, g_idx, vote_gain, total):
+            sub_hists = jnp.take_along_axis(
+                cand_hists, g_idx[:, None, :, None], axis=2)
+            sub_hists = jax.lax.psum(sub_hists, "dcn")
+            gains = jax.lax.all_gather(vote_gain, "dcn")
+            worst = jax.lax.pmax(total, ("ici", "dcn"))
+            slice_hists = jax.lax.psum(cand_hists, "ici")
+            return sub_hists, gains, worst, slice_hists
+    """}, rules=["R17"])
+    assert rep.findings == []
+
+
+def test_r17_negative_full_hist_inside_slice(tmp_path):
+    """The intra-slice full merge is the design, not a finding."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        DATA_AXIS = "data"
+
+        def merge(fresh_hists, hist0):
+            a = jax.lax.psum(fresh_hists, "ici")
+            b = jax.lax.psum_scatter(hist0, DATA_AXIS,
+                                     scatter_dimension=2, tiled=True)
+            return a, b
+    """}, rules=["R17"])
+    assert rep.findings == []
+
+
+def test_r17_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        def debug_merge(dbg_hists):
+            return jax.lax.psum(dbg_hists, "dcn")  # jaxlint: disable=R17 (fixture: one-off debug parity probe, never the round path)
+    """}, rules=["R17"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+def test_r17_nested_def_neither_duplicates_nor_misses_enclosing_gather(
+        tmp_path):
+    """Nested defs are walked through their enclosing function only: a
+    top-k gather assigned in the ENCLOSING scope sanctions a dcn
+    collective inside a nested def (no false positive), and a genuine
+    violation inside a nested def reports exactly once."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def outer_clean(cand_hists, g_idx):
+            sub_hists = jnp.take_along_axis(
+                cand_hists, g_idx[:, None, :, None], axis=2)
+
+            def merge():
+                return jax.lax.psum(sub_hists, "dcn")
+            return merge
+
+        def outer_bad(fresh_hists):
+            def merge():
+                return jax.lax.psum(fresh_hists, "dcn")
+            return merge
+    """}, rules=["R17"])
+    assert len(rep.findings) == 1, rep.findings
+    assert "outer_bad" in rep.findings[0].message

@@ -37,6 +37,8 @@ def test_contract_catalogue_pins_the_flagships():
     assert {
         "windowed_round_float", "windowed_round_quantized",
         "windowed_round_sharded_psum", "windowed_round_sharded_scatter",
+        "windowed_round_hierarchical_psum",
+        "windowed_round_hierarchical_voting",
         "predict_warm_single", "predict_warm_multiclass",
         "predict_warm_converted", "predict_coalesced_bucket",
         "ooc_root_chunk", "ooc_split_chunk", "continual_refit_leaves",
@@ -107,7 +109,8 @@ def test_donations_all_consumable(report):
         live = r.detail.get("live_donated_leaves")
         if not live:
             continue
-        if r.name.startswith("windowed_round_sharded"):
+        if r.name.startswith(("windowed_round_sharded",
+                              "windowed_round_hierarchical")):
             continue  # aliasing attrs absent in multi-device CPU lowering
         assert r.detail.get("aliased_in_lowering") == live, (r.name, r.detail)
 
@@ -409,6 +412,98 @@ def test_j7_extra_sweep_fails():
     res = jaxpr_audit.audit_contract(c)
     assert any(f.rule == "J7" for f in res.findings), res.findings
     assert res.detail["bin_sweeps"] > 2.5
+
+
+def _axis_mapped_ici_sequence(tokens):
+    """Map a hierarchical round's collective tokens onto the legacy
+    single-axis vocabulary: drop dcn-only collectives (the top-k
+    election), rename both-axes scalar merges and ici merges to the
+    legacy 'data' axis."""
+    out = []
+    for t in tokens:
+        name, _, axes = t.partition("@")
+        ax = set(axes.split(","))
+        if ax == {"dcn"}:
+            continue  # the election block: dcn-only, by design
+        assert "ici" in ax, t
+        out.append(f"{name}@data")
+    return out
+
+
+def test_hierarchical_ici_sequence_equals_legacy_sharded(report):
+    """ISSUE 15 acceptance: per slice, the hierarchical round's ici
+    collective sequence is IDENTICAL to the legacy sharded round's —
+    the intra-slice merge (J1 sequence) is unchanged; only the dcn
+    election block is new."""
+    detail = {r.name: r.detail for r in report.results}
+    for hier, legacy in (
+            ("windowed_round_hierarchical_psum",
+             "windowed_round_sharded_psum"),
+            ("windowed_round_hierarchical_voting",
+             "windowed_round_sharded_scatter")):
+        assert (_axis_mapped_ici_sequence(detail[hier]["collectives"])
+                == detail[legacy]["collectives"]), (hier, legacy)
+
+
+def test_hierarchical_dcn_bytes_pinned(report):
+    """The cross-slice byte bill: both hierarchical contracts carry a
+    dcn_bytes detail under the declared dcn_max_bytes budget — ≤ top-k
+    histograms' worth per round — and exactly TWO large collectives
+    (one intra-slice merge + one top-k exchange), the dcn one bounded."""
+    from lightgbm_tpu.analysis.contracts import (
+        _BINS, _HIER_TOPK, _TILE)
+
+    k_hist_bytes = 2 * _TILE * 3 * _HIER_TOPK * _BINS * 4
+    for name in ("windowed_round_hierarchical_psum",
+                 "windowed_round_hierarchical_voting"):
+        c = CONTRACTS[name]
+        r = {x.name: x for x in report.results}[name]
+        assert c.dcn_max_bytes is not None
+        assert 0 < r.detail["dcn_bytes"] <= c.dcn_max_bytes, r.detail
+        # the election's histogram payload dominates; scalar slack only
+        assert r.detail["dcn_bytes"] <= k_hist_bytes + 1024, r.detail
+        assert r.detail["large_collectives"] == 2, r.detail
+
+
+def test_dcn_bytes_fixture_full_histogram_over_dcn_fails():
+    """A deliberately full-F histogram psum over the dcn axis against a
+    top-k-sized budget: the regression class the hierarchical merge
+    exists to prevent (and jaxlint R17 flags at the source level)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.analysis.jaxpr_audit import dcn_axis_bytes
+    from lightgbm_tpu.parallel.compat import shard_map
+    from lightgbm_tpu.parallel.mesh import make_mesh_hierarchical
+
+    mesh = make_mesh_hierarchical(2, min(2, max(1, jax.device_count() // 2)))
+
+    def body(h):  # (C, 3, F, B) full histogram block
+        h = jax.lax.psum(h, "ici")          # intra-slice: fine
+        return jax.lax.psum(h, "dcn")       # full-F over DCN: the bug
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(),), out_specs=P(),
+        check_vma=False))
+    c = dataclasses.replace(
+        _fixture_contract(
+            "fixture_full_hist_over_dcn",
+            lambda: Target(
+                fn, (jax.ShapeDtypeStruct((8, 3, 64, 32), jnp.float32),),
+                {}),
+            collectives=("psum@ici", "psum@dcn")),
+        dcn_max_bytes=4096)
+    res = jaxpr_audit.audit_contract(c)
+    assert any(f.rule == "J1" and "dcn" in f.message
+               for f in res.findings), res.findings
+    assert res.detail["dcn_bytes"] == 8 * 3 * 64 * 32 * 4
+    # and the helper counts only dcn-crossing collectives
+    assert dcn_axis_bytes([("psum", ("ici",), 100),
+                           ("psum", ("ici", "dcn"), 8),
+                           ("psum", ("dcn",), 50)]) == 58
 
 
 def test_j7_detail_rides_the_artifact_verdict():

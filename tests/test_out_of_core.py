@@ -840,3 +840,93 @@ def test_append_to_corrupt_cache_refuses_before_replace(tmp_path):
     with pytest.raises(CorruptBinCacheError):
         append_rows(cache, ds.binner.transform(Xn), label=yn)
     assert open(cache, "rb").read() == before
+
+
+# ---------------------------------------------------------------------------
+# the launcher's rank-sharded cache feed (ISSUE 15 satellite): workers
+# materialize ONLY their shard of a shared cache via BinCacheStream(shard=)
+# ---------------------------------------------------------------------------
+
+def test_dataset_bin_cache_shard_parity(tmp_path):
+    """Dataset(cache, params={'bin_cache_shard': (lo, hi, pad)}) builds
+    the identical binned rows/label/weight the full cache holds at
+    [lo, hi) — plus weight-0 zero-bin padding to the fleet's equal-shard
+    size — without ever materializing the whole matrix member."""
+    cache, bins = _make_cache(tmp_path, n=300, f=4)
+    with np.load(cache, allow_pickle=False) as z:
+        full_label = np.asarray(z["label"])
+    lo, hi, pad = 37, 263, 240  # a range cutting CRC blocks, padded
+    ds = lgb.Dataset(cache,
+                     params=dict(_PARAMS, bin_cache_shard=(lo, hi, pad)))
+    ds.construct()
+    got = np.asarray(ds.bins)
+    assert got.shape == (pad, bins.shape[1])
+    np.testing.assert_array_equal(got[: hi - lo], bins[lo:hi])
+    assert (got[hi - lo:] == 0).all()
+    np.testing.assert_array_equal(np.asarray(ds.label)[: hi - lo],
+                                  full_label[lo:hi])
+    w = np.asarray(ds.weight)
+    assert (w[: hi - lo] == 1.0).all() and (w[hi - lo:] == 0.0).all()
+    # an unpadded shard keeps weight=None semantics (no synthetic ones)
+    ds2 = lgb.Dataset(cache, params=dict(_PARAMS,
+                                         bin_cache_shard=(lo, hi)))
+    ds2.construct()
+    assert ds2.weight is None
+    np.testing.assert_array_equal(np.asarray(ds2.bins), bins[lo:hi])
+
+
+def test_dataset_bin_cache_shard_crc_boundary(tmp_path):
+    """The shard feed keeps the integrity contract: a corrupt byte in a
+    CRC block the shard fully covers raises row-ranged through
+    read_cache_shard; a shard cutting the poisoned block mid-way cannot
+    verify it (leading bytes never read) and streams through."""
+    from lightgbm_tpu.io.stream import CorruptBinCacheError
+
+    cache, bins = _make_cache(tmp_path)
+    final, bad_bins = _poisoned_cache(tmp_path, bins, cache)
+    ds = lgb.Dataset(final, params=dict(_PARAMS,
+                                        bin_cache_shard=(128, 300)))
+    with pytest.raises(CorruptBinCacheError) as ei:
+        ds.construct()
+    assert ei.value.row_lo == 128 and ei.value.row_hi == 192
+    ds2 = lgb.Dataset(final, params=dict(_PARAMS,
+                                         bin_cache_shard=(140, 300)))
+    ds2.construct()
+    np.testing.assert_array_equal(np.asarray(ds2.bins), bad_bins[140:300])
+
+
+def test_cache_shard_fingerprint_tracks_bytes(tmp_path):
+    """The launcher's shard fingerprint (CRC-table-derived, no payload
+    read) is stable across reads, distinct per range, and flips when the
+    shard's bytes change."""
+    from lightgbm_tpu.io.stream import cache_shard_fingerprint
+
+    cache, bins = _make_cache(tmp_path)
+    fp = cache_shard_fingerprint(cache, 0, 150)
+    assert fp and fp == cache_shard_fingerprint(cache, 0, 150)
+    assert fp != cache_shard_fingerprint(cache, 150, 300)
+    final, _ = _poisoned_cache(tmp_path, bins, cache, bad_row=10)
+    assert cache_shard_fingerprint(final, 0, 150) != fp
+
+
+def test_launcher_cache_feed_trains_equal_to_in_memory(tmp_path):
+    """End to end: train_distributed(data_cache=) feeds the worker
+    through the shard stream and produces the identical model a plain
+    in-process training on the same cache does."""
+    from lightgbm_tpu.parallel import launcher
+
+    cache, _bins = _make_cache(tmp_path, n=400, f=5, name="feed.bin")
+    params = dict(_PARAMS, bin_construct_sample_cnt=400)
+    ref = lgb.train(dict(params), lgb.Dataset(cache), num_boost_round=4)
+    ref_path = str(tmp_path / "ref_model.txt")
+    ref.save_model(ref_path)
+    bst, files = launcher.train_distributed(
+        params, None, None, num_boost_round=4, num_machines=1,
+        data_cache=cache,
+        env_extra={"JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    assert open(files[0]).read() == open(ref_path).read()
+    with pytest.raises(ValueError, match="XOR"):
+        launcher.train_distributed(params, np.zeros((4, 2)), None,
+                                   num_boost_round=1, num_machines=1,
+                                   data_cache=cache)

@@ -350,3 +350,62 @@ def test_fleet_live_collector_labels_ranks_and_skips_torn(tmp_path):
         assert 'boost_rounds_total{rank="0"} 5' in text
     finally:
         _obs.REGISTRY.register_collector("fleet_live", lambda: {})
+
+
+def test_slow_rank_median_is_per_slice_not_fleet_wide(tmp_path, monkeypatch):
+    """ISSUE 15 satellite: with ``slice_of`` the straggler threshold
+    medians WITHIN each slice.  A uniformly slow slice 1 (both ranks at a
+    lazy-but-matched cadence) must not inflate the comparison median for
+    slice 0, where rank 1 genuinely stalls against a fast peer — the
+    fleet-wide median (~the slow slice's cadence) would have hidden it."""
+    import threading
+
+    from lightgbm_tpu.obs import metrics as _obs
+    from lightgbm_tpu.parallel import launcher
+
+    monkeypatch.setattr(launcher, "_SLOW_RANK_FLOOR_S", 0.05)
+    monkeypatch.setenv("LGBMTPU_METRICS_SNAPSHOT_PERIOD_S", "0.1")
+    workers = [_worker(tmp_path, r, "import time; time.sleep(7)")
+               for r in range(4)]
+    paths = {r: str(tmp_path / f"s{r}.metrics.json") for r in range(4)}
+    slice_of = {0: 0, 1: 0, 2: 1, 3: 1}
+    stop = threading.Event()
+
+    def beat():
+        v = 0.0
+        while not stop.is_set():
+            v += 1.0
+            _write_heartbeat(paths[0], v)          # slice 0: fast peer
+            if v <= 12:                            # slice 0: rank 1 arms...
+                _write_heartbeat(paths[1], v)      # ...then stalls
+            if v % 4 == 0:                         # slice 1: slow cadence
+                _write_heartbeat(paths[2], v)      # (0.8 s — under the
+                _write_heartbeat(paths[3], v)      # 1.2 s floor, so read-
+                # phase desync between its two matched ranks can't trip)
+            time.sleep(0.2)
+
+    threading.Thread(target=beat, daemon=True).start()
+    c0 = _obs.counter("fleet_slow_ranks_total").value
+    # the event ring is process-wide: scope to THIS watch (earlier tests
+    # in this module emit fleet_slow_rank events for their own ranks)
+    ev0 = len(list(_obs.events("fleet_slow_rank")))
+    ages = {}
+    try:
+        launcher._watch_workers(workers, timeout_s=60, heartbeat_paths=paths,
+                                slow_rank_factor=3.0, hb_ages=ages,
+                                slice_of=slice_of)
+    finally:
+        stop.set()
+    evs = list(_obs.events("fleet_slow_rank"))[ev0:]
+    flagged = {e["worker_rank"] for e in evs}
+    assert 1 in flagged, "intra-slice straggler missed"
+    # the matched-cadence slow slice never trips — its own median IS its
+    # cadence; and no event ever compared against a cross-slice median
+    # (the slice-0 events' median is the fast peer's age, well under the
+    # slow slice's ~1.6 s cadence)
+    assert not ({2, 3} & flagged), evs
+    r1 = [e for e in evs if e["worker_rank"] == 1]
+    assert all(e.get("slice") == 0 for e in r1)
+    assert all(e["fleet_median_s"] < 1.0 for e in r1), r1
+    assert _obs.counter("fleet_slow_ranks_total").value >= c0 + 1
+    assert all(p.returncode == 0 for _, p, _ in workers)  # detection only
