@@ -25,6 +25,15 @@ the AST rules structurally cannot see through the shared round driver's
 closure dispatch.  Import it explicitly (it is not imported here, so
 ``lightgbm_tpu.analysis`` stays JAX-free for pre-commit use).
 
+A third, concurrency layer lives in :mod:`.locks` (rules L1-L5): it
+builds a whole-package lock model (which Lock/RLock/Condition attributes
+exist, which ``with`` blocks acquire them, which attributes mutate under
+which guards) and pins lock discipline — order inversions, blocking
+calls under locks, unguarded shared mutations, predicate-free waits and
+orphan threads.  It shares the AST layer's registry, pragma format and
+stale-pragma detection; ``--locks`` selects it alone.  Its runtime twin
+is :mod:`lightgbm_tpu.utils.locktrace` (witness-graph lock wrappers).
+
 Usage::
 
     python -m lightgbm_tpu.analysis lightgbm_tpu/            # full package
@@ -48,7 +57,8 @@ See docs/ANALYSIS.md for the rule catalogue and how to add a rule.
 
 from .core import (Finding, PackageIndex, Pragma, Report, RULES,
                    register_rule, run)
-from . import rules  # noqa: F401  — registers R1-R5 on import
+from . import rules  # noqa: F401  — registers R1-R17 on import
+from . import locks  # noqa: F401  — registers the concurrency layer L1-L5
 
 __all__ = ["Finding", "PackageIndex", "Pragma", "Report", "RULES",
            "register_rule", "run"]

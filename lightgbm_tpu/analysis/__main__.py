@@ -1,11 +1,15 @@
 """CLI: ``python -m lightgbm_tpu.analysis [paths...]``.
 
-Two layers, one entry point (docs/ANALYSIS.md):
+Three layers, one entry point (docs/ANALYSIS.md):
 
-* default — **jaxlint**, the AST pass over source (rules R1-R14).  Runs
-  without touching JAX device state.  Stale pragmas (a ``disable=Rn``
-  whose line no longer triggers Rn) warn by default; ``--strict-pragmas``
-  promotes them to findings.
+* default — **jaxlint**, the AST pass over source (rules R1-R17 plus the
+  concurrency rules L1-L5).  Runs without touching JAX device state.
+  Stale pragmas (a ``disable=Rn`` whose line no longer triggers Rn) warn
+  by default; ``--strict-pragmas`` promotes them to findings.
+* ``--locks`` — the **concurrency layer** alone (rules L1-L5 over the
+  whole-package lock model, analysis/locks.py): lock-order inversions,
+  blocking calls under locks, unguarded shared mutations, predicate-free
+  Condition.waits, orphan threads.
 * ``--jaxpr`` — the **jaxpr executable audit** (rules J1-J6 over the
   registered contracts, analysis/contracts.py).  Traces the flagship
   executables hermetically on the host CPU; ``--contract NAME`` selects
@@ -27,6 +31,7 @@ from pathlib import Path
 
 from .core import RULES, run
 from . import rules  # noqa: F401
+from . import locks  # noqa: F401  — registers L1-L5
 
 
 def _ensure_loopback_devices() -> None:
@@ -105,6 +110,9 @@ def main(argv=None) -> int:
                         help="promote stale pragmas (suppressions whose "
                              "line no longer triggers the named rule) "
                              "from warnings to findings")
+    parser.add_argument("--locks", action="store_true",
+                        help="run only the concurrency layer (rules L1-L5 "
+                             "over the package lock model)")
     parser.add_argument("--jaxpr", action="store_true",
                         help="run the jaxpr executable audit (J1-J6 over "
                              "the registered contracts) instead of the "
@@ -120,6 +128,13 @@ def main(argv=None) -> int:
                              "cross-check (pure trace/lower, no "
                              "execution)")
     args = parser.parse_args(argv)
+
+    if args.locks and (args.jaxpr or args.contract or args.list_contracts
+                       or args.rules):
+        print("error: --locks selects the L1-L5 layer and contradicts "
+              "--jaxpr/--contract/--list-contracts/--rules",
+              file=sys.stderr)
+        return 2
 
     if args.jaxpr or args.contract or args.list_contracts:
         if args.paths:
@@ -150,7 +165,10 @@ def main(argv=None) -> int:
             return 2
 
     rule_ids = None
-    if args.rules:
+    if args.locks:
+        rule_ids = [rid for rid, rule in RULES.items()
+                    if rule.layer == "locks"]
+    elif args.rules:
         rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
         unknown = [r for r in rule_ids if r not in RULES]
         if unknown:

@@ -517,16 +517,23 @@ class Rule:
     name: str
     fn: RuleFn
     doc: str
+    # which analysis layer the rule belongs to: "ast" (jaxlint R-rules) or
+    # "locks" (the concurrency layer L-rules).  The CLI's --locks flag and
+    # helpers/run_jaxlint.py's --locks-only select by layer; a plain run
+    # executes every registered rule regardless of layer.
+    layer: str = "ast"
 
 
 RULES: Dict[str, Rule] = {}
 
 
-def register_rule(rule_id: str, name: str) -> Callable[[RuleFn], RuleFn]:
+def register_rule(rule_id: str, name: str,
+                  layer: str = "ast") -> Callable[[RuleFn], RuleFn]:
     def deco(fn: RuleFn) -> RuleFn:
         if rule_id in RULES:
             raise ValueError(f"duplicate rule id {rule_id}")
-        RULES[rule_id] = Rule(rule_id, name, fn, (fn.__doc__ or "").strip())
+        RULES[rule_id] = Rule(rule_id, name, fn, (fn.__doc__ or "").strip(),
+                              layer)
         return fn
 
     return deco
@@ -559,6 +566,7 @@ def run(roots: Iterable[Path], rule_ids: Optional[Iterable[str]] = None,
     actually selected this run — a subset run cannot conclude anything
     about an unselected rule's pragmas."""
     from . import rules as _rules  # noqa: F401  (registers built-in rules)
+    from . import locks as _locks  # noqa: F401  (registers L1-L5)
 
     pkg = PackageIndex(roots)
     selected = sorted(rule_ids) if rule_ids else sorted(RULES)

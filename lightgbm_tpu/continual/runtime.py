@@ -61,6 +61,7 @@ from ..basic import Booster, LightGBMError
 from ..obs import metrics as _obs
 from ..obs import trace as _trace
 from ..utils import checkpoint as _checkpoint
+from ..utils import locktrace as _lt
 from ..utils import faults as _faults
 from ..utils import sanitizer as _san
 from .refit import ContinualError, make_refit_entry, refit_eligible, \
@@ -173,7 +174,7 @@ class ContinualRunner:
 
         # rolling window (raw rows + labels, host): refit traverses raw
         # values, appends bin via the reference mappers — both read it
-        self._wlock = threading.Lock()
+        self._wlock = _lt.lock("continual.window")
         self._wx: List[np.ndarray] = []
         self._wy: List[np.ndarray] = []
         self._wrows = 0
@@ -190,12 +191,12 @@ class ContinualRunner:
         self._inflight_rows = 0
         self._inflight_oldest: Optional[float] = None
         self._label_hist: List[tuple] = []  # (rows, sum) per chunk
-        self._mu = threading.Lock()  # one update/rollover at a time
+        self._mu = _lt.lock("continual.update")  # one update/rollover at a time
         # durable-cache appends are read-rewrite-replace: serialized
         # here so concurrent ingest() calls cannot drop each other's
         # rows (one process owns a cache; cross-process appends are out
         # of contract, like save_binary itself)
-        self._cache_lock = threading.Lock()
+        self._cache_lock = _lt.lock("continual.cache")
         # runner-thread failure backoff: a deterministic update failure
         # must not retry at tick cadence forever
         self._fail_backoff_s = 0.0

@@ -37,6 +37,7 @@ from ..utils import faults as _faults
 from ..utils import profiling as _profiling  # noqa: F401 — importing
 # installs the jax.profiler span-annotation bridge when
 # LGBMTPU_JAX_PROFILER=1 (obs/ itself must stay jax-free)
+from ..utils import locktrace as _lt
 from ..utils import sanitizer as _san
 from .tree import Tree, tree_from_device
 
@@ -120,7 +121,7 @@ def _quantized_wide_default(*, on_tpu: bool, n_features: int,
 
 # guards lazy _pack_lock creation on instances that predate the lock
 # (unpickled state, legacy deepcopies) — see GBDT._plock
-_PACK_LOCK_INIT = threading.Lock()
+_PACK_LOCK_INIT = _lt.lock("gbdt.pack_init")
 
 
 class GBDT:
@@ -155,7 +156,7 @@ class GBDT:
         # serving threads' _packed lookup/insert holds — an unlocked
         # bump racing a lookup could evict a dict entry mid-iteration or
         # publish a pack under a version it no longer belongs to
-        self._pack_lock = threading.RLock()
+        self._pack_lock = _lt.rlock("gbdt.pack")
         self.binner = None
         self.rng = np.random.RandomState(cfg.seed)
         # non-finite guard rail (docs/ROBUSTNESS.md): first boosting
@@ -188,7 +189,7 @@ class GBDT:
         self._models = value
         self._invalidate_pred_cache("models_setter")
 
-    def _plock(self) -> threading.RLock:
+    def _plock(self) -> "_lt.TracedLock":
         """The pack lock, lazily recreated for instances that predate it
         (unpickled/legacy state); creation races are excluded by the
         module-level init lock."""
@@ -197,7 +198,7 @@ class GBDT:
             with _PACK_LOCK_INIT:
                 lock = getattr(self, "_pack_lock", None)
                 if lock is None:
-                    lock = self._pack_lock = threading.RLock()
+                    lock = self._pack_lock = _lt.rlock("gbdt.pack")
         return lock
 
     def __getstate__(self):
@@ -208,7 +209,16 @@ class GBDT:
 
     def __setstate__(self, d):
         self.__dict__.update(d)
-        self._pack_lock = threading.RLock()
+        # re-create the pack lock under the SAME init lock _plock uses:
+        # the old unconditional assignment raced a concurrent _plock()
+        # caller — it could mint lock A (and start serving under it)
+        # between the __dict__ update and this line, after which the
+        # overwrite published lock B and two threads held "the" pack
+        # lock simultaneously.  Create-if-absent under _PACK_LOCK_INIT
+        # makes exactly one lock win both paths.
+        with _PACK_LOCK_INIT:
+            if getattr(self, "_pack_lock", None) is None:
+                self._pack_lock = _lt.rlock("gbdt.pack")
 
     def _invalidate_pred_cache(self, reason: str) -> None:
         """VERSION the packed-ensemble serving cache instead of nulling it
@@ -1810,7 +1820,7 @@ class GBDT:
                 self._score = self._score + row_delta
             else:
                 self._score = self._score.at[:, c].add(row_delta)
-            self.models.append(tree)
+            self.models.append(tree)  # jaxlint: disable=L3 (append+version-bump protocol: the pack key carries (version, len) so a mid-build append is caught at insert; locking here would nest the models-property device flush under the pack lock — an L2)
             # valid scores
             for vi, vs in enumerate(self.valid_sets):
                 leaf_v = vs.predict_leaf_binned_tree(tree)
@@ -1858,7 +1868,7 @@ class GBDT:
             tree = self.models.pop()
             if tree.is_linear:
                 vals = jnp.asarray(
-                    tree.predict_batch(np.asarray(self.train_set.raw_device)),
+                    tree.predict_batch(np.asarray(self.train_set.raw_device)),  # jaxlint: disable=L2 (rollback is a mutator: the pop + score rebuild must be atomic vs serving pack builds, and the linear-path pull is trainer-thread-only)
                     jnp.float32,
                 )
             else:

@@ -33,7 +33,7 @@ def _build() -> bool:
     from .utils.log import log_warning
 
     try:
-        r = subprocess.run(cmd, capture_output=True, timeout=240, text=True)
+        r = subprocess.run(cmd, capture_output=True, timeout=240, text=True)  # jaxlint: disable=L2 (one-time lazy .so build under the load lock; contending callers need the built library before they can proceed anyway)
         ok = r.returncode == 0 and os.path.exists(_SO)
         if not ok:
             log_warning(

@@ -68,6 +68,14 @@ EVENT_RING_CAP = 4096
 _PROM_PREFIX = "lgbmtpu_"
 
 _lock = threading.RLock()
+# dedicated event-sink IO leaf lock: the JSONL write/flush of an event
+# record happens here, NOT under the registry ``_lock`` every counter
+# inc contends on — a slow disk must never stall the hot metric paths
+# (the L2 lock-lint finding this split fixed).  Order: never taken while
+# holding ``_lock`` (both call sites release the registry lock first);
+# the write-error path nests ``_lock`` INSIDE it, which is the one
+# allowed direction.
+_events_io_lock = threading.Lock()
 # the process default (env-derived); Config application restores it for
 # models that do not set telemetry= explicitly, so one model's
 # telemetry=false cannot silently disable a later model's metrics_file=
@@ -272,14 +280,17 @@ class Registry:
         """Explicit sink path; ``None`` reverts to env-var resolution
         (``LGBMTPU_EVENTS_FILE``) at the next event."""
         with _lock:
-            if self._events_fh is not None:
-                try:
-                    self._events_fh.close()
-                except OSError:
-                    pass
-            self._events_fh = None
+            fh, self._events_fh = self._events_fh, None
             self._events_path = path
             self._events_resolved = False
+        if fh is not None:
+            # close on the IO leaf lock so it serializes with in-flight
+            # sink writes instead of stalling registry readers
+            with _events_io_lock:
+                try:
+                    fh.close()  # jaxlint: disable=L2 (dedicated event-sink IO leaf lock; guards only the fh)
+                except OSError:
+                    pass
 
     def event(self, kind: str, **fields: Any) -> None:
         if not _enabled:
@@ -295,17 +306,35 @@ class Registry:
                     "LGBMTPU_EVENTS_FILE")
                 if path:
                     try:
-                        self._events_fh = open(path, "a", encoding="utf-8")
+                        # one-time sink arm (first event only): the open
+                        # stays under the registry lock so exactly one
+                        # resolution wins; steady-state writes do not
+                        # pass through here
+                        self._events_fh = open(path, "a", encoding="utf-8")  # jaxlint: disable=L2 (one-time sink arm on the first event, not a steady-state path)
                         self._events_path = path
                     except OSError:
                         self._events_fh = None  # stays failed: no
                         # per-event retry, no fallback to another path
-            if self._events_fh is not None:
-                try:
-                    self._events_fh.write(json.dumps(rec, default=str) + "\n")
-                    self._events_fh.flush()
-                except (OSError, ValueError):
-                    self._events_fh = None
+            fh = self._events_fh
+        if fh is None:
+            return
+        # sink write OUTSIDE the registry lock: a slow disk stalls only
+        # other event writers (this leaf lock), never counter/gauge/
+        # histogram updates.  A concurrent set_events_file may have
+        # detached fh since the snapshot — the identity re-check makes
+        # the stale writer skip instead of writing to a closed handle.
+        # File line order can differ from ring order across racing
+        # events; records carry ts.
+        with _events_io_lock:
+            if fh is not self._events_fh:
+                return
+            try:
+                fh.write(json.dumps(rec, default=str) + "\n")  # jaxlint: disable=L2 (dedicated event-sink IO leaf lock; guards only the fh)
+                fh.flush()  # jaxlint: disable=L2 (dedicated event-sink IO leaf lock; guards only the fh)
+            except (OSError, ValueError):
+                with _lock:
+                    if self._events_fh is fh:
+                        self._events_fh = None
 
     def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
         with _lock:

@@ -66,6 +66,8 @@ DEGRADED_COUNTERS = (
     ("faults_injected_total", "injected faults fired (test harness armed)"),
     ("continual_update_failures_total",
      "continual update failed; serving continues on the previous ensemble"),
+    ("lock_order_violations_total",
+     "lock-order inversion witnessed by the runtime lock sanitizer"),
 )
 # gauge-driven degraded states: unlike the cumulative counters above these
 # are CURRENT conditions — the serving runtime sets serve_shedding to 1

@@ -62,6 +62,7 @@ import numpy as np
 from ..basic import Booster, LightGBMError
 from ..models.gbdt import _predict_bucket
 from ..obs import metrics as _obs
+from ..utils import locktrace as _lt
 from ..obs import server as _obs_server
 from ..obs import trace as _trace
 
@@ -167,7 +168,7 @@ class ServingRuntime:
                               if tenant_quota is None else int(tenant_quota))
         self._shed_unhealthy = bool(shed_unhealthy)
 
-        self._cv = threading.Condition()
+        self._cv = _lt.condition("serve.cv")
         self._queue: List[_Request] = []
         self._queued_per_tenant: Dict[str, int] = {}
         # depth-1 handoff: the coalescer blocks here while the dispatcher
@@ -193,12 +194,17 @@ class ServingRuntime:
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingRuntime":
-        if self._closed:
-            raise LightGBMError("ServingRuntime is stopped")
-        if self._started:
-            return self
-        self._started = True
-        self._running = True
+        # state flips under _cv: stop() reads/writes _running/_closed
+        # under the same lock, and the under-lock _started check makes
+        # concurrent start() calls spawn exactly one thread pair (the
+        # unlocked version was an L3 finding plus a double-spawn TOCTOU)
+        with self._cv:
+            if self._closed:
+                raise LightGBMError("ServingRuntime is stopped")
+            if self._started:
+                return self
+            self._started = True
+            self._running = True
         self._coalescer = threading.Thread(
             target=self._coalesce_loop, daemon=True, name="lgbmtpu-coalescer")
         self._dispatcher = threading.Thread(

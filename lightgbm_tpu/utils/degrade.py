@@ -29,6 +29,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from . import locktrace as _lt
 from .log import log_warning
 
 # registry keys
@@ -37,7 +38,7 @@ PARTITION = "partition_pallas"
 ROUND = "round_pallas"  # the round megakernel (ops/round_pallas.py); its
 # fallback is the three-pass fused round, which may still use HIST/PARTITION
 
-_lock = threading.Lock()
+_lock = _lt.lock("degrade.registry")
 _disabled: Dict[str, str] = {}
 
 # substrings that identify a Pallas/Mosaic kernel failure in exception

@@ -43,11 +43,12 @@ import jax
 import numpy as np
 
 from ..obs import metrics as _obs
+from . import locktrace as _lt
 
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 
-_lock = threading.Lock()
+_lock = _lt.lock("sanitizer.counts")
 _counts = {"compiles": 0, "traces": 0, "dispatches": 0, "host_syncs": 0,
            "async_resolves": 0}
 _installed = False

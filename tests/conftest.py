@@ -33,3 +33,11 @@ except Exception:  # backends already initialized: verified cpu below
 assert jax.default_backend() == "cpu", (
     f"test suite must run on local CPU, got {jax.default_backend()!r}"
 )
+
+# run the WHOLE suite under the runtime lock sanitizer, strict: every
+# traced acquire checks the witness graph and raises LockOrderError on a
+# cycle, and blocking acquires become 60s timeout-acquires so a true
+# deadlock fails the test instead of hanging the run (docs/ANALYSIS.md)
+from lightgbm_tpu.utils import locktrace as _locktrace  # noqa: E402
+
+_locktrace.enable(True, strict=True)

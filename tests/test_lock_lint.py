@@ -1,0 +1,679 @@
+"""Concurrency-layer (L1-L5) rule self-tests: positive / negative /
+pragma-suppressed fixture snippets per rule, mirroring
+tests/test_jaxlint_rules.py so a rule regression is caught independently
+of the package's own code — plus the package-wide locks-layer gate and
+the ``--locks`` CLI exit-code contract."""
+
+import textwrap
+from pathlib import Path
+
+from lightgbm_tpu.analysis import run
+from lightgbm_tpu.analysis.__main__ import main
+from lightgbm_tpu.analysis.core import RULES
+
+PKG_DIR = Path(__file__).resolve().parent.parent / "lightgbm_tpu"
+LOCK_RULES = ["L1", "L2", "L3", "L4", "L5"]
+
+
+def _scan(tmp_path, sources, rules=None):
+    """sources: {filename: code} written into one scanned root."""
+    root = tmp_path / "fixture_pkg"
+    root.mkdir()
+    for name, code in sources.items():
+        (root / name).write_text(textwrap.dedent(code))
+    return run([root], rules)
+
+
+# ---------------------------------------------------------------------------
+# L1 lock-order-inversion
+# ---------------------------------------------------------------------------
+
+def test_l1_positive_reversed_with_nesting(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f():
+            with _a:
+                with _b:
+                    pass
+
+        def g():
+            with _b:
+                with _a:
+                    pass
+    """}, rules=["L1"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "L1"
+    assert "inversion" in rep.findings[0].message
+
+
+def test_l1_negative_consistent_order(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f():
+            with _a:
+                with _b:
+                    pass
+
+        def g():
+            with _a:
+                with _b:
+                    pass
+    """}, rules=["L1"])
+    assert rep.findings == []
+
+
+def test_l1_positive_inversion_through_a_call(tmp_path):
+    """f holds _a and calls helper() which acquires _b; g nests the other
+    way — the edge collector sees one level of resolvable calls."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def helper():
+            with _b:
+                pass
+
+        def f():
+            with _a:
+                helper()
+
+        def g():
+            with _b:
+                with _a:
+                    pass
+    """}, rules=["L1"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "L1"
+
+
+def test_l1_negative_reentrant_same_lock(tmp_path):
+    """Nested acquisition of the SAME lock is reentrancy (rlock) or a
+    plain bug, not an order inversion — no self-edges."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _a = threading.RLock()
+
+        def f():
+            with _a:
+                with _a:
+                    pass
+    """}, rules=["L1"])
+    assert rep.findings == []
+
+
+def test_l1_positive_instance_attr_locks(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def m2(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """}, rules=["L1"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "L1"
+
+
+def test_l1_pragma_suppressed(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f():
+            with _a:
+                with _b:  # jaxlint: disable=L1 (fixture: documented order exception)
+                    pass
+
+        def g():
+            with _b:
+                with _a:
+                    pass
+    """}, rules=["L1"])
+    assert rep.findings == [], rep.findings
+    assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# L2 blocking-call-under-lock
+# ---------------------------------------------------------------------------
+
+def test_l2_positive_open_under_lock(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _lock = threading.Lock()
+
+        def dump(payload):
+            with _lock:
+                with open("/tmp/x", "w") as fh:
+                    fh.write(payload)
+    """}, rules=["L2"])
+    assert any("open" in f.message for f in rep.findings), rep.findings
+    assert all(f.rule == "L2" for f in rep.findings)
+
+
+def test_l2_positive_device_sync_under_lock(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+        import numpy as np
+
+        _lock = threading.Lock()
+
+        def pull(x):
+            with _lock:
+                host = np.asarray(x)
+                x.block_until_ready()
+            return host
+    """}, rules=["L2"])
+    assert len(rep.findings) == 2, rep.findings
+    assert any("device sync" in f.message for f in rep.findings)
+
+
+def test_l2_positive_subprocess_and_sleep_under_lock(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import subprocess
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def build():
+            with _lock:
+                subprocess.run(["make"])
+                time.sleep(1.0)
+    """}, rules=["L2"])
+    assert len(rep.findings) == 2, rep.findings
+
+
+def test_l2_negative_io_outside_lock(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _lock = threading.Lock()
+
+        def dump(payload):
+            with _lock:
+                snap = list(payload)
+            with open("/tmp/x", "w") as fh:
+                fh.write("".join(snap))
+    """}, rules=["L2"])
+    assert rep.findings == []
+
+
+def test_l2_positive_private_callee_inherits_held(tmp_path):
+    """A private helper called only from under-lock sites is analyzed in
+    its caller's context."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                _helper()
+
+        def _helper():
+            open("/tmp/x")
+    """}, rules=["L2"])
+    assert len(rep.findings) == 1, rep.findings
+    assert "open" in rep.findings[0].message
+
+
+def test_l2_negative_public_callee_open_world(tmp_path):
+    """Public functions never inherit caller held sets: external callers
+    the index cannot see may call them lock-free."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                helper()
+
+        def helper():
+            open("/tmp/x")
+    """}, rules=["L2"])
+    assert rep.findings == []
+
+
+def test_l2_pragma_suppressed_with_reason(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _io_lock = threading.Lock()
+
+        def dump(fh, payload):
+            with _io_lock:
+                fh.write(payload)  # jaxlint: disable=L2 (fixture: dedicated IO leaf lock)
+    """}, rules=["L2"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+    assert rep.suppressed[0][1].reason == "fixture: dedicated IO leaf lock"
+
+
+# ---------------------------------------------------------------------------
+# L3 unguarded-shared-mutation
+# ---------------------------------------------------------------------------
+
+def test_l3_positive_bare_minority_site(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lk = threading.Lock()
+                self.n = 0
+
+            def inc(self):
+                with self._lk:
+                    self.n += 1
+
+            def inc2(self):
+                with self._lk:
+                    self.n += 2
+
+            def racy(self):
+                self.n = 0
+    """}, rules=["L3"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "L3"
+    assert "no lock held" in rep.findings[0].message
+
+
+def test_l3_negative_majority_bare(tmp_path):
+    """One incidental under-lock store among many bare single-thread
+    stores does not make the attribute 'guarded' (majority vote)."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lk = threading.Lock()
+                self.n = 0
+
+            def locked_once(self):
+                with self._lk:
+                    self.n += 1
+
+            def trainer_a(self):
+                self.n += 1
+
+            def trainer_b(self):
+                self.n += 1
+    """}, rules=["L3"])
+    assert rep.findings == []
+
+
+def test_l3_negative_ctor_exempt(tmp_path):
+    """__init__/__setstate__ run pre-publication — their stores are not
+    race candidates."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lk = threading.Lock()
+                self.n = 0
+
+            def __setstate__(self, d):
+                self.n = d["n"]
+
+            def inc(self):
+                with self._lk:
+                    self.n += 1
+    """}, rules=["L3"])
+    assert rep.findings == []
+
+
+def test_l3_positive_mutator_method_call(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lk = threading.Lock()
+                self.items = []
+
+            def add(self, x):
+                with self._lk:
+                    self.items.append(x)
+
+            def add2(self, x):
+                with self._lk:
+                    self.items.append(x)
+
+            def racy(self, x):
+                self.items.append(x)
+    """}, rules=["L3"])
+    assert len(rep.findings) == 1, rep.findings
+    assert "items" in rep.findings[0].message
+
+
+def test_l3_positive_declared_global(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _lock = threading.Lock()
+        _count = 0
+
+        def inc():
+            global _count
+            with _lock:
+                _count += 1
+
+        def inc2():
+            global _count
+            with _lock:
+                _count += 1
+
+        def racy():
+            global _count
+            _count = 0
+    """}, rules=["L3"])
+    assert len(rep.findings) == 1, rep.findings
+    assert "_count" in rep.findings[0].message
+
+
+def test_l3_pragma_suppressed(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lk = threading.Lock()
+                self.n = 0
+
+            def inc(self):
+                with self._lk:
+                    self.n += 1
+
+            def single_thread_phase(self):
+                self.n = 0  # jaxlint: disable=L3 (fixture: setup phase, single-threaded)
+    """}, rules=["L3"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# L4 wait-without-predicate-loop
+# ---------------------------------------------------------------------------
+
+def test_l4_positive_bare_wait(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def block(self):
+                with self._cv:
+                    self._cv.wait()
+    """}, rules=["L4"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "L4"
+
+
+def test_l4_positive_if_guarded_wait(tmp_path):
+    """A bare if around the wait still loses to spurious wakeups — only a
+    while re-checks the predicate."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def block(self):
+                with self._cv:
+                    if not self.ready:
+                        self._cv.wait()
+    """}, rules=["L4"])
+    assert len(rep.findings) == 1, rep.findings
+
+
+def test_l4_negative_while_wrapped_wait(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def block(self):
+                with self._cv:
+                    while not self.ready:
+                        self._cv.wait(timeout=1.0)
+    """}, rules=["L4"])
+    assert rep.findings == []
+
+
+def test_l4_negative_wait_for(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def block(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self.ready)
+    """}, rules=["L4"])
+    assert rep.findings == []
+
+
+def test_l4_positive_module_level_condition(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        _cv = threading.Condition()
+
+        def block():
+            with _cv:
+                _cv.wait()
+    """}, rules=["L4"])
+    assert len(rep.findings) == 1, rep.findings
+
+
+def test_l4_pragma_suppressed(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def block(self):
+                with self._cv:
+                    self._cv.wait(timeout=0.5)  # jaxlint: disable=L4 (fixture: timeout-bounded poll, predicate re-checked by caller)
+    """}, rules=["L4"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# L5 orphan-thread
+# ---------------------------------------------------------------------------
+
+def test_l5_positive_orphan_instance_thread(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+    """}, rules=["L5"])
+    assert len(rep.findings) == 1, rep.findings
+    assert rep.findings[0].rule == "L5"
+    assert "_t" in rep.findings[0].message
+
+
+def test_l5_positive_orphan_local_thread(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        def fire_and_forget(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+    """}, rules=["L5"])
+    assert len(rep.findings) == 1, rep.findings
+
+
+def test_l5_negative_joined_in_stop(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def stop(self):
+                self._t.join(timeout=5)
+
+            def _run(self):
+                pass
+    """}, rules=["L5"])
+    assert rep.findings == []
+
+
+def test_l5_negative_swap_join_idiom(tmp_path):
+    """stop() swaps the handle to a local before joining (the idiom that
+    makes stop() idempotent under concurrent callers)."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def stop(self):
+                t, self._t = self._t, None
+                if t is not None:
+                    t.join(timeout=5)
+
+            def _run(self):
+                pass
+    """}, rules=["L5"])
+    assert rep.findings == []
+
+
+def test_l5_negative_stop_event_pattern(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def start(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def stop(self):
+                self._stop.set()
+
+            def _run(self):
+                while not self._stop.is_set():
+                    pass
+    """}, rules=["L5"])
+    assert rep.findings == []
+
+
+def test_l5_pragma_suppressed(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import threading
+
+        def fire_and_forget(fn):
+            t = threading.Thread(target=fn, daemon=True)  # jaxlint: disable=L5 (fixture: process-lifetime daemon by design)
+            t.start()
+    """}, rules=["L5"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# registry + package gate + CLI contract
+# ---------------------------------------------------------------------------
+
+def test_lock_rules_registered_under_locks_layer():
+    for rid in LOCK_RULES:
+        assert rid in RULES, rid
+        assert RULES[rid].layer == "locks", rid
+    # the R layer stayed where it was
+    assert RULES["R1"].layer == "ast"
+
+
+def test_package_locks_layer_is_clean():
+    """The tier-1 pin for the acceptance bar: zero unwaived L findings on
+    the package itself (intentional sites carry reasoned pragmas)."""
+    report = run([PKG_DIR], LOCK_RULES)
+    assert report.findings == [], [f.format() for f in report.findings]
+    assert report.ok
+
+
+def test_locks_cli_exit_codes(capsys):
+    assert main(["--locks", str(PKG_DIR)]) == 0
+    capsys.readouterr()
+    # --locks selects a whole layer; mixing with other selectors is usage
+    # error, same contract as --jaxpr
+    assert main(["--locks", "--jaxpr"]) == 2
+    assert main(["--locks", "--rules", "L1"]) == 2
+    assert main(["--locks", "--list-contracts"]) == 2
+    capsys.readouterr()
+
+
+def test_locks_cli_reports_findings_rc1(tmp_path, capsys):
+    bad = tmp_path / "badpkg"
+    bad.mkdir()
+    (bad / "mod.py").write_text(textwrap.dedent("""
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f():
+            with _a:
+                with _b:
+                    pass
+
+        def g():
+            with _b:
+                with _a:
+                    pass
+    """))
+    assert main(["--locks", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "L1" in out

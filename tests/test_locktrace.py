@@ -1,0 +1,393 @@
+"""Runtime lock-sanitizer pins (the dynamic half of the concurrency
+layer, lightgbm_tpu/utils/locktrace.py).
+
+The contract: every named lock participates in a process-wide witness
+graph — an acquisition order that contradicts a previously-witnessed
+order raises a typed ``LockOrderError`` naming BOTH sites; blocking
+acquires become timeout-acquires so a true deadlock surfaces as a typed
+``LockTimeoutError`` instead of a hung suite; wait/held reservoirs and
+the violation counters flow through the obs registry.  The whole tier-1
+suite runs with tracing ON (conftest), and the stress test here pins the
+threaded serve + continual + hot-swap runtime at zero violations, zero
+deadlocks, bitwise responses, and the warm 1-dispatch/1-accounted-sync
+predict budget with all instrumentation live.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.obs import metrics as obs
+from lightgbm_tpu.serve import ServingRuntime
+from lightgbm_tpu.utils import locktrace as lt
+from lightgbm_tpu.utils.sanitizer import DispatchCounter
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+          "min_data_in_leaf": 5}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_lock_state():
+    """Each test gets a clean witness graph and obs registry, and leaves
+    the session-wide strict tracing (conftest) back in force."""
+    from lightgbm_tpu.obs import server as _srv
+
+    obs.reset()
+    lt.reset()
+    yield
+    _srv.stop_server()
+    obs.reset()
+    lt.reset()
+    lt.set_timeout_s(60.0)
+    lt.enable(True, strict=True)
+
+
+def _setup(n=500, f=6, rounds=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params=dict(PARAMS), train_set=ds)
+    for _ in range(rounds):
+        bst.update()
+    return bst, ds, X, y, rng
+
+
+# ---------------------------------------------------------------------------
+# witness graph: order inversions
+# ---------------------------------------------------------------------------
+
+def test_order_inversion_raises_typed_error_naming_both_sites():
+    a, b = lt.lock("t.A"), lt.lock("t.B")
+    with a:
+        with b:  # witnesses A -> B
+            pass
+    with pytest.raises(lt.LockOrderError) as ei:
+        with b:
+            with a:  # closes the cycle
+                pass
+    msg = str(ei.value)
+    assert "t.A" in msg and "t.B" in msg
+    # names BOTH sites: the current acquire and the first-seen edge
+    assert msg.count("test_locktrace.py") == 2, msg
+    assert lt.stats()["order_violations"] == 1
+    assert obs.counter("lock_order_violations_total").value == 1
+
+
+def test_record_mode_counts_without_raising():
+    lt.enable(True, strict=False)
+    a, b = lt.lock("r.A"), lt.lock("r.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # inversion: counted, not raised
+            pass
+    assert lt.stats()["order_violations"] == 1
+    assert obs.counter("lock_order_violations_total").value == 1
+
+
+def test_transitive_inversion_detected():
+    a, b, c = lt.lock("tr.A"), lt.lock("tr.B"), lt.lock("tr.C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(lt.LockOrderError):
+        with c:
+            with a:  # A -> B -> C -> A
+                pass
+    assert lt.stats()["order_violations"] == 1
+
+
+def test_same_name_different_instance_records_no_self_edge():
+    """Two GBDT pack locks share the name 'gbdt.pack'; a rollover thread
+    nesting them must not poison the graph with a self-edge."""
+    p1, p2 = lt.rlock("same.pack"), lt.rlock("same.pack")
+    with p1:
+        with p2:
+            pass
+    with p2:
+        with p1:
+            pass
+    assert lt.stats() == {"witness_edges": 0, "order_violations": 0,
+                          "deadlock_timeouts": 0}
+
+
+def test_rlock_reentrancy_is_not_a_violation():
+    r = lt.rlock("re.R")
+    with r:
+        with r:
+            assert r.locked()
+    assert lt.stats()["order_violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# deadlock timeout + self-deadlock
+# ---------------------------------------------------------------------------
+
+def test_deadlock_surfaces_as_typed_timeout():
+    lt.set_timeout_s(0.3)
+    m = lt.lock("dl.M")
+    release = threading.Event()
+
+    def holder():
+        with m:
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    time.sleep(0.05)
+    with pytest.raises(lt.LockTimeoutError) as ei:
+        m.acquire()
+    assert "dl.M" in str(ei.value)
+    release.set()
+    t.join(timeout=10)
+    assert lt.stats()["deadlock_timeouts"] == 1
+    assert obs.counter("lock_deadlock_timeouts_total").value == 1
+
+
+def test_self_deadlock_fails_fast():
+    m = lt.lock("sd.M")
+    m.acquire()
+    try:
+        with pytest.raises(lt.LockTimeoutError) as ei:
+            m.acquire()
+        assert "re-acquired" in str(ei.value)
+    finally:
+        m.release()
+
+
+def test_explicit_timeout_keeps_caller_semantics():
+    """A caller-passed timeout returns False instead of raising — only
+    the default blocking acquire converts to a deadlock error."""
+    m = lt.lock("to.M")
+    release = threading.Event()
+
+    def holder():
+        with m:
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    time.sleep(0.05)
+    assert m.acquire(timeout=0.1) is False
+    assert m.acquire(blocking=False) is False
+    release.set()
+    t.join(timeout=10)
+    assert lt.stats()["deadlock_timeouts"] == 0
+
+
+# ---------------------------------------------------------------------------
+# condition + metrics + disabled mode
+# ---------------------------------------------------------------------------
+
+def test_condition_wait_notify_keeps_bookkeeping_consistent():
+    cv = lt.condition("cv.C")
+    ready = []
+
+    def waiter():
+        with cv:
+            while not ready:
+                cv.wait(timeout=5)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        ready.append(1)
+        cv.notify_all()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    # the lock is free and re-acquirable after wait's release/re-acquire
+    with cv:
+        pass
+    assert lt.stats()["order_violations"] == 0
+
+
+def test_wait_and_held_reservoirs_exported_per_lock():
+    m = lt.lock("mx.M")
+    with m:
+        time.sleep(0.01)
+    snap = obs.snapshot()
+    hists = snap.get("histograms", {})
+    assert obs.labeled("lock_wait_ms", lock="mx.M") in hists
+    held = obs.labeled("lock_held_ms", lock="mx.M")
+    assert held in hists
+    assert hists[held]["max"] >= 5.0  # the 10ms hold is visible
+
+
+def test_disabled_mode_is_passthrough():
+    lt.enable(False)
+    a, b = lt.lock("off.A"), lt.lock("off.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # would be an inversion; disabled mode never checks
+            pass
+    assert lt.stats() == {"witness_edges": 0, "order_violations": 0,
+                          "deadlock_timeouts": 0}
+
+
+def test_healthz_degrades_on_order_violation():
+    from lightgbm_tpu.obs.server import health
+
+    lt.enable(True, strict=False)
+    a, b = lt.lock("hz.A"), lt.lock("hz.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    code, body = health()
+    assert code == 200  # degraded still serves; unhealthy is the 5xx tier
+    assert body["status"] == "degraded"
+    assert any(p["counter"] == "lock_order_violations_total"
+               for p in body["problems"])
+
+
+# ---------------------------------------------------------------------------
+# GBDT pack-lock lazy-init (the __setstate__/_plock race fix)
+# ---------------------------------------------------------------------------
+
+def test_setstate_preserves_existing_pack_lock_identity():
+    bst, *_ = _setup(rounds=2)
+    state = bst._gbdt.__getstate__()
+    clone = object.__new__(GBDT)
+    clone.__setstate__(state)
+    lk = clone._plock()
+    assert lk is clone._pack_lock
+    # a second __setstate__ onto a live object (the old code minted a
+    # NEW lock here unconditionally — a caller already serving under lk
+    # would race a caller on the replacement)
+    clone.__setstate__(state)
+    assert clone._plock() is lk
+
+
+def test_plock_hammer_single_identity():
+    """N threads racing the lazy _plock init on a lock-less instance all
+    get the SAME lock object."""
+    bst, *_ = _setup(rounds=2)
+    state = bst._gbdt.__getstate__()
+    for _ in range(20):
+        clone = object.__new__(GBDT)
+        clone.__dict__.update(state)
+        assert getattr(clone, "_pack_lock", None) is None
+        got = []
+        barrier = threading.Barrier(8)
+
+        def grab():
+            barrier.wait(5)
+            got.append(clone._plock())
+
+        ts = [threading.Thread(target=grab) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert len(got) == 8
+        assert all(g is got[0] for g in got), "two pack locks minted"
+
+
+# ---------------------------------------------------------------------------
+# THE stress pin: serve + continual + hot swap under strict tracing
+# ---------------------------------------------------------------------------
+
+def test_stress_serve_continual_swap_zero_violations_and_budget(tmp_path):
+    """Concurrent predict load on two models + >=2 continual rollovers
+    (in-place refit and append) + a hot swap_model, all with strict lock
+    tracing, telemetry, span tracing and the HTTP server ON: zero
+    order violations, zero deadlock timeouts, zero caller errors, every
+    response bitwise equal to a legitimately-published ensemble, and the
+    warm predict budget still 1 dispatch + 1 accounted sync."""
+    from lightgbm_tpu.obs import server as _srv
+
+    assert lt.enabled()
+    _srv.start_server(0)
+    bst, ds, X, y, rng = _setup()
+    b_alt, _, _, _, _ = _setup(rounds=2, seed=7)
+    b_alt2, _, _, _, _ = _setup(rounds=6, seed=8)
+
+    rt = ServingRuntime(models={"main": bst, "alt": b_alt}, max_wait_ms=5,
+                        shed_unhealthy=False)
+    cr = lgb.continual_train(
+        bst, {"update_every_rows": 120, "append_trees": 2},
+        runtime=rt, model_name="main", reference=ds,
+        state_dir=str(tmp_path), start=False)
+
+    Q = rng.randn(48, 6)
+    slices = [Q[i * 16:(i + 1) * 16] for i in range(3)]
+    published = {"main": [bst], "alt": [b_alt]}
+    responses = []
+    stop = threading.Event()
+    errors = []
+
+    def caller(model):
+        try:
+            while not stop.is_set():
+                for i, s in enumerate(slices):
+                    responses.append((model, i, rt.predict(
+                        s, model=model, raw_score=True, timeout=60)))
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = ([threading.Thread(target=caller, args=("main",))
+                for _ in range(2)]
+               + [threading.Thread(target=caller, args=("alt",))])
+    for t in threads:
+        t.start()
+    try:
+        # in-place refit rollover, then an append rollover, live
+        for kind_want in ("refit", "append"):
+            Xc = rng.randn(150, 6)
+            yc = (Xc[:, 0] + 0.5 * Xc[:, 1] > 0).astype(float)
+            cr.ingest(Xc, yc)
+            assert cr.update(kind_want) == kind_want
+            published["main"].append(cr.booster)
+        # hot swap the second tenant mid-load
+        rt.swap_model("alt", b_alt2)
+        published["alt"].append(b_alt2)
+        time.sleep(0.2)  # let callers observe the final versions
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        cr.stop()
+    assert not errors, errors
+    assert responses, "stress produced no load"
+
+    # bitwise: every response equals SOME published version of its model
+    refs = {m: [[v.predict(s, raw_score=True) for s in slices]
+                for v in vs] for m, vs in published.items()}
+    for model, i, got in responses:
+        assert any(np.array_equal(r[i], got) for r in refs[model]), (
+            f"{model} slice {i} matches no published ensemble")
+
+    # zero violations / deadlocks under the full threaded runtime
+    assert lt.stats()["order_violations"] == 0
+    assert lt.stats()["deadlock_timeouts"] == 0
+    assert obs.counter("lock_order_violations_total").value == 0
+    assert obs.counter("lock_deadlock_timeouts_total").value == 0
+
+    # warm budget with the sanitizer's own instrumentation live
+    rt.predict(Q[:32], model="main", raw_score=True, timeout=60)
+    with DispatchCounter() as d:
+        rt.predict(Q[:32], model="main", raw_score=True, timeout=60)
+    assert d.dispatches == 1, d.dispatches
+    assert d.host_syncs == 1, d.host_syncs
+    d.assert_no_recompile("warm predict under strict lock tracing")
+
+    # the traced runtime locks left their reservoirs behind
+    snap = obs.snapshot()
+    hists = snap.get("histograms", {})
+    assert obs.labeled("lock_wait_ms", lock="serve.cv") in hists
+    assert obs.labeled("lock_held_ms", lock="gbdt.pack") in hists
+    rt.stop()
