@@ -69,6 +69,14 @@ ingest rows/s incl. the durable CRC'd cache append, refit vs
 append-trees update latency, and serve p50/p99 ACROSS zero-downtime
 rollovers vs the BENCH_serve_r01 baseline, rollover parity + audit
 verdict asserted in-artifact); knobs CONTINUAL_BENCH_*.
+
+Fleet mode (round 21): BENCH_MODE=fleet runs the booster-fleet
+benchmark (benchmarks/fleet_bench.py — models/s at B in {1, 64, 4096}
+training B independent boosters as one donated dispatch per round via
+lgb.train_fleet vs the host loop over the solo windowed grower, with
+B=8 bitwise parity float + int8, the warm 1-dispatch/0-sync/0-retrace
+round budget pinned per B from the fleet_round event ledger, and the
+audit verdict in-artifact); knobs FLEET_BENCH_*.
 """
 
 import json
@@ -373,6 +381,16 @@ def main():
         from benchmarks.continual_bench import main as continual_main
 
         return continual_main()
+    if os.environ.get("BENCH_MODE") == "fleet":
+        # booster-fleet benchmark (round 21): B independent boosters as
+        # ONE donated dispatch per round vs the host loop over the solo
+        # grower, bitwise parity + per-B round budget + audit verdict
+        # in-artifact (BENCH_fleet_* row)
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.fleet_bench import main as fleet_main
+
+        return fleet_main()
     if os.environ.get("BENCH_MODE") == "ooc":
         # out-of-core/partition data-path levers (BENCH_ooc_* artifact)
         import sys as _sys

@@ -396,12 +396,55 @@ def bench_multislice(n=1600, n_feat=10):
     return int(tree_h.num_leaves), dcn, time.perf_counter() - t0
 
 
+def bench_fleet(b=16, n_rows=256, n_feat=6, n_trees=3):
+    """Round-20 fleet smoke: a B-lane fleet trained as one dispatch per
+    round must leave every lane's served predictions bitwise equal to
+    the same lane trained alone through ``lgb.train_fleet`` at B=1, with
+    the warm round budget (dispatches == rounds, 0 syncs/retries/
+    compiles) pinned from the fleet_round event ledger — the off-chip CI
+    catch for batched-training regressions."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import metrics as _obs
+
+    rng = np.random.RandomState(20)
+    X = rng.rand(n_rows, n_feat)
+    labels = (rng.rand(b, n_rows) > 0.5).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "min_data_in_leaf": 5, "seed": 3}
+    ds = lgb.Dataset(X, label=labels[0])
+    ev0 = len(_obs.events("fleet_round"))
+    t0 = time.perf_counter()
+    fb = lgb.train_fleet(params, ds, labels, num_boost_round=n_trees)
+    dt = time.perf_counter() - t0
+    warm = [e for e in _obs.events("fleet_round")[ev0:]
+            if e.get("iteration", 0) > 1]
+    assert warm and all(
+        e.get("dispatches") == e.get("rounds") and e.get("host_syncs") == 0
+        and e.get("retries") == 0 and e.get("compiles") == 0
+        for e in warm), f"warm fleet round budget broke: {warm}"
+    Q = rng.rand(64, n_feat)
+    for lane in (0, b // 2, b - 1):
+        ds1 = lgb.Dataset(X, label=labels[lane])
+        solo = lgb.train_fleet(dict(params), ds1, labels[lane:lane + 1],
+                               num_boost_round=n_trees)
+        assert np.array_equal(
+            fb.booster(lane).predict(Q, raw_score=True),
+            solo.booster(0).predict(Q, raw_score=True)), (
+            f"fleet lane {lane} diverged from its B=1 run")
+    snap = _obs.snapshot()
+    _obs.validate_snapshot(snap)
+    assert "train_fleet_models_total" in snap["counters"]
+    assert "fleet_models" in snap["gauges"]
+    return b, n_trees, dt
+
+
 def main():
     n = int(os.environ.get("SMOKE_ROWS", 1_000_000))
     iters = int(os.environ.get("SMOKE_ITERS", 10))
     which = (sys.argv[1].split(",") if len(sys.argv) > 1
              else ["rank", "multiclass", "predict", "serve", "ooc",
-                   "megakernel", "continual", "multislice"])
+                   "megakernel", "continual", "fleet", "multislice"])
     if "rank" in which:
         ips = bench_rank(n, q_len=128, iters=iters)
         print(f"lambdarank {n//1000}k rows x64f q128 63bins: {ips:.2f} iters/sec", flush=True)
@@ -432,6 +475,11 @@ def main():
               f"rollovers (refit+append) -> {trees} trees, served "
               f"bitwise, staleness drops, snapshot keys ok ({dt:.1f}s)",
               flush=True)
+    if "fleet" in which:
+        b, trees, dt = bench_fleet()
+        print(f"fleet {b} boosters x256 rows x6f: {trees} rounds at one "
+              f"dispatch/round, lanes bitwise == their B=1 runs, warm "
+              f"budget pinned ({dt:.1f}s)", flush=True)
     if "multislice" in which:
         got = bench_multislice()
         if got is None:

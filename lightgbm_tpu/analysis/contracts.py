@@ -736,6 +736,58 @@ def _build_continual_refit_leaves() -> Target:
 
 
 # ---------------------------------------------------------------------------
+# fleet round (ops/treegrow_fleet.py)
+# ---------------------------------------------------------------------------
+
+_FB = 4  # fleet lanes in the fixture — small, but enough that a
+# superlinear state duplication (O(B^2) broadcast in the vmapped body)
+# overshoots the linear budget below
+
+
+@contract(
+    "fleet_round_batched",
+    description="the vmapped fleet round (B independent boosters, one "
+                "donated dispatch): the solo round body lifted over a "
+                "leading model axis plus the in-dispatch (B,5)->(5,) "
+                "info fold — vmap must add ZERO collectives vs. the "
+                "single-model round (J1), donation consumed on the "
+                "(B, ...) stacked state (J2), peak-live LINEAR in B at "
+                "the fixture shape (J6: B x the solo budget)",
+    collectives=(),
+    donated_args=(0,),
+    # the solo float round measures ~4.03 MB at this fixture under its
+    # 10 MB budget; linear-in-B means the fleet stays under _FB x that —
+    # an accidental O(B^2) buffer (e.g. a cross-lane broadcast in the
+    # histogram fallback) fails HERE, before it fails allocation at
+    # B=4096 on chip
+    max_live_bytes=_FB * (10 << 20),
+    family="fleet",
+)
+def _build_fleet_round_batched() -> Target:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import treegrow_fleet as tf
+
+    common = _round_common()
+    solo = _single_state(0, _N, _F, common)
+    stacked = jax.tree_util.tree_map(
+        lambda s: _sds((_FB,) + tuple(s.shape), s.dtype), solo)
+    row = lambda dt: _sds((_FB, _N), dt)  # noqa: E731
+    pf = _sds((_F,), jnp.int32)
+    args = (stacked, _sds((_F, _N), jnp.int16),
+            row(jnp.float32), row(jnp.float32),
+            None, None, None,
+            row(jnp.bool_), pf, pf, _sds((_F,), jnp.bool_))
+    kw = dict(max_depth=-1, W=_W, use_pallas=False, quantize_bins=0,
+              hist_precision="f32", pallas_partition=False, **common)
+    return Target(tf._fleet_round, args, kw,
+                  note="B=4 float fleet round (CPU trace: XLA histogram "
+                       "fallback; the quantized/Pallas lanes share the "
+                       "solo contracts' variant coverage)")
+
+
+# ---------------------------------------------------------------------------
 # spill grower chunk steps (ops/treegrow_ooc.py)
 # ---------------------------------------------------------------------------
 

@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R17.
+"""jaxlint built-in rules R1-R18.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -1654,3 +1654,111 @@ def r17_full_histogram_over_dcn(pkg: PackageIndex) -> Iterator[Finding]:
                     "histogram operand across the dcn axis — the "
                     "cross-slice merge must be top-k-shaped or scalar",
                     hint)
+
+
+# ---------------------------------------------------------------------------
+# R18 — host-loop-over-independent-boosters
+# ---------------------------------------------------------------------------
+
+# the per-model entry points a fleet batches: one dispatch per round for
+# ALL models (ops/treegrow_fleet.py) instead of one per model per round
+_R18_ENTRIES = ("train_one_iter", "refit_leaves")
+# "train" is a common verb — only the package entry spellings count
+# (bare `train` from `from lightgbm_tpu import train`, or qualified
+# through the canonical module aliases); `self.train()` methods do not
+_R18_TRAIN_QUALS = ("train", "lgb.train", "engine.train",
+                    "lightgbm_tpu.train", "lightgbm_tpu.engine.train")
+
+
+def _r18_is_entry(fn: str) -> bool:
+    last = fn.split(".")[-1]
+    if last in _R18_ENTRIES:
+        return True
+    return fn in _R18_TRAIN_QUALS
+
+
+def _r18_walk_no_defs(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk minus nested function defs — their bodies are their own
+    FuncInfo's territory (the _own_body discipline)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield child
+        yield from _r18_walk_no_defs(child)
+
+
+def _r18_loop_assigned(loop: ast.For) -> set:
+    """Names assigned by statements in the loop body — the loop-carried
+    candidates.  A call argument reading one of these means iteration i
+    consumes iteration i-1's output (warm-started training, a running
+    score feeding the next refit): sequentially dependent, not a fleet."""
+    out = set()
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+    return out
+
+
+@register_rule("R18", "host-loop-over-independent-boosters")
+def r18_host_loop_over_independent_boosters(
+        pkg: PackageIndex) -> Iterator[Finding]:
+    """A host ``for`` loop training or refitting boosters one model per
+    iteration with no cross-iteration data dependence: each pass calls
+    ``train`` / ``train_one_iter`` / ``refit_leaves`` on its own
+    element of a model list/dict, so every round costs one dispatch PER
+    MODEL — B dispatch fees, B recompilation keys, B host round-trips —
+    for work that is one vmapped dispatch in total.  The booster fleet
+    (``lgb.train_fleet``, ``ops/treegrow_fleet.py``) trains B
+    independent boosters in ONE donated dispatch per round, and
+    ``continual.fleet_refit_leaves`` is the batched refit twin; at
+    B=64 the batched path is the difference between a fleet sweep and a
+    lunch break (BENCH_fleet artifacts).  A call argument that READS a
+    name assigned inside the loop body is a loop-carried dependence
+    (warm-start chains like ``bst = train(..., init_model=bst)``, a
+    running score feeding the next refit) — sequential by construction,
+    not flagged.  Name-heuristic on the entry spellings: bare/qualified
+    package ``train`` plus any ``train_one_iter``/``refit_leaves``
+    (methods named ``.train`` on other objects are out of scope)."""
+    hint = ("batch the models: lgb.train_fleet(datasets, params) trains "
+            "B boosters in one dispatch per round "
+            "(lightgbm_tpu/models/fleet.py); "
+            "continual.fleet_refit_leaves batches the refit — or "
+            "suppress with the dependence that makes the loop "
+            "sequential")
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            seen = set()
+            for node in _own_body(fi):
+                if not isinstance(node, ast.For):
+                    continue
+                carried = _r18_loop_assigned(node)
+                for sub in _r18_walk_no_defs(node):
+                    if not isinstance(sub, ast.Call) or id(sub) in seen:
+                        continue
+                    fn = dotted_name(sub.func)
+                    if fn is None and isinstance(sub.func, ast.Attribute):
+                        # subscripted receiver (lanes[i].train_one_iter):
+                        # no dotted spelling, but the method name decides
+                        if sub.func.attr in _R18_ENTRIES:
+                            fn = sub.func.attr
+                    if fn is None or not _r18_is_entry(fn):
+                        continue
+                    seen.add(id(sub))
+                    arg_names = {
+                        s.id for a in (list(sub.args)
+                                       + [k.value for k in sub.keywords])
+                        for s in ast.walk(a) if isinstance(s, ast.Name)}
+                    if arg_names & carried:
+                        continue  # loop-carried input: sequential
+                    yield _finding(
+                        fi, sub, "R18",
+                        f"{fn}(...) inside {fi.qualname}'s host loop "
+                        "trains/refits one model per iteration — B "
+                        "independent models cost B dispatches per round "
+                        "where a fleet costs one", hint)

@@ -1402,22 +1402,41 @@ class Booster:
             pred_contrib=pred_contrib,
         )
 
-    def refit(self, data, label, decay_rate: float = 0.9, **kwargs) -> "Booster":
+    def refit(self, data, label, decay_rate: float = 0.9, weight=None,
+              **kwargs) -> "Booster":
         """Refit leaf values on new data (reference: GBDT::RefitTree via
-        LGBM_BoosterRefit): new_leaf = decay * old + (1-decay) * new_optimal."""
+        LGBM_BoosterRefit): new_leaf = decay * old + (1-decay) * new_optimal.
+
+        Multiclass ensembles renew tree ``t`` against class ``t % k``'s
+        gradient column, accumulating into a per-class score plane — the
+        reference's iter-major RefitTree order.  ``weight`` optionally
+        carries per-row sample weights into the gradient call (reference:
+        RefitTree reuses the Dataset's weights)."""
         X = _to_2d_float(data)
         label = np.asarray(label, dtype=np.float64).ravel()
         new_booster = Booster(model_str=self.model_to_string())
         new_booster._gbdt.cfg = self.cfg
         gbdt = new_booster._gbdt
-        score = np.zeros(len(label), dtype=np.float64)
+        k = gbdt.num_tree_per_iteration
+        score = np.zeros((len(label), k) if k > 1 else len(label),
+                         dtype=np.float64)
+        w_dev = None
+        if weight is not None:
+            weight = np.asarray(weight, dtype=np.float64).ravel()
+            if len(weight) != len(label):
+                raise LightGBMError(
+                    f"refit: {len(label)} labels but {len(weight)} weights")
+            w_dev = jnp.asarray(weight, jnp.float32)
         from .objectives import create_objective
 
         obj = create_objective(self.cfg)
         for t_i, tree in enumerate(gbdt.models):
             leaf = tree.predict_leaf(X)
-            g, h = obj.get_gradients(jnp.asarray(score, jnp.float32), jnp.asarray(label, jnp.float32), None)
+            g, h = obj.get_gradients(jnp.asarray(score, jnp.float32), jnp.asarray(label, jnp.float32), w_dev)
             g, h = np.asarray(g, np.float64), np.asarray(h, np.float64)
+            if k > 1:  # tree t renews against its class column c = t % k
+                c = t_i % k
+                g, h = g[:, c], h[:, c]
             sum_g = np.bincount(leaf, weights=g, minlength=tree.num_leaves)
             sum_h = np.bincount(leaf, weights=h, minlength=tree.num_leaves)
             lam2 = self.cfg.lambda_l2
@@ -1425,7 +1444,10 @@ class Booster:
             tree.leaf_value = decay_rate * tree.leaf_value + (1.0 - decay_rate) * np.where(
                 sum_h > 0, new_vals, tree.leaf_value
             )
-            score += tree.predict(X)
+            if k > 1:
+                score[:, t_i % k] += tree.predict(X)
+            else:
+                score += tree.predict(X)
         gbdt._invalidate_pred_cache("refit")  # leaf values renewed in place
         # (bump-on-mutate: in-flight serving readers keep the old pack)
         return new_booster

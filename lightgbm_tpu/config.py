@@ -565,6 +565,15 @@ class Config:
     # continual_chunk event stream.
     drift_window: int = 8192
 
+    # --- booster fleets (ours; README "Booster fleets",
+    # lightgbm_tpu/models/fleet.py) ---
+    # fleet_size: expected number of boosters in a train_fleet batch.
+    # 0 (default) = infer B from the (B, N) label matrix; a non-zero
+    # value is a guard — train_fleet raises when it disagrees with the
+    # labels, catching a transposed label matrix before a B=N fleet
+    # trains silently.
+    fleet_size: int = 0
+
     # unknown/passthrough params preserved here
     extra: Dict[str, Any] = field(default_factory=dict)
     # names the user explicitly set (vs defaults) — lets device-specific

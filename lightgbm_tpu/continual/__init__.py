@@ -2,8 +2,10 @@
 (README "Continuous training"; the train-while-serving loop beside
 ``lightgbm_tpu/serve``)."""
 
-from .refit import ContinualError, make_refit_entry, refit_leaves
+from .refit import (ContinualError, fleet_refit_leaves,
+                    make_fleet_refit_entry, make_refit_entry, refit_leaves)
 from .runtime import ContinualRunner
 
 __all__ = ["ContinualRunner", "ContinualError", "refit_leaves",
-           "make_refit_entry"]
+           "make_refit_entry", "fleet_refit_leaves",
+           "make_fleet_refit_entry"]

@@ -170,7 +170,8 @@ class ContinualRunner:
         if refit_eligible(self._live._gbdt) is None:
             self._refit_entry = make_refit_entry(
                 self._live._gbdt.objective, float(cfg.refit_decay_rate),
-                float(cfg.lambda_l2))
+                float(cfg.lambda_l2),
+                k=self._live._gbdt.num_tree_per_iteration)
 
         # rolling window (raw rows + labels, host): refit traverses raw
         # values, appends bin via the reference mappers — both read it

@@ -1,0 +1,434 @@
+"""FleetBooster: B independent boosters trained as ONE model batch.
+
+The training-side mirror of the multi-tenant serve table (README
+"Booster fleets"): per-tenant personalization wants FLEETS of small
+ensembles over the SAME feature matrix — one binned dataset, B label
+vectors, B independent models.  Trained as a host loop over
+``engine.train`` that costs B dispatches per round plus B python
+drivers; trained here it is ONE donated dispatch per round
+(ops/treegrow_fleet.py::grow_fleet_windowed) plus one batched gradient
+dispatch and one batched score-update dispatch per boosting iteration,
+at ANY B.
+
+Parity bar (tests/test_fleet_train.py): every lane of the fleet is
+BITWISE identical to the same model trained alone through the
+single-model windowed grower — float and int8-quantized.  The batched
+gradient/update jits reproduce the solo iteration's op sequence
+elementwise over the (B, N) plane (the allowlisted objectives are
+elementwise in score/label, so broadcasting IS the solo computation),
+and the grower itself vmaps the solo round body (see the fleet op's
+module docstring for the W-schedule argument).
+
+Early stop is DEVICE-SIDE: per-lane round budgets fold into the row
+mask inside the batched gradient jit (``rounds > it``), so a finished
+lane rides as a no-op lane — single-leaf tree, -0.0 root leaf, bitwise
+score passthrough — and the host loop never branches per lane.  Budget
+trees past a lane's horizon are simply not materialized.
+
+Serving: each lane is a `_FleetLane` — a GBDT whose host trees
+materialize lazily out of the fleet's STACKED device storage (one
+``np.asarray`` per iteration for the whole fleet, numpy lane views
+after that) and lower into the standard ``_packed`` serve layout.  Lane
+packs mint their lock through the locktrace factories and join the
+``_pack_version`` invalidation protocol (PR 16 discipline), so
+fleet-trained models serve through ``ServingRuntime`` unchanged.
+
+Envelope (gated loudly in ``_check_envelope``): the fused windowed
+grower's single-device numerical envelope with k=1 elementwise
+objectives — no bagging/GOSS, no feature sampling, no categorical
+features, no EFB, no monotone/interaction/forced constraints, no
+linear leaves, no ranking, no multiclass.  Everything outside belongs
+to a solo ``engine.train`` run; jaxlint R18 flags the host-loop
+anti-pattern the other direction.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..objectives import Objective, create_objective
+from ..obs import metrics as _obs
+from ..ops.treegrow import TreeArrays
+from ..ops.treegrow_fleet import grow_fleet_windowed
+from ..utils import locktrace as _lt
+from ..utils import sanitizer as _san
+from ..utils.log import set_verbosity
+from .gbdt import GBDT
+from .tree import Tree, tree_from_device
+
+# objectives whose gradients are elementwise in (score, label) and carry
+# no per-lane traced state beyond BinaryLogloss.pos_weight (folded to a
+# (B, 1) broadcast below) — the set the batched gradient jit can serve
+# bitwise-identically to B solo calls
+_FLEET_OBJECTIVES = (
+    "RegressionL2", "RegressionHuber", "RegressionFair",
+    "RegressionPoisson", "RegressionGamma", "RegressionTweedie",
+    "BinaryLogloss", "CrossEntropy",
+)
+
+
+class FleetError(ValueError):
+    """A configuration outside the fleet envelope (module docstring)."""
+
+
+def _check_envelope(cfg: Config, objective: Objective, proto: GBDT,
+                    train_set) -> None:
+    bad: List[str] = []
+    if cfg.num_tree_per_iteration != 1:
+        bad.append("multiclass objectives (num_tree_per_iteration > 1)")
+    if type(objective).__name__ not in _FLEET_OBJECTIVES:
+        bad.append(f"objective {cfg.objective!r} (fleet gradients must be "
+                   "elementwise; supported: regression/huber/fair/poisson/"
+                   "gamma/tweedie/binary/cross_entropy)")
+    if getattr(objective, "need_renew", False):
+        bad.append(f"objective {cfg.objective!r} needs leaf renewal")
+    if proto.average_output or cfg.boosting not in ("gbdt",):
+        bad.append(f"boosting={cfg.boosting!r} (gbdt only)")
+    if cfg.data_sample_strategy == "goss":
+        bad.append("GOSS sampling")
+    if cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0
+                                 or cfg.pos_bagging_fraction < 1.0
+                                 or cfg.neg_bagging_fraction < 1.0):
+        bad.append("bagging")
+    if cfg.feature_fraction < 1.0 or cfg.feature_fraction_bynode < 1.0 \
+            or proto._needs_node_rng:
+        bad.append("feature sampling / extra_trees")
+    if proto._monotone is not None:
+        bad.append("monotone constraints")
+    if proto._interaction_sets is not None:
+        bad.append("interaction constraints")
+    if proto._forced_schedule() is not None:
+        bad.append("forced splits")
+    if proto._linear:
+        bad.append("linear trees")
+    if proto._categorical_mask is not None:
+        bad.append("categorical features")
+    if getattr(train_set, "efb", None) is not None:
+        bad.append("EFB bundles")
+    if cfg.num_machines > 1 or jax.process_count() > 1:
+        bad.append("multi-host runs")
+    if proto._cegb_lazy is not None or proto._cegb_coupled is not None:
+        bad.append("CEGB penalties")
+    if bad:
+        raise FleetError(
+            "train_fleet: configuration outside the fleet envelope — "
+            + "; ".join(bad)
+            + ". Train these models through engine.train instead "
+            "(models/fleet.py module docstring).")
+
+
+class FleetBooster:
+    """B independent k=1 boosters over one shared binned dataset.
+
+    ``labels`` is (B, N); ``weights`` optionally (B, N).  ``rounds``
+    optionally gives PER-LANE boosting-round budgets (device-side early
+    stop); lanes past their budget ride as no-op lanes.  Call
+    :meth:`train` once, then :meth:`booster` / :meth:`boosters` for
+    standard per-lane ``Booster`` handles (predict/save/serve/refit).
+    """
+
+    def __init__(self, train_set, labels, params=None, *,
+                 weights=None, rounds: Optional[Sequence[int]] = None):
+        self.params = dict(params or {})
+        self.cfg = Config.from_dict(dict(self.params))
+        set_verbosity(self.cfg.verbosity)
+        labels = np.asarray(labels, np.float64)
+        if labels.ndim != 2 or labels.shape[0] < 1:
+            raise FleetError(
+                f"train_fleet: labels must be (B, N), got {labels.shape}")
+        self.fleet_size, n = labels.shape
+        if self.cfg.fleet_size and self.cfg.fleet_size != self.fleet_size:
+            raise FleetError(
+                f"train_fleet: fleet_size={self.cfg.fleet_size} does not "
+                f"match labels.shape[0]={self.fleet_size}")
+        self._labels = labels
+        self._weights = None
+        if weights is not None:
+            self._weights = np.asarray(weights, np.float64)
+            if self._weights.shape != labels.shape:
+                raise FleetError(
+                    f"train_fleet: weights must match labels {labels.shape},"
+                    f" got {self._weights.shape}")
+
+        # lane 0's label/weight become the shared Dataset's so the proto
+        # GBDT below prepares/boosts lane 0 through the EXACT solo path
+        train_set.set_field("label", labels[0])
+        if self._weights is not None:
+            train_set.set_field("weight", self._weights[0])
+        self._objectives = [create_objective(self.cfg)
+                            for _ in range(self.fleet_size)]
+        # the prototype solo model: constructs the dataset, and derives
+        # every shared training input exactly as a solo run would —
+        # _split_params, _allowed_features (feature_pre_filter), leaf
+        # tile, lane 0's objective.prepare + boost_from_average init
+        self._proto = GBDT(self.cfg, train_set, objective=self._objectives[0])
+        self.train_set = train_set
+        self.binner = self._proto.binner
+        self.feature_names = list(self._proto.feature_names)
+        if train_set.num_data() != n:
+            raise FleetError(
+                f"train_fleet: labels are (B, {n}) but the dataset has "
+                f"{train_set.num_data()} rows")
+        _check_envelope(self.cfg, self._objectives[0], self._proto, train_set)
+
+        # per-lane objective state + init scores through the solo host
+        # math (bitwise vs a solo run's reset_training_data); lane 0 is
+        # already done by the proto's reset
+        self.init_scores = [0.0] * self.fleet_size
+        if self.cfg.boost_from_average:
+            self.init_scores[0] = float(self._proto.init_scores[0])
+        for b in range(1, self.fleet_size):
+            obj = self._objectives[b]
+            wb = None if self._weights is None else self._weights[b]
+            if hasattr(obj, "prepare"):
+                obj.prepare(labels[b], wb)
+            if self.cfg.boost_from_average:
+                self.init_scores[b] = float(obj.boost_from_score(
+                    jnp.asarray(labels[b], jnp.float32),
+                    None if wb is None else jnp.asarray(wb, jnp.float32)))
+
+        init = np.zeros((self.fleet_size, n), np.float32)
+        init += np.asarray(self.init_scores, np.float32)[:, None]
+        self._score = jnp.asarray(init)
+        self._bad = jnp.zeros((self.fleet_size,), jnp.int32)
+
+        self._label_d = jnp.asarray(labels, jnp.float32)
+        self._weight_d = (None if self._weights is None
+                          else jnp.asarray(self._weights, jnp.float32))
+        if rounds is None:
+            self._rounds = None  # filled by train()
+        else:
+            self._rounds = np.asarray(rounds, np.int64)
+            if self._rounds.shape != (self.fleet_size,) \
+                    or (self._rounds < 0).any():
+                raise FleetError(
+                    "train_fleet: rounds must be B non-negative per-lane "
+                    f"budgets, got {rounds!r}")
+
+        # the gradient objective the batched jit traces: a fresh instance
+        # whose only per-lane state (BinaryLogloss is_unbalance pos
+        # weight) is folded to a (B, 1) device constant — the broadcast
+        # against (B, N) reproduces each lane's solo f32 arithmetic
+        self._grad_obj = create_objective(self.cfg)
+        pw = np.asarray([float(getattr(o, "pos_weight", 1.0))
+                         for o in self._objectives], np.float32)
+        if (pw != 1.0).any():
+            self._grad_obj.pos_weight = jnp.asarray(pw)[:, None]
+
+        self._iters: List[tuple] = []  # [(stacked TreeArrays, shrinkage)]
+        self._host_cache: dict = {}  # iteration -> host (np) TreeArrays
+        self._lanes: dict = {}  # lane -> _FleetLane
+        self._prep = None
+        self._update = None
+        self._trained = False
+
+    # -- batched per-iteration jits ------------------------------------
+    def _build_jits(self, rounds_d: jnp.ndarray):
+        gobj, label_d, weight_d = self._grad_obj, self._label_d, self._weight_d
+
+        @jax.jit
+        # jaxlint: disable=R2 (built ONCE per fleet: train() is once-only and caches self._prep)
+        def prep(score, it):
+            # the solo iteration's gradient call, elementwise over (B, N);
+            # per-lane budgets fold into the row mask HERE (device-side
+            # early stop: a masked lane admits nothing downstream)
+            g, h = gobj.get_gradients(score, label_d, weight_d)
+            active = rounds_d > it
+            rm = jnp.broadcast_to(active[:, None], g.shape)
+            return g, h, rm
+
+        @jax.jit
+        # jaxlint: disable=R2 (built ONCE per fleet: train() is once-only and caches self._update)
+        def update(score, bad, lv_b, sg_b, lid_b, shrink, it):
+            # solo: score + (leaf_value * f32(shrinkage))[leaf_id], per
+            # lane via one take_along_axis; the per-lane non-finite guard
+            # (gbdt.py::_guard_accumulate) rides the same dispatch
+            delta = jnp.take_along_axis(lv_b * shrink, lid_b, axis=1)
+            ok = (jnp.isfinite(lv_b).all(axis=1)
+                  & ~jnp.isnan(sg_b).any(axis=1))
+            bad = jnp.where((bad == 0) & ~ok, it + 1, bad)
+            return score + delta, bad
+
+        self._prep, self._update = prep, update
+
+    # -- training ------------------------------------------------------
+    def train(self, num_boost_round: int = 100) -> "FleetBooster":
+        """Run the whole fleet ``num_boost_round`` iterations (lanes with
+        a smaller per-lane budget stop early ON DEVICE).  One call per
+        fleet; lanes are immutable afterwards."""
+        if self._trained:
+            raise FleetError("train_fleet: a FleetBooster trains once")
+        self._trained = True
+        cfg, ts, proto = self.cfg, self.train_set, self._proto
+        b = self.fleet_size
+        if self._rounds is None:
+            self._rounds = np.full((b,), int(num_boost_round), np.int64)
+        num_boost_round = int(max(self._rounds.max(), 0))
+        rounds_d = jnp.asarray(self._rounds, jnp.int32)
+        self._build_jits(rounds_d)
+
+        telemetry_on = (bool(cfg.telemetry) if cfg.is_set("telemetry")
+                        else _obs.DEFAULT_ENABLED)
+        _obs.set_enabled(telemetry_on)
+        _obs.gauge("fleet_models").set(float(b))
+        _obs.counter("train_fleet_models_total").inc(b)
+
+        n = ts.num_data()
+        bins_t = ts.bins_device_t()
+        sample_weight = jnp.ones((b, n), jnp.float32)
+        feature_mask = proto._allowed_features
+        quant = bool(cfg.use_quantized_grad)
+        shrinkage = 1.0 if proto.average_output else cfg.learning_rate
+        shrink_d = jnp.float32(shrinkage)
+        for it in range(num_boost_round):
+            t0 = time.perf_counter()
+            c0 = _san.compile_totals()["compiles"]
+            g, h, rm = self._prep(self._score, jnp.int32(it))
+            stats: dict = {}
+            arrays_b, lid_b = grow_fleet_windowed(
+                bins_t, g, h, rm, sample_weight, feature_mask,
+                ts.num_bins_pf_device, ts.missing_bin_pf_device,
+                (jax.random.PRNGKey(cfg.seed * 1000003 + it * 31)
+                 if quant else None),
+                num_leaves=cfg.num_leaves,
+                num_bins=ts.max_num_bins,
+                max_depth=cfg.max_depth,
+                params=proto._split_params,
+                leaf_tile=proto._leaf_tile(ts),
+                hist_precision=cfg.hist_precision,
+                use_pallas=proto._on_tpu,
+                quantize_bins=(cfg.num_grad_quant_bins if quant else 0),
+                stochastic_rounding=bool(cfg.stochastic_rounding),
+                quant_renew=bool(cfg.quant_train_renew_leaf),
+                stats=stats,
+                guard_label=f" (fleet iteration {it + 1})")
+            self._score, self._bad = self._update(
+                self._score, self._bad, arrays_b.leaf_value,
+                arrays_b.split_gain, lid_b, shrink_d, jnp.int32(it))
+            self._iters.append((arrays_b, shrinkage))
+            _obs.event(
+                "fleet_round", models=b, iteration=it + 1,
+                rounds=stats.get("rounds"),
+                dispatches=stats.get("dispatches"),
+                host_syncs=stats.get("host_syncs"),
+                retries=stats.get("retries"),
+                compiles=_san.compile_totals()["compiles"] - c0,
+                ms=round((time.perf_counter() - t0) * 1e3, 3))
+        return self
+
+    # -- guard + materialization ---------------------------------------
+    def _guard_check(self) -> None:
+        bad = np.asarray(self._bad)
+        if bad.any():
+            from ..utils.guards import NonFiniteError
+
+            lanes = np.nonzero(bad)[0].tolist()
+            _obs.counter("train_nonfinite_errors_total").inc()
+            _obs.event("nonfinite", phase="fleet_guard",
+                       lanes=lanes[:16], iteration=int(bad[bad > 0].min()))
+            raise NonFiniteError(
+                f"non-finite leaf values entered fleet lane(s) {lanes[:16]} "
+                f"at boosting iteration {int(bad[bad > 0].min())}; retrain "
+                "the named lanes solo to isolate the offending labels "
+                "(docs/ROBUSTNESS.md)")
+
+    def _host_iter(self, i: int) -> TreeArrays:
+        """Host view of iteration ``i``'s STACKED trees — one device pull
+        for all B lanes, numpy slices per lane after that."""
+        cached = self._host_cache.get(i)
+        if cached is None:
+            arrays_b = self._iters[i][0]
+            cached = TreeArrays(*(None if x is None else np.asarray(x)
+                                  for x in arrays_b))
+            self._host_cache[i] = cached
+        return cached
+
+    def _lane_trees(self, lane: int) -> List[Tree]:
+        """Lane ``lane``'s host trees (budget-trimmed, shrinkage applied)
+        — the solo _flush_pending path on numpy lane views."""
+        self._guard_check()
+        trees = []
+        for i in range(min(int(self._rounds[lane]), len(self._iters))):
+            ab = self._host_iter(i)
+            view = TreeArrays(*(None if x is None else x[lane] for x in ab))
+            tree = tree_from_device(view, self.binner)
+            tree.apply_shrinkage(self._iters[i][1])
+            trees.append(tree)
+        return trees
+
+    # -- per-lane serving handles --------------------------------------
+    def _lane(self, b: int) -> "_FleetLane":
+        if not 0 <= b < self.fleet_size:
+            raise IndexError(f"fleet lane {b} out of range "
+                             f"[0, {self.fleet_size})")
+        lane = self._lanes.get(b)
+        if lane is None:
+            lane = self._lanes[b] = _FleetLane(self, b)
+        return lane
+
+    def booster(self, b: int):
+        """A standard :class:`~lightgbm_tpu.basic.Booster` over lane ``b``
+        (predict / save_model / ServingRuntime / Booster.refit)."""
+        from ..basic import Booster
+
+        bst = Booster.__new__(Booster)
+        bst.params = dict(self.params)
+        bst.best_iteration = -1
+        bst.best_score = {}
+        bst._train_set = self.train_set
+        bst.cfg = self.cfg
+        bst._gbdt = self._lane(b)
+        return bst
+
+    def boosters(self) -> List:
+        return [self.booster(b) for b in range(self.fleet_size)]
+
+    @property
+    def num_iterations(self) -> np.ndarray:
+        """Per-lane trained iteration counts (the ``rounds`` budgets)."""
+        return (np.zeros(self.fleet_size, np.int64) if self._rounds is None
+                else self._rounds.copy())
+
+
+class _FleetLane(GBDT):
+    """One fleet lane as a serve/export-only GBDT: host trees materialize
+    lazily from the fleet's stacked storage and flow through the standard
+    ``_packed`` layout, version protocol and lock discipline — the pack
+    lock is minted through the locktrace factories under its own name so
+    lock-order traces attribute fleet serving correctly (PR 16)."""
+
+    def __init__(self, fleet: FleetBooster, lane: int):
+        super().__init__(fleet.cfg, None, objective=fleet._objectives[lane])
+        self._fleet = fleet
+        self._lane_idx = lane
+        self._lane_materialized = False
+        self._pack_lock = _lt.rlock("fleet.pack")
+        self.binner = fleet.binner
+        self.feature_names = list(fleet.feature_names)
+        self.train_set = fleet.train_set
+        self.init_scores = [fleet.init_scores[lane]]
+        self.iter_ = min(int(fleet._rounds[lane]), len(fleet._iters))
+
+    @property
+    def models(self) -> List[Tree]:
+        if not self._lane_materialized:
+            self._models = self._fleet._lane_trees(self._lane_idx)
+            self._lane_materialized = True
+        return self._models
+
+    @models.setter
+    def models(self, value) -> None:
+        self._lane_materialized = True
+        GBDT.models.fset(self, value)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        raise FleetError(
+            "fleet lanes are serve/export-only: grow the fleet through "
+            "train_fleet (continual refresh: continual_refit_leaves / "
+            "fleet_refit_leaves)")

@@ -1078,7 +1078,7 @@ def _grow_windowed_impl(
 
 def _run_fused_rounds(round_fn, state, *, n_ladder: int, w_first: int,
                       num_leaves: int, stats: Optional[dict],
-                      guard_label: str):
+                      guard_label: str, floor: int = 8192):
     """The one-dispatch/zero-sync round protocol (module docstring),
     factored out of :func:`_grow_windowed_impl` so the SPMD driver
     (parallel/data_parallel.py::grow_tree_windowed_data_parallel) runs
@@ -1087,7 +1087,12 @@ def _run_fused_rounds(round_fn, state, *, n_ladder: int, w_first: int,
     over a shard_mapped round.  ``round_fn(state, W) -> (state', info)``
     must be a single donated dispatch; ``n_ladder`` is the row count the
     W ladder quantizes against (the LOCAL shard size under SPMD: W bounds
-    each rank's own window)."""
+    each rank's own window).  ``floor`` is the ladder's minimum rung:
+    8192 per ROUND for the solo/SPMD growers (compile-cost bound — each W
+    is its own Mosaic compile), but a BATCHED round (treegrow_fleet.py)
+    quantizes the floor on the total live window across the batch, so
+    its per-lane floor shrinks as 8192/B; W padding is row masking only,
+    so the grown trees are bitwise invariant to the floor."""
     prof = os.environ.get("LGBMTPU_WPROF") == "1"
     enforce = os.environ.get("LGBMTPU_DISPATCH_BUDGET") == "1"
     n = n_ladder
@@ -1147,7 +1152,7 @@ def _run_fused_rounds(round_fn, state, *, n_ladder: int, w_first: int,
                 # whint that will ladder W two dispatches later — one
                 # trace now answers whether whint overshoots the
                 # realized windows (rows vs W per rung)
-                rung = _window_rung(w_ran, n)
+                rung = _window_rung(w_ran, n, floor)
                 _trace.record_span(
                     "windowed_round",
                     t_now - (t_resolve_prev if t_resolve_prev is not None
@@ -1180,13 +1185,13 @@ def _run_fused_rounds(round_fn, state, *, n_ladder: int, w_first: int,
                 # workload property): the device skipped the round; fold the
                 # corrected W into the next dispatch instead of syncing
                 retries += 1
-                W = _window_size(max(total, 1), n)
+                W = _window_size(max(total, 1), n, floor)
                 continue
             n_leaves += k_acc
             if k_acc == 0 or n_leaves >= num_leaves:
                 converged = True
                 break
-            W = _window_size(max(whint, 1), n)
+            W = _window_size(max(whint, 1), n, floor)
         # drain the in-flight round's info so its finite flag is checked
         # too (the pipeline runs one dispatch ahead of the resolve point;
         # without the drain, corruption in the final rounds would slip
@@ -1201,7 +1206,7 @@ def _run_fused_rounds(round_fn, state, *, n_ladder: int, w_first: int,
                 # round of a tree resolves HERE, one dispatch behind), and
                 # this resolve is just as accounted as the in-loop one
                 t_now = _time.perf_counter()
-                rung = _window_rung(windows[resolved - 1], n)
+                rung = _window_rung(windows[resolved - 1], n, floor)
                 _trace.record_span(
                     "windowed_round",
                     t_now - (t_resolve_prev if t_resolve_prev is not None

@@ -214,6 +214,109 @@ def test_device_refit_matches_host_refit_and_budget():
     d.assert_no_recompile("warm continual refit")
 
 
+def test_multiclass_refit_matches_host_and_budget():
+    """Round 20: the k-aware scan renews a multiclass ensemble — device
+    refit vs the host ``Booster.refit`` recipe, determinism, and the
+    1-dispatch/1-sync budget all hold at k=3."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(400, 6)
+    y = rng.randint(0, 3, 400).astype(float)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbosity": -1, "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4)
+    Xn = rng.randn(400, 6)
+    yn = rng.randint(0, 3, 400).astype(float)
+    host = bst.refit(Xn, yn, decay_rate=0.9)
+
+    clone = lgb.Booster(model_str=bst.model_to_string())
+    clone._gbdt.cfg = bst.cfg
+    entry = make_refit_entry(clone._gbdt.objective, 0.9,
+                             clone._gbdt.cfg.lambda_l2, k=3)
+    refit_leaves(clone._gbdt, Xn, yn, entry=entry)
+    a = host.predict(X[:64], raw_score=True)
+    b = clone.predict(X[:64], raw_score=True)
+    assert np.abs(a - b).max() < 1e-4, np.abs(a - b).max()
+
+    # determinism + budget: same refit twice is BITWISE the same model,
+    # one donated dispatch + one accounted sync, no recompile
+    clone2 = lgb.Booster(model_str=bst.model_to_string())
+    clone2._gbdt.cfg = bst.cfg
+    with DispatchCounter() as d:
+        refit_leaves(clone2._gbdt, Xn, yn, entry=entry)
+    assert clone.model_to_string() == clone2.model_to_string()
+    assert d.dispatches == 1 and d.host_syncs == 1, (d.dispatches,
+                                                     d.host_syncs)
+    d.assert_no_recompile("warm multiclass refit")
+
+
+def test_weighted_refit_matches_host_and_weight_flows():
+    """Round 20: ``weight=`` reaches objective.get_gradients — device vs
+    the host ``Booster.refit(weight=...)``, and weighted != unweighted."""
+    bst, ds, X, y, rng = _setup()
+    Xn, yn = _chunk(rng, n=300)
+    w = rng.uniform(0.5, 2.0, len(yn))
+    host = bst.refit(Xn, yn, decay_rate=0.9, weight=w)
+
+    clone = lgb.Booster(model_str=bst.model_to_string())
+    clone._gbdt.cfg = bst._gbdt.cfg
+    refit_leaves(clone._gbdt, Xn, yn, weight=w)
+    a = host.predict(X[:64], raw_score=True)
+    b = clone.predict(X[:64], raw_score=True)
+    assert np.abs(a - b).max() < 1e-4, np.abs(a - b).max()
+
+    # the weights actually flow: unweighted refit lands elsewhere
+    unw = lgb.Booster(model_str=bst.model_to_string())
+    unw._gbdt.cfg = bst._gbdt.cfg
+    refit_leaves(unw._gbdt, Xn, yn)
+    assert np.abs(b - unw.predict(X[:64], raw_score=True)).max() > 1e-7
+
+
+def test_fleet_refit_one_dispatch_matches_per_lane_solo():
+    """The batched twin: B lanes renewed in ONE donated dispatch + ONE
+    accounted sync, each lane's result equal (to f32 resolution) to a
+    solo refit_leaves of that lane — weighted and unweighted."""
+    from lightgbm_tpu.continual import fleet_refit_leaves
+
+    rng = np.random.RandomState(11)
+    B, N, F = 4, 400, 6
+    X = rng.randn(N, F)
+    labels = np.stack([(X[:, 0] + rng.randn(N) > 0).astype(float)
+                       for _ in range(B)])
+    fb = lgb.train_fleet(dict(PARAMS), lgb.Dataset(X), labels,
+                         num_boost_round=3)
+    Xn = rng.randn(N, F)
+    labels_n = np.stack([(Xn[:, 0] > 0).astype(float) for _ in range(B)])
+    W = rng.uniform(0.5, 2.0, (B, N))
+
+    for weights in (None, W):
+        fb2 = lgb.train_fleet(dict(PARAMS), lgb.Dataset(X), labels,
+                              num_boost_round=3)
+        solo = []
+        for b in range(B):
+            cp = lgb.Booster(model_str=fb.booster(b).model_to_string())
+            cp._gbdt.cfg = fb.booster(b).cfg
+            refit_leaves(cp._gbdt, Xn, labels_n[b],
+                         weight=None if weights is None else weights[b])
+            solo.append(cp)
+        with DispatchCounter() as d:
+            fleet_refit_leaves(fb2, Xn, labels_n, weights=weights)
+        assert d.dispatches == 1 and d.host_syncs == 1, (d.dispatches,
+                                                         d.host_syncs)
+        for b in range(B):
+            ps = np.asarray(solo[b].predict(Xn[:64], raw_score=True))
+            pf = np.asarray(fb2.booster(b).predict(Xn[:64], raw_score=True))
+            assert np.abs(ps - pf).max() < 1e-5, (weights is not None, b)
+
+    # envelope: a multiclass lane refuses loudly
+    ymc = rng.randint(0, 3, N).astype(float)
+    mc = lgb.train({"objective": "multiclass", "num_class": 3,
+                    "num_leaves": 7, "verbosity": -1,
+                    "min_data_in_leaf": 5},
+                   lgb.Dataset(X, label=ymc), num_boost_round=2)
+    with pytest.raises(ContinualError):
+        fleet_refit_leaves([mc], Xn, labels_n[:1])
+
+
 def test_runner_rollovers_bitwise_equal_offline_application(tmp_path):
     """The under-load runner path IS the offline path: replaying the
     same ingest/update sequence offline reproduces the runner's ensemble
@@ -501,7 +604,8 @@ def test_time_policy_update_every_s():
 # ---------------------------------------------------------------------------
 
 def test_envelope_refusals():
-    # multiclass: device refit refuses (structure-only scan is k=1)
+    # multiclass: refused through round 19; round 21's k-aware scan makes
+    # it ELIGIBLE — pin that the runner refits a k=3 model without error
     rng = np.random.RandomState(1)
     X = rng.randn(300, 5)
     y = rng.randint(0, 3, 300).astype(float)
@@ -511,8 +615,7 @@ def test_envelope_refusals():
     mc.update()
     cr = lgb.continual_train(mc, {}, start=False)
     cr.ingest(X[:50], y[:50])
-    with pytest.raises(ContinualError):
-        cr.update("refit")
+    assert cr.update("refit") == "refit"
 
     # append without frozen mappers refuses
     bst, ds, _, _, rng2 = _setup()
@@ -533,23 +636,24 @@ def test_envelope_refusals():
 
 
 def test_auto_update_falls_back_to_append_when_refit_ineligible():
-    """A refit-ineligible ensemble (multiclass) with append_trees
-    configured: auto updates take the append path instead of failing
-    toward the refit the envelope already refused."""
+    """A refit-ineligible ensemble (linear leaves — multiclass became
+    eligible in round 21) with append_trees configured: auto updates
+    take the append path instead of failing toward the refit the
+    envelope already refused."""
     rng = np.random.RandomState(2)
     Xm = rng.randn(300, 5)
-    ym = rng.randint(0, 3, 300).astype(float)
+    ym = (Xm[:, 0] + 0.1 * rng.randn(300)).astype(float)
     dsm = lgb.Dataset(Xm, label=ym)
-    mc = lgb.Booster(params={"objective": "multiclass", "num_class": 3,
-                             "num_leaves": 5, "verbosity": -1},
-                     train_set=dsm)
-    mc.update()
-    cr = lgb.continual_train(mc, {"update_every_rows": 50,
-                                  "append_trees": 1},
+    lin = lgb.Booster(params={"objective": "regression", "linear_tree": True,
+                              "num_leaves": 5, "verbosity": -1},
+                      train_set=dsm)
+    lin.update()
+    cr = lgb.continual_train(lin, {"update_every_rows": 50,
+                                   "append_trees": 1},
                              reference=dsm, start=False)
     cr.ingest(Xm[:60], ym[:60])
     assert cr.update("auto") == "append"
-    assert cr.booster.num_trees() == 6  # 3 + 1 iteration x 3 classes
+    assert cr.booster.num_trees() == 2  # 1 + 1 appended iteration
 
 
 def test_window_overflow_evicts_pending_rows_honestly():
@@ -573,15 +677,16 @@ def test_window_overflow_evicts_pending_rows_honestly():
 
 
 def test_runner_thread_failure_backoff_and_healthz():
-    """A deterministically failing update (multiclass refit-only runner)
-    backs off exponentially instead of retrying at tick cadence, and the
-    failure counter flips /healthz degraded."""
+    """A deterministically failing update (linear-leaf refit-only runner
+    — refit refuses linear models and no append is configured) backs off
+    exponentially instead of retrying at tick cadence, and the failure
+    counter flips /healthz degraded."""
     from lightgbm_tpu.obs import server as _srv
 
     rng = np.random.RandomState(3)
     Xm = rng.randn(200, 4)
-    ym = rng.randint(0, 3, 200).astype(float)
-    mc = lgb.Booster(params={"objective": "multiclass", "num_class": 3,
+    ym = (Xm[:, 0] + 0.1 * rng.randn(200)).astype(float)
+    mc = lgb.Booster(params={"objective": "regression", "linear_tree": True,
                              "num_leaves": 5, "verbosity": -1},
                      train_set=lgb.Dataset(Xm, label=ym))
     mc.update()

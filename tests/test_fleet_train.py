@@ -1,0 +1,202 @@
+"""Booster-fleet training (round 21): B independent boosters per
+dispatch (ops/treegrow_fleet.py + models/fleet.py + lgb.train_fleet).
+
+The parity bar (ISSUE 17 acceptance): EVERY lane of a B=64 fleet is
+BITWISE identical to the same model trained alone through the
+single-model windowed grower — tree arrays field by field AND the final
+raw scores — float and int8-quantized.  The fleet's W ladder floors at
+8192/B per lane (the batch-total live window is what the solo 8192
+compile-cost floor bounds), so the pin also proves the grown trees are
+bitwise invariant to the window floor.  The warm per-round budget
+(1 dispatch / 0 syncs / 0 retraces at any B) lives in test_retrace.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import FleetError
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+          "min_data_in_leaf": 5, "seed": 3}
+
+FIELDS = ("num_leaves", "split_feature", "threshold_bin", "leaf_value",
+          "left_child", "right_child", "default_left", "split_gain")
+
+
+def _data(b, n, f, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.rand(n, f), (rng.rand(b, n) > 0.5).astype(np.float64)
+
+
+def _solo(X, label, params, rounds):
+    """One model through the exact solo op sequence the fleet vmaps:
+    objective prepare/boost_from_score, then per round gradients ->
+    windowed grower (8192 floor) -> score update.  Returns the per-round
+    TreeArrays and the final raw score."""
+    cfg = Config.from_dict(dict(params))
+    ds = lgb.Dataset(X, label=label, params={"verbosity": -1})
+    proto = GBDT(cfg, ds, objective=create_objective(cfg))
+    n = X.shape[0]
+    quant = bool(cfg.use_quantized_grad)
+    obj = create_objective(cfg)
+    if hasattr(obj, "prepare"):
+        obj.prepare(label, None)
+    init = float(obj.boost_from_score(jnp.asarray(label, jnp.float32), None))
+    score = jnp.asarray(np.zeros(n, np.float32) + np.float32(init))
+    lab_d = jnp.asarray(label, jnp.float32)
+    rm = jnp.ones((n,), bool)
+    sw = jnp.ones((n,), jnp.float32)
+    iters = []
+    for it in range(rounds):
+        g, h = obj.get_gradients(score, lab_d, None)
+        qk = (jax.random.PRNGKey(cfg.seed * 1000003 + it * 31)
+              if quant else None)
+        arrays, leaf_id = grow_tree_windowed(
+            ds.bins_device_t(), g, h, rm, sw, proto._allowed_features,
+            ds.num_bins_pf_device, ds.missing_bin_pf_device, None, qk,
+            None, None, None, None, None,
+            num_leaves=cfg.num_leaves, num_bins=ds.max_num_bins,
+            max_depth=cfg.max_depth, params=proto._split_params,
+            leaf_tile=proto._leaf_tile(ds),
+            hist_precision=cfg.hist_precision, use_pallas=False,
+            quantize_bins=(cfg.num_grad_quant_bins if quant else 0),
+            stochastic_rounding=bool(cfg.stochastic_rounding),
+            quant_renew=bool(cfg.quant_train_renew_leaf))
+        score = score + (arrays.leaf_value
+                         * jnp.float32(cfg.learning_rate))[leaf_id]
+        iters.append(arrays)
+    return iters, np.asarray(score)
+
+
+def _assert_lane_bitwise(fb, lane, iters, score, rounds):
+    for it in range(rounds):
+        fl = fb._host_iter(it)
+        for fld in FIELDS:
+            a = np.asarray(getattr(iters[it], fld))
+            f = getattr(fl, fld)[lane]
+            assert np.array_equal(a, f, equal_nan=True), (
+                f"lane {lane} iter {it} field {fld} diverged from solo")
+    assert np.array_equal(np.asarray(fb._score[lane]), score), (
+        f"lane {lane} final score diverged from solo")
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["float", "int8"])
+def test_b64_fleet_bitwise_equals_solo_grower(quant):
+    """ISSUE 17 acceptance: every model in a B=64 batch bitwise == its
+    solo windowed-grower run, float AND int8-quantized."""
+    B, N, F, R = 64, 300, 6, 3 if not quant else 2
+    params = dict(PARAMS)
+    if quant:
+        params.update(use_quantized_grad=True, num_grad_quant_bins=16)
+    X, labels = _data(B, N, F)
+    ds = lgb.Dataset(X, label=labels[0], params={"verbosity": -1})
+    fb = lgb.train_fleet(dict(params), ds, labels, num_boost_round=R)
+    for lane in range(B):
+        iters, score = _solo(X, labels[lane], params, R)
+        _assert_lane_bitwise(fb, lane, iters, score, R)
+
+
+def test_weighted_fleet_bitwise_equals_solo_and_weights_flow():
+    """Per-lane (B, N) sample weights reach each lane's gradients: the
+    weighted fleet matches the weighted solo run bitwise and differs
+    from the unweighted one."""
+    B, N, F, R = 4, 250, 5, 2
+    X, labels = _data(B, N, F, seed=11)
+    rng = np.random.RandomState(12)
+    weights = 0.25 + rng.rand(B, N)
+    ds = lgb.Dataset(X, label=labels[0], params={"verbosity": -1})
+    fb = lgb.train_fleet(dict(PARAMS), ds, labels, num_boost_round=R,
+                         weights=weights)
+    for lane in range(B):
+        ds1 = lgb.Dataset(X, label=labels[lane], params={"verbosity": -1})
+        solo = lgb.train_fleet(dict(PARAMS), ds1, labels[lane:lane + 1],
+                               num_boost_round=R,
+                               weights=weights[lane:lane + 1])
+        Q = X[:64]
+        assert np.array_equal(
+            fb.booster(lane).predict(Q, raw_score=True),
+            solo.booster(0).predict(Q, raw_score=True))
+    ds1 = lgb.Dataset(X, label=labels[0], params={"verbosity": -1})
+    unw = lgb.train_fleet(dict(PARAMS), ds1, labels[0:1], num_boost_round=R)
+    assert not np.array_equal(
+        fb.booster(0).predict(X[:64], raw_score=True),
+        unw.booster(0).predict(X[:64], raw_score=True)), (
+        "weights did not flow into lane gradients")
+
+
+def test_per_lane_rounds_early_stop_device_side():
+    """``rounds`` gives per-lane budgets: finished lanes ride as no-op
+    lanes (no host-loop exit), each lane exports exactly its budgeted
+    tree count, and budgeted lanes stay bitwise equal to solo runs of
+    the same length."""
+    B, N, F = 4, 250, 5
+    rounds = [1, 4, 2, 4]
+    X, labels = _data(B, N, F, seed=21)
+    ds = lgb.Dataset(X, label=labels[0], params={"verbosity": -1})
+    fb = lgb.train_fleet(dict(PARAMS), ds, labels, num_boost_round=4,
+                         rounds=rounds)
+    assert list(fb.num_iterations) == rounds
+    for lane in range(B):
+        bst = fb.booster(lane)
+        assert bst.num_trees() == rounds[lane]
+        iters, _ = _solo(X, labels[lane], PARAMS, rounds[lane])
+        for it in range(rounds[lane]):
+            fl = fb._host_iter(it)
+            for fld in FIELDS:
+                assert np.array_equal(np.asarray(getattr(iters[it], fld)),
+                                      getattr(fl, fld)[lane],
+                                      equal_nan=True)
+
+
+def test_lane_boosters_serve_and_round_trip():
+    """Per-lane Booster handles behave like standard boosters: predict
+    matches a host walk of the lane's trees + init, model_to_string
+    round-trips through Booster(model_str=...) with identical
+    predictions."""
+    B, N, F, R = 3, 300, 6, 3
+    X, labels = _data(B, N, F, seed=31)
+    ds = lgb.Dataset(X, label=labels[0], params={"verbosity": -1})
+    fb = lgb.train_fleet(dict(PARAMS), ds, labels, num_boost_round=R)
+    Q = np.random.RandomState(32).rand(80, F)
+    for lane in range(B):
+        bst = fb.booster(lane)
+        got = bst.predict(Q, raw_score=True)
+        assert got.shape == (80,)
+        reloaded = lgb.Booster(model_str=bst.model_to_string())
+        np.testing.assert_allclose(
+            reloaded.predict(Q, raw_score=True), got, rtol=0, atol=1e-6)
+        with pytest.raises(FleetError):
+            bst._gbdt.train_one_iter()
+
+
+def test_envelope_and_shape_refusals():
+    """Out-of-envelope configs refuse loudly BEFORE any device work, and
+    fleet_size acts as a shape guard."""
+    B, N, F = 2, 120, 4
+    X, labels = _data(B, N, F, seed=41)
+
+    def fleet(params, **kw):
+        ds = lgb.Dataset(X, label=labels[0], params={"verbosity": -1})
+        return lgb.train_fleet(params, ds, labels, num_boost_round=2, **kw)
+
+    with pytest.raises(FleetError, match="multiclass"):
+        fleet({"objective": "multiclass", "num_class": 3, "verbosity": -1})
+    with pytest.raises(FleetError, match="GOSS"):
+        fleet(dict(PARAMS, data_sample_strategy="goss"))
+    with pytest.raises(FleetError, match="monotone"):
+        fleet(dict(PARAMS, monotone_constraints=[1, 0, 0, 0]))
+    with pytest.raises(FleetError, match="feature sampling"):
+        fleet(dict(PARAMS, feature_fraction=0.5))
+    with pytest.raises(FleetError, match="fleet_size"):
+        fleet(dict(PARAMS, fleet_size=B + 1))
+    # matching fleet_size passes the guard
+    fb = fleet(dict(PARAMS, fleet_size=B))
+    assert fb.booster(0).num_trees() == 2

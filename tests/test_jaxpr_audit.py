@@ -42,6 +42,7 @@ def test_contract_catalogue_pins_the_flagships():
         "predict_warm_single", "predict_warm_multiclass",
         "predict_warm_converted", "predict_coalesced_bucket",
         "ooc_root_chunk", "ooc_split_chunk", "continual_refit_leaves",
+        "fleet_round_batched",
     } <= set(CONTRACTS)
 
 
@@ -67,7 +68,7 @@ def test_single_device_bodies_are_collective_free(report):
                       "predict_warm_single", "predict_warm_multiclass",
                       "predict_warm_converted", "predict_coalesced_bucket",
                       "ooc_root_chunk", "ooc_split_chunk",
-                      "continual_refit_leaves"):
+                      "continual_refit_leaves", "fleet_round_batched"):
             assert r.detail.get("collectives") == [], (r.name, r.detail)
 
 

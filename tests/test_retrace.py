@@ -275,3 +275,39 @@ def test_sharded_windowed_one_dispatch_zero_syncs_per_rank_telemetry_on():
     # the second tree left a windowed_round span, none added a sync
     assert (len(obs_trace.spans("windowed_round")) - spans_before
             == stats["rounds"])
+
+
+def test_fleet_steady_state_one_dispatch_zero_syncs_no_retrace():
+    """ISSUE 17 acceptance: the vmapped fleet round holds the solo
+    steady-state budget at ANY B — exactly ONE donated dispatch and ZERO
+    blocking host pulls per ladder round, ZERO retries, ZERO compiles
+    past warmup — with telemetry + span tracing ON.  Read from the
+    fleet_round event ledger, whose dispatches/host_syncs fields are the
+    driver's own DispatchCounter totals (ops/treegrow_windowed.py
+    _run_fused_rounds), so this is the counter pin, not an inference."""
+    from lightgbm_tpu.obs import metrics as _obs
+
+    rng = np.random.RandomState(17)
+    n, f, R = 300, 5, 5
+    X = rng.rand(n, f)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "min_data_in_leaf": 5, "seed": 3}
+    for B in (2, 16):
+        labels = (rng.rand(B, n) > 0.5).astype(np.float64)
+        ds = lgb.Dataset(X, label=labels[0], params={"verbosity": -1})
+        ev0 = len(_obs.events("fleet_round"))
+        fb = lgb.train_fleet(dict(params), ds, labels, num_boost_round=R)
+        events = _obs.events("fleet_round")[ev0:]
+        assert len(events) == R, "one fleet_round event per iteration"
+        assert all(e["models"] == B for e in events)
+        # warmup may compile (_fleet_init / the round at this rung /
+        # _fleet_finalize + the per-fleet prep/update jits); iterations
+        # past it must be fully warm
+        warm = [e for e in events if e["iteration"] > 2]
+        assert len(warm) == R - 2
+        for e in warm:
+            assert e["dispatches"] == e["rounds"], e
+            assert e["host_syncs"] == 0, e
+            assert e["retries"] == 0, e
+            assert e["compiles"] == 0, e
+        assert int(fb.booster(B - 1).num_trees()) == R
