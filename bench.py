@@ -60,7 +60,10 @@ must flatten on chip); knobs OOC_BENCH_*.
 Serve mode (round 18): BENCH_MODE=serve runs the serving-LOOP benchmark
 (benchmarks/serve_bench.py — K concurrent callers coalesced onto one
 warm executable vs per-request serial predicts, closed + open loop,
-bitwise parity and the jaxpr-audit verdict asserted in-artifact);
+bitwise parity and the jaxpr-audit verdict asserted in-artifact; round
+23 adds the `fleet_chaos` row: a 2-replica ServingFleet losing one
+replica to an injected death mid-open-loop with zero lost requests,
+bitwise parity, and the requeue/restart counts in the artifact);
 knobs SERVE_BENCH_*.
 
 Continual mode (round 19): BENCH_MODE=continual runs the train-while-

@@ -15,6 +15,11 @@ sync + staging.  Two load shapes:
   inter-arrival gap, fixed size cycle — no wall-clock randomness in the
   artifact; the measured latencies are of course wall clock) submitted
   asynchronously, completions collected afterwards.
+* ``fleet_chaos`` (round 23) — the same open-loop schedule against a
+  2-replica ServingFleet with an injected ``replica_death`` mid-run:
+  reports lost-request count (must be 0), bitwise parity, requeue /
+  restart counts and the chaos-run p50/p99 — resilience priced in the
+  same artifact as throughput.
 
 ``parity`` runs first and asserts IN THE ARTIFACT PATH that every
 coalesced response is bitwise the individual ``predict``'s — the same
@@ -235,6 +240,77 @@ def bench_open_loop(g, X, rows):
     _emit()
 
 
+def bench_fleet_chaos(g, X, rows):
+    """Chaos row (round 23): a 2-replica ServingFleet loses one replica
+    to an injected ``replica_death`` mid-open-loop and must lose ZERO
+    admitted requests, keep every response bitwise equal to the warm
+    predict, requeue the failed batch exactly once, and restart the
+    replacement — the resilience numbers published next to the
+    throughput numbers they protect."""
+    from lightgbm_tpu.obs import metrics as _obs
+    from lightgbm_tpu.serve import ServingFleet
+    from lightgbm_tpu.utils import faults as _flt
+
+    n_req, gap_s = 120, 0.002
+    sizes = [rows, 2 * rows, 1, rows]  # deterministic size cycle
+    _warm_ladder(g, X, 16 * max(sizes))
+    d0 = _obs.counter("serve_replica_deaths_total").value
+    q0 = _obs.counter("serve_requeues_total").value
+    r0 = _obs.counter("serve_replica_restarts_total").value
+    fl = ServingFleet(g, replicas=2, max_wait_ms=2, shed_unhealthy=False,
+                      restart_backoff_ms=50, hedge_ms=0)
+    lat, lost = [], 0
+    try:
+        # warm the fleet path with the fault env UNSET: fire() only
+        # advances counters for armed sites, so this never skews the arm
+        fl.predict(X[:rows], raw_score=True, timeout=120)
+        os.environ["LGBMTPU_FAULT"] = "replica_death:0"
+        handles = []
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            target = t0 + i * gap_s
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            handles.append(fl.submit(X[:sizes[i % len(sizes)]],
+                                     raw_score=True))
+        results = []
+        for h in handles:
+            try:
+                results.append(fl.result(h, timeout=120))
+                lat.append(h.t_done - h.t0)
+            except Exception:  # noqa: BLE001 — a lost admitted request
+                lost += 1
+        wall = time.perf_counter() - t0
+        ok = all(
+            np.array_equal(r, g.predict(X[:r.shape[0]], raw_score=True))
+            for r in results)
+        # the replacement rejoins on the supervisor cadence
+        deadline = time.monotonic() + 15
+        while (_obs.counter("serve_replica_restarts_total").value - r0 < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+    finally:
+        os.environ.pop("LGBMTPU_FAULT", None)
+        _flt.reset()
+        fl.stop()
+    p50, p99 = _pcts(lat)
+    total_rows = sum(sizes[i % len(sizes)] for i in range(n_req))
+    _STATE["workloads"]["fleet_chaos"] = {
+        "replicas": 2, "requests": n_req, "lost": lost,
+        "bitwise_parity": ok,
+        "deaths": _obs.counter("serve_replica_deaths_total").value - d0,
+        "requeues": _obs.counter("serve_requeues_total").value - q0,
+        "restarts": _obs.counter("serve_replica_restarts_total").value - r0,
+        "rows_per_sec": round(total_rows / wall, 1),
+        "p50_ms": p50, "p99_ms": p99,
+    }
+    if lost or not ok:
+        raise AssertionError(
+            f"fleet chaos: lost={lost} bitwise_parity={ok}")
+    _emit()
+
+
 def main():
     import jax
 
@@ -259,6 +335,8 @@ def main():
              budget_floor=30.0)
     _guarded("open_loop", lambda: bench_open_loop(g, X, rows),
              budget_floor=15.0)
+    _guarded("fleet_chaos", lambda: bench_fleet_chaos(g, X, rows),
+             budget_floor=25.0)
 
     # jaxpr-audit verdict (docs/ANALYSIS.md): the artifact carries proof
     # the serving contracts — incl. predict_coalesced_bucket — held at

@@ -284,6 +284,71 @@ def bench_serve(n_rows=600, n_feat=8, n_trees=12):
     return len(parts), batches, sum(p.shape[0] for p in parts) / dt
 
 
+def bench_fleet_serve(n_rows=600, n_feat=8, n_trees=12):
+    """Round-23 fleet-serve smoke: a 2-replica ServingFleet survives an
+    injected replica death with ZERO lost requests and bitwise parity
+    against individual predicts, requeues the failed batch, restarts the
+    replacement, and leaves the fleet snapshot keys — so an off-chip CI
+    run catches serve-path resilience regressions in the artifact path,
+    not just in tier-1."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import metrics as _obs
+    from lightgbm_tpu.serve import ServingFleet
+    from lightgbm_tpu.utils import faults as _flt
+
+    rng = np.random.RandomState(23)
+    X = rng.randn(n_rows, n_feat)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(float)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                              "max_bin": 63, "verbosity": -1},
+                      train_set=lgb.Dataset(X, label=y))
+    for _ in range(n_trees):
+        bst.update()
+
+    parts = [X[i * 16:(i + 1) * 16] for i in range(8)]
+    want = [bst.predict(p, raw_score=True) for p in parts]
+    d0 = _obs.counter("serve_replica_deaths_total").value
+    q0 = _obs.counter("serve_requeues_total").value
+    fl = ServingFleet(bst, replicas=2, max_wait_ms=20, hedge_ms=0,
+                      restart_backoff_ms=50, shed_unhealthy=False)
+    t0 = time.perf_counter()
+    try:
+        # warm with the fault env UNSET (fire() only counts armed sites)
+        fl.predict(X[:16], raw_score=True, timeout=120)
+        os.environ["LGBMTPU_FAULT"] = "replica_death:0"
+        handles = [fl.submit(p, raw_score=True) for p in parts]
+        got = [fl.result(h, timeout=120) for h in handles]
+        for w, g in zip(want, got):
+            assert np.array_equal(w, g), (
+                "fleet response diverged across the injected death")
+        assert _obs.counter("serve_replica_deaths_total").value == d0 + 1
+        assert _obs.counter("serve_requeues_total").value > q0, (
+            "the dead replica's batch was never requeued")
+        deadline = time.monotonic() + 15
+        while (any(r.state != 0 for r in fl._replicas)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert fl.stats()["replicas"] == {0: "active", 1: "active"}, (
+            "replacement replica never rejoined rotation")
+    finally:
+        os.environ.pop("LGBMTPU_FAULT", None)
+        _flt.reset()
+        fl.stop()
+    dt = time.perf_counter() - t0
+
+    snap = _obs.snapshot()
+    _obs.validate_snapshot(snap)
+    for key in ("serve_replica_deaths_total", "serve_requeues_total",
+                "serve_replica_restarts_total", "faults_injected_total"):
+        assert key in snap["counters"], f"metrics snapshot missing {key}"
+    assert "serve_fleet_degraded" in snap["gauges"]
+    assert any(k.startswith('serve_replica_batch_ms{replica="')
+               for k in snap["histograms"]), (
+        "per-replica batch latency labels missing from the snapshot")
+    return len(parts), dt
+
+
 def bench_continual(n_rows=600, n_feat=6, n_trees=6):
     """Round-19 continual smoke: a refit + an append rollover through a
     live ServingRuntime must keep every response bitwise equal to a
@@ -444,7 +509,8 @@ def main():
     iters = int(os.environ.get("SMOKE_ITERS", 10))
     which = (sys.argv[1].split(",") if len(sys.argv) > 1
              else ["rank", "multiclass", "predict", "serve", "ooc",
-                   "megakernel", "continual", "fleet", "multislice"])
+                   "megakernel", "continual", "fleet", "fleet_serve",
+                   "multislice"])
     if "rank" in which:
         ips = bench_rank(n, q_len=128, iters=iters)
         print(f"lambdarank {n//1000}k rows x64f q128 63bins: {ips:.2f} iters/sec", flush=True)
@@ -480,6 +546,11 @@ def main():
         print(f"fleet {b} boosters x256 rows x6f: {trees} rounds at one "
               f"dispatch/round, lanes bitwise == their B=1 runs, warm "
               f"budget pinned ({dt:.1f}s)", flush=True)
+    if "fleet_serve" in which:
+        reqs, dt = bench_fleet_serve()
+        print(f"fleet_serve 2 replicas x{reqs} requests: injected replica "
+              f"death, 0 lost, bitwise parity, requeued + restarted, "
+              f"snapshot keys ok ({dt:.1f}s)", flush=True)
     if "multislice" in which:
         got = bench_multislice()
         if got is None:

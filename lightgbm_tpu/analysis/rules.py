@@ -1762,3 +1762,146 @@ def r18_host_loop_over_independent_boosters(
                         "trains/refits one model per iteration — B "
                         "independent models cost B dispatches per round "
                         "where a fleet costs one", hint)
+
+
+# ---------------------------------------------------------------------------
+# R19 — unbounded-retry
+# ---------------------------------------------------------------------------
+
+# IO/dispatch-ish call spellings worth retry discipline: a failure here is
+# transient-by-nature (network, device runtime, filesystem), which is what
+# tempts the swallow-and-spin loop this rule exists to catch
+_R19_IO_RE = re.compile(
+    r"(request|urlopen|fetch|download|upload|connect|send|recv|rpc|query"
+    r"|dispatch|predict|submit|read|write|open|post|push|pull)",
+    re.IGNORECASE)
+# loop identifiers that evidence a retry BUDGET or DEADLINE — any of these
+# appearing anywhere in the loop (test or body) means the author bounded it
+_R19_BUDGET_RE = re.compile(
+    r"(attempt|retr(y|ies)|budget|deadline|tries|remaining|give_up|giveup)",
+    re.IGNORECASE)
+# pacing call spellings: a loop that sleeps, backs off, or waits between
+# attempts cannot hot-spin
+_R19_PACING = ("sleep", "wait")
+_R19_PACING_RE = re.compile(r"(backoff|jitter)", re.IGNORECASE)
+# exception spellings broad enough to swallow EVERY transient failure —
+# catching these without re-raising, bounding or pacing is the hallmark
+_R19_BROAD = ("Exception", "BaseException", "OSError", "IOError",
+              "EnvironmentError", "TimeoutError", "ConnectionError")
+
+
+def _r19_is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in types:
+        nm = dotted_name(e)
+        if nm is not None and nm.split(".")[-1] in _R19_BROAD:
+            return True
+    return False
+
+
+def _r19_handler_escapes(handler: ast.ExceptHandler) -> bool:
+    """True when the handler leaves the loop or re-raises — the failure
+    is surfaced, not swallowed back into another attempt."""
+    for node in _r18_walk_no_defs(handler):
+        if isinstance(node, (ast.Raise, ast.Break, ast.Return)):
+            return True
+    return False
+
+
+def _r19_is_pacing_call(node: ast.Call) -> bool:
+    fn = dotted_name(node.func)
+    last = (fn.split(".")[-1] if fn is not None
+            else getattr(node.func, "attr", ""))
+    if last in _R19_PACING or _R19_PACING_RE.search(last or ""):
+        return True
+    # a bare `.get()` / `.get(timeout=...)` on some receiver is a BLOCKING
+    # queue handoff — the worker-loop shape (the serve dispatcher): the
+    # loop stalls for fresh WORK between iterations, so it cannot spin.
+    # `dict.get(key)` passes positional args and does not count.
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get" and not node.args)
+
+
+def _r19_loop_is_paced_or_bounded(loop: ast.While) -> bool:
+    for node in _r18_walk_no_defs(loop):
+        if isinstance(node, ast.Call) and _r19_is_pacing_call(node):
+            return True
+        if isinstance(node, ast.Name) and _R19_BUDGET_RE.search(node.id):
+            return True
+        if (isinstance(node, ast.Attribute)
+                and _R19_BUDGET_RE.search(node.attr)):
+            return True
+    # the loop TEST is not inside walk(loop)'s body-only iteration? it is —
+    # ast.iter_child_nodes(While) yields test first; kept explicit anyway
+    for node in ast.walk(loop.test):
+        if isinstance(node, ast.Name) and _R19_BUDGET_RE.search(node.id):
+            return True
+    return False
+
+
+@register_rule("R19", "unbounded-retry")
+def r19_unbounded_retry(pkg: PackageIndex) -> Iterator[Finding]:
+    """A ``while`` loop that swallows broad exceptions around an
+    IO/dispatch-ish call and loops straight back into the next attempt —
+    no sleep/backoff/jitter between tries, no attempt budget, no
+    deadline.  Under a persistent failure (a device runtime wedged, an
+    endpoint down, a full disk) the loop hot-spins: 100% host CPU,
+    a log volcano, and — when the callee holds locks or device queues —
+    a livelock that looks exactly like the hang it was written to
+    survive.  The serve fleet's discipline is the counter-example
+    (serve/fleet.py): every redispatch pays a retry-budget token, every
+    restart backs off exponentially with jitter, and deadlines turn a
+    sick fleet into typed shedding.  Statically: a ``while`` containing
+    a ``try`` whose handler catches ``Exception``/``BaseException``/
+    ``OSError``/``TimeoutError``/bare without raising or leaving the
+    loop, whose try body makes an IO-ish call, in a loop with no pacing
+    call (``sleep``/``wait``/``backoff``/``jitter``/blocking queue
+    ``.get()``) and no budget/deadline identifier
+    (``attempt``/``retry``/``budget``/``deadline``/``tries``/
+    ``remaining``).  Narrow catches (``except Empty``) pass clean —
+    they name the one expected failure instead of swallowing all of
+    them."""
+    hint = ("bound the loop: pace attempts (time.sleep with exponential "
+            "backoff + jitter), spend a retry budget, or check a "
+            "deadline — and re-raise or surface the error once the "
+            "budget is gone (serve/fleet.py::_retry_or_fail_locked is "
+            "the in-tree shape); narrow the except to the one expected "
+            "failure where possible")
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            for node in _own_body(fi):
+                if not isinstance(node, ast.While):
+                    continue
+                if _r19_loop_is_paced_or_bounded(node):
+                    continue
+                for sub in _r18_walk_no_defs(node):
+                    if not isinstance(sub, ast.Try):
+                        continue
+                    broad = [h for h in sub.handlers
+                             if _r19_is_broad_handler(h)
+                             and not _r19_handler_escapes(h)]
+                    if not broad:
+                        continue
+                    io_call = None
+                    for b in sub.body:
+                        for c in _r18_walk_no_defs(b):
+                            if isinstance(c, ast.Call):
+                                fn = (dotted_name(c.func)
+                                      or getattr(c.func, "attr", ""))
+                                if fn and _R19_IO_RE.search(fn):
+                                    io_call = fn.split(".")[-1]
+                                    break
+                        if io_call:
+                            break
+                    if io_call is None:
+                        continue
+                    yield _finding(
+                        fi, sub, "R19",
+                        f"retry loop in {fi.qualname} swallows broad "
+                        f"exceptions around {io_call}(...) with no "
+                        "backoff, budget or deadline — a persistent "
+                        "failure hot-spins forever", hint)
+                    break  # one finding per loop is enough

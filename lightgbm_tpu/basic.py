@@ -322,6 +322,17 @@ class Dataset:
                         getattr(self, "_ooc_stream", None) is not None
                         or shard_spec is not None)
                         else np.asarray(z["bins"]))
+                    _seg = None
+                    if pre_bins is not None:
+                        # live append segments (io/stream.py round 22)
+                        # extend the cache past the base npz: the
+                        # materialized load must see them too (the ooc
+                        # stream and shard feed already compose them)
+                        from .io.stream import load_segmented_cache
+
+                        _seg = load_segmented_cache(path)
+                        if _seg is not None:
+                            pre_bins = _seg[0]
                     loaded = {
                         "label": (z["label"] if z["label"].size else None),
                         "weight": (z["weight"] if z["weight"].size else None),
@@ -336,6 +347,12 @@ class Dataset:
                             else None),
                         "feature_names": [str(x) for x in z["feature_names"]],
                     }
+                    if _seg is not None:
+                        # per-row metadata concatenated across segments
+                        loaded["label"] = (_seg[1] if _seg[1].size
+                                           else None)
+                        loaded["weight"] = (_seg[2] if _seg[2].size
+                                            else None)
                 if shard_spec is not None:
                     # rank-sharded cache feed (docs/DISTRIBUTED.md): this
                     # worker materializes ONLY its [lo, hi) rows of the

@@ -540,6 +540,54 @@ class Config:
     # sheds with Overloaded while other tenants keep serving — one noisy
     # caller cannot monopolize the chip.  0 (default) = unlimited.
     serve_tenant_quota: int = 0
+    # serve_replicas: replica count for the resilient fleet layer
+    # (lightgbm_tpu/serve/fleet.py) — N dispatchers behind ONE admission
+    # queue (one per device on a real slice; N threads off-chip), with
+    # health-aware routing, an ejection/readmission circuit breaker and
+    # watchdog-driven replica restart.  1 (default) keeps the solo
+    # ServingRuntime unless another fleet knob opts in.
+    serve_replicas: int = 1
+    # serve_deadline_ms: per-request completion deadline — an admitted
+    # request that cannot finish inside it raises a typed
+    # DeadlineExceeded (distinct from Overloaded: admission succeeded,
+    # completion was late; /predict maps it to 504).  Expired requests
+    # still queued are dropped BEFORE spending a dispatch.  0 = off.
+    serve_deadline_ms: float = 0.0
+    # serve_hedge_ms: tail-latency hedging — a batch in flight on one
+    # replica longer than this is speculatively re-dispatched on another
+    # (first completion wins; predict is pure, so both produce the same
+    # bits).  0 (default) = off; -1 = auto, p99-derived from the
+    # serve_replica_batch_ms reservoirs.
+    serve_hedge_ms: float = 0.0
+    # serve_retry_budget: retry tokens added per admitted request (a
+    # failed/dead/hung replica dispatch requeues its batch's requests
+    # EXACTLY once onto a healthy replica, spending one token per
+    # batch).  The budget is what turns a sick fleet into shedding
+    # instead of a retry storm.  Negative = unlimited retries.
+    serve_retry_budget: float = 0.25
+    # serve_replica_trip: consecutive batch failures that trip a
+    # replica's circuit breaker (ejected from rotation, readmitted via a
+    # half-open probe after a jittered exponential cooldown).  The LAST
+    # healthy replica is never ejected.
+    serve_replica_trip: int = 3
+    # serve_replica_cooldown_ms: base ejection cooldown; doubles per
+    # consecutive trip, with +/-50% jitter.
+    serve_replica_cooldown_ms: float = 50.0
+    # serve_hang_timeout_ms: per-replica heartbeat staleness bound — a
+    # replica holding a batch without a heartbeat tick for this long is
+    # declared hung (serve_replica_hangs_total), its in-flight requests
+    # requeue, and a replacement is spawned.  Size it above the worst
+    # legitimate batch latency.
+    serve_hang_timeout_ms: float = 2000.0
+    # serve_restart_backoff_ms: base delay before a dead/hung replica's
+    # replacement spawns; doubles per restart, jittered.  The
+    # replacement warms the bucket ladder BEFORE joining rotation.
+    serve_restart_backoff_ms: float = 20.0
+    # serve_max_restarts: restarts per replica slot before it is
+    # abandoned (the fleet degrades to the surviving replicas; the last
+    # replica's death with no restarts left fails queued requests with a
+    # typed error rather than hanging them).
+    serve_max_restarts: int = 3
 
     # --- continual training (ours; README "Continuous training",
     # lightgbm_tpu/continual) ---
@@ -564,6 +612,14 @@ class Config:
     # against — the cheap covariate/label-shift signal riding the
     # continual_chunk event stream.
     drift_window: int = 8192
+    # bin_cache_segment_threshold: durable-ingest append mode for
+    # save_binary caches (io/stream.py).  0 (default) = every
+    # append_rows() rewrites the whole cache (one file, O(total rows)
+    # per append).  >= 1 = appends land in CRC'd sidecar segment files
+    # (O(new rows) per append — the continual runner's steady-state
+    # ingest cost) and the cache compacts back to one file once this
+    # many live segments accumulate.
+    bin_cache_segment_threshold: int = 0
 
     # --- booster fleets (ours; README "Booster fleets",
     # lightgbm_tpu/models/fleet.py) ---

@@ -141,6 +141,10 @@ class ContinualRunner:
         self._window_rows = int(window_rows)
         self._staleness_slo_s = float(staleness_slo_s)
         self._snapshot_keep = int(snapshot_keep)
+        # durable-ingest append mode: >= 1 routes ingest appends into
+        # CRC'd sidecar segments (O(new rows) per chunk) with threshold
+        # compaction, instead of rewriting the whole cache every chunk
+        self._seg_threshold = int(cfg.bin_cache_segment_threshold)
 
         # frozen mappers: an explicit reference Dataset (or save_binary
         # cache path) wins; else the booster's own training set
@@ -412,7 +416,8 @@ class ContinualRunner:
             create_bin_cache(self._cache_path, bins, self._binner.mappers,
                              label=y, feature_names=names)
         else:
-            append_rows(self._cache_path, bins, label=y)
+            append_rows(self._cache_path, bins, label=y,
+                        segment_threshold=self._seg_threshold or None)
 
     # -- update policy ---------------------------------------------------
     def _due(self) -> bool:

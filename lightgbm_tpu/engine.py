@@ -409,11 +409,20 @@ def serve(model=None, params: Optional[Dict[str, Any]] = None, *,
     table for multi-tenant serving.  ``params`` carries the serve knobs
     (``serve_max_wait_ms``, ``serve_max_queue``, ``serve_slo_p99_ms``,
     ``serve_tenant_quota``) plus ``metrics_port=``/``telemetry=`` — the
-    same Config names as everywhere else (docs/Parameters.md).
+    same Config names as everywhere else (docs/Parameters.md).  Setting
+    ANY fleet knob (``serve_replicas``, ``serve_deadline_ms``,
+    ``serve_hedge_ms``, ``serve_retry_budget``, ``serve_replica_trip``,
+    ``serve_replica_cooldown_ms``, ``serve_hang_timeout_ms``,
+    ``serve_restart_backoff_ms``, ``serve_max_restarts``) builds a
+    :class:`~lightgbm_tpu.serve.ServingFleet` instead — health-routed
+    replicas, deadlines, exactly-once retry and the restart watchdog.
 
     >>> rt = lgb.serve(booster, {"serve_max_wait_ms": 2})
     >>> y = rt.predict(X); rt.stop()
+    >>> fl = lgb.serve(booster, {"serve_replicas": 2,
+    ...                          "serve_deadline_ms": 50})
     """
+    from .serve.fleet import ServingFleet
     from .serve.runtime import ServingRuntime
 
     cfg = Config.from_dict(dict(params or {}))
@@ -441,6 +450,21 @@ def serve(model=None, params: Optional[Dict[str, Any]] = None, *,
                         ("tenant_quota", "serve_tenant_quota")):
         if cfg.is_set(param):
             kw[name] = getattr(cfg, param)
+    fleet_kw = {}
+    for name, param in (("replicas", "serve_replicas"),
+                        ("deadline_ms", "serve_deadline_ms"),
+                        ("hedge_ms", "serve_hedge_ms"),
+                        ("retry_budget", "serve_retry_budget"),
+                        ("trip", "serve_replica_trip"),
+                        ("cooldown_ms", "serve_replica_cooldown_ms"),
+                        ("hang_timeout_ms", "serve_hang_timeout_ms"),
+                        ("restart_backoff_ms", "serve_restart_backoff_ms"),
+                        ("max_restarts", "serve_max_restarts")):
+        if cfg.is_set(param):
+            fleet_kw[name] = getattr(cfg, param)
+    if fleet_kw:
+        return ServingFleet(single, models=table, start=start,
+                            **kw, **fleet_kw)
     return ServingRuntime(single, models=table, start=start, **kw)
 
 

@@ -141,15 +141,10 @@ class BinCacheStream:
                 f"streaming (shape={shape}, fortran={fortran})")
         self.shape = shape
         self.dtype = dtype
-        if shard is not None:
-            lo, hi = int(shard[0]), int(shard[1])
-            if not (0 <= lo < hi <= shape[0]):
-                raise ValueError(
-                    f"shard range [{lo}, {hi}) is outside the cache's "
-                    f"{shape[0]} rows")
-            self.shard = (lo, hi)
-        else:
-            self.shard = None
+        # base-member row extent — live append SEGMENTS (round 22,
+        # sidecar `<path>.seg.<k>` files) ride BEHIND it in the logical
+        # row space; self.shape grows to cover them below
+        self._base_rows = int(shape[0])
         # per-chunk CRC trailer table (written by save_binary since round
         # 13).  Old trailerless caches still load — with a warning, since
         # nothing can vouch for their bytes.
@@ -159,6 +154,11 @@ class BinCacheStream:
         # offsets where each append_rows() call began, so a row-ranged
         # corruption error can NAME the appended chunk it falls in
         self.append_log: Optional[np.ndarray] = None
+        # compaction watermark (round 22): segment indices <= watermark
+        # are already folded into the base member — a stale sidecar left
+        # by a crash between the compaction's atomic replace and its
+        # segment deletes is IGNORED, never double-counted
+        self.seg_watermark = -1
         try:
             with np.load(path, allow_pickle=False) as z:
                 if (f"{member}_crc32" in z.files
@@ -170,14 +170,52 @@ class BinCacheStream:
                 if f"{member}_append_rows" in z.files:
                     self.append_log = np.asarray(
                         z[f"{member}_append_rows"], np.int64)
+                if f"{member}_seg_watermark" in z.files:
+                    self.seg_watermark = int(np.asarray(
+                        z[f"{member}_seg_watermark"]).reshape(-1)[0])
         except (OSError, ValueError, zipfile.BadZipFile):
             pass  # chunk reads will surface real corruption row-ranged
+        # live segments: each is itself a mini bin cache (bins + CRC
+        # table + label/weight), so a nested stream verifies it with the
+        # SAME machinery.  Segment files are never themselves segmented
+        # (append_rows only writes sidecars next to the base path).
+        self.segments: List[Tuple[int, str, int]] = []  # (k, path, rows)
+        if member == "bins":
+            n_total = self._base_rows
+            starts: List[int] = []
+            for k, sp in _live_segments(path, self.seg_watermark):
+                sub = BinCacheStream(sp)
+                if (sub.shape[1] != shape[1] or sub.dtype != self.dtype):
+                    raise CorruptBinCacheError(
+                        sp, "bins.npy", 0, 0, sub.shape[0],
+                        f"segment shape {sub.shape}/{sub.dtype} does not "
+                        f"match base cache {shape}/{self.dtype}")
+                starts.append(n_total)
+                self.segments.append((k, sp, sub.shape[0]))
+                n_total += sub.shape[0]
+            if self.segments:
+                self.shape = (n_total, shape[1])
+                base_log = (np.asarray(self.append_log, np.int64)
+                            if self.append_log is not None
+                            else np.zeros(0, np.int64))
+                self.append_log = np.concatenate(
+                    [base_log, np.asarray(starts, np.int64)])
+        if shard is not None:
+            lo, hi = int(shard[0]), int(shard[1])
+            if not (0 <= lo < hi <= self.shape[0]):
+                raise ValueError(
+                    f"shard range [{lo}, {hi}) is outside the cache's "
+                    f"{self.shape[0]} rows")
+            self.shard = (lo, hi)
+        else:
+            self.shard = None
         if self.crcs is not None:
-            expect = -(-self.shape[0] // self.crc_rows) if self.shape[0] else 0
+            expect = (-(-self._base_rows // self.crc_rows)
+                      if self._base_rows else 0)
             if len(self.crcs) != expect:
                 raise CorruptBinCacheError(
                     path, self.member, 0, 0, min(self.crc_rows,
-                                                 self.shape[0]),
+                                                 self._base_rows),
                     f"CRC table has {len(self.crcs)} entries, "
                     f"expected {expect}")
         else:
@@ -239,9 +277,34 @@ class BinCacheStream:
         member is seeked to row_lo (stored members skip the prefix
         without decompressing it) and blocks the shard enters mid-way
         are skipped by verification, never trusted blind — a corrupt
-        byte inside any FULLY covered block still raises row-ranged."""
-        n, f = self.shape
-        lo0, hi0 = self.shard if self.shard is not None else (0, n)
+        byte inside any FULLY covered block still raises row-ranged.
+
+        Live append segments ride transparently: the sweep covers the
+        base member, then each segment in index order, with GLOBAL row
+        offsets — each segment verifies against its OWN CRC table
+        through a nested stream."""
+        lo0, hi0 = self.shard if self.shard is not None else (0,
+                                                              self.shape[0])
+        nb = self._base_rows
+        if lo0 < nb:
+            yield from self._base_chunks(chunk_rows, lo0, min(hi0, nb))
+        off = nb
+        for _k, sp, n_seg in self.segments:
+            s_lo, s_hi = max(lo0 - off, 0), min(hi0 - off, n_seg)
+            if s_lo < s_hi:
+                sub = BinCacheStream(
+                    sp, shard=((s_lo, s_hi) if (s_lo, s_hi) != (0, n_seg)
+                               else None))
+                for seg_lo, view in sub.chunks(chunk_rows):
+                    yield off + seg_lo, view
+            off += n_seg
+
+    def _base_chunks(self, chunk_rows: int, lo0: int,
+                     hi0: int) -> Iterator[Tuple[int, np.ndarray]]:
+        """The base-member sweep over rows [lo0, hi0) — the pre-segment
+        chunks() body, with the row range parameterized so the composed
+        sweep can clip it to the base extent."""
+        n, f = self._base_rows, self.shape[1]
         chunk_rows = max(int(chunk_rows), 1)
         buf = np.empty((chunk_rows, f), self.dtype)  # the reused buffer
         flat = buf.reshape(-1).view(np.uint8)
@@ -335,11 +398,27 @@ def cache_shard_fingerprint(path: str, row_lo: int, row_hi: int,
     if st.crcs is None:
         return ""
     lo_b = int(row_lo) // st.crc_rows
-    hi_b = -(-int(row_hi) // st.crc_rows)
+    hi_b = -(-min(int(row_hi), st._base_rows) // st.crc_rows)
     h = hashlib.sha256()
     h.update(repr((st.shape, str(st.dtype), int(row_lo),
                    int(row_hi))).encode())
     h.update(np.ascontiguousarray(st.crcs[lo_b:hi_b]).tobytes())
+    # live segments overlapping the range contribute their OWN CRC
+    # entries (plus identity), so the fingerprint moves whenever any
+    # covered byte does — base or sidecar
+    off = st._base_rows
+    for k, sp, n_seg in st.segments:
+        s_lo = max(int(row_lo) - off, 0)
+        s_hi = min(int(row_hi) - off, n_seg)
+        if s_lo < s_hi:
+            sub = BinCacheStream(sp)
+            if sub.crcs is None:
+                return ""  # unverifiable segment: nothing can vouch
+            h.update(repr((k, sub.shape, s_lo, s_hi)).encode())
+            h.update(np.ascontiguousarray(
+                sub.crcs[s_lo // sub.crc_rows:
+                         -(-s_hi // sub.crc_rows)]).tobytes())
+        off += n_seg
     return h.hexdigest()
 
 
@@ -508,31 +587,67 @@ def create_bin_cache(path: str, bins: np.ndarray, mappers, **kw) -> None:
 # members append_rows recomputes; everything else (mappers, group,
 # init_score, position, names) is byte-copied verbatim from the old zip
 _APPEND_REWRITTEN = ("bins.npy", "bins_crc32.npy", "bins_crc_rows.npy",
-                     "bins_append_rows.npy", "label.npy", "weight.npy")
+                     "bins_append_rows.npy", "bins_seg_watermark.npy",
+                     "label.npy", "weight.npy")
 
 
-def append_rows(path: str, bins_new: np.ndarray, *,
-                label=None, weight=None,
-                chunk_rows: int = DEFAULT_CHUNK_ROWS) -> int:
-    """Append binned rows (already transformed by the cache's FROZEN
-    mappers) to a save_binary cache, atomically.
+def _seg_path(path: str, k: int) -> str:
+    return f"{path}.seg.{k}"
 
-    The old payload streams through the CRC-verified
-    :class:`BinCacheStream` path into a same-directory temp file, the new
-    rows follow, and ``os.replace`` publishes — a crash anywhere leaves
-    the previous cache intact, and a corrupt old cache raises the
-    row-ranged :class:`CorruptBinCacheError` before anything is replaced.
-    A legacy trailerless cache is UPGRADED to a full CRC table on the way
-    through (never a mixed verified/unverified file); the append-origin
-    log (``bins_append_rows``) records where each append began so later
-    corruption errors can name the appended chunk.  Returns the new total
-    row count.
 
-    Labels must ride along when the cache carries them (training data and
-    targets may never go out of step); ranking caches (non-empty
-    ``group``) and init_score/position-carrying caches refuse appends."""
-    stream = BinCacheStream(path)
-    n_old, f = stream.shape
+def _live_segments(path: str, watermark: int) -> List[Tuple[int, str]]:
+    """Sidecar segment files of ``path`` NOT yet folded into the base
+    (index past the compaction watermark), in index order.  A cheap
+    directory scan — no payload reads."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    prefix = os.path.basename(path) + ".seg."
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith(prefix):
+            continue
+        tail = name[len(prefix):]
+        if not tail.isdigit():
+            continue  # temp files from an in-flight atomic write
+        k = int(tail)
+        if k > watermark:
+            out.append((k, os.path.join(d, name)))
+    out.sort()
+    return out
+
+
+def _cache_row_meta(path: str, stream: "BinCacheStream"):
+    """(label, weight, group, init_score, position) across the base npz
+    AND its live segments — the concatenated per-row metadata a rewrite
+    or materialized load must carry (group/init/position never ride
+    segments: appends refuse those caches outright)."""
+    with np.load(path, allow_pickle=False) as z:
+        label = z["label"] if "label" in z.files else np.zeros(0)
+        weight = z["weight"] if "weight" in z.files else np.zeros(0)
+        group = z["group"] if "group" in z.files else np.zeros(0)
+        init = z["init_score"] if "init_score" in z.files else np.zeros(0)
+        pos = z["position"] if "position" in z.files else np.zeros(0)
+    labels, weights = [np.asarray(label, np.float64)], [
+        np.asarray(weight, np.float64)]
+    for _k, sp, _n in stream.segments:
+        with np.load(sp, allow_pickle=False) as z:
+            if "label" in z.files and z["label"].size:
+                labels.append(np.asarray(z["label"], np.float64))
+            if "weight" in z.files and z["weight"].size:
+                weights.append(np.asarray(z["weight"], np.float64))
+    return (np.concatenate(labels), np.concatenate(weights),
+            group, init, pos)
+
+
+def _validate_append(path: str, stream: "BinCacheStream", bins_new,
+                    label, weight):
+    """Shared admission checks for both append modes.  Returns
+    (bins_new_contig, label_f64_or_None, weight_f64_or_None,
+    old_label, old_weight)."""
+    f = stream.shape[1]
     bins_new = np.ascontiguousarray(bins_new)
     if bins_new.ndim != 2 or bins_new.shape[1] != f:
         raise ValueError(
@@ -546,12 +661,8 @@ def append_rows(path: str, bins_new: np.ndarray, *,
             f"append_rows: bin values outside the cache dtype "
             f"{stream.dtype} — the chunk was not binned by this cache's "
             "mappers")
-    with np.load(path, allow_pickle=False) as z:
-        old_label = z["label"] if "label" in z.files else np.zeros(0)
-        old_weight = z["weight"] if "weight" in z.files else np.zeros(0)
-        old_group = z["group"] if "group" in z.files else np.zeros(0)
-        old_init = z["init_score"] if "init_score" in z.files else np.zeros(0)
-        old_pos = z["position"] if "position" in z.files else np.zeros(0)
+    old_label, old_weight, old_group, old_init, old_pos = _cache_row_meta(
+        path, stream)
     if old_group.size or old_init.size or old_pos.size:
         raise ValueError(
             "append_rows: caches carrying group/init_score/position rows "
@@ -566,13 +677,10 @@ def append_rows(path: str, bins_new: np.ndarray, *,
         if len(label) != n_new:
             raise ValueError(
                 f"append_rows: {n_new} rows but {len(label)} labels")
-        new_label = np.concatenate([np.asarray(old_label, np.float64), label])
     elif label is not None:
         raise ValueError(
             f"append_rows: cache {path} carries no labels; appending "
             "labeled rows would leave the original rows unlabeled")
-    else:
-        new_label = np.zeros(0)
     if old_weight.size:
         if weight is None:
             raise ValueError(
@@ -582,42 +690,52 @@ def append_rows(path: str, bins_new: np.ndarray, *,
         if len(weight) != n_new:
             raise ValueError(
                 f"append_rows: {n_new} rows but {len(weight)} weights")
-        new_weight = np.concatenate([np.asarray(old_weight, np.float64),
-                                     weight])
-    else:
-        if weight is not None:
-            raise ValueError(
-                f"append_rows: cache {path} carries no weights; appending "
-                "weighted rows would leave the original rows unweighted")
-        new_weight = np.zeros(0)
-    upgraded = stream.crcs is None
+    elif weight is not None:
+        raise ValueError(
+            f"append_rows: cache {path} carries no weights; appending "
+            "weighted rows would leave the original rows unweighted")
+    return bins_new, label, weight, old_label, old_weight
+
+
+def _rewrite_cache(path: str, stream: "BinCacheStream", bins_new,
+                   new_label: np.ndarray, new_weight: np.ndarray,
+                   append_log: np.ndarray, watermark: int,
+                   chunk_rows: int) -> None:
+    """Stream base + live segments (+ optionally fresh rows) into a new
+    base npz through the ONE atomic-replace scaffold.  Every old byte
+    passes the verified chunks() path, so corruption raises row-ranged
+    BEFORE the replace; the watermark member marks every folded segment
+    index so stale sidecars a crash leaves behind are ignored."""
+    n_total = stream.shape[0] + (int(bins_new.shape[0])
+                                 if bins_new is not None else 0)
+    f = stream.shape[1]
     crc_rows = stream.crc_rows or DEFAULT_CRC_ROWS
-    append_log = (np.asarray(stream.append_log, np.int64)
-                  if stream.append_log is not None
-                  else np.zeros(0, np.int64))
-    append_log = np.concatenate([append_log,
-                                 np.asarray([n_old], np.int64)])
     crc = _CrcTableBuilder(crc_rows, f * stream.dtype.itemsize)
 
     def _write(fh):
         # closing the ZipFile INSIDE the writer is what makes the
         # scaffold's post-writer fsync cover the central directory
         with zipfile.ZipFile(fh, "w", zipfile.ZIP_STORED) as zf:
-            # the old payload sweeps through the VERIFIED stream
-            # (chunks() raises row-ranged on corruption — before the
-            # replace ever runs), chained with the new rows; one CRC
-            # table covers both sides of the seam
+            # the old payload (base AND segments) sweeps through the
+            # VERIFIED stream (chunks() raises row-ranged on corruption
+            # — before the replace ever runs), chained with the new
+            # rows; one CRC table covers every seam
             def _all_chunks():
                 yield from stream.chunks(chunk_rows)
-                yield from array_chunks(bins_new, chunk_rows)
+                if bins_new is not None:
+                    yield from array_chunks(bins_new, chunk_rows)
 
-            _write_streamed_bins(zf, "bins.npy", n_old + n_new, f,
+            _write_streamed_bins(zf, "bins.npy", n_total, f,
                                  stream.dtype, _all_chunks(), crc)
             zf.writestr("bins_crc32.npy", _npy_member_bytes(crc.finish()))
             zf.writestr("bins_crc_rows.npy",
                         _npy_member_bytes(np.asarray(crc_rows, np.int64)))
             zf.writestr("bins_append_rows.npy",
                         _npy_member_bytes(append_log))
+            if watermark >= 0:
+                zf.writestr("bins_seg_watermark.npy",
+                            _npy_member_bytes(np.asarray(watermark,
+                                                         np.int64)))
             zf.writestr("label.npy", _npy_member_bytes(new_label))
             zf.writestr("weight.npy", _npy_member_bytes(new_weight))
             with zipfile.ZipFile(path) as zf_old:
@@ -629,8 +747,78 @@ def append_rows(path: str, bins_new: np.ndarray, *,
     # serving process under another uid) cache stays readable after
     # its first append
     _atomic_replace(path, _write, os.stat(path).st_mode & 0o7777)
+
+
+def append_rows(path: str, bins_new: np.ndarray, *,
+                label=None, weight=None,
+                chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                segment_threshold: Optional[int] = None) -> int:
+    """Append binned rows (already transformed by the cache's FROZEN
+    mappers) to a save_binary cache, atomically.
+
+    Two modes, both riding the one :func:`_atomic_replace` scaffold:
+
+    * **rewrite** (default, ``segment_threshold`` unset/0) — the old
+      payload streams through the CRC-verified :class:`BinCacheStream`
+      path into a same-directory temp file, the new rows follow, and
+      ``os.replace`` publishes.  Any live segments fold in on the way
+      through.  O(total rows) per append, but the cache stays one file.
+    * **segment** (``segment_threshold >= 1``) — the new rows land in a
+      CRC'd sidecar ``<path>.seg.<k>`` (its OWN atomic replace; the base
+      file is untouched), O(new rows) per append — the continual
+      runner's steady-state ingest cost.  Once live segments reach the
+      threshold, :func:`compact_bin_cache` folds them back into the base
+      (the rewrite path), bumping the compaction watermark so sidecars a
+      crash strands are ignored, never double-counted.
+
+    A crash anywhere leaves the previous logical cache intact, and a
+    corrupt old cache raises the row-ranged :class:`CorruptBinCacheError`
+    before anything is replaced.  A legacy trailerless cache is UPGRADED
+    to a full CRC table by any rewrite (never a mixed
+    verified/unverified file); the append-origin log
+    (``bins_append_rows``) records where each append began so later
+    corruption errors can name the appended chunk.  Returns the new
+    total row count.
+
+    Labels must ride along when the cache carries them (training data and
+    targets may never go out of step); ranking caches (non-empty
+    ``group``) and init_score/position-carrying caches refuse appends."""
+    stream = BinCacheStream(path)
+    n_old = stream.shape[0]
+    bins_new, label, weight, old_label, old_weight = _validate_append(
+        path, stream, bins_new, label, weight)
+    n_new = int(bins_new.shape[0])
     from ..obs import metrics as _obs
 
+    if segment_threshold and int(segment_threshold) >= 1:
+        k = max([s[0] for s in stream.segments] + [stream.seg_watermark]) + 1
+        _write_segment(path, k, bins_new, stream.dtype,
+                       stream.crc_rows or DEFAULT_CRC_ROWS,
+                       label, weight, chunk_rows)
+        _obs.counter("bin_cache_appends_total").inc()
+        _obs.counter("bin_cache_appended_rows_total").inc(n_new)
+        _obs.counter("bin_cache_segment_appends_total").inc()
+        _obs.event("bin_cache_segment_append", path=os.fspath(path),
+                   segment=k, rows=n_new, total_rows=n_old + n_new,
+                   live_segments=len(stream.segments) + 1)
+        if len(stream.segments) + 1 >= int(segment_threshold):
+            compact_bin_cache(path, chunk_rows=chunk_rows)
+        return n_old + n_new
+
+    upgraded = stream.crcs is None
+    new_label = (np.concatenate([old_label, label])
+                 if old_label.size else np.zeros(0))
+    new_weight = (np.concatenate([old_weight, weight])
+                  if old_weight.size else np.zeros(0))
+    append_log = np.concatenate([
+        (np.asarray(stream.append_log, np.int64)
+         if stream.append_log is not None else np.zeros(0, np.int64)),
+        np.asarray([n_old], np.int64)])
+    folded = [s[0] for s in stream.segments]
+    watermark = max(folded + [stream.seg_watermark])
+    _rewrite_cache(path, stream, bins_new, new_label, new_weight,
+                   append_log, watermark, chunk_rows)
+    _reap_segments(path, stream.segments)
     _obs.counter("bin_cache_appends_total").inc()
     _obs.counter("bin_cache_appended_rows_total").inc(n_new)
     if upgraded:
@@ -644,6 +832,86 @@ def append_rows(path: str, bins_new: np.ndarray, *,
     _obs.event("bin_cache_append", path=os.fspath(path), rows=n_new,
                total_rows=n_old + n_new, upgraded=upgraded)
     return n_old + n_new
+
+
+def _write_segment(path: str, k: int, bins_new: np.ndarray, dtype,
+                   crc_rows: int, label, weight, chunk_rows: int) -> None:
+    """One CRC'd sidecar segment, atomically published next to the base
+    cache (its own temp + fsync + replace — a crash strands at most a
+    temp file the segment scan already skips)."""
+    n, f = bins_new.shape
+    crc = _CrcTableBuilder(crc_rows, f * np.dtype(dtype).itemsize)
+
+    def _write(fh):
+        with zipfile.ZipFile(fh, "w", zipfile.ZIP_STORED) as zf:
+            _write_streamed_bins(zf, "bins.npy", n, f, dtype,
+                                 array_chunks(bins_new, chunk_rows), crc)
+            zf.writestr("bins_crc32.npy", _npy_member_bytes(crc.finish()))
+            zf.writestr("bins_crc_rows.npy",
+                        _npy_member_bytes(np.asarray(crc_rows, np.int64)))
+            zf.writestr("label.npy", _npy_member_bytes(
+                label if label is not None else np.zeros(0)))
+            zf.writestr("weight.npy", _npy_member_bytes(
+                weight if weight is not None else np.zeros(0)))
+
+    _atomic_replace(_seg_path(path, k), _write,
+                    os.stat(path).st_mode & 0o7777)
+
+
+def _reap_segments(path: str, segments) -> None:
+    """Best-effort deletion of folded sidecars AFTER the rewrite
+    published — a crash in between strands files the watermark already
+    excludes from every future read."""
+    for _k, sp, _n in segments:
+        try:
+            os.unlink(sp)
+        except OSError:
+            pass
+
+
+def compact_bin_cache(path: str,
+                      chunk_rows: int = DEFAULT_CHUNK_ROWS) -> int:
+    """Fold every live segment of ``path`` back into its base npz: one
+    verified streamed rewrite through the atomic-replace scaffold, then
+    the folded sidecars are deleted.  The new base's watermark covers
+    every folded index, so the crash window between the replace and the
+    deletes is safe — a stranded sidecar is ignored, never
+    double-counted.  Returns the total row count (unchanged by
+    compaction).  No-op (no rewrite) when no live segments exist."""
+    stream = BinCacheStream(path)
+    if not stream.segments:
+        return stream.shape[0]
+    new_label, new_weight, _g, _i, _p = _cache_row_meta(path, stream)
+    append_log = (np.asarray(stream.append_log, np.int64)
+                  if stream.append_log is not None
+                  else np.zeros(0, np.int64))
+    watermark = max([s[0] for s in stream.segments]
+                    + [stream.seg_watermark])
+    _rewrite_cache(path, stream, None, new_label, new_weight,
+                   append_log, watermark, chunk_rows)
+    _reap_segments(path, stream.segments)
+    from ..obs import metrics as _obs
+
+    _obs.counter("bin_cache_compactions_total").inc()
+    _obs.event("bin_cache_compact", path=os.fspath(path),
+               folded_segments=len(stream.segments),
+               total_rows=stream.shape[0], watermark=watermark)
+    return stream.shape[0]
+
+
+def load_segmented_cache(path: str, chunk_rows: int = DEFAULT_CHUNK_ROWS):
+    """``(bins, label, weight)`` fully materialized across base + live
+    segments — the materialized Dataset loader's segment-aware path —
+    or None when the cache has no live segments (the caller's plain
+    ``np.load`` view is already complete)."""
+    stream = BinCacheStream(path)
+    if not stream.segments:
+        return None
+    out = np.empty((stream.shape[0], stream.shape[1]), stream.dtype)
+    for lo, view in stream.chunks(chunk_rows):
+        out[lo:lo + view.shape[0]] = view
+    label, weight, _g, _i, _p = _cache_row_meta(path, stream)
+    return out, label, weight
 
 
 def array_chunks(arr: np.ndarray,

@@ -15,7 +15,11 @@ daemon-threaded stdlib ``http.server`` (no third-party deps, no jax —
   tripped (non-finite guard, worker death, watchdog timeout, torn
   checkpoint);
 * ``GET /snapshot`` — the raw JSON snapshot (schema lgbmtpu-metrics-v1);
-* ``GET /events?tail=N[&kind=K]`` — the newest N ring events as NDJSON.
+* ``GET /events?tail=N[&kind=K]`` — the newest N ring events as NDJSON;
+* ``POST /predict`` — the serving front door (JSON rows in, predictions
+  out), routed through whatever ServingRuntime/ServingFleet registered
+  itself via :func:`set_predict_handler`: shed -> 429, deadline -> 504,
+  unhealthy/stopped -> 503 (see lightgbm_tpu/serve).
 
 Opt-in and lifecycle: ``metrics_port=`` (Config/CLI) or
 ``LGBMTPU_METRICS_PORT`` starts the singleton on engine.train entry
@@ -81,7 +85,51 @@ DEGRADED_GAUGES = (
     # predictions, still correct ones (lightgbm_tpu/continual)
     ("continual_staleness_exceeded",
      "serving model is stale past the continual staleness SLO"),
+    # set by the serving fleet (lightgbm_tpu/serve/fleet.py) while ANY
+    # replica is not in active rotation (ejected / half-open / dead /
+    # restarting) — requests still serve on the healthy replicas, so
+    # this is degradation, not unavailability
+    ("serve_fleet_degraded",
+     "serving fleet has replicas out of rotation"),
 )
+
+# ---------------------------------------------------------------------------
+# serve-layer hooks: obs stays stdlib-only (no jax, no serve import), so the
+# serving runtime REGISTERS callables here instead of being imported —
+# /predict routes through the hook, /healthz merges the replica table
+# ---------------------------------------------------------------------------
+
+_predict_fn: Optional[Callable[[Dict[str, Any]], Tuple[int, Dict]]] = None
+_health_extra_fn: Optional[Callable[[], Dict[str, Any]]] = None
+
+
+def set_predict_handler(fn: Callable[[Dict[str, Any]], Tuple[int, Dict]]) -> None:
+    """Attach the process's ``POST /predict`` handler (a callable taking
+    the parsed JSON body and returning ``(http_status, body_dict)``).
+    Last registration wins — one process, one front door."""
+    global _predict_fn
+    _predict_fn = fn
+
+
+def clear_predict_handler(fn) -> None:
+    """Detach ``fn`` if it is the current handler (a stopped runtime must
+    not unregister its successor's route)."""
+    global _predict_fn
+    if _predict_fn == fn:
+        _predict_fn = None
+
+
+def set_health_extra(fn: Callable[[], Dict[str, Any]]) -> None:
+    """Attach a callable whose dict is merged into the /healthz body under
+    ``"serve_fleet"`` — the replica state table."""
+    global _health_extra_fn
+    _health_extra_fn = fn
+
+
+def clear_health_extra(fn) -> None:
+    global _health_extra_fn
+    if _health_extra_fn == fn:
+        _health_extra_fn = None
 
 
 def health(snap: Optional[Dict[str, Any]] = None) -> Tuple[int, Dict[str, Any]]:
@@ -125,6 +173,12 @@ def health(snap: Optional[Dict[str, Any]] = None) -> Tuple[int, Dict[str, Any]]:
         "rank": snap.get("rank"),
         "ts": snap.get("ts"),
     }
+    extra = _health_extra_fn
+    if extra is not None:
+        try:
+            body["serve_fleet"] = extra()
+        except Exception:  # noqa: BLE001 — a health probe must not 500
+            body["serve_fleet"] = {"error": "replica table unavailable"}
     return (503 if status == "unhealthy" else 200), body
 
 
@@ -173,10 +227,52 @@ def _make_handler(server: "MetricsServer"):
                                    for e in evs)
                     self._send(200, body.encode("utf-8"),
                                "application/x-ndjson")
+                elif route == "/predict":
+                    self._send(405, b'{"error": "use POST /predict"}\n',
+                               "application/json")
                 else:
                     self._send(404, b"not found\n", "text/plain")
             except BrokenPipeError:
                 pass  # the scraper hung up mid-response
+            except Exception as e:  # noqa: BLE001 — endpoint must not die
+                try:
+                    self._send(500, f"error: {e}\n".encode("utf-8"),
+                               "text/plain")
+                except OSError:
+                    pass
+
+        def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+            try:
+                route = urlparse(self.path).path.rstrip("/") or "/"
+                if route != "/predict":
+                    self._send(404, b"not found\n", "text/plain")
+                    return
+                fn = _predict_fn
+                if fn is None:
+                    self._send(503, b'{"error": "unavailable", "detail": '
+                                    b'"no serving runtime attached"}\n',
+                               "application/json")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                except ValueError:
+                    n = 0
+                if n > 32 << 20:
+                    self._send(413, b'{"error": "payload too large"}\n',
+                               "application/json")
+                    return
+                try:
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._send(400, b'{"error": "bad_request", "detail": '
+                                    b'"body is not valid JSON"}\n',
+                               "application/json")
+                    return
+                code, body = fn(payload)
+                self._send(code, (json.dumps(body, default=str) + "\n")
+                           .encode("utf-8"), "application/json")
+            except BrokenPipeError:
+                pass  # the client hung up mid-response
             except Exception as e:  # noqa: BLE001 — endpoint must not die
                 try:
                     self._send(500, f"error: {e}\n".encode("utf-8"),

@@ -62,6 +62,7 @@ import numpy as np
 from ..basic import Booster, LightGBMError
 from ..models.gbdt import _predict_bucket
 from ..obs import metrics as _obs
+from ..utils import faults as _flt
 from ..utils import locktrace as _lt
 from ..obs import server as _obs_server
 from ..obs import trace as _trace
@@ -91,15 +92,38 @@ class Overloaded(LightGBMError):
         self.tenant = tenant
 
 
+class DeadlineExceeded(LightGBMError):
+    """A request that was ADMITTED but missed its ``serve_deadline_ms``
+    budget — typed distinctly from :class:`Overloaded` (which is an
+    admission refusal): the caller's SLA logic treats "never started"
+    and "started but late" differently, and the ``/predict`` front door
+    maps them to 429 vs 504."""
+
+    def __init__(self, tenant: str, deadline_ms: float):
+        super().__init__(
+            f"serving request exceeded its {deadline_ms:g} ms deadline "
+            f"(tenant={tenant}) — admission succeeded, completion was "
+            "late; see serve_deadline_exceeded_total")
+        self.tenant = tenant
+        self.deadline_ms = deadline_ms
+
+
+# /predict requests are bounded even when no deadline is configured: an
+# HTTP worker must never wedge on a result() wait
+_PREDICT_HTTP_TIMEOUT_S = 30.0
+_PREDICT_MAX_BODY = 32 << 20
+
+
 class _Request:
     """One queued predict: host rows + completion event.  ``x`` is
     already cast to f64 (mirroring ``Booster.predict``'s intake cast, so
     the staged f32 batch holds the same bits an individual call would)."""
 
     __slots__ = ("x", "n", "model", "raw", "serial", "event", "result",
-                 "error", "t0", "t_done")
+                 "error", "t0", "t_done", "deadline", "retries", "avoid")
 
-    def __init__(self, x: np.ndarray, model: str, raw: bool):
+    def __init__(self, x: np.ndarray, model: str, raw: bool,
+                 deadline: Optional[float] = None):
         self.x = x
         self.n = int(x.shape[0])
         self.model = model
@@ -111,6 +135,12 @@ class _Request:
         self.t0 = time.perf_counter()
         self.t_done: Optional[float] = None  # stamped at completion —
         # open-loop harnesses read t_done - t0 for true request latency
+        # fleet-layer fields (serve/fleet.py): absolute monotonic deadline,
+        # the exactly-once requeue count, and the replica index a retried
+        # request must route AWAY from
+        self.deadline = deadline
+        self.retries = 0
+        self.avoid = -1
 
 
 def _unwrap(model) -> Any:
@@ -167,6 +197,10 @@ class ServingRuntime:
         self._tenant_quota = (int(cfg.serve_tenant_quota)
                               if tenant_quota is None else int(tenant_quota))
         self._shed_unhealthy = bool(shed_unhealthy)
+        # request deadline in seconds; 0 disables.  The base runtime never
+        # sets it — the fleet layer (serve/fleet.py) does, and stamps every
+        # admitted request via submit()'s _Request construction.
+        self._deadline_s = 0.0
 
         self._cv = _lt.condition("serve.cv")
         self._queue: List[_Request] = []
@@ -183,6 +217,12 @@ class ServingRuntime:
         # array), so a toggle scheme keyed on batch parity would corrupt
         # an in-flight batch under sustained load
         self._staging: Dict[Tuple[int, int], Queue] = {}
+        # every ADMITTED, unresolved request (added in submit under _cv,
+        # discarded when its event is set).  stop()'s drain sweep walks
+        # this — NOT just self._queue — so a request a worker popped but
+        # never resolved (a dispatch wedged inside the device runtime)
+        # still gets a typed error instead of hanging its waiter forever
+        self._pending: set = set()
         self._shed_cache: Tuple[float, Optional[str]] = (-1e9, None)
         self._running = False
         self._started = False
@@ -205,21 +245,42 @@ class ServingRuntime:
                 return self
             self._started = True
             self._running = True
-        self._coalescer = threading.Thread(
-            target=self._coalesce_loop, daemon=True, name="lgbmtpu-coalescer")
-        self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, daemon=True, name="lgbmtpu-dispatch")
-        self._dispatcher.start()
-        self._coalescer.start()
+        self._spawn_workers()
+        # the /predict front door: the most recently started runtime owns
+        # the route on the (singleton) metrics endpoint — obs stays
+        # stdlib-only, so the serve layer registers a callable instead of
+        # obs importing serve
+        _obs_server.set_predict_handler(self._http_predict)
         _obs.event("serve_start", models=sorted(self._table),
                    max_wait_ms=self._max_wait_s * 1e3,
                    max_queue=self._max_queue)
         return self
 
+    def _spawn_workers(self) -> None:
+        """Spawn the worker threads (overridden by ServingFleet, which
+        runs one dispatcher per replica plus a supervisor)."""
+        self._coalescer = threading.Thread(  # jaxlint: disable=L5 (joined via the _worker_threads() loop in stop())
+            target=self._coalesce_loop, daemon=True, name="lgbmtpu-coalescer")
+        self._dispatcher = threading.Thread(  # jaxlint: disable=L5 (joined via the _worker_threads() loop in stop())
+            target=self._dispatch_loop, daemon=True, name="lgbmtpu-dispatch")
+        self._dispatcher.start()
+        self._coalescer.start()
+
+    def _worker_threads(self) -> List[threading.Thread]:
+        """Every thread stop() must join (fleet adds replicas + the
+        supervisor)."""
+        return [t for t in (self._coalescer, self._dispatcher)
+                if t is not None]
+
     def stop(self) -> None:
-        """Drain the queue, then stop both threads.  Idempotent; never
-        abandons an accepted request (each either completes or carries
-        an error)."""
+        """Drain the queue, then stop the worker threads.  Idempotent;
+        never abandons an accepted request: after the joins, EVERY
+        admitted request whose event is still unset — still queued,
+        or popped by a worker that wedged mid-dispatch and will never
+        publish a result — is failed with a typed error.  (The old
+        sweep only failed ``self._queue``; a batch a wedged dispatcher
+        held was in neither list, and its waiters hung forever — the
+        stop-under-load test in tests/test_serve.py pins the fix.)"""
         with self._cv:
             if self._closed:
                 return
@@ -229,31 +290,32 @@ class ServingRuntime:
             self._closed = True
             self._running = False
             self._cv.notify_all()
+        _obs_server.clear_predict_handler(self._http_predict)
+        wedged = False
         if self._started:
-            self._coalescer.join(timeout=30)
-            self._dispatcher.join(timeout=30)
-            if self._coalescer.is_alive() or self._dispatcher.is_alive():
-                # a wedged worker must not let stop() silently abandon
-                # accepted requests: fail everything still queued loudly
-                # (in-flight batch requests stay with the wedged thread,
-                # but their callers' result(timeout=) bounds the wait)
-                with self._cv:
-                    pending, self._queue = self._queue, []
-                for r in pending:
-                    r.error = LightGBMError(
-                        "ServingRuntime stopped with a wedged worker "
-                        "thread; request was never dispatched")
-                    r.event.set()
-                _obs.event("serve_stop_wedged",
-                           failed_requests=len(pending))
-        else:
-            # never-started runtime: fail whatever was queued, loudly
-            with self._cv:
-                pending, self._queue = self._queue, []
-            for r in pending:
-                r.error = LightGBMError(
-                    "ServingRuntime stopped before starting")
-                r.event.set()
+            for t in self._worker_threads():
+                t.join(timeout=30)
+                wedged = wedged or t.is_alive()
+        # the drain sweep: anything admitted but unresolved gets a typed
+        # error NOW.  After a clean join this set is empty (the coalescer
+        # drains the queue and the dispatcher resolves every handed batch
+        # before exiting); it is non-empty only for a never-started
+        # runtime or a wedged worker.
+        with self._cv:
+            leftover = [r for r in self._pending if not r.event.is_set()]
+            self._pending.clear()
+            self._queue = []
+            self._queued_per_tenant.clear()
+        for r in leftover:
+            r.error = LightGBMError(
+                "ServingRuntime stopped before the request resolved "
+                + ("(wedged worker thread)" if wedged
+                   else "(runtime never started)" if not self._started
+                   else "(shutdown drain)"))
+            r.event.set()
+        if leftover:
+            _obs.event("serve_stop_wedged" if wedged else "serve_stop_drain",
+                       failed_requests=len(leftover))
         _obs.gauge("serve_queue_depth").set(0.0)
         _obs.event("serve_stop")
 
@@ -286,6 +348,10 @@ class ServingRuntime:
         if name not in self._table:
             raise LightGBMError(f"model {name!r} is not served")
         g._packed(0, -1)  # warm the new pack outside the serving path
+        # chaos site: a failure BETWEEN the warm build and the table
+        # publish must leave every replica serving the OLD ensemble —
+        # the swap either fully publishes or changes nothing
+        _flt.maybe_fail("swap_publish")
         with self._cv:
             self._table[name] = g
         _obs.counter("serve_model_swaps_total").inc()
@@ -339,8 +405,11 @@ class ServingRuntime:
                     # cumulative p99 could latch the runtime shut
                     shed = None
             if shed is None:
-                req = _Request(X, model, bool(raw_score))
+                req = _Request(X, model, bool(raw_score),
+                               deadline=(time.monotonic() + self._deadline_s
+                                         if self._deadline_s > 0 else None))
                 self._queue.append(req)
+                self._pending.add(req)
                 self._queued_per_tenant[model] = (
                     self._queued_per_tenant.get(model, 0) + 1)
                 _obs.gauge("serve_queue_depth").set(len(self._queue))
@@ -360,13 +429,31 @@ class ServingRuntime:
 
     def result(self, req: _Request,
                timeout: Optional[float] = None) -> np.ndarray:
-        if not req.event.wait(timeout):
+        if req.deadline is not None:
+            budget = req.deadline - time.monotonic()
+            if timeout is not None:
+                budget = min(budget, timeout)
+            if not req.event.wait(max(budget, 0.0)):
+                if time.monotonic() >= req.deadline:
+                    self._count_deadline(req.model)
+                    raise DeadlineExceeded(req.model, self._deadline_s * 1e3)
+                raise TimeoutError("serving request did not complete in "
+                                   f"{timeout}s (queue depth "
+                                   f"{len(self._queue)})")
+        elif not req.event.wait(timeout):
             raise TimeoutError("serving request did not complete in "
                                f"{timeout}s (queue depth "
                                f"{len(self._queue)})")
         if req.error is not None:
             raise req.error
         return req.result
+
+    @staticmethod
+    def _count_deadline(tenant: str) -> None:
+        _obs.counter("serve_deadline_exceeded_total").inc()
+        _obs.counter(_obs.labeled("serve_deadline_exceeded_total",
+                                  tenant=tenant)).inc()
+        _obs.event("serve_deadline", tenant=tenant)
 
     def stats(self) -> Dict[str, Any]:
         with self._cv:
@@ -442,6 +529,14 @@ class ServingRuntime:
                 for r in batch:
                     r.error = e
                     r.event.set()
+                with self._cv:
+                    for r in batch:
+                        self._pending.discard(r)
+        self._shutdown_pipeline()
+
+    def _shutdown_pipeline(self) -> None:
+        """Coalescer exit: wake the dispatch side (overridden by the
+        fleet, whose replica loops poll ``self._running`` instead)."""
         self._hand.put(None)  # dispatcher stop sentinel
 
     def _note_dequeued(self, req: _Request) -> None:
@@ -491,7 +586,7 @@ class ServingRuntime:
                             break
                 if (total >= MAX_BATCH_ROWS
                         or total == _predict_bucket(total)
-                        or self._hand.unfinished_tasks == 0):
+                        or self._pipeline_idle()):
                     break  # rung filled, cap reached, or idle pipeline
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._running:
@@ -511,11 +606,23 @@ class ServingRuntime:
         pool = self._staging.get(key)
         if pool is None:
             pool = Queue()
-            for _ in range(2):
+            for _ in range(self._staging_pairs()):
                 pool.put((np.zeros((nb, f), np.float32),
                           np.zeros(nb, bool)))
             self._staging[key] = pool
         return key, pool.get()
+
+    def _staging_pairs(self) -> int:
+        """Pinned pairs per rung: 2 (the double buffer) for the solo
+        runtime; the fleet sizes it replicas+1 so N concurrent in-flight
+        batches on one rung cannot starve the coalescer."""
+        return 2
+
+    def _pipeline_idle(self) -> bool:
+        """True when the dispatch side has fully retired its work — the
+        coalescer's immediate-flush condition (overridden by the fleet:
+        idle means ANY routable replica is idle)."""
+        return self._hand.unfinished_tasks == 0
 
     def _return_staging(self, key, pair) -> None:
         self._staging[key].put(pair)
@@ -524,10 +631,21 @@ class ServingRuntime:
         """Pack the batch into the rung's pinned buffer (ONE copy per
         request), upload, and hand to the dispatcher.  The blocking
         depth-1 put is the pipeline: this upload overlaps the previous
-        batch's device execution."""
+        batch's device execution.  (The fleet overrides this to ROUTE
+        the staged item to a healthy replica's hand queue.)"""
         if batch[0].serial:
             self._hand.put(("serial", batch, g))
             return
+        self._hand.put(self._stage_batch(g, batch))
+
+    def _stage_batch(self, g, batch: List[_Request]):
+        """Stage one coalesced batch into a checked-out pinned pair and
+        return the ``("batch", batch, payload)`` hand item.  On ANY
+        failure the pair is returned before re-raising: leaking it would
+        shrink the rung's pool and eventually block _checkout_staging
+        forever — wedging the coalescer, the hang this module exists to
+        prevent.  (After a successful hand-off the DISPATCHER owns the
+        return.)"""
         total = sum(r.n for r in batch)
         nb = _predict_bucket(total)
         skey, pair = self._checkout_staging(nb, batch[0].x.shape[1])
@@ -542,14 +660,8 @@ class ServingRuntime:
             mask[off:] = False
             x_dev = jax.device_put(buf)
             active = None if off == nb else jax.device_put(mask)
-            self._hand.put(("batch", batch,
-                            (g, x_dev, active, total, nb, skey, pair)))
+            return ("batch", batch, (g, x_dev, active, total, nb, skey, pair))
         except BaseException:
-            # a failed stage (device OOM in device_put, ...) must return
-            # the pair: leaking it would shrink the rung's 2-pair pool
-            # and eventually block _checkout_staging forever — wedging
-            # the coalescer, the hang this module exists to prevent.
-            # (After a successful put the DISPATCHER owns the return.)
             self._return_staging(skey, pair)
             raise
 
@@ -616,7 +728,51 @@ class ServingRuntime:
                 # admission window stays a busy-pipeline-only cost
                 self._hand.task_done()
                 with self._cv:
+                    for r in batch:
+                        self._pending.discard(r)
                     self._cv.notify_all()
+
+
+    # -- /predict front door (obs/server.py owns the socket) -------------
+    def _http_predict(self, payload: Dict[str, Any]) -> Tuple[int, Dict]:
+        """One ``POST /predict`` request: JSON rows in, predictions out,
+        routed through the SAME submit/result path every other caller
+        uses — so shedding, deadlines and fleet health apply unchanged,
+        mapped onto HTTP: Overloaded -> 429 (unhealthy -> 503),
+        DeadlineExceeded/timeout -> 504, stopped runtime -> 503, bad
+        request -> 400."""
+        _obs.counter("serve_http_requests_total").inc()
+        try:
+            rows = payload.get("rows") if isinstance(payload, dict) else None
+            if rows is None:
+                return 400, {"error": "bad_request",
+                             "detail": 'body must be JSON like '
+                                       '{"rows": [[...], ...], '
+                                       '"model": "default", '
+                                       '"raw_score": false}'}
+            X = np.asarray(rows, dtype=np.float64)
+            model = str(payload.get("model", "default"))
+            raw = bool(payload.get("raw_score", False))
+            y = self.predict(X, model=model, raw_score=raw,
+                             timeout=_PREDICT_HTTP_TIMEOUT_S)
+            return 200, {"model": model,
+                         "rows": int(np.atleast_2d(X).shape[0]),
+                         "predictions": np.asarray(y).tolist()}
+        except Overloaded as e:
+            # admission refusals: 429 back-pressure, except an unhealthy
+            # process, which is a 503 service condition
+            code = 503 if e.reason == "unhealthy" else 429
+            return code, {"error": "overloaded", "reason": e.reason,
+                          "tenant": e.tenant}
+        except DeadlineExceeded as e:
+            return 504, {"error": "deadline_exceeded", "tenant": e.tenant,
+                         "deadline_ms": e.deadline_ms}
+        except TimeoutError as e:
+            return 504, {"error": "timeout", "detail": str(e)}
+        except LightGBMError as e:
+            return 503, {"error": "unavailable", "detail": str(e)}
+        except (TypeError, ValueError, KeyError) as e:
+            return 400, {"error": "bad_request", "detail": str(e)}
 
 
 # -- audit hook (analysis/contracts.py predict_coalesced_bucket) --------

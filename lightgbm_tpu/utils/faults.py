@@ -57,6 +57,29 @@ Sites (see docs/ROBUSTNESS.md for the exact trigger points):
                     1-based boosting iteration <round>.
 ``nonfinite_hess``  same, for the hessian.
 
+Serve-side sites (round 22 — the chaos harness for the replica fleet,
+``serve/fleet.py``; all four are CALL-counted like the pallas sites, and
+each replica batch touches a site at two pipeline stages — stage A on
+batch receipt, stage B after the dispatch retires — so even/odd <round>
+values select the stage):
+
+``replica_dispatch`` a replica's batch dispatch raises
+                    :class:`InjectedFault` — the transient failure class
+                    (driver hiccup, OOM on one device): the batch's
+                    requests requeue EXACTLY once onto a healthy replica.
+``replica_death``   the replica worker THREAD dies (the thread-fleet
+                    analogue of ``worker_death``): its in-flight batch
+                    requeues and the fleet supervisor restarts the
+                    replica with backoff.
+``replica_hang``    the replica thread SLEEPS FOREVER mid-pipeline —
+                    only the per-replica heartbeat watchdog catches it;
+                    the supervisor requeues the wedged batch and spawns
+                    a replacement.
+``swap_publish``    ``ServingRuntime.swap_model`` — raises BETWEEN the
+                    replacement pack's warm build and its publication to
+                    the model table: every replica must keep serving the
+                    OLD ensemble, never a torn table.
+
 Determinism rules:
 
 * a (site, round) pair fires exactly ONCE per process (an in-memory
@@ -86,8 +109,11 @@ CRASH_EXIT_CODE = 113
 _RANK_GATED_SITES = ("worker_death", "worker_hang")
 
 # sites whose <round> is a per-site CALL counter rather than an explicit
-# round number passed by the caller (trace-time sites have no round)
-_CALL_COUNTED_SITES = ("pallas_hist", "pallas_partition", "pallas_round")
+# round number passed by the caller (trace-time sites have no round; the
+# serve sites count pipeline-stage touches — see the module docstring)
+_CALL_COUNTED_SITES = ("pallas_hist", "pallas_partition", "pallas_round",
+                       "replica_dispatch", "replica_death", "replica_hang",
+                       "swap_publish")
 
 
 class InjectedFault(RuntimeError):

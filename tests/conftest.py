@@ -14,8 +14,20 @@ so the re-exec'd process inherits redirected fds and its output is orphaned.)
 """
 
 import os
+import pathlib
 
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# Persistent XLA compilation cache: tier-1 wall clock is dominated by CPU
+# backend compiles (the bucket ladder + fused round re-compile identical
+# HLO every run), and a warm disk cache roughly halves the suite.  The dir
+# lives inside the repo so hermetic checkouts stay self-contained; only
+# compiles >= 0.5s are cached, so cheap per-test executables still exercise
+# the real compile path and in-process retrace/budget pins (which hook
+# trace events and executable reuse, not disk) are unaffected.
+_cache_dir = pathlib.Path(__file__).resolve().parent.parent / ".jax_compile_cache"
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", str(_cache_dir))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"

@@ -2185,3 +2185,109 @@ def test_r18_pragma_suppression(tmp_path):
     """}, rules=["R18"])
     assert rep.findings == []
     assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R19 unbounded-retry
+# ---------------------------------------------------------------------------
+
+def test_r19_positive_hot_retry_loop(tmp_path):
+    """The canonical anti-pattern: swallow everything, loop straight back
+    into the next attempt — no pacing, no budget, no deadline."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import requests
+
+        def poll(url):
+            while True:
+                try:
+                    return requests.get(url)
+                except Exception:
+                    continue
+    """}, rules=["R19"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].rule == "R19"
+    assert "backoff" in rep.findings[0].message
+
+
+def test_r19_positive_bare_except_swallow(tmp_path):
+    """A bare except that logs and spins is the same hazard; re-dispatch
+    spellings (predict/send) count as IO-ish."""
+    rep = _scan(tmp_path, {"mod.py": """
+        def drive(runtime, batch, log):
+            while True:
+                try:
+                    runtime.predict(batch)
+                except:
+                    log.warning("dispatch failed")
+    """}, rules=["R19"])
+    assert len(rep.findings) == 1, rep.findings
+    assert "predict" in rep.findings[0].message
+
+
+def test_r19_negative_paced_or_bounded(tmp_path):
+    """Pacing (sleep/backoff), a retry budget, or a deadline check each
+    bound the loop — any one of them clears the finding."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import time
+        import requests
+
+        def paced(url):
+            backoff = 0.05
+            while True:
+                try:
+                    return requests.get(url)
+                except Exception:
+                    time.sleep(backoff)
+                    backoff *= 2
+
+        def budgeted(url, clock):
+            deadline = clock() + 30.0
+            while clock() < deadline:
+                try:
+                    return requests.get(url)
+                except Exception:
+                    pass
+            raise TimeoutError(url)
+    """}, rules=["R19"])
+    assert rep.findings == []
+
+
+def test_r19_negative_narrow_catch_and_worker_loop(tmp_path):
+    """A narrow catch names the one expected failure instead of swallowing
+    all of them, and a worker loop blocking on a bare queue ``.get()`` for
+    its next item cannot hot-spin (the serve dispatcher shape); a handler
+    that re-raises or breaks surfaces the failure instead of retrying."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import queue
+
+        def worker(hand, runtime):
+            while True:
+                try:
+                    item = hand.get()
+                    runtime.predict(item)
+                except queue.Empty:
+                    continue
+
+        def surfaced(runtime, batch):
+            while True:
+                try:
+                    return runtime.predict(batch)
+                except Exception:
+                    raise
+    """}, rules=["R19"])
+    assert rep.findings == []
+
+
+def test_r19_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import requests
+
+        def poll(url):
+            while True:
+                try:  # jaxlint: disable=R19 (fixture: chaos-harness spin probe, bounded by the harness timeout)
+                    return requests.get(url)
+                except Exception:
+                    continue
+    """}, rules=["R19"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1

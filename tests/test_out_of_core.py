@@ -930,3 +930,139 @@ def test_launcher_cache_feed_trains_equal_to_in_memory(tmp_path):
         launcher.train_distributed(params, np.zeros((4, 2)), None,
                                    num_boost_round=1, num_machines=1,
                                    data_cache=cache)
+
+
+# ---------------------------------------------------------------------------
+# segmented appends + compaction (round 23 — the continual runner's
+# O(new rows) steady-state ingest: sidecar segments, threshold-triggered
+# fold-back, crash-stranded sidecars ignored via the watermark)
+# ---------------------------------------------------------------------------
+
+def test_segment_append_leaves_base_untouched_and_reloads(tmp_path):
+    """Under the threshold, appends land in CRC'd sidecars: the base file
+    is BYTE-identical afterwards (O(new rows) per append), the stream and
+    a Dataset reload both see base + segments as one logical cache, and
+    the append log records every seam."""
+    from lightgbm_tpu.io.stream import BinCacheStream, append_rows
+    from lightgbm_tpu.obs import metrics as obs
+
+    cache, bins = _make_cache(tmp_path, n=300, f=4, name="seg.bin")
+    base_bytes = open(cache, "rb").read()
+    ds0 = lgb.Dataset(cache, params=dict(_PARAMS))
+    ds0.construct()
+    Xn, yn = _make_data(n=90, f=4, seed=9)
+    nb = ds0.binner.transform(Xn)
+    c0 = obs.counter("bin_cache_segment_appends_total").value
+    assert append_rows(cache, nb[:40], label=yn[:40],
+                       segment_threshold=3) == 340
+    assert append_rows(cache, nb[40:], label=yn[40:],
+                       segment_threshold=3) == 390
+    assert open(cache, "rb").read() == base_bytes  # base never rewritten
+    assert os.path.exists(cache + ".seg.0")
+    assert os.path.exists(cache + ".seg.1")
+    assert obs.counter("bin_cache_segment_appends_total").value == c0 + 2
+
+    s = BinCacheStream(cache)
+    assert s.shape == (390, 4)
+    assert [k for k, _sp, _n in s.segments] == [0, 1]
+    assert list(s.append_log) == [300, 340]
+    got = np.concatenate([v.copy() for _, v in s.chunks(64)])
+    np.testing.assert_array_equal(got[:300], bins)
+    np.testing.assert_array_equal(got[300:], nb.astype(s.dtype))
+
+    ds = lgb.Dataset(cache, params=dict(_PARAMS))
+    ds.construct()
+    assert ds.num_data() == 390
+    np.testing.assert_array_equal(np.asarray(ds.bins)[300:],
+                                  nb.astype(np.asarray(ds.bins).dtype))
+    np.testing.assert_allclose(np.asarray(ds.label)[300:], yn)
+
+
+def test_segment_threshold_triggers_compaction(tmp_path):
+    """Reaching the threshold folds every live segment back into the base
+    through the verified rewrite: sidecars are deleted, the watermark
+    covers the folded indices, and the logical rows are preserved
+    exactly."""
+    from lightgbm_tpu.io.stream import BinCacheStream, append_rows
+    from lightgbm_tpu.obs import metrics as obs
+
+    cache, bins = _make_cache(tmp_path, n=300, f=4, name="fold.bin")
+    ds0 = lgb.Dataset(cache, params=dict(_PARAMS))
+    ds0.construct()
+    Xn, yn = _make_data(n=80, f=4, seed=9)
+    nb = ds0.binner.transform(Xn)
+    c0 = obs.counter("bin_cache_compactions_total").value
+    append_rows(cache, nb[:30], label=yn[:30], segment_threshold=2)
+    assert os.path.exists(cache + ".seg.0")
+    assert obs.counter("bin_cache_compactions_total").value == c0
+    append_rows(cache, nb[30:], label=yn[30:], segment_threshold=2)
+    assert obs.counter("bin_cache_compactions_total").value == c0 + 1
+    assert not os.path.exists(cache + ".seg.0")
+    assert not os.path.exists(cache + ".seg.1")
+
+    s = BinCacheStream(cache)
+    assert not s.segments and s.shape == (380, 4)
+    assert s.seg_watermark == 1  # both folded indices covered
+    got = np.concatenate([v.copy() for _, v in s.chunks(50)])
+    np.testing.assert_array_equal(got[:300], bins)
+    np.testing.assert_array_equal(got[300:], nb.astype(s.dtype))
+    with np.load(cache, allow_pickle=False) as z:
+        assert len(z["label"]) == 380  # labels folded into the base npz
+    ds = lgb.Dataset(cache, params=dict(_PARAMS))
+    ds.construct()
+    assert ds.num_data() == 380
+    np.testing.assert_allclose(np.asarray(ds.label)[300:], yn)
+
+
+def test_stale_sidecar_past_watermark_is_ignored(tmp_path):
+    """A crash between compaction's atomic replace and its sidecar
+    deletes strands already-folded segment files: the watermark makes
+    every reader skip them — rows are never double-counted."""
+    from lightgbm_tpu.io.stream import BinCacheStream, append_rows
+
+    cache, _bins = _make_cache(tmp_path, n=300, f=4, name="stale.bin")
+    ds0 = lgb.Dataset(cache, params=dict(_PARAMS))
+    ds0.construct()
+    Xn, yn = _make_data(n=60, f=4, seed=9)
+    nb = ds0.binner.transform(Xn)
+    append_rows(cache, nb[:25], label=yn[:25], segment_threshold=2)
+    stranded = open(cache + ".seg.0", "rb").read()
+    append_rows(cache, nb[25:], label=yn[25:], segment_threshold=2)
+    assert not os.path.exists(cache + ".seg.0")  # compaction reaped it
+    # the crash: the folded sidecar reappears after the base replace
+    open(cache + ".seg.0", "wb").write(stranded)
+
+    s = BinCacheStream(cache)
+    assert not s.segments, "stale sidecar was re-counted"
+    assert s.shape == (360, 4)
+    ds = lgb.Dataset(cache, params=dict(_PARAMS))
+    ds.construct()
+    assert ds.num_data() == 360
+    # temp files from an in-flight segment write are skipped too
+    open(cache + ".seg.tmp123", "wb").write(b"junk")
+    assert not BinCacheStream(cache).segments
+
+
+def test_segment_fingerprint_moves_on_append_and_compaction(tmp_path):
+    """The shard fingerprint covers sidecar bytes: every segment append
+    moves it (the fleet manifests must notice new rows without reading
+    payloads), and it never goes empty while segments carry CRC
+    tables."""
+    from lightgbm_tpu.io.stream import (append_rows,
+                                        cache_shard_fingerprint)
+
+    cache, _bins = _make_cache(tmp_path, n=300, f=4, name="fp.bin")
+    ds0 = lgb.Dataset(cache, params=dict(_PARAMS))
+    ds0.construct()
+    Xn, yn = _make_data(n=60, f=4, seed=9)
+    nb = ds0.binner.transform(Xn)
+    fps = [cache_shard_fingerprint(cache, 0, 10_000)]
+    append_rows(cache, nb[:20], label=yn[:20], segment_threshold=4)
+    fps.append(cache_shard_fingerprint(cache, 0, 10_000))
+    append_rows(cache, nb[20:], label=yn[20:], segment_threshold=4)
+    fps.append(cache_shard_fingerprint(cache, 0, 10_000))
+    assert all(fps), "fingerprint went unverifiable mid-ingest"
+    assert len(set(fps)) == 3, "an append did not move the fingerprint"
+    # a base-range fingerprint ignores the sidecars entirely
+    assert cache_shard_fingerprint(cache, 0, 300) == \
+        cache_shard_fingerprint(cache, 0, 300)

@@ -1,0 +1,622 @@
+"""Serving-fleet resilience pins (round 23 — lightgbm_tpu/serve/fleet).
+
+The fleet contract under chaos: N replicas behind ONE admission queue
+lose ZERO admitted requests when a replica dies, hangs, or fails a
+dispatch — every response stays BITWISE equal to the solo
+``ServingRuntime`` (itself bitwise equal to ``Booster.predict``), a
+failed batch's requests are requeued EXACTLY once onto a healthy
+replica, the circuit breaker never ejects the LAST healthy replica, a
+replacement replica warms its packs BEFORE joining rotation, and the
+warm per-batch budget (1 dispatch + 1 accounted sync) holds at any
+replica count.  The whole file runs under the session-wide STRICT lock
+sanitizer (conftest) with telemetry and span tracing on — resilience
+machinery that only works with observability off would be theater.
+
+Fault-injection notes (utils/faults.py): the serve sites are
+call-counted — sequential submits coalesce into ONE batch, and each
+replica execution touches every serve site twice (stage A before the
+dispatch, stage B after), so ``<site>:0`` arms stage A of the first
+armed execution and ``<site>:1`` stage B.  ``fire()`` only advances a
+site's counter while the site is armed, so tests warm the executables
+FIRST (env unset), then arm the env — the warm traffic never skews the
+counters.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import metrics as obs
+from lightgbm_tpu.serve import (DeadlineExceeded, Overloaded, ServingFleet,
+                                ServingRuntime)
+from lightgbm_tpu.utils import faults as flt
+from lightgbm_tpu.utils.sanitizer import DispatchCounter
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    from lightgbm_tpu.obs import server as _srv
+    from lightgbm_tpu.obs import trace as _trc
+
+    obs.reset()
+    _trc.reset_trace()
+    os.environ.pop("LGBMTPU_FAULT", None)
+    flt.reset()
+    yield
+    os.environ.pop("LGBMTPU_FAULT", None)
+    flt.reset()
+    _srv.stop_server()
+    obs.reset()
+    _trc.reset_trace()
+
+
+def _binary_booster(n=400, f=6, rounds=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 7,
+                              "verbosity": -1},
+                      train_set=lgb.Dataset(X, label=y))
+    for _ in range(rounds):
+        bst.update()
+    return bst, X
+
+
+def _fleet(bst, replicas=2, **kw):
+    kw.setdefault("max_wait_ms", 20)
+    kw.setdefault("shed_unhealthy", False)
+    kw.setdefault("hang_timeout_ms", 30_000)  # hang tests override
+    kw.setdefault("hedge_ms", 0)
+    return ServingFleet(bst, replicas=replicas, **kw)
+
+
+def _warm(fl, X):
+    """One round of traffic with NO fault armed: compiles the coalesced
+    bucket executables so chaos rounds dispatch in milliseconds (a cold
+    jit compile under a short hang timeout would false-positive the
+    watchdog) and leaves the fault call-counters untouched (fire() only
+    counts armed sites)."""
+    assert "LGBMTPU_FAULT" not in os.environ
+    got = fl.predict(X[:16], raw_score=True, timeout=120)
+    assert got.shape == (16,)
+
+
+def _arm(spec):
+    os.environ["LGBMTPU_FAULT"] = spec
+
+
+# ---------------------------------------------------------------------------
+# parity: fleet == solo runtime == Booster.predict, bitwise
+# ---------------------------------------------------------------------------
+
+def test_fleet_bitwise_parity_vs_solo_runtime():
+    bst, X = _binary_booster()
+    slices = [X[i * 16:(i + 1) * 16] for i in range(6)]
+    with ServingRuntime(bst, max_wait_ms=20, shed_unhealthy=False) as solo:
+        want = [solo.predict(s, raw_score=True, timeout=120) for s in slices]
+    for w, s in zip(want, slices):
+        assert np.array_equal(w, bst.predict(s, raw_score=True))
+    fl = _fleet(bst, replicas=2)
+    try:
+        got = [fl.predict(s, raw_score=True, timeout=120) for s in slices]
+    finally:
+        fl.stop()
+    for w, g in zip(want, got):
+        assert np.array_equal(w, g), "fleet diverged from solo runtime"
+
+
+def test_engine_serve_entry_returns_fleet():
+    bst, X = _binary_booster()
+    rt = lgb.serve(bst, {"serve_replicas": 2, "serve_max_wait_ms": 10})
+    try:
+        assert isinstance(rt, ServingFleet)
+        got = rt.predict(X[:8], raw_score=True, timeout=120)
+        assert np.array_equal(got, bst.predict(X[:8], raw_score=True))
+        assert rt.stats()["replicas"] == {0: "active", 1: "active"}
+    finally:
+        rt.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE chaos matrix: death / hang at each pipeline stage x replica counts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("replicas", [1, 2, 4])
+@pytest.mark.parametrize("stage", [0, 1], ids=["stageA", "stageB"])
+@pytest.mark.parametrize("site", ["replica_death", "replica_hang"])
+def test_chaos_matrix_zero_loss_bitwise(site, stage, replicas):
+    """A replica killed or wedged at EITHER side of the dispatch loses
+    zero admitted requests: the inflight batch requeues onto a healthy
+    replica (or the restarted one, at replicas=1) and every response is
+    bitwise equal to Booster.predict."""
+    bst, X = _binary_booster()
+    slices = [X[i * 8:(i + 1) * 8] for i in range(4)]
+    want = [bst.predict(s, raw_score=True) for s in slices]
+    fl = _fleet(bst, replicas=replicas,
+                hang_timeout_ms=1_500, restart_backoff_ms=50,
+                max_wait_ms=60)
+    try:
+        _warm(fl, X)
+        _arm(f"{site}:{stage}")
+        handles = [fl.submit(s, raw_score=True) for s in slices]
+        got = [fl.result(h, timeout=120) for h in handles]
+        for w, g in zip(want, got):
+            assert np.array_equal(w, g), f"{site}@{stage} diverged"
+        assert obs.counter("faults_injected_total").value == 1
+        dead = ("serve_replica_hangs_total" if site == "replica_hang"
+                else "serve_replica_deaths_total")
+        assert obs.counter(dead).value == 1
+        assert obs.counter("serve_requeues_total").value >= 1
+    finally:
+        # stop() must return promptly even though the wedged incarnation
+        # sleeps forever: the watchdog either marked it hung (skipped at
+        # join) or already replaced rep.thread with a fresh incarnation —
+        # the daemon is abandoned, never joined
+        t0 = time.monotonic()
+        fl.stop()
+        assert time.monotonic() - t0 < 20, "stop() joined a wedged thread"
+
+
+def test_replacement_warms_before_rotation_and_restart_counted():
+    """After a death the supervisor restarts the replica; the replacement
+    re-warms the pack ladder BEFORE taking traffic, so post-recovery
+    batches stay on the warm budget."""
+    bst, X = _binary_booster()
+    fl = _fleet(bst, replicas=2, restart_backoff_ms=30, max_wait_ms=30)
+    try:
+        _warm(fl, X)
+        _arm("replica_death:0")
+        got = fl.predict(X[:16], raw_score=True, timeout=120)
+        assert np.array_equal(got, bst.predict(X[:16], raw_score=True))
+        deadline = time.monotonic() + 30
+        while (obs.counter("serve_replica_restarts_total").value < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert obs.counter("serve_replica_restarts_total").value == 1
+        with fl._cv:
+            states = [r.state for r in fl._replicas]
+        assert states == [0, 0], f"replica not back in rotation: {states}"
+        # the restarted fleet serves warm: 1 dispatch + 1 sync per batch
+        with DispatchCounter() as d:
+            out = fl.predict(X[:16], raw_score=True, timeout=120)
+        # read the ledger BEFORE the reference predict below adds to it
+        assert d.dispatches == 1 and d.host_syncs == 1
+        d.assert_no_recompile("post-restart fleet batch")
+        assert np.array_equal(out, bst.predict(X[:16], raw_score=True))
+    finally:
+        fl.stop()
+
+
+# ---------------------------------------------------------------------------
+# exactly-once requeue + typed failure when the retry is also lost
+# ---------------------------------------------------------------------------
+
+def test_requeue_is_exactly_once_then_typed_error():
+    """dispatch-failure at stage A requeues the batch once (counted per
+    request); when the RETRIED batch dies too, the requests surface a
+    typed error — never a second requeue, never a hang."""
+    bst, X = _binary_booster()
+    slices = [X[0:8], X[8:16]]
+    fl = _fleet(bst, replicas=2, max_wait_ms=60, restart_backoff_ms=50)
+    try:
+        _warm(fl, X)
+        # dispatch counter touch 0 = first armed execution's stage A
+        # (death touched once there, c0); the REQUEUED execution touches
+        # death at stage A (c1) and stage B (c2) — arm c2 to kill the
+        # replica right after the retried dispatch
+        _arm("replica_dispatch:0,replica_death:2")
+        handles = [fl.submit(s, raw_score=True) for s in slices]
+        errs = []
+        for h in handles:
+            with pytest.raises(RuntimeError, match="died"):
+                try:
+                    fl.result(h, timeout=120)
+                except RuntimeError as e:
+                    errs.append(e)
+                    raise
+        assert len(errs) == 2
+        # one requeue per request of the failed batch — and ONLY one
+        assert obs.counter("serve_requeues_total").value == 2
+        assert obs.counter("serve_replica_failures_total").value >= 1
+        assert obs.events("serve_requeue")
+    finally:
+        fl.stop()
+
+
+def test_retry_budget_exhaustion_degrades_to_shedding():
+    """With the retry budget drained a failed batch does NOT requeue: the
+    requests fail typed and the exhaustion is counted — a sick fleet
+    sheds instead of retry-storming."""
+    bst, X = _binary_booster()
+    fl = _fleet(bst, replicas=2, max_wait_ms=30)
+    try:
+        _warm(fl, X)
+        with fl._cv:
+            fl._retry_tokens = 0.0
+        fl._retry_rate = 0.0  # submit must not refill for this pin
+        _arm("replica_dispatch:0")
+        h = fl.submit(X[:8], raw_score=True)
+        with pytest.raises(flt.InjectedFault):
+            fl.result(h, timeout=120)
+        assert obs.counter("serve_retry_budget_exhausted_total").value == 1
+        assert obs.counter("serve_requeues_total").value == 0
+    finally:
+        fl.stop()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: ejection, half-open readmission, last-replica guard
+# ---------------------------------------------------------------------------
+
+def test_breaker_ejects_readmits_and_never_ejects_last_replica():
+    bst, X = _binary_booster()
+    fl = _fleet(bst, replicas=2, trip=1, cooldown_ms=60, max_wait_ms=30)
+    try:
+        _warm(fl, X)
+        _arm("replica_dispatch:0")
+        got = fl.predict(X[:8], raw_score=True, timeout=120)
+        assert np.array_equal(got, bst.predict(X[:8], raw_score=True))
+        assert obs.counter("serve_replica_ejections_total").value == 1
+        assert obs.events("serve_replica_eject")
+        # cooldown -> half-open -> a probe batch readmits it
+        deadline = time.monotonic() + 30
+        while (obs.counter("serve_replica_readmissions_total").value < 1
+               and time.monotonic() < deadline):
+            fl.predict(X[:8], raw_score=True, timeout=120)
+            time.sleep(0.02)
+        assert obs.counter("serve_replica_readmissions_total").value == 1
+        assert obs.events("serve_replica_readmit")
+        # the LAST healthy replica is never ejected, whatever its streak
+        with fl._cv:
+            last = next(r for r in fl._replicas if r.state == 0)
+            for other in fl._replicas:
+                if other is not last:
+                    other.state = 2  # ejected
+            last.fail_streak = 99
+            fl._breaker_failure_locked(last, time.monotonic())
+            assert last.state == 0, "last healthy replica was ejected"
+            for other in fl._replicas:
+                if other is not last:
+                    other.state = 0
+        assert obs.counter("serve_replica_ejections_total").value == 1
+    finally:
+        fl.stop()
+
+
+# ---------------------------------------------------------------------------
+# deadlines and hedging
+# ---------------------------------------------------------------------------
+
+def test_deadline_exceeded_is_typed_and_distinct_from_overloaded():
+    bst, X = _binary_booster()
+    fl = _fleet(bst, replicas=2, deadline_ms=40, start=False)
+    h = fl.submit(X[:8])
+    time.sleep(0.1)  # never started: the deadline lapses in the queue
+    with pytest.raises(DeadlineExceeded) as ei:
+        fl.result(h, timeout=10)
+    assert not isinstance(ei.value, Overloaded)
+    assert ei.value.deadline_ms == pytest.approx(40.0)
+    assert obs.counter("serve_deadline_exceeded_total").value == 1
+    assert obs.events("serve_deadline")
+    fl.stop()
+
+
+def test_hedge_dispatches_second_copy_and_dedups():
+    """A dispatch that outlives the hedge delay gets a second copy on the
+    other replica; whichever publishes first wins and the loser's publish
+    is skipped — responses stay correct and are delivered once."""
+    bst, X = _binary_booster()
+    # a wedged stage-A dispatch is the deterministic slow replica; the
+    # 25 ms hedge fires long before the 2 s hang watchdog, which then
+    # reaps the wedged incarnation so stop() stays prompt
+    fl = _fleet(bst, replicas=2, hedge_ms=25, hang_timeout_ms=2_000,
+                restart_backoff_ms=50)
+    try:
+        _warm(fl, X)
+        _arm("replica_hang:0")
+        got = fl.predict(X[:16], raw_score=True, timeout=120)
+        assert np.array_equal(got, bst.predict(X[:16], raw_score=True))
+        assert obs.counter("serve_hedges_total").value >= 1
+        assert obs.events("serve_hedge")
+        # the hedge answered the caller; the watchdog reaps the wedged
+        # replica afterwards without disturbing the delivered response
+        deadline = time.monotonic() + 30
+        while (obs.counter("serve_replica_hangs_total").value < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert obs.counter("serve_replica_hangs_total").value == 1
+    finally:
+        fl.stop()
+
+
+# ---------------------------------------------------------------------------
+# warm budget: 1 dispatch + 1 accounted sync per fleet batch
+# ---------------------------------------------------------------------------
+
+def test_fleet_warm_batch_budget_with_telemetry_and_tracing_on():
+    from lightgbm_tpu.obs import trace as _trc
+
+    bst, X = _binary_booster()
+    fl = _fleet(bst, replicas=2, max_wait_ms=120)
+    try:
+        _warm(fl, X)
+        with DispatchCounter() as d:
+            got = fl.predict(X[:16], raw_score=True, timeout=120)
+        # read the ledger BEFORE the reference predict below adds to it
+        assert d.dispatches == 1, d.dispatches
+        assert d.host_syncs == 1, d.host_syncs
+        d.assert_no_recompile("warm fleet batch (strict lock tracing on)")
+        assert np.array_equal(got, bst.predict(X[:16], raw_score=True))
+        spans = _trc.spans("serve.batch")
+        assert spans and "replica" in spans[-1]["attrs"]
+        assert obs.histogram("serve_replica_batch_ms").count >= 1
+    finally:
+        fl.stop()
+
+
+# ---------------------------------------------------------------------------
+# stop() drains: admitted requests are answered or failed typed (bugfix pin)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cls", [ServingRuntime, ServingFleet],
+                         ids=["solo", "fleet"])
+def test_stop_under_load_answers_every_admitted_request(cls):
+    """stop() racing live submitters: every request admitted before (or
+    during) shutdown gets a result or a TYPED error promptly — no
+    stranded Event, no TimeoutError-only resolution."""
+    bst, X = _binary_booster()
+    kw = {"max_wait_ms": 10, "shed_unhealthy": False}
+    if cls is ServingFleet:
+        kw["replicas"] = 2
+    rt = cls(bst, **kw)
+    rt.predict(X[:16], raw_score=True, timeout=120)  # warm
+    outcomes = []
+    lock = threading.Lock()
+
+    def caller(i):
+        s = X[(i % 20) * 8:(i % 20) * 8 + 8]
+        try:
+            h = rt.submit(s, raw_score=True)
+        except (Overloaded, lgb.LightGBMError, RuntimeError):
+            # admission refused typed (shed, or the runtime had already
+            # stopped) — a legitimate outcome for a submit racing stop()
+            with lock:
+                outcomes.append("shed")
+            return
+        try:
+            got = rt.result(h, timeout=30)
+            ok = np.array_equal(got, bst.predict(s, raw_score=True))
+            with lock:
+                outcomes.append("ok" if ok else "WRONG")
+        except (lgb.LightGBMError, Overloaded, DeadlineExceeded,
+                RuntimeError, flt.InjectedFault):
+            with lock:
+                outcomes.append("typed")
+        except TimeoutError:
+            with lock:
+                outcomes.append("HUNG")
+
+    threads = [threading.Thread(target=caller, args=(i,)) for i in range(24)]
+    for i, t in enumerate(threads):
+        t.start()
+        if i == 8:
+            stopper = threading.Thread(target=rt.stop)
+            stopper.start()
+    for t in threads:
+        t.join(timeout=60)
+    stopper.join(timeout=60)
+    assert not stopper.is_alive(), "stop() hung under load"
+    assert len(outcomes) == 24
+    assert "WRONG" not in outcomes
+    assert "HUNG" not in outcomes, f"stranded requests: {outcomes}"
+    assert outcomes.count("ok") >= 1
+
+
+# ---------------------------------------------------------------------------
+# swap chaos: a failed publish leaves the OLD model serving
+# ---------------------------------------------------------------------------
+
+def test_swap_publish_fault_keeps_old_model_serving():
+    b1, X = _binary_booster(rounds=2, seed=5)
+    b2, _ = _binary_booster(rounds=7, seed=6)
+    fl = _fleet(bst=b1, replicas=2)
+    try:
+        _warm(fl, X)
+        _arm("swap_publish:0")
+        with pytest.raises(flt.InjectedFault):
+            fl.swap_model("default", b2)
+        os.environ.pop("LGBMTPU_FAULT", None)
+        got = fl.predict(X[:16], raw_score=True, timeout=120)
+        assert np.array_equal(got, b1.predict(X[:16], raw_score=True)), \
+            "failed publish leaked the replacement model"
+    finally:
+        fl.stop()
+
+
+# ---------------------------------------------------------------------------
+# /predict front door + /healthz replica table (HTTP satellites)
+# ---------------------------------------------------------------------------
+
+def _post(url, body, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_http_predict_route_codes_and_parity():
+    from lightgbm_tpu.obs import server as _srv
+
+    srv = _srv.start_server(0)
+    bst, X = _binary_booster()
+    fl = _fleet(bst, replicas=2)
+    try:
+        _warm(fl, X)
+        code, body = _post(srv.url("/predict"),
+                           {"rows": X[:8].tolist(), "raw_score": True})
+        assert code == 200
+        assert np.array_equal(np.asarray(body["predictions"]),
+                              bst.predict(X[:8], raw_score=True))
+        assert body["rows"] == 8
+        code, body = _post(srv.url("/predict"), {"nope": 1})
+        assert code == 400 and body["error"] == "bad_request"
+        assert obs.counter("serve_http_requests_total").value == 2
+        # the fleet's replica table rides on /healthz
+        hz = json.load(urllib.request.urlopen(srv.url("/healthz"),
+                                              timeout=10))
+        assert "serve_fleet" in hz
+        reps = hz["serve_fleet"]["replicas"]
+        assert len(reps) == 2
+        assert {r["state"] for r in reps} == {"active"}
+    finally:
+        fl.stop()
+    # a stopped runtime unregisters its route: 503, not a hang
+    code, body = _post(srv.url("/predict"), {"rows": X[:2].tolist()})
+    assert code == 503
+
+
+def test_http_predict_shed_and_deadline_status_codes():
+    from lightgbm_tpu.obs import server as _srv
+
+    srv = _srv.start_server(0)
+    bst, X = _binary_booster()
+    # UNSTARTED tiny queue: requests queue forever -> 429 on overflow and
+    # 504 once the deadline lapses.  start() is what registers the route
+    # (no workers run here by design), so attach the front door directly
+    # max_queue=2: the expired 504 request STAYS queued (nothing dequeues
+    # on an unstarted fleet), so the explicit submit below is slot #2
+    fl = _fleet(bst, replicas=2, max_queue=2, deadline_ms=300, start=False)
+    _srv.set_predict_handler(fl._http_predict)
+    try:
+        code, body = _post(srv.url("/predict"), {"rows": X[:4].tolist()})
+        assert code == 504 and body["error"] == "deadline_exceeded"
+        fl.submit(X[:4])  # fills the queue
+        code, body = _post(srv.url("/predict"), {"rows": X[:4].tolist()})
+        assert code == 429 and body["error"] == "overloaded"
+        assert body["reason"] == "queue_full"
+    finally:
+        fl.stop()
+
+
+def test_http_predict_unhealthy_is_503():
+    from lightgbm_tpu.obs import server as _srv
+
+    srv = _srv.start_server(0)
+    bst, X = _binary_booster()
+    obs.counter("train_nonfinite_errors_total").inc()  # unhealthy process
+    fl = ServingFleet(bst, replicas=2, hedge_ms=0)  # started: route live
+    try:
+        code, body = _post(srv.url("/predict"), {"rows": X[:4].tolist()})
+        assert code == 503 and body["reason"] == "unhealthy"
+    finally:
+        fl.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance: open-loop death chaos, zero loss, healthz flips, warm
+# budget re-pinned — telemetry + tracing + strict locktrace all ON
+# ---------------------------------------------------------------------------
+
+def test_acceptance_open_loop_death_zero_loss_bitwise_and_recovery():
+    from lightgbm_tpu.obs import server as _srv
+    from lightgbm_tpu.obs import trace as _trc
+
+    srv = _srv.start_server(0)
+    bst, X = _binary_booster()
+    slices = [X[(i % 24) * 8:(i % 24) * 8 + 8] for i in range(30)]
+    with ServingRuntime(bst, max_wait_ms=20, shed_unhealthy=False) as solo:
+        want = [solo.predict(s, raw_score=True, timeout=120)
+                for s in slices[:4]]
+    want += [bst.predict(s, raw_score=True) for s in slices[4:]]
+
+    # 1.5 s restart backoff keeps the degraded /healthz window wide enough
+    # for the live poll below to observe it even when warm-up is instant
+    # (persistent compile cache) and the poll thread is starved by the 30
+    # submitter threads
+    fl = _fleet(bst, replicas=2, restart_backoff_ms=1500, max_wait_ms=15,
+                max_queue=256)
+    got = [None] * len(slices)
+    errs = []
+
+    def _healthz():
+        return json.load(urllib.request.urlopen(srv.url("/healthz"),
+                                                timeout=10))
+
+    def _fleet_problem(hz):
+        return [p for p in hz["problems"]
+                if p.get("gauge") == "serve_fleet_degraded"]
+
+    try:
+        _warm(fl, X)
+        _arm("replica_death:0")
+
+        def caller(i):
+            try:
+                got[i] = fl.predict(slices[i], raw_score=True, timeout=120)
+            except BaseException as e:  # noqa: BLE001
+                errs.append((i, e))
+
+        threads = [threading.Thread(target=caller, args=(i,))
+                   for i in range(len(slices))]
+        for t in threads:  # open loop: keep submitting across the death
+            t.start()
+            time.sleep(0.004)
+        # /healthz flips to degraded WHILE the replica is out of rotation
+        saw_degraded = False
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not saw_degraded:
+            hz = _healthz()
+            saw_degraded = (hz["status"] == "degraded"
+                            and bool(_fleet_problem(hz)))
+        assert saw_degraded, "/healthz never showed the fleet degraded"
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, f"admitted requests were lost: {errs[:3]}"
+        for i, (w, g) in enumerate(zip(want, got)):
+            assert g is not None, f"request {i} got no response"
+            assert np.array_equal(w, g), f"request {i} diverged from solo"
+        # the death really happened and was survived
+        assert obs.counter("serve_replica_deaths_total").value == 1
+        assert obs.counter("serve_requeues_total").value >= 1
+        # the replacement rejoined: restart counted, both replicas active,
+        # the fleet-degraded condition cleared from /healthz (the injected
+        # fault's cumulative degraded marker — faults_injected_total —
+        # remains by design: chaos leaves an audit trail)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with fl._cv:
+                if (all(r.state == 0 for r in fl._replicas)
+                        and obs.counter(
+                            "serve_replica_restarts_total").value >= 1):
+                    break
+            time.sleep(0.02)
+        assert obs.counter("serve_replica_restarts_total").value == 1
+        assert obs.gauge("serve_fleet_degraded").value == 0.0
+        hz = _healthz()
+        assert not _fleet_problem(hz), hz["problems"]
+        assert [p for p in hz["problems"]
+                if p.get("counter") == "faults_injected_total"]
+        assert all(r["state"] == "active"
+                   for r in hz["serve_fleet"]["replicas"])
+        # degradation WAS visible while the replica was down
+        assert [e for e in obs.events("serve_replica_death")]
+        # warm budget re-pinned on the recovered fleet
+        with DispatchCounter() as d:
+            out = fl.predict(X[:16], raw_score=True, timeout=120)
+        assert d.dispatches == 1 and d.host_syncs == 1
+        d.assert_no_recompile("recovered fleet warm batch")
+        assert np.array_equal(out, bst.predict(X[:16], raw_score=True))
+        assert _trc.spans("serve.batch")
+    finally:
+        fl.stop()
