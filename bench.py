@@ -51,6 +51,15 @@ single-mesh sharded at full top-k coverage, per-rank round budget, and
 the statically pinned per-round DCN byte bill in-artifact
 (MULTICHIP_r07-format JSON).
 
+Feature2d mode (round 24): BENCH_MODE=feature2d runs the 2-D
+(rows x features) windowed-round dryrun (2x4 float and 4x2 int8
+off-chip via the hermetic subprocess helper; FEATURE2D_ROW_SHARDS /
+FEATURE2D_FEATURE_SHARDS override the float grid): tree == serial
+windowed, per-rank round budget, and the statically pinned per-axis
+collective byte bills — the feature axis carrying ONLY the go/no-go
+broadcast + election, never histograms — in-artifact
+(MULTICHIP_r08-format JSON).
+
 Out-of-core mode (round 12): BENCH_MODE=ooc runs the data-path levers
 (benchmarks/ooc_bench.py — stream-ingest rows/s vs chunk size,
 spill-training rows/s with bitwise parity asserted, and the partition
@@ -481,6 +490,62 @@ def main():
                          "dcn_bytes": r.detail.get("dcn_bytes"),
                          "large_collectives":
                              r.detail.get("large_collectives")}
+                for r in rep.results}
+            result["ok"] = result["ok"] and rep.ok
+        except Exception as e:  # noqa: BLE001 — artifact robustness
+            result["jaxpr_audit"] = {"error": f"{type(e).__name__}: {e}"}
+            result["ok"] = False
+        print(json.dumps(result, indent=2))
+        return 0 if result["ok"] else 1
+    if os.environ.get("BENCH_MODE") == "feature2d":
+        # 2-D (rows x features) windowed-round dryrun (round 24): the
+        # fused round over the (feature, row) mesh — per-feature-block
+        # histograms complete by layout (ZERO feature-axis collectives
+        # in the histogram phase), owned-feature election, winner's
+        # go/no-go row broadcast — validated for structural tree
+        # equality vs serial windowed growth + the per-rank round
+        # budget, with the per-axis collective byte bills from the
+        # jaxpr audit embedded in-artifact.  Writes MULTICHIP_r08-format
+        # JSON.
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import __graft_entry__ as _ge
+
+        d_r = int(os.environ.get("FEATURE2D_ROW_SHARDS", "2"))
+        d_f = int(os.environ.get("FEATURE2D_FEATURE_SHARDS", "4"))
+        grids = [(d_r, d_f, False), (d_f, d_r, True)]
+        result = {"mode": "feature2d_windowed", "grids": {}, "ok": True}
+        for rows, feats, quant in grids:
+            import io
+            from contextlib import redirect_stdout
+
+            key = f"{rows}x{feats}" + ("_int8" if quant else "_float")
+            buf = io.StringIO()
+            try:
+                with redirect_stdout(buf):
+                    _ge.dryrun_feature2d_windowed(rows, feats, quant)
+                result["grids"][key] = {
+                    "rc": 0, "ok": True,
+                    "tail": buf.getvalue()[-500:]}
+            except Exception as e:  # noqa: BLE001 — artifact robustness
+                result["grids"][key] = {
+                    "rc": 1, "ok": False,
+                    "tail": (buf.getvalue() + f"\n{type(e).__name__}: "
+                             f"{e}")[-800:]}
+                result["ok"] = False
+        # the per-axis byte bills, proven on the traced IR: the feature
+        # axis budget (go/no-go broadcast + election, no histograms)
+        # rides the artifact next to the row-axis histogram merge bill
+        try:
+            from lightgbm_tpu.analysis.jaxpr_audit import run_jaxpr_audit
+
+            rep = run_jaxpr_audit(
+                ["windowed_round_2d_float",
+                 "windowed_round_2d_quantized"], runtime=False)
+            result["jaxpr_audit"] = {
+                r.name: {"ok": r.ok,
+                         "axis_bytes": r.detail.get("axis_bytes"),
+                         "feature_bytes": r.detail.get("feature_bytes")}
                 for r in rep.results}
             result["ok"] = result["ok"] and rep.ok
         except Exception as e:  # noqa: BLE001 — artifact robustness

@@ -461,6 +461,67 @@ def bench_multislice(n=1600, n_feat=10):
     return int(tree_h.num_leaves), dcn, time.perf_counter() - t0
 
 
+def bench_feature2d(n=1600, n_feat=10):
+    """2-D (rows x features) windowed smoke (round 24): a 2x2
+    (row, feature) mesh training (needs >= 4 local devices — self-skips
+    below) must equal single-device windowed growth structurally with
+    zero retries/syncs, and the per-round feature-axis byte bill — the
+    go/no-go broadcast + election only, never histograms — must be
+    pinned in the metrics-facing audit detail."""
+    import jax
+
+    if jax.device_count() < 4:
+        return None
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.analysis.contracts import _2D_FEATURE_BUDGET
+    from lightgbm_tpu.analysis.jaxpr_audit import run_jaxpr_audit
+    from lightgbm_tpu.binning import DatasetBinner
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+    from lightgbm_tpu.parallel.feature2d import (
+        Sharded2DData, grow_tree_windowed_feature2d)
+    from lightgbm_tpu.parallel.mesh import make_mesh_2d
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(n, n_feat)
+    y = X @ rng.randn(n_feat) + 0.2 * rng.randn(n)
+    binner = DatasetBinner.fit(X, max_bin=31)
+    bins = binner.transform(X)
+    grad = jnp.asarray(0.6 * y, jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    kw = dict(num_leaves=15, num_bins=32,
+              params=SplitParams(min_data_in_leaf=5.0), leaf_tile=4,
+              use_pallas=False)
+    t0 = time.perf_counter()
+    tree_s, _ = grow_tree_windowed(
+        jnp.asarray(bins.T, jnp.int16), grad, hess, jnp.ones((n,), bool),
+        jnp.ones((n,), jnp.float32), jnp.ones((n_feat,), bool),
+        jnp.asarray(binner.num_bins_per_feature),
+        jnp.asarray(binner.missing_bin_per_feature), **kw)
+    sd = Sharded2DData(make_mesh_2d(2, 2), bins,
+                       binner.num_bins_per_feature,
+                       binner.missing_bin_per_feature)
+    stats = {}
+    tree_d, leaf_d = grow_tree_windowed_feature2d(
+        sd, sd.pad_rows_device(grad, jnp.float32),
+        sd.pad_rows_device(hess, jnp.float32), sd.row_valid,
+        sd.pad_rows_device(np.ones(n, np.float32), jnp.float32, fill=1.0),
+        jnp.ones((sd.f_pad,), bool).at[n_feat:].set(False),
+        stats=stats, **kw)
+    jax.block_until_ready(leaf_d)
+    m = int(tree_s.num_leaves) - 1
+    assert int(tree_d.num_leaves) == m + 1
+    assert (np.asarray(tree_s.split_feature)[:m]
+            == np.asarray(tree_d.split_feature)[:m]).all()
+    assert stats["retries"] == 0 and stats["host_syncs"] == 0, stats
+    rep = run_jaxpr_audit(["windowed_round_2d_float"], runtime=False)
+    assert rep.ok, [f.format() for f in rep.findings]
+    fb = rep.results[0].detail["feature_bytes"]
+    assert 0 < fb <= _2D_FEATURE_BUDGET
+    return int(tree_d.num_leaves), fb, time.perf_counter() - t0
+
+
 def bench_fleet(b=16, n_rows=256, n_feat=6, n_trees=3):
     """Round-20 fleet smoke: a B-lane fleet trained as one dispatch per
     round must leave every lane's served predictions bitwise equal to
@@ -510,7 +571,7 @@ def main():
     which = (sys.argv[1].split(",") if len(sys.argv) > 1
              else ["rank", "multiclass", "predict", "serve", "ooc",
                    "megakernel", "continual", "fleet", "fleet_serve",
-                   "multislice"])
+                   "multislice", "feature2d"])
     if "rank" in which:
         ips = bench_rank(n, q_len=128, iters=iters)
         print(f"lambdarank {n//1000}k rows x64f q128 63bins: {ips:.2f} iters/sec", flush=True)
@@ -560,6 +621,16 @@ def main():
             print(f"multislice 1.6k rows x10f on 2x2 nested mesh: "
                   f"{leaves}-leaf tree == single-device at full top-k, "
                   f"dcn_bytes/round={dcn} pinned ({dt:.1f}s)", flush=True)
+    if "feature2d" in which:
+        got = bench_feature2d()
+        if got is None:
+            print("feature2d: skipped (< 4 local devices)", flush=True)
+        else:
+            leaves, fb, dt = got
+            print(f"feature2d 1.6k rows x10f on 2x2 (rows x features) "
+                  f"mesh: {leaves}-leaf tree == single-device, "
+                  f"feature_bytes/round={fb} pinned, hist merge row-axis "
+                  f"only ({dt:.1f}s)", flush=True)
 
 
 if __name__ == "__main__":

@@ -110,6 +110,10 @@ class Contract:
     # whose axes include the dcn axis must stay under this — ≤ top-k
     # histograms' worth per round.  None = no dcn traffic declared.
     dcn_max_bytes: Optional[int] = None
+    # the feature-axis twin (the 2-D round's pin): ≤ the winner's
+    # go/no-go row broadcast + election scalars per round.  None = no
+    # feature-axis traffic declared.
+    feature_max_bytes: Optional[int] = None
 
 
 CONTRACTS: Dict[str, Contract] = {}
@@ -126,7 +130,8 @@ def contract(name: str, *, description: str,
              executes: bool = False,
              bin_arg: Optional[int] = None,
              max_bin_sweeps: Optional[float] = None,
-             dcn_max_bytes: Optional[int] = None):
+             dcn_max_bytes: Optional[int] = None,
+             feature_max_bytes: Optional[int] = None):
     """Register a contract; the decorated function is its builder."""
 
     def deco(build: Callable[[], Target]) -> Callable[[], Target]:
@@ -142,7 +147,8 @@ def contract(name: str, *, description: str,
             waivers=dict(waivers or {}), file=frame.filename,
             line=frame.lineno, executes=executes,
             bin_arg=bin_arg, max_bin_sweeps=max_bin_sweeps,
-            dcn_max_bytes=dcn_max_bytes)
+            dcn_max_bytes=dcn_max_bytes,
+            feature_max_bytes=feature_max_bytes)
         return build
 
     return deco
@@ -472,6 +478,135 @@ def _build_windowed_round_hierarchical_psum() -> Target:
 )
 def _build_windowed_round_hierarchical_voting() -> Target:
     return _windowed_hier_target("scatter")
+
+
+# ---------------------------------------------------------------------------
+# 2-D (feature x row) sharded round (parallel/feature2d.py) — the wide-F
+# regime.  The histogram phase must cross the feature axis with ZERO
+# collectives (the tile's histograms are complete for the owned block by
+# layout); the feature axis carries only the winner's go/no-go row
+# broadcast and the owned-feature election, byte-billed and pinned.
+# ---------------------------------------------------------------------------
+
+def _audit_mesh_2d():
+    """Loopback 2-D (row, feature) mesh: 2 x 2 on the virtual 8-device
+    host (axis size only changes the lowering, not the jaxpr — see
+    audit_mesh)."""
+    import jax
+
+    from ..parallel.mesh import make_mesh_2d
+    n = len(jax.devices())
+    if n >= 4:
+        return make_mesh_2d(2, 2)
+    return make_mesh_2d(1, min(n, 2))
+
+
+def _windowed_2d_target(quantize_bins: int) -> Target:
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import feature2d as f2d
+
+    mesh = _audit_mesh_2d()
+    q = bool(quantize_bins)
+    row = lambda dt: _sds((_N,), dt)  # noqa: E731
+    bt = _sds((_F, _N), jnp.int16)  # _F divides d_f=2: no dead padding
+    pf = _sds((_F,), jnp.int32)
+    fm = _sds((_F,), jnp.bool_)
+    init_statics = tuple(sorted(dict(
+        _round_common(), use_pallas=False, quantize_bins=quantize_bins,
+        hist_precision="f32", stochastic_rounding=False).items()))
+    init_names = ("quant_key",) if q else ()
+    init_fn = f2d._windowed_init_2d(mesh, init_names, init_statics)
+    init_args = (bt, row(jnp.float32), row(jnp.float32), row(jnp.bool_),
+                 row(jnp.float32), pf, pf, fm)
+    if q:
+        init_args = init_args + (_sds((2,), jnp.uint32),)
+    state = jax.eval_shape(init_fn, *init_args)[0]
+    round_statics = tuple(sorted(dict(
+        _round_common(), max_depth=-1, use_pallas=False,
+        quantize_bins=quantize_bins, hist_precision="f32", has_cat=False,
+        pallas_partition=False, megakernel=False,
+        mk_interpret=False).items()))
+    names = ("gq", "hq", "quant_scale") if q else ()
+    fn = f2d._windowed_round_2d(mesh, _W, names, round_statics)
+    args = (state, bt, row(jnp.float32), row(jnp.float32), row(jnp.bool_),
+            pf, pf, fm)
+    if q:
+        args = args + (row(jnp.int8), row(jnp.int8), _sds((3,), jnp.float32))
+    d_r, d_f = mesh.shape["data"], mesh.shape["feature"]
+    return Target(fn, args, {},
+                  note=f"jit(shard_map) 2-D fused round, "
+                       f"{d_r}x{d_f} (row x feature) loopback mesh"
+                       + (", int8-quantized config" if q else ""))
+
+
+# the winner's row decisions — computable only on the owner's feature
+# block — broadcast at round start, BEFORE the partition movement: the
+# round's only feature-axis data exchange
+_2D_DECIDE = ("axis_index@feature", "psum@feature")
+# the protocol spine: row-domain sums stay on the row axis alone (a
+# feature-axis sum would over-count the replicated rows d_f times);
+# idempotent info merges span both axes
+_2D_PREFIX = _2D_DECIDE + (
+    "psum@data",           # global left counts (window-child election)
+    "psum@data",           # global segment lengths (same election)
+    "pmin@data,feature",   # info: ok — idempotent, spans the full mesh
+    "pmax@data,feature",   # info: total
+)
+_2D_SUFFIX = (
+    "pmax@data,feature",   # info: whint
+    "pmin@data,feature",   # info: finite
+)
+# the owned-feature winner election (the scatter merge's machinery with
+# the FEATURE axis as the owning axis): globalize the block offset,
+# elect by gain, psum-mask-broadcast every BestSplit field from the owner
+_2D_ELECTION = (
+    "axis_index@feature",          # _split_tables: this block's F offset
+    "axis_index@feature",          # _merge_best: owner election index
+    "pmax@feature", "pmin@feature",  # gain max, lowest-block tie-break
+) + ("psum@feature",) * 12         # one masked broadcast per field
+
+# the per-round feature-axis byte bill: the go/no-go row broadcast
+# ((N_loc,) i32, worst case d_r=1) + the election's per-leaf broadcast +
+# scalar slack — a full histogram merge (3*F*B*4 per leaf pair) cannot fit
+_2D_FEATURE_BUDGET = 2 * _N * 4 + 1024
+
+
+@contract(
+    "windowed_round_2d_float",
+    description="SPMD fused windowed round over the 2-D (feature x row) "
+                "mesh, float histograms: the histogram phase is the row "
+                "psum ALONE — zero feature-axis collectives (the owned "
+                "block's histograms are complete by layout) — then the "
+                "owned-feature election and the winner's row-decision "
+                "broadcast, the only feature-axis traffic, byte-billed",
+    collectives=_2D_PREFIX + ("psum@data",) + _2D_ELECTION + _2D_SUFFIX,
+    donated_args=(0,),
+    max_live_bytes=10 << 20,  # measured ≈ 4.1 MB at the fixture shape
+    family="windowed_2d",
+    spine=(len(_2D_PREFIX), len(_2D_SUFFIX)),
+    feature_max_bytes=_2D_FEATURE_BUDGET,
+)
+def _build_windowed_round_2d_float() -> Target:
+    return _windowed_2d_target(0)
+
+
+@contract(
+    "windowed_round_2d_quantized",
+    description="SPMD fused windowed round over the 2-D mesh, int8-"
+                "quantized config (CPU trace: dequantized fallback "
+                "histograms) — the wide-F regime default; same sequence, "
+                "same feature-axis byte bill as the float round",
+    collectives=_2D_PREFIX + ("psum@data",) + _2D_ELECTION + _2D_SUFFIX,
+    donated_args=(0,),
+    max_live_bytes=10 << 20,
+    family="windowed_2d",
+    spine=(len(_2D_PREFIX), len(_2D_SUFFIX)),
+    feature_max_bytes=_2D_FEATURE_BUDGET,
+)
+def _build_windowed_round_2d_quantized() -> Target:
+    return _windowed_2d_target(16)
 
 
 # ---------------------------------------------------------------------------

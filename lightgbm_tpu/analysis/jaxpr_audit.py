@@ -209,6 +209,20 @@ def dcn_axis_bytes(found) -> int:
     return sum(nb for _name, axes, nb in found if DCN_AXIS in axes)
 
 
+def axis_bytes(found) -> Dict[str, int]:
+    """Per-axis collective byte bill: total operand bytes of every
+    collective whose axes include each mesh axis.  A both-axes scalar
+    merge bills BOTH axes (it crosses both).  This is the generic form
+    of ``dcn_axis_bytes`` — every contract's bill rides ``verdict()``
+    into bench artifacts, so a chip row shows at a glance where a
+    round's collective traffic lands on the (dcn, feature, row) grid."""
+    out: Dict[str, int] = {}
+    for _name, axes, nb in found:
+        for ax in axes:
+            out[ax] = out.get(ax, 0) + nb
+    return out
+
+
 def _check_dcn_bytes(c: Contract, found
                      ) -> Tuple[List[Finding], Dict[str, object]]:
     """The per-axis half of J1 (analogous to J7's sweep bound): the
@@ -231,6 +245,33 @@ def _check_dcn_bytes(c: Contract, found
             "(parallel/hierarchy.py::dcn_topk_best) or raise the budget "
             "consciously (docs/ANALYSIS.md, jaxlint R17)"))
     return findings, {"dcn_bytes": got}
+
+
+def _check_feature_bytes(c: Contract, found
+                         ) -> Tuple[List[Finding], Dict[str, object]]:
+    """The 2-D layout's axis-bill pin (the feature-axis twin of
+    ``_check_dcn_bytes``): collective operand bytes crossing the feature
+    axis per round must stay under ``feature_max_bytes`` — the winner's
+    go/no-go row broadcast plus election scalars.  A histogram merge
+    smuggled onto the feature axis fails here (jaxlint R20 flags the
+    source form; the exact J1 sequence pin is the ordering half)."""
+    if c.feature_max_bytes is None:
+        return [], {}
+    from ..parallel.mesh import FEATURE_AXIS
+    got = sum(nb for _name, axes, nb in found if FEATURE_AXIS in axes)
+    findings = []
+    if got > c.feature_max_bytes:
+        findings.append(_finding(
+            c, "J1",
+            f"{got} bytes of collective operands cross the feature axis "
+            f"per round, exceeding the {c.feature_max_bytes}-byte "
+            "contract pin",
+            "the 2-D layout makes the owned feature block's histograms "
+            "complete locally — only the winner's row decisions and "
+            "election scalars may cross the feature axis "
+            "(parallel/feature2d.py, jaxlint R20); route new traffic "
+            "through the election or raise the budget consciously"))
+    return findings, {"feature_bytes": got}
 
 
 def _check_j1(c: Contract, found) -> Tuple[List[Finding], List[str]]:
@@ -670,9 +711,14 @@ def audit_contract(c: Contract) -> ContractResult:
     detail["collectives"] = tokens
     detail["large_collectives"] = sum(
         1 for _n, _ax, nb in found if nb >= _LARGE_COLLECTIVE_BYTES)
+    if found:
+        detail["axis_bytes"] = axis_bytes(found)
     jdcn, ddcn = _check_dcn_bytes(c, found)
     raw += jdcn
     detail.update(ddcn)
+    jfeat, dfeat = _check_feature_bytes(c, found)
+    raw += jfeat
+    detail.update(dfeat)
     j2, d2 = _check_j2(c, target, jaxpr, lowered_text)
     raw += j2
     detail.update(d2)
@@ -841,6 +887,13 @@ def verdict(runtime: bool = False, exec_contracts: bool = True) -> dict:
            if "dcn_bytes" in r.detail}
     if dcn:
         out["dcn_bytes"] = dcn
+    # the full per-axis bills (row/feature/ici/dcn) of every collective-
+    # bearing contract — a 2-D bench row shows where the round's traffic
+    # lands on the mesh grid without re-running the audit
+    per_axis = {r.name: r.detail["axis_bytes"] for r in rep.results
+                if r.detail.get("axis_bytes")}
+    if per_axis:
+        out["axis_bytes"] = per_axis
     if skipped:
         out["skipped_exec_contracts"] = skipped
     return out

@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R18.
+"""jaxlint built-in rules R1-R20.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -1905,3 +1905,84 @@ def r19_unbounded_retry(pkg: PackageIndex) -> Iterator[Finding]:
                         "backoff, budget or deadline — a persistent "
                         "failure hot-spins forever", hint)
                     break  # one finding per loop is enough
+
+
+# ---------------------------------------------------------------------------
+# R20 — feature-axis-hist-collective
+# ---------------------------------------------------------------------------
+
+
+def _r20_axis_mentions_feature(axis_arg: ast.AST) -> bool:
+    """The axis expression references the feature mesh axis: the string
+    literal, the FEATURE_AXIS mesh constant, or a *feature*-named
+    variable/attribute (feature_axis_name) — including inside a tuple."""
+    for sub in ast.walk(axis_arg):
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and "feature" in sub.value.lower()):
+            return True
+        if isinstance(sub, ast.Name) and "feature" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "feature" in sub.attr.lower():
+            return True
+    return False
+
+
+@register_rule("R20", "feature-axis-hist-collective")
+def r20_feature_axis_hist_collective(pkg: PackageIndex) -> Iterator[Finding]:
+    """A collective whose axis set includes the FEATURE mesh axis moving a
+    histogram operand.  The 2-D (feature x row) layout's entire point
+    (docs/DISTRIBUTED.md "2-D sharding", parallel/feature2d.py) is that
+    each device's ``(F/d_f, N/d_r)`` bin tile builds histograms that are
+    already COMPLETE for the owned feature block — the merge is the row
+    psum alone, and the feature axis carries only the winner's go/no-go
+    row broadcast and election scalars.  A histogram collective over the
+    feature axis re-replicates what the layout made local, paying d_f
+    times the merge bytes to erase the axis the mesh was widened for.
+    Statically: any ``jax.lax`` collective whose axis expression
+    references the feature axis and whose first operand NAMES a
+    histogram (``*hist*``) is flagged, unless that operand is assigned
+    from a top-k gather in the same function (an elected subset, the R17
+    escape).  Name-heuristic by necessity; the ``windowed_round_2d_*``
+    jaxpr-audit contracts are the sound IR-level half — they pin ZERO
+    feature-axis collectives in the histogram phase and bill every axis's
+    bytes (docs/ANALYSIS.md)."""
+    hint = ("histograms over the feature-sharded bin tile are complete "
+            "for the owned block by layout — merge over the row axis "
+            "only, and cross the feature axis with the winner's row "
+            "decisions or election scalars "
+            "(parallel/feature2d.py, docs/DISTRIBUTED.md '2-D sharding')")
+    for mod in pkg.modules.values():
+        for fi in mod.functions.values():
+            if fi.parent is not None:
+                # nested defs walk through their enclosing function (the
+                # R17 discipline): one visit, enclosing-scope gathers seen
+                continue
+            for node in _own_body(fi, include_nested=True):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = dotted_name(node.func)
+                if fn is None or fn.split(".")[-1] not in _R17_COLLECTIVES:
+                    continue
+                if not node.args:
+                    continue
+                axis_arg = None
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis"):
+                        axis_arg = kw.value
+                if axis_arg is None and len(node.args) > 1:
+                    axis_arg = node.args[1]
+                if axis_arg is None or not _r20_axis_mentions_feature(
+                        axis_arg):
+                    continue
+                hist_nm = _r17_hist_name(node.args[0])
+                if hist_nm is None:
+                    continue
+                if _r17_topk_shaped(fi, hist_nm):
+                    continue
+                yield _finding(
+                    fi, node, "R20",
+                    f"{fn}({hist_nm}, …) in {fi.qualname} moves a "
+                    "histogram operand across the feature axis — the "
+                    "feature-sharded tile's histograms are complete for "
+                    "the owned block; merge over the row axis only",
+                    hint)

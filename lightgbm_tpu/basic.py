@@ -1417,7 +1417,17 @@ class Booster:
             num_iteration=num_iteration,
             pred_leaf=pred_leaf,
             pred_contrib=pred_contrib,
+            # giant-batch serving: mesh= shards the traversal over the row
+            # axis in ONE SPMD dispatch, bitwise the single-device result
+            mesh=kwargs.get("mesh"),
         )
+
+    def predict_sharded(self, data, mesh, **kwargs) -> np.ndarray:
+        """Row-sharded giant-batch :meth:`predict`: scores ``data`` as ONE
+        SPMD dispatch over the row ("data") axis of ``mesh`` — bitwise the
+        single-device result (models/gbdt.py predict_raw_sharded).  A 2-D
+        training mesh works directly (rows shard, features replicate)."""
+        return self.predict(data, mesh=mesh, **kwargs)
 
     def refit(self, data, label, decay_rate: float = 0.9, weight=None,
               **kwargs) -> "Booster":

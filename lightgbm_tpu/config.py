@@ -418,6 +418,18 @@ class Config:
     # (jaxpr-audit dcn_max_bytes, jaxlint R17).  Distinct from top_k,
     # which parameterizes the strict voting-parallel grower.
     top_k_features: int = 32
+    # num_feature_shards (ours; docs/DISTRIBUTED.md "2-D sharding"):
+    # feature-axis size d_f of the 2-D (feature, row) mesh for
+    # tree_learner=feature2d — each device owns an (F/d_f, N/d_r) tile
+    # of the bin matrix, per-leaf histograms are complete for the owned
+    # feature block with ZERO feature-axis collectives, and the split
+    # election runs the owned-feature winner machinery over the feature
+    # axis.  F pads to a multiple of d_f with dead features (never
+    # electable), rows pad to a multiple of d_r = devices/d_f.  A d_f
+    # that does not divide the device count warns and falls back to the
+    # single-level mesh instead of crashing.  1 (default) = rows-only
+    # sharding.
+    num_feature_shards: int = 1
 
     # --- GPU-compat (accepted, translated to mesh semantics) ---
     gpu_platform_id: int = -1
@@ -708,7 +720,7 @@ class Config:
         "gpu_use_dp": "histogram accumulation precision is controlled by "
         "hist_precision (bf16x2/f32 lanes)",
         "num_gpu": "multi-device scale-out uses jax.sharding meshes via "
-        "tree_learner=data|feature|voting",
+        "tree_learner=data|feature|voting|feature2d",
         "precise_float_parser": "parsing always uses full float64 "
         "precision (numpy)",
         "parser_config_file": "custom parser plugins are not supported",

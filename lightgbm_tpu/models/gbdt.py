@@ -62,6 +62,42 @@ def _predict_bucket(n: int) -> int:
     return b
 
 
+@functools.lru_cache(maxsize=64)
+def _sharded_raw_entry(mesh, k: int, has_cat: bool):
+    """Giant-batch serving entry: the stacked traversal as ONE SPMD
+    dispatch over the row ("data") axis of ``mesh``.
+
+    Rows traverse independently and the per-row tree sum keeps the exact
+    single-device reduction order inside each rank, so the row-sharded
+    result is BITWISE the single-device ``predict_raw`` — the same
+    property that makes the bucket ladder safe makes the row split safe.
+    The body has ZERO collectives (each rank emits exactly its own row
+    block); the packed per-tree tables ride replicated.  On a 2-D
+    (feature x row) training mesh ``P(data)`` shards rows and replicates
+    over the feature axis, so the training mesh is directly servable."""
+    from jax.sharding import PartitionSpec as _P
+
+    from ..parallel.compat import shard_map as _smap
+    from ..parallel.mesh import DATA_AXIS as _AX
+
+    row, rep = _P(_AX), _P()
+
+    def run(x, active, sf, th, dl, mt, lc, rc, nl, lv, *cat):
+        ckw = {}
+        if has_cat:
+            ckw = dict(is_cat=cat[0], cat_base=cat[1], cat_nwords=cat[2],
+                       cat_words=cat[3])
+        if k == 1:
+            return predict_ops.predict_raw_values(
+                x, sf, th, dl, mt, lc, rc, nl, lv, active=active, **ckw)
+        return predict_ops.predict_raw_multiclass(
+            x, sf, th, dl, mt, lc, rc, nl, lv, active=active, k=k, **ckw)
+
+    in_specs = (row, row) + (rep,) * (8 + (4 if has_cat else 0))
+    return jax.jit(_smap(run, mesh=mesh, in_specs=in_specs, out_specs=row,
+                         check_vma=False))
+
+
 def _dummy_tree() -> Tree:
     """Single-leaf zero-value tree: pads the tree axis of a packed ensemble
     so every early-stop window has the same static size (contributes exactly
@@ -673,7 +709,9 @@ class GBDT:
         self._dp = None
         self._fp = None
         self._dp_hier = None
-        if self.cfg.tree_learner in ("data", "feature", "voting"):
+        self._dp2d = None
+        if self.cfg.tree_learner in ("data", "feature", "voting",
+                                     "feature2d"):
             import jax as _jax
 
             if _jax.device_count() > 1:
@@ -685,7 +723,44 @@ class GBDT:
                 host_bins = train_set._host_bins(
                     f"tree_learner={self.cfg.tree_learner}")
                 mesh = make_mesh()
-                if self.cfg.tree_learner == "feature":
+                if self.cfg.tree_learner == "feature2d":
+                    # 2-D (feature, row) mesh for the wide-F regime
+                    # (docs/DISTRIBUTED.md "2-D sharding"): d_f feature
+                    # blocks x d_r row shards.  A d_f that does not
+                    # divide the device count falls back to the
+                    # single-level row mesh, loudly, instead of crashing.
+                    nd = _jax.device_count()
+                    d_f = max(int(self.cfg.num_feature_shards), 1)
+                    if d_f > 1 and nd % d_f:
+                        log_warning(
+                            f"num_feature_shards={d_f} does not divide "
+                            f"{nd} devices; training on the single-level "
+                            "row mesh")
+                        d_f = 1
+                    if d_f > 1:
+                        from ..parallel.feature2d import Sharded2DData
+                        from ..parallel.mesh import make_mesh_2d
+
+                        self._dp2d = Sharded2DData(
+                            make_mesh_2d(nd // d_f, d_f),
+                            np.asarray(host_bins),
+                            np.asarray(
+                                train_set.binner.num_bins_per_feature),
+                            np.asarray(
+                                train_set.binner.missing_bin_per_feature),
+                        )
+                    else:
+                        from ..parallel.data_parallel import ShardedData
+
+                        self._dp = ShardedData(
+                            mesh,
+                            np.asarray(host_bins),
+                            np.asarray(
+                                train_set.binner.num_bins_per_feature),
+                            np.asarray(
+                                train_set.binner.missing_bin_per_feature),
+                        )
+                elif self.cfg.tree_learner == "feature":
                     from ..parallel.feature_parallel import FeatureShardedData
 
                     self._fp = FeatureShardedData(
@@ -944,8 +1019,8 @@ class GBDT:
         return (
             self._on_tpu
             and bool(self.cfg.extra.get("windowed_growth", False))
-            and self._dp is not None
-            and self.cfg.tree_learner in ("data", "voting")
+            and (self._dp is not None or self._dp2d is not None)
+            and self.cfg.tree_learner in ("data", "voting", "feature2d")
             and (mode == "rounds" or (mode == "auto" and self._on_tpu))
             and getattr(ts, "efb", None) is None
             and ts.num_feature() >= 512
@@ -967,6 +1042,20 @@ class GBDT:
         must be deterministic and slice-consistent)."""
         return (
             self._dp_hier is not None
+            and not self._needs_node_rng
+            and self._use_windowed_dp(ts)
+        )
+
+    def _use_windowed_2d(self, ts) -> bool:
+        """2-D (feature, row) mesh gate (docs/DISTRIBUTED.md "2-D
+        sharding"): the one-dispatch windowed round with the bin matrix
+        on P(feature, row) — feature-complete per-block histograms, the
+        owned-feature election over the feature axis.  Rides
+        :meth:`_use_windowed_dp`'s envelope minus per-node feature
+        sampling (the owned-feature search needs the sampled set to span
+        the full axis deterministically, like the scatter merge)."""
+        return (
+            self._dp2d is not None
             and not self._needs_node_rng
             and self._use_windowed_dp(ts)
         )
@@ -1450,6 +1539,48 @@ class GBDT:
                     monotone_method=self._monotone_method,
                 )
                 arrays, leaf_id = self._localize_tree(arrays, leaf_id)
+            elif self._dp2d is not None and self._use_windowed_2d(ts):
+                # 2-D (feature, row) mesh (docs/DISTRIBUTED.md "2-D
+                # sharding"): each device owns an (F/d_f, N/d_r) tile,
+                # the histogram phase crosses the feature axis with ZERO
+                # collectives, the owned-feature election crosses it with
+                # scalars + one (N_loc,) decision broadcast — all inside
+                # the one donated dispatch per round
+                from ..parallel.feature2d import grow_tree_windowed_feature2d
+
+                d2 = self._dp2d
+                quant = self.cfg.use_quantized_grad
+                arrays, leaf_id_pad = grow_tree_windowed_feature2d(
+                    d2,
+                    d2.pad_rows_device(gc, jnp.float32),
+                    d2.pad_rows_device(hc, jnp.float32),
+                    d2.pad_rows_device(row_mask, bool, fill=False),
+                    d2.pad_rows_device(sample_weight, jnp.float32,
+                                       fill=1.0),
+                    feature_mask,
+                    self._categorical_mask,
+                    None,  # rng_key: per-node sampling is outside the gate
+                    (jax.random.PRNGKey(
+                        self.cfg.seed * 1000003 + self.iter_ * 31 + c)
+                     if quant else None),
+                    self._feature_contri,
+                    num_leaves=self.cfg.num_leaves,
+                    num_bins=ts.max_num_bins,
+                    max_depth=self.cfg.max_depth,
+                    params=self._split_params,
+                    leaf_tile=self._leaf_tile(ts, use_efb=False),
+                    hist_precision=self.cfg.hist_precision,
+                    use_pallas=self._on_tpu,
+                    quantize_bins=(self.cfg.num_grad_quant_bins
+                                   if quant else 0),
+                    stochastic_rounding=bool(self.cfg.stochastic_rounding),
+                    quant_renew=bool(self.cfg.quant_train_renew_leaf),
+                    guard_label=(
+                        f" (boosting iteration {self.iter_ + 1})"),
+                )
+                arrays, leaf_id_pad = self._localize_tree(
+                    arrays, leaf_id_pad)
+                leaf_id = leaf_id_pad[: ts.num_data()]
             elif self._dp_hier is not None and self._use_windowed_hier(ts):
                 # multi-slice scale-out (docs/DISTRIBUTED.md "Hierarchical
                 # merge"): the two-level windowed round — intra-slice
@@ -2370,6 +2501,61 @@ class GBDT:
         self._serve_note("raw_multiclass", n, t0c0, bucket=nb)
         return res
 
+    def predict_raw_sharded(self, X: np.ndarray, mesh,
+                            start_iteration: int = 0,
+                            num_iteration: int = -1) -> np.ndarray:
+        """``predict_raw`` for giant batches: score a row-sharded ``X`` as
+        ONE SPMD dispatch over the row axis of ``mesh``.
+
+        Serving contract (pinned by tests/test_predict_budget.py): BITWISE
+        equal to the single-device ``predict_raw``, and a warm call is one
+        packed-cache hit, ONE dispatch and ONE blocking pull.  N pads to
+        ``d_row * _predict_bucket(ceil(N / d_row))`` so every rank sees the
+        same per-rank bucket ladder (one compile per bucket per mesh); the
+        padded rows are masked on device exactly like the single-device
+        ladder.  The replicated per-tree tables are placed on the mesh once
+        per (pack, mesh) and cached inside the pack, so warm calls move
+        ONLY the row-sharded batch."""
+        s = self._packed(start_iteration, num_iteration)
+        n = X.shape[0]
+        k = self.num_tree_per_iteration
+        if s is None or s["_linear"]:
+            # nothing traverses on device (init-score-only or host-walked
+            # linear leaves) — the single-device path is already optimal
+            return self.predict_raw(X, start_iteration, num_iteration)
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+        from ..parallel.mesh import DATA_AXIS as _AX
+
+        d_r = int(mesh.shape[_AX])
+        t0c0 = self._serve_t0()
+        nb = d_r * _predict_bucket(max(1, -(-n // d_r)))
+        row_s = NamedSharding(mesh, _P(_AX))
+        xh = np.zeros((nb, X.shape[1]), dtype=np.float32)
+        xh[:n] = X
+        x = jax.device_put(xh, row_s)
+        am = np.zeros(nb, dtype=bool)
+        am[:n] = True
+        active = jax.device_put(am, row_s)
+        has_cat = "is_cat" in s
+        tabs = s.setdefault("_mesh_tables", {}).get(mesh)
+        if tabs is None:
+            rep_s = NamedSharding(mesh, _P())
+            names = ["split_feature", "threshold", "default_left",
+                     "missing_type", "left_child", "right_child",
+                     "num_leaves", "leaf_value"]
+            if has_cat:
+                names += ["is_cat", "cat_base", "cat_nwords", "cat_words"]
+            tabs = tuple(jax.device_put(s[m], rep_s) for m in names)
+            s["_mesh_tables"][mesh] = tabs
+        entry = _sharded_raw_entry(mesh, k, has_cat)
+        n_per_class = max(s["T"] // k, 1)
+        scale = (1.0 / n_per_class) if self.average_output else 1.0
+        _san.record_dispatch()
+        out = entry(x, active, *tabs)
+        res = np.asarray(_san.sync_pull(out)[:n], dtype=np.float64) * scale
+        self._serve_note("raw_sharded", n, t0c0, bucket=nb)
+        return res
+
     def _get_convert_entry(self):
         """Jitted traversal + ``objective.convert_output`` in ONE trace:
         a converted warm predict is one dispatch + one accounted pull
@@ -2526,7 +2712,12 @@ class GBDT:
         return res
 
     def predict(self, X, raw_score=False, start_iteration=0, num_iteration=-1,
-                pred_leaf=False, pred_contrib=False) -> np.ndarray:
+                pred_leaf=False, pred_contrib=False, mesh=None) -> np.ndarray:
+        """``mesh=`` routes the raw traversal through the row-sharded
+        giant-batch entry (:meth:`predict_raw_sharded`) — bitwise the
+        single-device result.  Early-stopping, pred_leaf and pred_contrib
+        have data-dependent/host-side structure and keep the single-device
+        path even when a mesh is passed."""
         X = np.asarray(X, dtype=np.float64)
         if pred_leaf:
             return self._predict_leaf(X, start_iteration, num_iteration)
@@ -2541,6 +2732,7 @@ class GBDT:
         if (
             not raw_score
             and not early_stop
+            and mesh is None
             and self.objective is not None
             # RF scales raw margins by 1/T on the host in f64 before
             # converting — keep that exact path rather than re-deriving it
@@ -2552,6 +2744,9 @@ class GBDT:
                 return res
         if early_stop:
             raw = self._predict_raw_early_stop(X, start_iteration, num_iteration)
+        elif mesh is not None:
+            raw = self.predict_raw_sharded(X, mesh, start_iteration,
+                                           num_iteration)
         else:
             raw = self.predict_raw(X, start_iteration, num_iteration)
         if raw_score or self.objective is None:

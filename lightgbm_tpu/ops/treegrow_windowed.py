@@ -171,18 +171,24 @@ def _window_rung(w: int, n: int, floor: int = 8192) -> int:
 
 
 def _split_tables(axis_name, merge, f_loc, num_bins_pf, missing_bin_pf,
-                  feature_mask, categorical_mask, feature_contri):
+                  feature_mask, categorical_mask, feature_contri,
+                  feature_axis_name=None):
     """Per-rank feature tables for the split search.  Replicated (full-F)
-    outside the owned-feature merge; under ``merge="scatter"`` each rank
-    searches only its contiguous F/R feature block (reference: the
-    data-parallel learner's per-rank feature ownership after
-    ReduceScatter), so the tables are dynamic-sliced at this rank's
-    offset.  Returns the tables plus the rank's feature offset (None when
-    features are not owned)."""
-    if axis_name is None or merge != "scatter":
+    outside the owned-feature merge; when features are OWNED — under
+    ``merge="scatter"`` (each rank holds its contiguous F/R block of the
+    reduce-scattered histograms) or on a 2-D mesh (each feature-axis
+    block holds complete histograms for its F/d_f slice by layout) — the
+    rank searches only its block, so the tables are dynamic-sliced at
+    this rank's offset along the OWNING axis.  One code path serves both
+    ownership sources (reference: the data-parallel learner's per-rank
+    feature ownership after ReduceScatter).  Returns the tables plus the
+    rank's feature offset (None when features are not owned)."""
+    own_axis = (feature_axis_name if feature_axis_name is not None
+                else (axis_name if merge == "scatter" else None))
+    if own_axis is None:
         return (num_bins_pf, missing_bin_pf, feature_mask, categorical_mask,
                 feature_contri, None)
-    f0 = jax.lax.axis_index(axis_name) * f_loc
+    f0 = jax.lax.axis_index(own_axis) * f_loc
 
     def sl(v):
         return (None if v is None
@@ -224,7 +230,7 @@ def _merge_best(bb: BestSplit, axis_name, f0) -> BestSplit:
                      "leaf_tile", "W", "use_pallas", "quantize_bins",
                      "hist_precision", "has_cat", "pallas_partition",
                      "axis_name", "merge", "megakernel", "mk_interpret",
-                     "dcn_axis_name", "dcn_top_k"),
+                     "dcn_axis_name", "dcn_top_k", "feature_axis_name"),
     donate_argnums=(0,),  # the 1.5 GB-at-Epsilon hist state threads
     # linearly through the host round loop; donation lets XLA update it in
     # place instead of alloc+copy per call (benchmarks/probe_r5_fixed.py)
@@ -265,6 +271,7 @@ def _round_fused(
     mk_interpret: bool = False,
     dcn_axis_name: Optional[str] = None,
     dcn_top_k: int = 0,
+    feature_axis_name: Optional[str] = None,
 ):
     """One whole boosting round in one traced body: gain admission,
     segment partition, bookkeeping, window gather, multi-leaf pass,
@@ -302,18 +309,42 @@ def _round_fused(
     protocol merges (window election, info vector) span BOTH axes, and
     NO full-F histogram ever crosses DCN — pinned statically by jaxlint
     R17 and the jaxpr-audit ``dcn_max_bytes`` contract pin.
+
+    With ``feature_axis_name`` the body runs over a 2-D (feature, row)
+    mesh (docs/DISTRIBUTED.md "2-D sharding"): ``bins_t`` is this rank's
+    (F/d_f, N/d_r) tile, rows and every row-indexed input are the ROW
+    shard (replicated across the feature axis), and the per-leaf window
+    histograms are COMPLETE for the owned feature block by layout — the
+    histogram merge stays the row-axis collective alone, with ZERO
+    collective over the feature axis (pinned by jaxlint R20 and the
+    ``windowed_round_2d_*`` jaxpr contracts).  The split search reuses
+    the scatter merge's owned-feature machinery (``_split_tables`` /
+    ``_merge_best``) with the feature axis as the owning axis, and the
+    winner's split decisions — computable only on the owner block, which
+    alone holds the winner feature's bin column — are psum-broadcast
+    over the feature axis (a (N,)-bool vector, the only feature-axis
+    exchange in the round).  Row-domain sums stay on the row axes alone:
+    rows are REPLICATED across the feature axis, so summing there would
+    over-count by d_f.
     """
     L = num_leaves
     f = bins_t.shape[0]
     n = state.order.shape[0]
-    # every-rank axes for the scalar protocol merges: under the two-level
-    # merge, window-child election and the info vector are GLOBAL
-    # agreements (all slices, all ranks) while the histogram merge stays
+    # axis discipline: `sum_axes` are the ROW-sharding axes — row-domain
+    # sums (window counts, leaf totals) merge there and ONLY there (rows
+    # are replicated across the feature axis; summing there would
+    # over-count by the feature-axis size).  `all_axes` adds the feature
+    # axis for the IDEMPOTENT protocol merges (pmin/pmax agreement on
+    # ok/total/whint/finite): under the two-level merge, window-child
+    # election and the info vector are GLOBAL agreements (all slices, all
+    # ranks, all feature blocks) while the histogram merge stays
     # per-slice on axis_name alone
-    all_axes = tuple(a for a in (axis_name, dcn_axis_name) if a is not None)
+    sum_axes = tuple(a for a in (axis_name, dcn_axis_name) if a is not None)
+    all_axes = sum_axes + (
+        (feature_axis_name,) if feature_axis_name is not None else ())
 
-    def pall(x):  # cross-rank sum over every mesh axis; identity 1-device
-        return jax.lax.psum(x, all_axes) if all_axes else x
+    def pall(x):  # cross-rank ROW-domain sum; identity on 1 device
+        return jax.lax.psum(x, sum_axes) if sum_axes else x
     eps = KMIN_SCORE / 2
     idx = jnp.arange(L, dtype=jnp.int32)
     pos = jnp.arange(n, dtype=jnp.int32)
@@ -343,7 +374,19 @@ def _round_fused(
     leaf_of_rank = srt[:leaf_tile]
     live_rk = accept0[leaf_of_rank]
     feats_rk = jnp.where(live_rk, s.feature[leaf_of_rank], 0)
-    cols = bins_t[feats_rk]  # (tile, N) by ROW id
+    if feature_axis_name is not None:
+        # 2-D mesh: bins_t holds only this rank's owned feature block, so
+        # a winner column exists on exactly ONE feature block (feats_rk
+        # are GLOBAL indices < f*d_f — every value has one owner).  Gather
+        # the clipped local column here; the owner's decisions are
+        # psum-broadcast over the feature axis after go_left is complete.
+        f0_dec = jax.lax.axis_index(feature_axis_name) * f
+        feats_loc = feats_rk - f0_dec
+        own_rk = (feats_loc >= 0) & (feats_loc < f)
+        cols = bins_t[jnp.clip(feats_loc, 0, f - 1)]  # (tile, N) by ROW id
+    else:
+        own_rk = None
+        cols = bins_t[feats_rk]  # (tile, N) by ROW id
     colv = cols[:, ord_rows].astype(jnp.int32)  # (tile, N) by POSITION
     for r in range(leaf_tile):
         leaf_r = leaf_of_rank[r]
@@ -375,6 +418,18 @@ def _round_fused(
         in_cat = jnp.any(oh & cat_rk, axis=0)
         gc = jnp.any(oh & go_cat_rk, axis=0)
         go_left = jnp.where(in_cat, gc, go_left)
+    if feature_axis_name is not None:
+        # broadcast each position's decision from its segment's OWNER
+        # feature block — the only block whose go_left gathered the real
+        # winner column.  Exactly one block owns each slot's feature, so
+        # the psum is a pure select; positions outside every live segment
+        # take slot 0's value and are masked downstream (seg_id < 0).
+        # This (N,)-bool vector is the round's ONLY feature-axis data
+        # exchange — the histogram phase stays @feature-collective-free.
+        own_pos = jnp.any(oh & own_rk[:, None], axis=0)
+        go_left = jax.lax.psum(
+            jnp.where(own_pos, go_left, False).astype(jnp.int32),
+            feature_axis_name) > 0
 
     # ---- on-device window verification (the fused round's safety net) ----
     # per-slot left counts from the one-hot the decisions already built —
@@ -696,7 +751,8 @@ def _round_fused(
     else:
         nb_l, mb_l, fm_l, cm_l, fc_l, f0 = _split_tables(
             axis_name, merge, state.hist.shape[2], num_bins_pf,
-            missing_bin_pf, feature_mask, categorical_mask, feature_contri)
+            missing_bin_pf, feature_mask, categorical_mask, feature_contri,
+            feature_axis_name=feature_axis_name)
         if dcn_axis_name is not None:
             # two-level split search (parallel/hierarchy.py): the cand
             # hists above are SLICE-domain (merged over axis_name only);
@@ -722,7 +778,9 @@ def _round_fused(
                 depth=leaf_depth[ci], parent_out=leaf_out[ci],
                 feature_contri=fc_l,
             )
-        bb = _merge_best(bb, axis_name, f0)
+        bb = _merge_best(
+            bb, feature_axis_name if feature_axis_name is not None
+            else axis_name, f0)
     scatter_pos = jnp.where(cand_ok, cand, 2 * L)
 
     def merge(old, new):
@@ -794,7 +852,7 @@ def _round_fused(
     static_argnames=("num_leaves", "num_bins", "params", "leaf_tile",
                      "use_pallas", "quantize_bins", "hist_precision",
                      "stochastic_rounding", "axis_name", "merge",
-                     "dcn_axis_name", "dcn_top_k"),
+                     "dcn_axis_name", "dcn_top_k", "feature_axis_name"),
 )
 def _w_init(
     bins_t, grad, hess, row_mask, sample_weight, num_bins_pf,
@@ -814,6 +872,7 @@ def _w_init(
     merge: str = "psum",
     dcn_axis_name: Optional[str] = None,
     dcn_top_k: int = 0,
+    feature_axis_name: Optional[str] = None,
 ):
     """Root state: quantize gradients, run the one full-N pass, seed best.
 
@@ -823,13 +882,22 @@ def _w_init(
     with the same collective the rounds use.  With ``dcn_axis_name`` the
     histogram merge stays per-slice (axis_name only) and the root split
     election goes through the same two-level top-k exchange the rounds
-    use; scalar totals and quant scales merge across BOTH axes."""
+    use; scalar totals and quant scales merge across BOTH axes.  With
+    ``feature_axis_name`` (2-D mesh) the root histogram over the local
+    (F/d_f, N/d_r) tile is already complete for the owned feature block
+    after the row-axis merge — ZERO feature-axis collectives — and the
+    root election runs the owned-feature search; row-domain totals merge
+    over the row axes only (rows are replicated across feature blocks)
+    while the quant-scale pmax spans every axis (idempotent: pins
+    cross-block grid consistency)."""
     f, n = bins_t.shape
     L = num_leaves
     grad = grad.astype(jnp.float32) * sample_weight
     hess = hess.astype(jnp.float32) * sample_weight
     grad_true, hess_true = grad, hess
-    all_axes = tuple(a for a in (axis_name, dcn_axis_name) if a is not None)
+    sum_axes = tuple(a for a in (axis_name, dcn_axis_name) if a is not None)
+    all_axes = sum_axes + (
+        (feature_axis_name,) if feature_axis_name is not None else ())
 
     def pmaxg(x):
         return jax.lax.pmax(x, all_axes) if all_axes else x
@@ -879,8 +947,11 @@ def _w_init(
     # 3-scalar psum); the histogram itself merges with the round's
     # collective — psum (replicated) or psum_scatter (owned F/R slice)
     sum0 = jnp.sum(hist0[:, 0, :], axis=1)  # totals from feature 0: (3,)
-    if all_axes:
-        sum0 = jax.lax.psum(sum0, all_axes)
+    if sum_axes:  # row-domain: every feature block's local feature 0
+        # already holds ALL local rows (each row lands in one bin per
+        # feature, padded dead features in bin 0) — summing the feature
+        # axis too would over-count by d_f
+        sum0 = jax.lax.psum(sum0, sum_axes)
     if axis_name is not None:
         if merge == "scatter":
             hist0 = jax.lax.psum_scatter(
@@ -911,7 +982,8 @@ def _w_init(
     )
     nb_l, mb_l, fm_l, cm_l, fc_l, f0_off = _split_tables(
         axis_name, merge, hist0.shape[1], num_bins_pf, missing_bin_pf,
-        feature_mask, categorical_mask, feature_contri)
+        feature_mask, categorical_mask, feature_contri,
+        feature_axis_name=feature_axis_name)
     if dcn_axis_name is not None:
         from ..parallel.hierarchy import dcn_topk_best
 
@@ -935,7 +1007,9 @@ def _w_init(
         )
     best0 = _set_best(
         _empty_best(L, num_bins), jnp.asarray(0),
-        jax.tree.map(lambda a: a[0], _merge_best(bb0, axis_name, f0_off)),
+        jax.tree.map(lambda a: a[0], _merge_best(
+            bb0, feature_axis_name if feature_axis_name is not None
+            else axis_name, f0_off)),
     )
     state = WState(
         order=jnp.arange(n, dtype=jnp.int32),
@@ -959,22 +1033,29 @@ def _w_init(
 
 
 @functools.partial(jax.jit, static_argnames=("params", "quant_renew",
-                                             "axis_name", "dcn_axis_name"))
+                                             "axis_name", "dcn_axis_name",
+                                             "feature_axis_name"))
 def _w_finalize(state: WState, grad_true, hess_true, row_mask,
                 *, params: SplitParams, quant_renew: bool,
                 axis_name: Optional[str] = None,
-                dcn_axis_name: Optional[str] = None):
+                dcn_axis_name: Optional[str] = None,
+                feature_axis_name: Optional[str] = None):
+    # `feature_axis_name` is accepted for uniform static threading on the
+    # 2-D mesh but contributes NO collective: every sum here is
+    # row-domain (rows are replicated across feature blocks — summing
+    # the feature axis would over-count by d_f) and the inputs are
+    # already feature-replicated.
     L = state.leaf_out.shape[0]
-    all_axes = tuple(a for a in (axis_name, dcn_axis_name) if a is not None)
+    sum_axes = tuple(a for a in (axis_name, dcn_axis_name) if a is not None)
     if quant_renew:
         mrow = row_mask.astype(jnp.float32)
         Gt = jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(
             grad_true * mrow)
         Ht = jnp.zeros((L,), jnp.float32).at[state.leaf_id].add(
             hess_true * mrow)
-        if all_axes:  # true-gradient renewal is a global sum
-            Gt = jax.lax.psum(Gt, all_axes)
-            Ht = jax.lax.psum(Ht, all_axes)
+        if sum_axes:  # true-gradient renewal sums the ROW axes
+            Gt = jax.lax.psum(Gt, sum_axes)
+            Ht = jax.lax.psum(Ht, sum_axes)
         leaf_value = leaf_output(Gt, Ht, params)
     else:
         leaf_value = leaf_output(state.leaf_sum_g, state.leaf_sum_h, params)

@@ -1,0 +1,257 @@
+"""2-D (feature x row) sharded windowed training — loopback pins.
+
+The third mesh axis (parallel/feature2d.py): the bin matrix lives as
+``P(feature, row)`` tiles, per-leaf histograms are complete for the owned
+feature block by LAYOUT (the merge is the row psum alone — zero feature
+collectives in the histogram phase, pinned structurally by jaxlint R20 and
+the ``windowed_round_2d_*`` jaxpr contracts), and the split election rides
+the scatter merge's owned-feature winner machinery with the feature axis
+as the owning axis.
+
+This suite pins the loopback semantics on 8 virtual CPU devices
+(conftest): every mesh shape times {float, int8} grows the STRUCTURALLY
+EXACT tree of the single-device windowed grower, within the same
+1-dispatch-per-round / 0-host-sync / 0-retrace budget — with telemetry and
+span tracing ON (the defaults; obs must never cost the budget) — plus the
+booster-level routing, the non-divisor fallback, the dead-feature padding
+guard, and the model round-trip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.binning import DatasetBinner
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.obs import metrics as obs_metrics
+from lightgbm_tpu.ops.split import SplitParams
+from lightgbm_tpu.ops.treegrow_windowed import grow_tree_windowed
+from lightgbm_tpu.parallel.feature2d import (
+    Sharded2DData, grow_tree_windowed_feature2d)
+from lightgbm_tpu.parallel.mesh import make_mesh_2d
+from lightgbm_tpu.utils.sanitizer import CompileCounter
+
+
+def _case(seed=5, n=1600, f=10, quant=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X @ rng.randn(f) + 0.2 * rng.randn(n)
+    binner = DatasetBinner.fit(X, max_bin=31)
+    bins = binner.transform(X)
+    grad = jnp.asarray(0.6 * y, jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    kw = dict(num_leaves=15, num_bins=32,
+              params=SplitParams(min_data_in_leaf=5.0), leaf_tile=4,
+              use_pallas=False)
+    if quant:
+        # deterministic rounding: int8 training must be EXACTLY the
+        # single-device int8 training, not merely statistically close
+        kw.update(quantize_bins=quant, stochastic_rounding=False,
+                  quant_renew=True)
+    return X, bins, binner, grad, hess, kw
+
+
+def _grow_solo(bins, binner, grad, hess, kw, quant_key):
+    n, f = bins.shape
+    return grow_tree_windowed(
+        jnp.asarray(bins.T, jnp.int16), grad, hess,
+        jnp.ones((n,), bool), jnp.ones((n,), jnp.float32),
+        jnp.ones((f,), bool),
+        jnp.asarray(binner.num_bins_per_feature),
+        jnp.asarray(binner.missing_bin_per_feature),
+        quant_key=quant_key, **kw)
+
+
+def _grow_2d(mesh, bins, binner, grad, hess, kw, quant_key, stats):
+    n, f = bins.shape
+    sd = Sharded2DData(mesh, bins.astype(np.int16),
+                       binner.num_bins_per_feature,
+                       binner.missing_bin_per_feature)
+    return sd, grow_tree_windowed_feature2d(
+        sd, sd.pad_rows_device(np.asarray(grad), jnp.float32),
+        sd.pad_rows_device(np.asarray(hess), jnp.float32),
+        sd.row_valid,
+        sd.pad_rows_device(np.ones(n, np.float32), jnp.float32, fill=1.0),
+        jnp.ones((f,), bool), quant_key=quant_key, stats=stats, **kw)
+
+
+def _assert_same_tree(tree_s, tree_d, leaf_s, leaf_d, n, label):
+    assert int(tree_s.num_leaves) == int(tree_d.num_leaves), label
+    m = int(tree_s.num_leaves) - 1
+    for name in ("split_feature", "threshold_bin", "left_child",
+                 "right_child", "default_left"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(tree_s, name))[:m],
+            np.asarray(getattr(tree_d, name))[:m],
+            err_msg=f"{name} {label}")
+    np.testing.assert_allclose(
+        np.asarray(tree_s.leaf_value)[:m + 1],
+        np.asarray(tree_d.leaf_value)[:m + 1], rtol=2e-3, atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(leaf_s),
+                                  np.asarray(leaf_d)[:n],
+                                  err_msg=f"leaf ids {label}")
+
+
+def _run_parity(dr, df, quant):
+    assert obs_metrics.enabled()  # budget holds with telemetry ON
+    X, bins, binner, grad, hess, kw = _case(quant=quant)
+    n = X.shape[0]
+    qk = jax.random.PRNGKey(3) if quant else None
+    tree_s, leaf_s = _grow_solo(bins, binner, grad, hess, kw, qk)
+    mesh = make_mesh_2d(dr, df)
+    stats = {}
+    _, (tree_d, leaf_d) = _grow_2d(mesh, bins, binner, grad, hess, kw, qk,
+                                   stats)
+    assert stats["retries"] == 0, stats
+    assert stats["host_syncs"] == 0, stats
+    assert stats["dispatches"] == stats["rounds"], stats
+    _assert_same_tree(tree_s, tree_d, leaf_s, leaf_d, n,
+                      f"{dr}x{df} quant={quant}")
+
+
+@pytest.mark.parametrize("quant", [0, 16], ids=["float", "int8"])
+def test_parity_2x2(quant):
+    """Tier-1 anchor: the genuinely 2-D mesh (both axes > 1), float AND
+    int8, structurally exact vs the single-device windowed grower within
+    the per-rank budget."""
+    _run_parity(2, 2, quant)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("quant", [0, 16], ids=["float", "int8"])
+@pytest.mark.parametrize("dr,df", [(1, 8), (8, 1), (2, 4)])
+def test_parity_matrix(dr, df, quant):
+    """Degenerate edges — (1, d) pure-feature, (d, 1) pure-row (must
+    reduce to data-parallel semantics) — and the wide 2x4."""
+    _run_parity(dr, df, quant)
+
+
+def test_second_tree_is_retrace_free():
+    """The windowed 0-retrace budget extends to the 2-D builders: the
+    second tree on the same mesh/shape reuses every cached executable."""
+    X, bins, binner, grad, hess, kw = _case()
+    mesh = make_mesh_2d(2, 2)
+    _grow_2d(mesh, bins, binner, grad, hess, kw, None, {})  # warm
+    with CompileCounter() as c:
+        stats = {}
+        _grow_2d(mesh, bins, binner, grad, hess, kw, None, stats)
+    c.assert_no_recompile("second feature2d tree")
+    assert stats["dispatches"] == stats["rounds"]
+
+
+def test_refuses_node_level_rng():
+    """Per-node RNG (bynode fractions / extra trees) draws on the winner's
+    owner block only — not replicated across the feature axis — so the
+    layer refuses instead of silently diverging."""
+    X, bins, binner, grad, hess, kw = _case(n=256, f=8)
+    mesh = make_mesh_2d(2, 2)
+    sd = Sharded2DData(mesh, bins.astype(np.int16),
+                       binner.num_bins_per_feature,
+                       binner.missing_bin_per_feature)
+    with pytest.raises(ValueError, match="feature2d"):
+        grow_tree_windowed_feature2d(
+            sd, sd.pad_rows_device(np.asarray(grad), jnp.float32),
+            sd.pad_rows_device(np.asarray(hess), jnp.float32),
+            sd.row_valid,
+            sd.pad_rows_device(np.ones(256, np.float32), jnp.float32,
+                               fill=1.0),
+            jnp.ones((8,), bool), rng_key=jax.random.PRNGKey(0), **kw)
+
+
+def test_padded_features_never_elected():
+    """Indivisible F pads dead feature slots (num_bins 1, missing -1,
+    mask False) exactly like the scatter merge's `_pad_features`; a padded
+    slot must NEVER win an election.  f=10 over d_f=4 pads to 12 — two
+    dead slots on the last block — and every split the grower emits must
+    name a REAL feature."""
+    X, bins, binner, grad, hess, kw = _case(f=10)
+    mesh = make_mesh_2d(2, 4)
+    sd, (tree_d, _) = _grow_2d(mesh, bins, binner, grad, hess, kw, None, {})
+    assert sd.f_pad == 12 and sd.num_features == 10
+    m = int(tree_d.num_leaves) - 1
+    sf = np.asarray(tree_d.split_feature)[:m]
+    assert m > 0 and np.all(sf < 10), sf
+
+
+# ---------------------------------------------------------------------------
+# booster-level routing
+# ---------------------------------------------------------------------------
+
+
+def _force_windowed(monkeypatch):
+    # loopback CPU: force the windowed gate past the on_tpu/F/leaves floors
+    monkeypatch.setattr(
+        GBDT, "_use_windowed_dp",
+        lambda self, ts: self._dp is not None or self._dp2d is not None)
+
+
+def test_booster_routes_feature2d(monkeypatch):
+    _force_windowed(monkeypatch)
+    rng = np.random.RandomState(12)
+    X = rng.randn(2000, 6).astype(np.float32)
+    y = ((X @ rng.randn(6)) > 0).astype(np.float64)
+    bst = lgb.Booster(
+        params={"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                "tree_learner": "feature2d", "tree_growth_mode": "rounds",
+                "num_feature_shards": 2},
+        train_set=lgb.Dataset(X, label=y))
+    g = bst._gbdt
+    assert g._dp2d is not None, "2-D layout not built"
+    assert g._dp2d.n_feature_shards == 2 and g._dp2d.n_row_shards == 4
+    assert g._use_windowed_2d(g.train_set)
+    for _ in range(5):
+        bst.update()
+    acc = np.mean((bst.predict(X) > 0.5) == (y > 0))
+    assert acc > 0.85, acc
+
+    # shard-local leaf ids localize to the same global tree the text model
+    # round-trips: a reloaded booster predicts bitwise
+    s = bst.model_to_string()
+    clone = lgb.Booster(model_str=s)
+    np.testing.assert_array_equal(clone.predict(X, raw_score=True),
+                                  bst.predict(X, raw_score=True))
+
+
+def test_non_divisor_shards_fall_back_single_mesh(monkeypatch):
+    """num_feature_shards that does not divide the device count warns and
+    trains on the plain row mesh — never a crash, never a silent wrong
+    grid."""
+    _force_windowed(monkeypatch)
+    rng = np.random.RandomState(3)
+    X = rng.randn(800, 6).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1]) > 0).astype(np.float64)
+    bst = lgb.Booster(
+        params={"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                "tree_learner": "feature2d", "num_feature_shards": 3},
+        train_set=lgb.Dataset(X, label=y))
+    assert bst._gbdt._dp2d is None
+    assert bst._gbdt._dp is not None
+    bst.update()
+    assert bst.num_trees() == 1
+
+
+def test_feature_fraction_trees_never_split_padded(monkeypatch):
+    """Per-tree feature sampling rides the padded feature mask: many trees
+    of a feature_fraction<1 booster on an indivisible F must only ever
+    split real features (the padded-slot election guard at booster
+    level)."""
+    _force_windowed(monkeypatch)
+    rng = np.random.RandomState(7)
+    X = rng.randn(1500, 6).astype(np.float32)
+    y = ((X @ rng.randn(6)) > 0).astype(np.float64)
+    bst = lgb.Booster(
+        params={"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                "tree_learner": "feature2d", "tree_growth_mode": "rounds",
+                "num_feature_shards": 4, "feature_fraction": 0.8,
+                "seed": 11},
+        train_set=lgb.Dataset(X, label=y))
+    g = bst._gbdt
+    assert g._dp2d is not None and g._dp2d.f_pad == 8
+    for _ in range(8):
+        bst.update()
+    for t in g.models:
+        sf = np.asarray(t.split_feature)
+        assert sf.size and np.all(sf < 6), sf

@@ -2291,3 +2291,87 @@ def test_r19_pragma_suppression(tmp_path):
     """}, rules=["R19"])
     assert rep.findings == []
     assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R20 feature-axis-hist-collective
+# ---------------------------------------------------------------------------
+
+def test_r20_positive_hist_psum_over_feature_literal(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        def merge(leaf_hists):
+            return jax.lax.psum(leaf_hists, "feature")
+    """}, rules=["R20"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].rule == "R20"
+    assert "feature axis" in rep.findings[0].message
+
+
+def test_r20_positive_axis_constant_and_tuple(tmp_path):
+    """The feature axis referenced through the mesh constant or a
+    feature_axis_name variable — including in a both-axes tuple — is
+    still the feature axis."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        DATA_AXIS = "data"
+        FEATURE_AXIS = "feature"
+
+        def gather(hist0):
+            return jax.lax.all_gather(hist0, FEATURE_AXIS)
+
+        def both(cand_hist, feature_axis_name):
+            return jax.lax.psum(cand_hist, (DATA_AXIS, feature_axis_name))
+    """}, rules=["R20"])
+    assert len(rep.findings) == 2
+    assert all(f.rule == "R20" for f in rep.findings)
+
+
+def test_r20_negative_row_merge_and_non_hist_broadcast(tmp_path):
+    """The sanctioned feature2d traffic: the histogram merge over the ROW
+    axis, the winner's go/no-go row broadcast (not hist-named), and
+    election scalars cross the feature axis clean."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        DATA_AXIS = "data"
+        FEATURE_AXIS = "feature"
+
+        def round_body(fresh_hists, go_left, own_pos, gain):
+            merged_hists = jax.lax.psum(fresh_hists, DATA_AXIS)
+            go_left = jax.lax.psum(
+                jnp.where(own_pos, go_left, False).astype(jnp.int32),
+                FEATURE_AXIS) > 0
+            best = jax.lax.pmax(gain, (DATA_AXIS, FEATURE_AXIS))
+            return merged_hists, go_left, best
+    """}, rules=["R20"])
+    assert rep.findings == []
+
+
+def test_r20_negative_topk_shaped_subset(tmp_path):
+    """An elected top-k histogram subset (take_along_axis by the vote's
+    indices) may cross the feature axis — the R17 escape carries over."""
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def election(cand_hists, g_idx):
+            sub_hists = jnp.take_along_axis(
+                cand_hists, g_idx[:, None, :, None], axis=2)
+            return jax.lax.psum(sub_hists, "feature")
+    """}, rules=["R20"])
+    assert rep.findings == []
+
+
+def test_r20_pragma_suppression(tmp_path):
+    rep = _scan(tmp_path, {"mod.py": """
+        import jax
+
+        def debug_merge(dbg_hists):
+            return jax.lax.psum(dbg_hists, "feature")  # jaxlint: disable=R20 (fixture: one-off parity probe, never the round path)
+    """}, rules=["R20"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1

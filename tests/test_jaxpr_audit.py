@@ -39,6 +39,7 @@ def test_contract_catalogue_pins_the_flagships():
         "windowed_round_sharded_psum", "windowed_round_sharded_scatter",
         "windowed_round_hierarchical_psum",
         "windowed_round_hierarchical_voting",
+        "windowed_round_2d_float", "windowed_round_2d_quantized",
         "predict_warm_single", "predict_warm_multiclass",
         "predict_warm_converted", "predict_coalesced_bucket",
         "ooc_root_chunk", "ooc_split_chunk", "continual_refit_leaves",
@@ -60,6 +61,28 @@ def test_sharded_rounds_have_exactly_one_large_collective(report):
         if not r.name.startswith("windowed_round_sharded"):
             continue
         assert r.detail.get("large_collectives") == 1, (r.name, r.detail)
+
+
+def test_2d_round_histogram_phase_never_crosses_the_feature_axis(report):
+    """The wide-F headline: in the 2-D round, the histogram phase is a
+    row-axis psum ALONE — the owned feature block's histograms are
+    complete by layout, so the sequence shows ZERO hist-sized
+    feature-axis traffic, and the per-axis byte bill proves the feature
+    axis carries only the go/no-go row broadcast + election scalars."""
+    from lightgbm_tpu.analysis.contracts import _2D_FEATURE_BUDGET
+    for name in ("windowed_round_2d_float", "windowed_round_2d_quantized"):
+        r = {x.name: x for x in report.results}[name]
+        toks = r.detail["collectives"]
+        # exactly one @data-only psum (the histogram merge) and it is the
+        # largest collective in the round
+        data_only = [t for t in toks if t == "psum@data"]
+        assert len(data_only) == 3, (name, toks)  # 2 protocol + 1 hist
+        bills = r.detail["axis_bytes"]
+        assert bills["feature"] <= _2D_FEATURE_BUDGET, (name, bills)
+        assert r.detail["feature_bytes"] == bills["feature"]
+        # the row axis carries the histogram merge: orders of magnitude
+        # more bytes than the feature axis at any realistic shape
+        assert bills["data"] > bills["feature"], (name, bills)
 
 
 def test_single_device_bodies_are_collective_free(report):
@@ -111,7 +134,8 @@ def test_donations_all_consumable(report):
         if not live:
             continue
         if r.name.startswith(("windowed_round_sharded",
-                              "windowed_round_hierarchical")):
+                              "windowed_round_hierarchical",
+                              "windowed_round_2d")):
             continue  # aliasing attrs absent in multi-device CPU lowering
         assert r.detail.get("aliased_in_lowering") == live, (r.name, r.detail)
 

@@ -423,6 +423,84 @@ def test_coalesced_batch_budget_and_parity():
         off += len(w)
 
 
+# ---------------------------------------------------------------------------
+# giant-batch row-sharded predict (parallel round: the third mesh axis)
+# ---------------------------------------------------------------------------
+
+
+def test_row_sharded_predict_bitwise_and_one_dispatch():
+    """``Booster.predict(..., mesh=)`` scores a row-sharded batch as ONE
+    SPMD dispatch over the row axis: rows traverse independently and each
+    rank keeps the single-device tree-sum order, so the sharded result is
+    BITWISE the single-device one — and a warm call keeps the exact
+    serving budget (packed-cache hit, 1 dispatch, 1 accounted pull, 0
+    retraces) with the replicated tables resident on the mesh."""
+    from lightgbm_tpu.parallel.mesh import make_mesh
+
+    bst, X, _ = _binary_booster()
+    mesh = make_mesh()
+    want = bst.predict(X, raw_score=True)
+    got = bst.predict(X, raw_score=True, mesh=mesh)  # warm the mesh entry
+    assert np.array_equal(want, got)
+
+    g = bst._gbdt
+    packs = []
+    orig = g._stacked
+
+    def counting_stacked(*a, **kw):
+        packs.append(1)
+        return orig(*a, **kw)
+
+    g._stacked = counting_stacked
+    try:
+        with DispatchCounter() as d:
+            again = bst.predict(X, raw_score=True, mesh=mesh)
+    finally:
+        g._stacked = orig
+    assert not packs, "warm sharded predict re-packed the ensemble"
+    assert d.dispatches == 1, d.dispatches
+    assert d.host_syncs == 1, d.host_syncs
+    d.assert_no_recompile("warm row-sharded predict")
+    assert np.array_equal(want, again)
+
+    # converted output rides the same sharded raw traversal, bitwise
+    assert np.array_equal(bst.predict(X), bst.predict(X, mesh=mesh))
+    # the explicit entry point is the same path
+    assert np.array_equal(want, bst.predict_sharded(X, mesh, raw_score=True))
+
+
+def test_row_sharded_predict_multiclass_bitwise():
+    from lightgbm_tpu.parallel.mesh import make_mesh
+
+    bm, Xm = _multiclass_booster()
+    mesh = make_mesh()
+    want = bm.predict(Xm, raw_score=True)
+    assert np.array_equal(want, bm.predict(Xm, raw_score=True, mesh=mesh))
+    bm.predict(Xm, raw_score=True, mesh=mesh)
+    with DispatchCounter() as d:
+        bm.predict(Xm, raw_score=True, mesh=mesh)
+    assert d.dispatches == 1 and d.host_syncs == 1, (d.dispatches,
+                                                     d.host_syncs)
+    d.assert_no_recompile("warm multiclass row-sharded predict")
+    assert np.array_equal(bm.predict(Xm), bm.predict(Xm, mesh=mesh))
+
+
+def test_row_sharded_predict_on_training_mesh_and_invalidates():
+    """A 2-D (feature x row) TRAINING mesh serves directly — P(data)
+    shards rows and replicates over the feature axis — and mutation
+    invalidates the mesh-resident tables with the pack itself."""
+    from lightgbm_tpu.parallel.mesh import make_mesh_2d
+
+    bst, X, _ = _binary_booster()
+    mesh = make_mesh_2d(4, 2)
+    want = bst.predict(X, raw_score=True)
+    assert np.array_equal(want, bst.predict(X, raw_score=True, mesh=mesh))
+    bst.update()  # bump the pack version
+    after = bst.predict(X, raw_score=True, mesh=mesh)
+    assert not np.array_equal(want, after)
+    assert np.array_equal(after, bst.predict(X, raw_score=True))
+
+
 def test_no_trees_and_single_row_paths():
     """Degenerate serving shapes: empty model and N=1 both work."""
     rng = np.random.RandomState(3)
