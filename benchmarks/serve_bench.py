@@ -97,6 +97,26 @@ def _pcts(lat_s):
             round(float(np.percentile(lat, 99)), 3))
 
 
+_PHASES = ("queue", "coalesce", "staging", "dispatch", "sliceout")
+
+
+def _phase_breakdown():
+    """p50/p99 of the per-request phase stamps the runtime records at
+    already-accounted sync points (zero extra device pulls).  Reservoirs
+    accumulate across the artifact run, so each row reports the
+    distribution as of the end of its workload."""
+    from lightgbm_tpu.obs import metrics as _obs
+
+    out = {}
+    for ph in _PHASES:
+        h = _obs.histogram(_obs.labeled("serve_phase_ms", phase=ph))
+        if h.count:
+            out[ph] = {"p50_ms": round(h.percentile(50), 3),
+                       "p99_ms": round(h.percentile(99), 3),
+                       "count": h.count}
+    return out
+
+
 def bench_parity(g, X):
     """Bitwise parity of coalesced responses, asserted in-artifact."""
     from lightgbm_tpu.serve import ServingRuntime
@@ -194,6 +214,7 @@ def bench_closed_loop(g, X, conc_list, rows, reqs_per_caller):
             "serial": {"rows_per_sec": ser_rps, "p50_ms": ser_p50,
                        "p99_ms": ser_p99, "batches": n_req},
             "speedup": round(rps / max(ser_rps, 1e-9), 2),
+            "phases": _phase_breakdown(),
         }
         if _STATE["value"] is None or rps > _STATE["value"]:
             _STATE["value"] = rps
@@ -236,6 +257,7 @@ def bench_open_loop(g, X, rows):
         "size_cycle": sizes, "shed": shed,
         "rows_per_sec": round(total_rows / wall, 1),
         "p50_ms": p50, "p99_ms": p99,
+        "phases": _phase_breakdown(),
     }
     _emit()
 
@@ -304,6 +326,7 @@ def bench_fleet_chaos(g, X, rows):
         "restarts": _obs.counter("serve_replica_restarts_total").value - r0,
         "rows_per_sec": round(total_rows / wall, 1),
         "p50_ms": p50, "p99_ms": p99,
+        "phases": _phase_breakdown(),
     }
     if lost or not ok:
         raise AssertionError(

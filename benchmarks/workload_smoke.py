@@ -281,6 +281,15 @@ def bench_serve(n_rows=600, n_feat=8, n_trees=12):
     assert any(k.startswith('serve_request_latency_ms{tenant="')
                for k in snap["histograms"]), (
         "per-tenant serve latency labels missing from the snapshot")
+    # round-25 phase breakdown: every request crossed all five phases,
+    # so each labeled reservoir must have fired at least once
+    for ph in ("queue", "coalesce", "staging", "dispatch", "sliceout"):
+        key = _obs.labeled("serve_phase_ms", phase=ph)
+        assert snap["histograms"].get(key, {}).get("count", 0) >= 1, (
+            f"phase breakdown missing {key}")
+    ex = snap["histograms"]["serve_request_latency_ms"].get("exemplar")
+    assert ex and ex.get("trace_id"), (
+        "serve_request_latency_ms carries no trace-id exemplar")
     return len(parts), batches, sum(p.shape[0] for p in parts) / dt
 
 

@@ -1,4 +1,4 @@
-"""jaxlint built-in rules R1-R20.
+"""jaxlint built-in rules R1-R21.
 
 Each rule is a generator over the :class:`~.core.PackageIndex`; see
 ``docs/ANALYSIS.md`` for the catalogue with examples and the pragma format.
@@ -1985,4 +1985,108 @@ def r20_feature_axis_hist_collective(pkg: PackageIndex) -> Iterator[Finding]:
                     "histogram operand across the feature axis — the "
                     "feature-sharded tile's histograms are complete for "
                     "the owned block; merge over the row axis only",
+                    hint)
+
+
+# ---------------------------------------------------------------------------
+# R21 — unlinked-cross-thread-span
+# ---------------------------------------------------------------------------
+
+# span-creation call names (last dotted component): the obs/trace.py API
+# surface that records into the span ring
+_R21_SPAN_CALLS = ("span", "record_span", "Span")
+# a span call carrying any of these keywords names its causal identity
+# explicitly and is immune to the thread-local-stack trap
+_R21_LINK_KWARGS = ("ctx", "parent", "links")
+
+
+def _r21_thread_entry_names(mod) -> set:
+    """Names of functions this module hands to a worker thread: the
+    ``target=`` of any ``*.Thread(...)`` ctor, or the first argument of
+    any ``*.submit(...)`` call (executor dispatch).  Both ``self._fn``
+    and bare ``fn`` references resolve to their last component — entry
+    functions are matched per-module by unqualified name."""
+    names: set = set()
+
+    def ref_name(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    for fi in mod.functions.values():
+        for node in _own_body(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        nm = ref_name(kw.value)
+                        if nm:
+                            names.add(nm)
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "submit" and node.args):
+                nm = ref_name(node.args[0])
+                if nm:
+                    names.add(nm)
+    return names
+
+
+@register_rule("R21", "unlinked-cross-thread-span")
+def r21_unlinked_cross_thread_span(pkg: PackageIndex) -> Iterator[Finding]:
+    """(round 24) a span created INSIDE a thread-entry function — one
+    handed to ``threading.Thread(target=...)`` or ``executor.submit(...)``
+    in the same module — without an explicit causal identity: no ``ctx=``,
+    ``parent=`` or ``links=`` keyword on the ``span(``/``record_span(``/
+    ``Span(`` call, and no ``.link(`` call in the function's own body.
+    The span stack that supplies implicit parentage is THREAD-LOCAL
+    (``obs/trace.py``): on a worker thread it is empty, so an implicit
+    span silently roots a brand-new top-level trace instead of joining
+    the request that crossed the thread boundary — the request's slice
+    then reconstructs without its dispatch/leg spans and the flight
+    recorder shows a broken story (the round-24 cross-thread bugfix).
+    Scoped to ``serve/``/``continual/`` modules — where worker threads
+    carry request/rollover contexts; own-body only (a helper the entry
+    calls is that helper's finding when it, too, becomes an entry —
+    static-limits note in docs/ANALYSIS.md)."""
+    hint = ("carry the TraceContext across the boundary explicitly: mint "
+            "or receive a ctx on the queued work item and pass ctx=/"
+            "parent= to span()/record_span(), or adopt members via "
+            "links=[...] (serve/runtime.py::_dispatch_loop is the "
+            "pattern); an intentional rootless maintenance span takes a "
+            "pragma with its reason")
+    for mod in pkg.modules.values():
+        parts = getattr(mod.path, "parts", ())
+        if not any(d in parts for d in _R16_SCOPED_DIRS):
+            continue
+        entries = _r21_thread_entry_names(mod)
+        if not entries:
+            continue
+        for fi in mod.functions.values():
+            if fi.qualname.split(".")[-1] not in entries:
+                continue
+            linked_via_api = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "link"
+                for n in _own_body(fi))
+            if linked_via_api:
+                continue
+            for node in _own_body(fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = dotted_name(node.func)
+                if fn is None or fn.split(".")[-1] not in _R21_SPAN_CALLS:
+                    continue
+                if any(kw.arg in _R21_LINK_KWARGS for kw in node.keywords):
+                    continue
+                yield _finding(
+                    fi, node, "R21",
+                    f"{fn}(...) in thread-entry {fi.qualname} without "
+                    "ctx=/parent=/links= — the thread-local span stack is "
+                    "empty on a worker thread, so this span roots a NEW "
+                    "trace instead of joining the request that crossed "
+                    "the boundary",
                     hint)

@@ -524,8 +524,24 @@ class Config:
     metrics_port: int = -1
     # trace_file: engine.train writes the span ring as Chrome-trace/
     # Perfetto JSON here at end of run (lightgbm_tpu/obs/trace.py; also
-    # `python -m lightgbm_tpu.obs trace`).
+    # `python -m lightgbm_tpu.obs trace`).  LGBMTPU_TRACE_FILE is the env
+    # spelling (the launcher sets it per worker and `python -m
+    # lightgbm_tpu.obs trace --merge` folds the per-rank files).
     trace_file: str = ""
+    # request_tracing: request-scoped distributed tracing (docs/
+    # OBSERVABILITY.md "Request tracing") — DEFAULT-ON like telemetry=,
+    # and with the same budget contract: a TraceContext minted per
+    # request at admission (honoring inbound W3C traceparent on
+    # /predict), threaded explicitly through coalescing/dispatch/fleet
+    # retry/hedge legs, zero added device dispatches or syncs.  false
+    # stops minting sampled contexts (responses still carry a trace id
+    # for correlation; no spans are recorded for them).
+    request_tracing: bool = True
+    # trace_sample: fraction of requests whose trace is RECORDED (the
+    # admission-time sampling decision; 1.0 default).  Unsampled
+    # requests still carry ids end-to-end — only span recording and the
+    # latency exemplar are skipped.
+    trace_sample: float = 1.0
 
     # --- serving runtime (ours; README "Serving", lightgbm_tpu/serve) ---
     # serve_max_wait_ms: the coalescer's admission window — after the

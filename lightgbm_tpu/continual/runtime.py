@@ -475,8 +475,16 @@ class ContinualRunner:
                 self._inflight_oldest = consumed[0][1] if consumed else None
             kind = self._choose_kind(mode)
             c0 = _san.compile_totals()
+            # the rollover's trace identity (ISSUE-20 vocabulary): build,
+            # checkpoint and swap legs all record under this one context,
+            # so a rollover published mid-request-storm reads as ONE
+            # connected story next to the serve.request spans in the
+            # merged flight recorder
+            roll_ctx = _trace.TraceContext(_trace.new_trace_id())
+            t_roll = time.perf_counter()
             try:
-                with _trace.span(f"continual_{kind}", rows=int(Xw.shape[0]),
+                with _trace.span(f"continual_{kind}", parent=roll_ctx,
+                                 rows=int(Xw.shape[0]),
                                  seq=self._seq + 1):
                     if kind == "append":
                         candidate = self._build_append(Xw, yw)
@@ -510,6 +518,10 @@ class ContinualRunner:
                         "continual_window_evicted_pending_rows_total").inc(
                         lost)
                     _obs.event("continual_window_overflow", rows=lost)
+                _trace.record_span("continual.rollover",
+                                   time.perf_counter() - t_roll,
+                                   ctx=roll_ctx, mode=kind,
+                                   seq=self._seq + 1, outcome="error")
                 raise
             c1 = _san.compile_totals()
             seq = self._seq + 1
@@ -519,17 +531,21 @@ class ContinualRunner:
                 # below resumes the UPDATE while the old ensemble keeps
                 # serving (no torn pack is ever published — swap_model
                 # packs before it publishes)
-                _checkpoint.write_fleet_checkpoint(
-                    self._state_dir,
-                    candidate.model_to_string(raw_deltas=True), seq,
-                    world_size=1, keep=self._snapshot_keep)
+                with _trace.span("checkpoint.snapshot", parent=roll_ctx,
+                                 seq=seq):
+                    _checkpoint.write_fleet_checkpoint(
+                        self._state_dir,
+                        candidate.model_to_string(raw_deltas=True), seq,
+                        world_size=1, keep=self._snapshot_keep)
             # the continual_swap fault site (docs/ROBUSTNESS.md): a hard
             # crash between checkpoint and publication
             _faults.maybe_crash("continual_swap", seq)
-            if self._runtime is not None:
-                self._runtime.swap_model(self._model_name, candidate)
-            else:
-                candidate._gbdt._packed(0, -1)  # warm, mirroring swap_model
+            with _trace.span("continual.swap", parent=roll_ctx, seq=seq,
+                             model=self._model_name):
+                if self._runtime is not None:
+                    self._runtime.swap_model(self._model_name, candidate)
+                else:
+                    candidate._gbdt._packed(0, -1)  # warm, like swap_model
             self._live = candidate
             self._seq = seq
             self._updates += 1
@@ -558,6 +574,13 @@ class ContinualRunner:
             _obs.event("continual_rollover", mode=kind, seq=seq,
                        rows=int(Xw.shape[0]), trees=self._live.num_trees(),
                        **ledger)
+            # the rollover's root span closes at publication — the
+            # build/checkpoint/swap legs above are its children
+            _trace.record_span("continual.rollover",
+                               time.perf_counter() - t_roll, ctx=roll_ctx,
+                               mode=kind, seq=seq, rows=int(Xw.shape[0]),
+                               trees=self._live.num_trees(), outcome="ok",
+                               **ledger)
             return kind
 
     def _clone(self) -> Booster:

@@ -186,6 +186,12 @@ def train(
             _obs.gauge("fleet_resumed_round").set(it)
             _obs.event("fleet_resume", round=it, manifest=os.fspath(resume),
                        snapshot=manifest["snapshot"])
+            # the resume leg joins the trace vocabulary (ISSUE-20): a
+            # rollover/relaunch reconstructs from the merged fleet trace
+            # next to the serve/request spans it interleaved with
+            _trace.record_span("checkpoint.resume", 0.0, round=it,
+                               manifest=os.fspath(resume),
+                               outcome="fleet_manifest")
             log_info(
                 f"resume: fleet manifest {resume} (round {it}) — training "
                 f"{num_boost_round} remaining round(s) from its snapshot")
@@ -204,6 +210,9 @@ def train(
                 it, snap = fb
                 init_model = snap
                 num_boost_round = max(num_boost_round - it, 0)
+                _trace.record_span("checkpoint.resume", 0.0, round=it,
+                                   snapshot=os.fspath(snap),
+                                   outcome="auto_snapshot")
                 log_info(
                     f"resume=auto: resuming from {snap} (iteration {it}); "
                     f"training {num_boost_round} remaining round(s)")
@@ -304,17 +313,23 @@ def train(
     # "train (total - k) more rounds" resume recipe both trust the name
     snapshot_base = booster.current_iteration()
 
-    if _obs.enabled() and cfg_probe.trace_file:
+    # request-scoped tracing knobs apply process-wide here, like the
+    # registry's enablement — admission points (serve submit, /predict)
+    # read them when minting per-request contexts
+    _trace.configure_request_tracing(cfg_probe.request_tracing,
+                                     cfg_probe.trace_sample)
+    trace_out = _trace_path(cfg_probe)
+    if _obs.enabled() and trace_out:
         # ring-overflow spill sink rides the trace_file= opt-in
         # (obs/trace.py): a long (out-of-core) run can no longer lose
         # spans silently — evictions append to the sidecar JSONL and
         # count trace_spans_spilled_total.  Best-effort, like the final
         # write_trace: an unwritable sidecar must not cost the run.
         try:
-            _trace.enable_spill(cfg_probe.trace_file + ".spill.jsonl")
+            _trace.enable_spill(trace_out + ".spill.jsonl")
         except OSError as e:
             log_warning("could not arm the trace spill sink next to "
-                        f"{cfg_probe.trace_file}: {e}")
+                        f"{trace_out}: {e}")
 
     # the run-level span is HOST-CAUSAL wall clock (docs/OBSERVABILITY.md
     # "Span tracing"): per-round device-inclusive spans are the windowed
@@ -362,9 +377,11 @@ def train(
                 # mid-write can no longer leave a torn snapshot that a
                 # restart would load.  raw_deltas: snapshots carry pure-delta
                 # trees + an init_scores header so resume is bitwise
-                _checkpoint.save_snapshot(
-                    snap, booster.model_to_string(raw_deltas=True),
-                    global_iter)
+                with _trace.span("checkpoint.snapshot",
+                                 iteration=global_iter, path=snap):
+                    _checkpoint.save_snapshot(
+                        snap, booster.model_to_string(raw_deltas=True),
+                        global_iter)
                 log_info(f"Saved snapshot to {snap}")
                 if int(cfg_probe.snapshot_keep) > 0:
                     # bounded retention (snapshot_keep=): prune the oldest
@@ -578,6 +595,13 @@ def train_fleet(params: Optional[Dict[str, Any]], train_set, labels=None, *,
     return fb.train(num_boost_round)
 
 
+def _trace_path(cfg: Config) -> str:
+    """The run's trace-export path: ``trace_file=`` when set, else the
+    ``LGBMTPU_TRACE_FILE`` env spelling (the launcher sets a per-rank
+    path so ``aggregate_fleet_trace`` can merge the fleet's files)."""
+    return cfg.trace_file or os.environ.get("LGBMTPU_TRACE_FILE", "")
+
+
 def _finish_run_report(cfg: Config) -> None:
     """End-of-run observability (docs/OBSERVABILITY.md): the reference-style
     "Time for X / counter = v" report through the logger (debug verbosity —
@@ -586,7 +610,7 @@ def _finish_run_report(cfg: Config) -> None:
     ``python -m lightgbm_tpu.obs <file>``)."""
     if not _obs.enabled():
         for name, val in (("metrics_file", cfg.metrics_file),
-                          ("trace_file", cfg.trace_file)):
+                          ("trace_file", _trace_path(cfg))):
             if val:
                 log_warning(f"{name}={val} ignored: telemetry is disabled "
                             "(telemetry=false / LGBMTPU_TELEMETRY=0)")
@@ -604,15 +628,16 @@ def _finish_run_report(cfg: Config) -> None:
                         f"{cfg.metrics_file}: {e}")
         else:
             log_info(f"Metrics snapshot written to {cfg.metrics_file}")
-    if cfg.trace_file:
+    trace_out = _trace_path(cfg)
+    if trace_out:
         # Chrome-trace/Perfetto span export (obs/trace.py); same
         # best-effort contract as metrics_file
         try:
-            n_spans = _trace.write_trace(cfg.trace_file)
+            n_spans = _trace.write_trace(trace_out)
         except OSError as e:
-            log_warning(f"could not write trace to {cfg.trace_file}: {e}")
+            log_warning(f"could not write trace to {trace_out}: {e}")
         else:
-            log_info(f"Trace ({n_spans} spans) written to {cfg.trace_file}")
+            log_info(f"Trace ({n_spans} spans) written to {trace_out}")
         # disarm the run's spill sink: evictions from LATER work in this
         # process (another train, serving) must not append to — and be
         # mistaken for — this run's span history

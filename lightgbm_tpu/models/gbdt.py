@@ -2382,7 +2382,8 @@ class GBDT:
         return time.perf_counter(), _san.compile_totals()["compiles"]
 
     def _serve_note(self, entry: str, n: int, t0c0: Tuple[float, int],
-                    bucket: Optional[int] = None) -> None:
+                    bucket: Optional[int] = None,
+                    trace_ctx=None) -> None:
         """Record one serving call.  Bucket hit/miss is decided by whether
         the call compiled anything (a miss = a new bucket/shape opened);
         only hits feed the warm-latency reservoirs, so cold compiles never
@@ -2414,7 +2415,12 @@ class GBDT:
                     "predict_warm_latency_ms", bucket=bucket)).observe(dt_ms)
         else:
             _obs.counter("predict_bucket_misses_total").inc()
-        _trace.record_span(f"predict.{entry}", dt_ms / 1e3, rows=n,
+        # trace_ctx (when a serving dispatcher passed its leg context)
+        # makes the device-side span a CHILD of that dispatch leg — this
+        # runs on dispatcher threads whose ambient span stack is empty,
+        # so parentage must arrive explicitly (the R21 rule)
+        _trace.record_span(f"predict.{entry}", dt_ms / 1e3,
+                           parent=trace_ctx, rows=n,
                            bucket=bucket, warm=warm)
 
     def _pad_rows(self, X: np.ndarray, n_bucket: int) -> jnp.ndarray:
@@ -2659,7 +2665,8 @@ class GBDT:
         return (not self.average_output
                 and os.environ.get("LGBMTPU_FUSED_CONVERT", "1") != "0")
 
-    def predict_coalesced(self, x, active, n, *, convert: bool):
+    def predict_coalesced(self, x, active, n, *, convert: bool,
+                          trace_ctx=None):
         """One coalesced serving batch (lightgbm_tpu/serve/runtime.py):
         ``x`` is an ALREADY-STAGED (nb, F) f32 device batch — the
         runtime's pinned-buffer upload, enqueued while the previous batch
@@ -2708,7 +2715,8 @@ class GBDT:
             n_per_class = max(s["T"] // k, 1)
             scale = (1.0 / n_per_class) if self.average_output else 1.0
             res = np.asarray(_san.sync_pull(out)[:n], dtype=np.float64) * scale
-        self._serve_note("coalesced", n, t0c0, bucket=nb)
+        self._serve_note("coalesced", n, t0c0, bucket=nb,
+                         trace_ctx=trace_ctx)
         return res
 
     def predict(self, X, raw_score=False, start_iteration=0, num_iteration=-1,

@@ -11,10 +11,15 @@ before anything is printed.
 
 Subcommands:
 
-* ``trace [trace.json] [-o OUT]`` — export spans as Chrome-trace/Perfetto
-  JSON.  With a path, validates + re-emits a saved trace file
-  (``trace_file=`` / :func:`write_trace`); without, exports the live span
-  ring.  ``-o`` writes atomically instead of printing.
+* ``trace [trace.json ...] [--merge] [--trace-id HEX32] [-o OUT]`` —
+  export spans as Chrome-trace/Perfetto JSON.  With a path, validates +
+  re-emits a saved trace file (``trace_file=`` / :func:`write_trace`);
+  without, exports the live span ring.  ``--merge`` folds several
+  per-rank/per-replica trace files into one clock-aligned timeline (the
+  flight recorder; completes the launcher's events/metrics merge triad);
+  ``--trace-id`` narrows the export to one request's connected trace
+  (span-link closure — the hedged/requeued story end-to-end).  ``-o``
+  writes atomically instead of printing.
 * ``serve SNAPSHOT [--port N] [--host H]`` — standalone HTTP endpoint
   over a saved snapshot file (``/metrics``, ``/healthz``, ``/snapshot``;
   ``/events`` serves a sibling ``--events`` JSONL when given) — the
@@ -80,22 +85,51 @@ def _cmd_trace(argv) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m lightgbm_tpu.obs trace",
         description="export spans as Chrome-trace/Perfetto JSON")
-    parser.add_argument("path", nargs="?", default=None,
-                        help="a saved trace file (trace_file= / "
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="saved trace file(s) (trace_file= / "
                              "write_trace) to validate + re-emit "
-                             "(default: export the live span ring)")
+                             "(default: export the live span ring); "
+                             "several paths require --merge")
+    parser.add_argument("--merge", action="store_true",
+                        help="fold the given per-rank/per-replica trace "
+                             "files into ONE clock-aligned timeline "
+                             "(each source keeps its own pid lane; the "
+                             "launcher's events/metrics merge triad, "
+                             "completed)")
+    parser.add_argument("--trace-id", default=None, metavar="HEX32",
+                        help="narrow the export to one request's "
+                             "CONNECTED trace: its own spans plus "
+                             "everything reachable over span links "
+                             "(coalesced batches, failed legs, "
+                             "hedge/requeue records)")
     parser.add_argument("-o", "--output", default=None,
                         help="write the trace JSON here (atomic) instead "
                              "of printing it")
     args = parser.parse_args(argv)
+    if len(args.paths) > 1 and not args.merge:
+        print("error: multiple trace files need --merge", file=sys.stderr)
+        return 2
     try:
-        if args.path is not None:
-            doc = _trace.load_trace(args.path)
+        if args.merge:
+            if not args.paths:
+                print("error: --merge needs at least one trace file",
+                      file=sys.stderr)
+                return 2
+            doc = _trace.merge_trace_files(args.paths)
+        elif args.paths:
+            doc = _trace.load_trace(args.paths[0])
         else:
             doc = _trace.to_chrome_trace()
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.trace_id:
+        meta = doc.get("lgbmtpu", {})
+        sliced = _trace.trace_slice(args.trace_id.strip().lower(),
+                                    meta.get("spans", []))
+        doc = _trace.to_chrome_trace(sliced)
+        if "merged" in meta:  # keep the provenance of a merged input
+            doc["lgbmtpu"]["merged"] = meta["merged"]
     if args.output:
         from .metrics import _atomic_write_json
 

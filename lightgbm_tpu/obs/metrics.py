@@ -65,6 +65,10 @@ from typing import Any, Callable, Dict, List, Optional
 SCHEMA = "lgbmtpu-metrics-v1"
 RESERVOIR_CAP = 512
 EVENT_RING_CAP = 4096
+# exemplar freshness window: the kept witness outlier yields to ANY newer
+# exemplar once it is this old, so a single cold-start spike cannot pin
+# the series' exemplar forever
+EXEMPLAR_TTL_S = 60.0
 _PROM_PREFIX = "lgbmtpu_"
 
 _lock = threading.RLock()
@@ -143,7 +147,8 @@ class Histogram:
     estimated from a RESERVOIR_CAP-sample reservoir (classic algorithm-R,
     seeded per name so runs are reproducible)."""
 
-    __slots__ = ("name", "count", "total", "min", "max", "_samples", "_rng")
+    __slots__ = ("name", "count", "total", "min", "max", "_samples", "_rng",
+                 "_exemplar")
 
     def __init__(self, name: str) -> None:
         self.name = name
@@ -155,11 +160,20 @@ class Histogram:
         # stable per-name seed (str hash() is salted per process — crc32
         # keeps the "identical runs keep identical reservoirs" promise)
         self._rng = random.Random(zlib.crc32(name.encode("utf-8")))
+        # OpenMetrics-style exemplar: the trace id of a WITNESS outlier —
+        # {"trace_id", "value", "ts"} — so a latency series answers
+        # "show me one request that actually looked like this tail"
+        self._exemplar: Optional[Dict[str, Any]] = None
 
-    def observe(self, v: float, always: bool = False) -> None:
+    def observe(self, v: float, always: bool = False,
+                exemplar: Optional[str] = None) -> None:
         """``always=True`` records even while telemetry is disabled — for
         explicitly invoked profiling APIs (utils/profiling.py
-        timed_section), where the call itself is the opt-in."""
+        timed_section), where the call itself is the opt-in.
+        ``exemplar=`` attaches a trace id witnessing this observation;
+        the histogram keeps the witness of the LARGEST value seen in the
+        trailing EXEMPLAR_TTL_S window (outliers win, a one-off spike
+        ages out)."""
         if not (_enabled or always):
             return
         v = float(v)
@@ -174,6 +188,18 @@ class Histogram:
                 j = self._rng.randrange(self.count)
                 if j < RESERVOIR_CAP:
                     self._samples[j] = v
+            if exemplar is not None:
+                ex = self._exemplar
+                now = time.time()
+                if (ex is None or v >= ex["value"]
+                        or now - ex["ts"] > EXEMPLAR_TTL_S):
+                    self._exemplar = {"trace_id": str(exemplar),
+                                      "value": v, "ts": now}
+
+    @property
+    def exemplar(self) -> Optional[Dict[str, Any]]:
+        with _lock:
+            return dict(self._exemplar) if self._exemplar else None
 
     def percentile(self, p: float) -> Optional[float]:
         with _lock:
@@ -195,6 +221,9 @@ class Histogram:
         }
         if samples is not None:
             out["samples"] = samples
+        ex = self.exemplar
+        if ex is not None:
+            out["exemplar"] = ex
         return out
 
 
@@ -562,7 +591,18 @@ def render_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
             if v is not None:
                 lines.append(f'{pn}{{{pre}quantile="{q}"}} {v}')
         lines.append(f"{pn}_sum{sfx} {h.get('sum', 0.0)}")
-        lines.append(f"{pn}_count{sfx} {h.get('count', 0)}")
+        ex = h.get("exemplar")
+        if isinstance(ex, dict) and ex.get("trace_id"):
+            # OpenMetrics exemplar syntax on the count series: the trace
+            # id of a witness outlier, so the latency family answers
+            # "show me one real request from this tail" (the trace CLI's
+            # --trace-id form reconstructs it from the flight recorder)
+            lines.append(
+                f"{pn}_count{sfx} {h.get('count', 0)} "
+                f'# {{trace_id="{ex["trace_id"]}"}} '
+                f"{ex.get('value')} {ex.get('ts')}")
+        else:
+            lines.append(f"{pn}_count{sfx} {h.get('count', 0)}")
     ev = snap.get("events_total")
     if ev is not None:
         pn = _prom_name("events_total")
@@ -657,6 +697,13 @@ def _merge_hist_summaries(summaries: List[Dict[str, Any]]) -> Dict[str, Any]:
         "min": min(mins) if mins else None,
         "max": max(maxs) if maxs else None,
     }
+    exemplars = [s["exemplar"] for s in summaries
+                 if isinstance(s.get("exemplar"), dict)
+                 and s["exemplar"].get("trace_id")]
+    if exemplars:
+        # fleet-wide witness: the worst outlier any rank saw
+        out["exemplar"] = max(
+            exemplars, key=lambda e: float(e.get("value") or 0.0))
     samples: List[float] = []
     for s in summaries:
         samples.extend(s.get("samples") or [])

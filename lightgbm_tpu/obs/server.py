@@ -99,14 +99,18 @@ DEGRADED_GAUGES = (
 # /predict routes through the hook, /healthz merges the replica table
 # ---------------------------------------------------------------------------
 
-_predict_fn: Optional[Callable[[Dict[str, Any]], Tuple[int, Dict]]] = None
+_predict_fn: Optional[Callable[..., Tuple]] = None
 _health_extra_fn: Optional[Callable[[], Dict[str, Any]]] = None
 
 
-def set_predict_handler(fn: Callable[[Dict[str, Any]], Tuple[int, Dict]]) -> None:
-    """Attach the process's ``POST /predict`` handler (a callable taking
-    the parsed JSON body and returning ``(http_status, body_dict)``).
-    Last registration wins — one process, one front door."""
+def set_predict_handler(fn: Callable[..., Tuple]) -> None:
+    """Attach the process's ``POST /predict`` handler.  The current
+    contract is ``fn(payload, traceparent=None) -> (http_status,
+    body_dict, traceparent_out)`` — the inbound W3C header (or None)
+    goes in, the outbound header (or None) comes back and is emitted on
+    the response.  A legacy 2-tuple handler ``fn(payload) -> (status,
+    body)`` still works (no trace header either way).  Last registration
+    wins — one process, one front door."""
     global _predict_fn
     _predict_fn = fn
 
@@ -190,10 +194,13 @@ def _make_handler(server: "MetricsServer"):
         def log_message(self, *args) -> None:  # noqa: D102, ARG002
             pass  # a scrape every few seconds must not spam the run log
 
-        def _send(self, code: int, body: bytes, ctype: str) -> None:
+        def _send(self, code: int, body: bytes, ctype: str,
+                  headers: Optional[Dict[str, str]] = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -268,9 +275,23 @@ def _make_handler(server: "MetricsServer"):
                                     b'"body is not valid JSON"}\n',
                                "application/json")
                     return
-                code, body = fn(payload)
+                # distributed tracing (docs/OBSERVABILITY.md "Request
+                # tracing"): the inbound W3C traceparent (if any) is
+                # handed to the runtime, which mints the request context
+                # from it; the response ALWAYS names the request's trace
+                # — body trace_id + outbound traceparent header — so a
+                # caller can join its own trace to the flight recorder.
+                tp_in = self.headers.get("traceparent")
+                try:
+                    code, body, tp_out = fn(payload, traceparent=tp_in)
+                except TypeError:
+                    # a legacy 1-arg handler (tests / external hooks)
+                    code, body = fn(payload)
+                    tp_out = None
                 self._send(code, (json.dumps(body, default=str) + "\n")
-                           .encode("utf-8"), "application/json")
+                           .encode("utf-8"), "application/json",
+                           headers={"traceparent": tp_out} if tp_out
+                           else None)
             except BrokenPipeError:
                 pass  # the client hung up mid-response
             except Exception as e:  # noqa: BLE001 — endpoint must not die

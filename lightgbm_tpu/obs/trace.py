@@ -50,6 +50,30 @@ module stays stdlib-only like the rest of ``lightgbm_tpu/obs``.
 Enablement follows the metrics registry (``telemetry=false`` /
 ``LGBMTPU_TELEMETRY=0`` silences spans too); a disabled span is a cheap
 no-op object.
+
+Request-scoped distributed tracing (docs/OBSERVABILITY.md "Request
+tracing"): a :class:`TraceContext` — 128-bit ``trace_id``, 64-bit
+``span_id``, optional parent span id, all lowercase hex — names a span's
+identity EXPLICITLY so causality survives thread handoffs.  The
+thread-local stack severs the moment a request crosses the serving
+coalescer (submitter thread -> coalescer -> dispatcher/replica threads);
+cross-thread emitters therefore pass ``parent=``/``ctx=`` to
+:func:`span`/:func:`record_span` instead of inheriting the WRONG
+thread's stack top, and fan-in/fan-out joins (one coalesced dispatch
+serving N requests, a hedge pair racing first-result-wins) are expressed
+as ``links=`` — a list of peer contexts attached to the record, the
+OpenTelemetry span-link shape.  Contexts interoperate with W3C
+``traceparent`` headers (:func:`parse_traceparent` /
+:func:`format_traceparent`); :func:`mint_request_context` is the
+/predict entry's minting point and applies the ``request_tracing=`` /
+``trace_sample=`` sampling decision (an unsampled context still carries
+a trace id for response correlation — its spans are simply not
+recorded).  :func:`spans_for_trace` and :func:`trace_slice` are the
+trace_id-indexed retrieval; :func:`merge_trace_files` folds per-rank /
+per-replica trace exports into one clock-aligned flight recorder (the
+launcher's events/metrics merge triad, completed).  None of this touches
+a device value: ids come from ``os.urandom``, timings from host clocks
+the caller already read.
 """
 
 from __future__ import annotations
@@ -58,14 +82,21 @@ import collections
 import itertools
 import json
 import os
+import random
 import threading
 import time
-from typing import Any, Callable, ContextManager, Dict, List, Optional
+from typing import (Any, Callable, ContextManager, Dict, Iterable, List,
+                    Optional, Sequence)
 
 from . import metrics as _metrics
 
 SCHEMA_TRACE = "lgbmtpu-trace-v1"
 TRACE_RING_CAP = 8192
+
+# spans a single record may link to: a serving batch can coalesce many
+# requests — the links list is bounded so one fan-in record cannot bloat
+# the ring; overflow is counted on the record (link_overflow attr)
+MAX_LINKS = 64
 
 SPILL_MAX_BYTES = 64 * 1024 * 1024  # default bound for the spill sink
 
@@ -171,19 +202,174 @@ def _stack() -> List["Span"]:
     return st
 
 
+# ---------------------------------------------------------------------------
+# request-scoped trace contexts (docs/OBSERVABILITY.md "Request tracing")
+# ---------------------------------------------------------------------------
+
+# request-tracing switch + sampling rate (Config request_tracing= /
+# trace_sample=; configure_request_tracing applies them).  Default ON at
+# rate 1.0 — the ISSUE-20 acceptance state.  The sampler is a private
+# random.Random seeded from os.urandom so tests seeding the global
+# random module cannot couple to the sampling stream.
+_req_tracing = True
+_req_sample = 1.0
+_req_rng = random.Random(os.urandom(8))
+
+
+def configure_request_tracing(enabled: bool = True,
+                              sample: float = 1.0) -> None:
+    """Apply the ``request_tracing=`` / ``trace_sample=`` Config params to
+    the process (engine/serve entries call this)."""
+    global _req_tracing, _req_sample
+    _req_tracing = bool(enabled)
+    _req_sample = min(max(float(sample), 0.0), 1.0)
+
+
+def request_tracing_enabled() -> bool:
+    return _req_tracing and _metrics.enabled()
+
+
+def new_trace_id() -> str:
+    """Fresh 128-bit trace id, 32 lowercase hex chars (W3C trace-id)."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """Fresh 64-bit span id, 16 lowercase hex chars (W3C parent-id)."""
+    return os.urandom(8).hex()
+
+
+class TraceContext:
+    """One span's identity: ``trace_id`` (128-bit hex) names the request's
+    whole causal story, ``span_id`` (64-bit hex) names THIS span inside
+    it, ``parent_id`` the span it descends from (None = trace root).
+    ``sampled`` carries the admission-time sampling decision: an
+    unsampled context still travels (responses carry the trace id either
+    way) but :func:`record_span` drops its spans."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: Optional[str] = None,
+                 parent_id: Optional[str] = None,
+                 sampled: bool = True) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id or new_span_id()
+        self.parent_id = parent_id
+        self.sampled = bool(sampled)
+
+    def child(self) -> "TraceContext":
+        """A context for a new span UNDER this one (same trace, this span
+        as parent) — the cross-thread handoff shape: the enqueuing side
+        makes the child, the worker thread records with ``ctx=child``."""
+        return TraceContext(self.trace_id, new_span_id(), self.span_id,
+                            self.sampled)
+
+    def sibling(self) -> "TraceContext":
+        """A context in the SAME trace with no parent — the fan-in shape:
+        a coalesced dispatch span lives in its first request's trace and
+        the member requests attach via ``links=``, not parentage."""
+        return TraceContext(self.trace_id, new_span_id(), None,
+                            self.sampled)
+
+    def ref(self) -> Dict[str, str]:
+        """The serialized link form stored on ring records."""
+        return {"trace": self.trace_id, "sid": self.span_id}
+
+    def __repr__(self) -> str:  # debugging/test readability only
+        return (f"TraceContext({self.trace_id[:8]}…/{self.span_id}"
+                f"{'' if self.sampled else ' unsampled'})")
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """Parse a W3C ``traceparent`` header (``00-<32hex>-<16hex>-<2hex>``)
+    into the REMOTE caller's context (their span id, no local parent).
+    Returns None on anything malformed — a bad header must never shed a
+    request, it just starts a fresh trace."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    ver, trace_id, span_id, flags = parts
+    if (len(ver) != 2 or len(trace_id) != 32 or len(span_id) != 16
+            or len(flags) != 2):
+        return None
+    try:
+        int(ver, 16), int(trace_id, 16), int(span_id, 16), int(flags, 16)
+    except ValueError:
+        return None
+    if ver == "ff" or int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+        return None  # ff is forbidden by the spec; zero ids are invalid
+    return TraceContext(trace_id, span_id, None,
+                        sampled=bool(int(flags, 16) & 0x01))
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    """The W3C ``traceparent`` header naming ``ctx`` as the parent of
+    whatever the receiver does next."""
+    return f"00-{ctx.trace_id}-{ctx.span_id}-{'01' if ctx.sampled else '00'}"
+
+
+def mint_request_context(
+        traceparent: Optional[str] = None) -> TraceContext:
+    """Mint the per-request root context at an admission point (/predict,
+    ``ServingRuntime.submit``).  An inbound ``traceparent`` is honored:
+    the request joins the caller's trace as a child of their span.  The
+    sampling decision (``request_tracing=`` x ``trace_sample=``) is made
+    HERE, once per request; every downstream span inherits it."""
+    sampled = (request_tracing_enabled()
+               and (_req_sample >= 1.0 or _req_rng.random() < _req_sample))
+    remote = parse_traceparent(traceparent)
+    if remote is not None:
+        return TraceContext(remote.trace_id, new_span_id(),
+                            remote.span_id, sampled)
+    return TraceContext(new_trace_id(), new_span_id(), None, sampled)
+
+
+def current_context() -> Optional[TraceContext]:
+    """The context of THIS thread's innermost open span (None outside any
+    span).  This is the explicit-handoff source: read it on the enqueuing
+    thread, pass ``.child()`` to the worker — never let the worker read
+    its own (different) stack."""
+    st = _stack()
+    return st[-1].ctx if st else None
+
+
+def _link_refs(links: Optional[Iterable[TraceContext]],
+               attrs: Dict[str, Any]) -> Optional[List[Dict[str, str]]]:
+    """Serialize a links list, bounding it at MAX_LINKS (overflow is
+    recorded on the span so a truncated fan-in reads as truncated)."""
+    if not links:
+        return None
+    refs = [c.ref() for c in links if c is not None]
+    if len(refs) > MAX_LINKS:
+        attrs["link_overflow"] = len(refs) - MAX_LINKS
+        refs = refs[:MAX_LINKS]
+    return refs or None
+
+
 class Span:
     """One open span.  Use via :func:`span`; ``set(**attrs)`` attaches
-    attributes any time before close."""
+    attributes any time before close.  ``ctx`` is the span's
+    :class:`TraceContext` — readable after ``__enter__`` so the opener
+    can hand ``sp.ctx.child()`` to another thread; ``link(ctx)`` attaches
+    a span link any time before close."""
 
     __slots__ = ("name", "attrs", "span_id", "parent_id", "depth",
+                 "ctx", "_parent_ctx", "_links",
                  "_ts", "_t0", "_annotation", "_recorded")
 
-    def __init__(self, name: str, attrs: Dict[str, Any]) -> None:
+    def __init__(self, name: str, attrs: Dict[str, Any],
+                 parent: Optional[TraceContext] = None,
+                 links: Optional[Iterable[TraceContext]] = None) -> None:
         self.name = name
         self.attrs = attrs
         self.span_id = next(_ids)
         self.parent_id: Optional[int] = None
         self.depth = 0
+        self._parent_ctx = parent
+        self.ctx: Optional[TraceContext] = None
+        self._links: List[TraceContext] = list(links) if links else []
         self._ts = time.time()
         self._t0 = time.perf_counter()
         self._annotation: Optional[ContextManager] = None
@@ -193,12 +379,32 @@ class Span:
         self.attrs.update(attrs)
         return self
 
+    def link(self, ctx: Optional[TraceContext]) -> "Span":
+        """Attach a span link (fan-in/fan-out peer) before close."""
+        if ctx is not None:
+            self._links.append(ctx)
+        return self
+
     # -- context protocol ------------------------------------------------
     def __enter__(self) -> "Span":
         st = _stack()
-        if st:
+        # resolve the span's identity: an EXPLICIT parent context wins —
+        # the cross-thread handoff case, where this thread's stack
+        # belongs to a DIFFERENT causal story and inheriting it would
+        # file the span under the wrong parent (the pre-round-24 bug).
+        # Else descend from this thread's innermost open span; else root
+        # a fresh trace.
+        if self._parent_ctx is not None:
+            self.ctx = self._parent_ctx.child()
+        elif st and st[-1].ctx is not None:
+            self.ctx = st[-1].ctx.child()
             self.parent_id = st[-1].span_id
             self.depth = st[-1].depth + 1
+        else:
+            self.ctx = TraceContext(new_trace_id())
+            if st:  # pre-context legacy nesting (factory-made spans)
+                self.parent_id = st[-1].span_id
+                self.depth = st[-1].depth + 1
         st.append(self)
         fac = _annotation_factory
         if fac is not None:
@@ -232,7 +438,8 @@ class Span:
                 self.attrs.setdefault("error", exc_type.__name__)
             _append(self.name, self._ts, dur, self.attrs,
                     span_id=self.span_id, parent_id=self.parent_id,
-                    depth=self.depth)
+                    depth=self.depth, ctx=self.ctx,
+                    links=_link_refs(self._links, self.attrs))
         return None
 
 
@@ -241,7 +448,12 @@ class _NoopSpan:
 
     __slots__ = ()
 
+    ctx: Optional[TraceContext] = None
+
     def set(self, **attrs: Any) -> "_NoopSpan":
+        return self
+
+    def link(self, ctx: Optional[TraceContext] = None) -> "_NoopSpan":
         return self
 
     def __enter__(self) -> "_NoopSpan":
@@ -254,29 +466,63 @@ class _NoopSpan:
 _NOOP = _NoopSpan()
 
 
-def span(name: str, **attrs: Any):
+def span(name: str, parent: Optional[TraceContext] = None,
+         links: Optional[Iterable[TraceContext]] = None,
+         **attrs: Any):
     """Open a nesting span around a host-side section.  Records a ring
     entry on close; mirrors into the installed device-annotation factory
-    (jax.profiler) when one is set."""
+    (jax.profiler) when one is set.  ``parent=`` names an explicit parent
+    context (the cross-thread form — REQUIRED when the opener's causal
+    parent lives on another thread's stack; jaxlint R21 polices the
+    serve/continual thread targets); ``links=`` attaches fan-in/fan-out
+    peer contexts."""
     if not _metrics.enabled():
         return _NOOP
-    return Span(name, attrs)
+    if parent is not None and not parent.sampled:
+        return _NOOP  # the request's admission-time sampling decision
+    return Span(name, attrs, parent=parent, links=links)
 
 
-def record_span(name: str, duration_s: float, **attrs: Any) -> None:
+def record_span(name: str, duration_s: float,
+                ctx: Optional[TraceContext] = None,
+                parent: Optional[TraceContext] = None,
+                links: Optional[Iterable[TraceContext]] = None,
+                **attrs: Any) -> None:
     """Record a span that ENDS NOW and lasted ``duration_s`` — the
     retroactive form for intervals anchored at an accounted sync point the
-    caller just passed (async info resolve, ``sync_pull``).  Does not
-    nest (no stack interaction) and never touches a device value."""
+    caller just passed (async info resolve, ``sync_pull``).  Never touches
+    a device value.
+
+    Identity is explicit, never implicit-cross-thread: ``ctx=`` records
+    under a pre-minted identity (so OTHER spans could already hold links
+    to it — the serving batch/leg shape); ``parent=`` derives a fresh
+    child of an explicit parent context; with neither, the span adopts
+    this thread's innermost open span as parent when one exists (the
+    training-loop form: ``windowed_round`` under ``boost_round``) and is
+    otherwise a fresh root.  ``links=`` attaches peer contexts.  A
+    context carrying ``sampled=False`` drops the record — that is the
+    request-sampling contract."""
     if not _metrics.enabled():
         return
+    attrs = dict(attrs)
+    if ctx is not None:
+        rec_ctx = ctx
+    elif parent is not None:
+        rec_ctx = parent.child()
+    else:
+        cur = current_context()
+        rec_ctx = cur.child() if cur is not None else None
+    if rec_ctx is not None and not rec_ctx.sampled:
+        return
     dur = max(float(duration_s), 0.0)
-    _append(name, time.time() - dur, dur, attrs)
+    _append(name, time.time() - dur, dur, attrs, ctx=rec_ctx,
+            links=_link_refs(links, attrs))
 
 
 def _append(name: str, ts: float, dur: float, attrs: Dict[str, Any],
             span_id: Optional[int] = None, parent_id: Optional[int] = None,
-            depth: int = 0) -> None:
+            depth: int = 0, ctx: Optional[TraceContext] = None,
+            links: Optional[List[Dict[str, str]]] = None) -> None:
     rec = {
         "name": name,
         "ts": ts,
@@ -289,6 +535,13 @@ def _append(name: str, ts: float, dur: float, attrs: Dict[str, Any],
         rec["id"] = span_id
     if parent_id is not None:
         rec["parent"] = parent_id
+    if ctx is not None:
+        rec["trace"] = ctx.trace_id
+        rec["sid"] = ctx.span_id
+        if ctx.parent_id is not None:
+            rec["psid"] = ctx.parent_id
+    if links:
+        rec["links"] = links
     with _lock:
         if len(_ring) == _ring.maxlen:
             # the deque would evict silently — account the victim first
@@ -303,6 +556,63 @@ def spans(name: Optional[str] = None) -> List[Dict[str, Any]]:
     if name is not None:
         out = [s for s in out if s["name"] == name]
     return out
+
+
+def spans_for_trace(trace_id: str,
+                    span_list: Optional[List[Dict[str, Any]]] = None
+                    ) -> List[Dict[str, Any]]:
+    """Spans recorded DIRECTLY under ``trace_id`` (oldest first) — the
+    trace_id-indexed retrieval over the live ring or a loaded span list.
+    For the cross-trace closure (a request's batch/leg/hedge spans that
+    live in sibling traces and connect via links) use
+    :func:`trace_slice`."""
+    if span_list is None:
+        span_list = spans()
+    return [s for s in span_list if s.get("trace") == trace_id]
+
+
+def trace_slice(trace_id: str,
+                span_list: Optional[List[Dict[str, Any]]] = None
+                ) -> List[Dict[str, Any]]:
+    """The CONNECTED trace: every span reachable from ``trace_id``'s own
+    spans by following links in either direction, to a fixpoint.  This is
+    what reconstructs one hedged, requeued request end-to-end — the
+    request span links to the winning dispatch span, the failed legs and
+    the requeue/hedge records link back to the request's context — across
+    threads, replicas and (after :func:`merge_trace_files`) ranks.
+    Membership is by link edge or direct trace membership only; an
+    adopted foreign span does NOT pull in its whole home trace."""
+    if span_list is None:
+        span_list = spans()
+    member = [s.get("trace") == trace_id for s in span_list]
+    sids = {s["sid"] for s, m in zip(span_list, member)
+            if m and "sid" in s}
+    changed = True
+    while changed:
+        changed = False
+        # sids every selected span points at (links + explicit parents)
+        wanted = set(sids)
+        for s, m in zip(span_list, member):
+            if not m:
+                continue
+            for ref in s.get("links", ()):
+                wanted.add(ref.get("sid"))
+            if "psid" in s:
+                wanted.add(s["psid"])
+        for i, s in enumerate(span_list):
+            if member[i]:
+                continue
+            sid = s.get("sid")
+            hit = sid is not None and sid in wanted
+            if not hit:
+                hit = any(ref.get("sid") in sids
+                          for ref in s.get("links", ()))
+            if hit:
+                member[i] = True
+                if sid is not None:
+                    sids.add(sid)
+                changed = True
+    return [s for s, m in zip(span_list, member) if m]
 
 
 def reset_trace() -> None:
@@ -327,15 +637,21 @@ def to_chrome_trace(
     pid = os.getpid()
     events = []
     for s in span_list:
+        args = dict(s.get("attrs", {}))
+        if "trace" in s:
+            # surface the causal identity to Perfetto/chrome queries —
+            # the raw records under "lgbmtpu" stay the machine form
+            args["trace"] = s["trace"]
+            args["sid"] = s.get("sid")
         ev = {
             "name": s["name"],
             "cat": "lgbmtpu",
             "ph": "X",
             "ts": s["ts"] * 1e6,
             "dur": s["dur"] * 1e6,
-            "pid": pid,
+            "pid": s.get("pid", pid),
             "tid": s.get("tid", 0),
-            "args": s.get("attrs", {}),
+            "args": args,
         }
         events.append(ev)
     return {
@@ -360,6 +676,46 @@ def load_trace(path: str) -> Dict[str, Any]:
     with open(path, encoding="utf-8") as fh:
         doc = json.load(fh)
     validate_trace(doc)
+    return doc
+
+
+def merge_trace_files(paths: Sequence[str],
+                      out_path: Optional[str] = None) -> Dict[str, Any]:
+    """Fold per-rank / per-replica trace exports into ONE clock-aligned
+    Chrome-trace document — the flight recorder's merge, completing the
+    launcher's events/metrics/trace triad (``python -m lightgbm_tpu.obs
+    trace --merge`` is the CLI form).
+
+    Every input is a :func:`write_trace` file.  Span ``ts`` is unix wall
+    clock stamped at record time, so spans from one host (the launcher's
+    worker processes) align natively; the merged timeline is the
+    ts-sorted union.  Each source keeps its own Chrome ``pid`` lane
+    (source index) and its spans gain a ``src`` field naming the input
+    file, so a fleet-wide view separates ranks while trace ids and links
+    join one request's story across them.  Missing inputs raise OSError;
+    schema-invalid ones raise ValueError (a merge must never silently
+    drop a rank's history).  With ``out_path`` the merged document is
+    also written atomically."""
+    merged: List[Dict[str, Any]] = []
+    sources = []
+    for idx, path in enumerate(paths):
+        doc = load_trace(path)
+        src = os.path.basename(str(path))
+        span_list = doc["lgbmtpu"]["spans"]
+        for s in span_list:
+            s = dict(s)
+            s["src"] = src
+            s["pid"] = idx
+            merged.append(s)
+        ts_vals = [s["ts"] for s in span_list]
+        sources.append({"src": src, "spans": len(span_list),
+                        "ts_min": min(ts_vals) if ts_vals else None,
+                        "ts_max": max(ts_vals) if ts_vals else None})
+    merged.sort(key=lambda s: s["ts"])
+    doc = to_chrome_trace(merged)
+    doc["lgbmtpu"]["merged"] = {"sources": sources, "clock": "unix-wall"}
+    if out_path:
+        _metrics._atomic_write_json(out_path, doc)
     return doc
 
 

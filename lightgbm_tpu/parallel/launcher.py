@@ -43,6 +43,7 @@ import numpy as np
 
 from ..config import _ALIASES, Config
 from ..obs import metrics as _obs
+from ..obs import trace as _trace
 from ..utils import checkpoint as _checkpoint
 from ..utils.log import log_warning
 
@@ -608,6 +609,25 @@ def aggregate_fleet_metrics(tmp: str, num_machines: int) -> str:
     return out
 
 
+def aggregate_fleet_trace(tmp: str, num_machines: int) -> Optional[str]:
+    """Merge per-rank trace exports (each worker's engine writes its span
+    ring to ``<tmp>/worker<rank>.trace.json`` via the LGBMTPU_TRACE_FILE
+    env the launcher sets) into ``<tmp>/fleet_trace.json`` — one
+    clock-aligned Chrome/Perfetto flight recorder, each rank in its own
+    pid lane, trace ids and span links joining one request/rollover story
+    across ranks.  Completes the events/metrics/trace merge triad.
+    Missing rank files (a worker killed before its end-of-run write) are
+    skipped, not fatal; returns None when NO rank left a trace."""
+    paths = [p for p in (os.path.join(tmp, f"worker{r}.trace.json")
+                         for r in range(num_machines))
+             if os.path.exists(p)]
+    if not paths:
+        return None
+    out = os.path.join(tmp, "fleet_trace.json")
+    _trace.merge_trace_files(paths, out_path=out)
+    return out
+
+
 def _free_ports(k: int) -> list:
     """reference: dask.py _find_n_open_ports."""
     socks, ports = [], []
@@ -1022,6 +1042,14 @@ def train_distributed(
         # same file (no extra channel)
         env["LGBMTPU_METRICS_SNAPSHOT_FILE"] = os.path.join(
             tmp, f"worker{rank}.metrics.json")
+        # per-rank trace export: the worker's engine writes its span ring
+        # here at end of run (a params-level trace_file= still wins
+        # inside the worker); aggregate_fleet_trace merges the rank
+        # files into fleet_trace.json — the flight recorder's third
+        # member.  Per-rank path always: inheriting one shared path from
+        # the outer environment would have every rank clobber it.
+        env["LGBMTPU_TRACE_FILE"] = os.path.join(
+            tmp, f"worker{rank}.trace.json")
         # coordinated fleet checkpoints + resume-to-round relaunch
         # (docs/ROBUSTNESS.md "Elastic fleet recovery")
         if fleet_freq > 0:
@@ -1054,6 +1082,13 @@ def train_distributed(
         # first write
         try:
             os.unlink(env["LGBMTPU_METRICS_SNAPSHOT_FILE"])
+        except OSError:
+            pass
+        # same for a previous attempt's trace export: a relaunched rank
+        # must not leave a stale (pre-crash) span file to be merged as
+        # if it were this attempt's history
+        try:
+            os.unlink(env["LGBMTPU_TRACE_FILE"])
         except OSError:
             pass
         # log file instead of a PIPE: a chatty worker cannot deadlock
@@ -1141,9 +1176,17 @@ def train_distributed(
         except OSError as e:
             log_warning(f"could not write fleet_metrics.json: {e}")
             fleet_metrics = None
+        # the trace twin, completing the triad: merge whatever per-rank
+        # trace exports exist into one clock-aligned flight recorder
+        try:
+            fleet_trace = aggregate_fleet_trace(tmp, num_machines)
+        except (OSError, ValueError) as e:
+            log_warning(f"could not write fleet_trace.json: {e}")
+            fleet_trace = None
     booster = lgb.Booster(model_file=model_out + ".rank0")
     booster._fleet_events = fleet_events
     booster._fleet_metrics = fleet_metrics
+    booster._fleet_trace = fleet_trace
     meta_path = model_out + ".meta.json"
     if os.path.exists(meta_path):
         with open(meta_path) as fh:

@@ -92,17 +92,30 @@ class _ReplicaDeath(BaseException):
     so the batch-failure handler cannot swallow it."""
 
 
+def _member_ctxs(reqs) -> Optional[List]:
+    """The sampled members' trace contexts — the link targets a
+    fleet-side span (failed leg, requeue, hedge) carries so every
+    affected request's trace slice adopts it."""
+    out = [r.ctx for r in reqs if r.ctx is not None and r.ctx.sampled]
+    return out or None
+
+
 class _Inflight:
     """What a replica is currently executing — enough for the supervisor
-    to requeue it (hang/death) or hedge it (tail latency)."""
+    to requeue it (hang/death) or hedge it (tail latency).  ``leg_ctx``
+    is the dispatch leg's trace identity: the supervisor's hedge/death
+    spans link to it so the chaos matrix reconstructs from the export."""
 
-    __slots__ = ("batch", "skey", "t_mono", "hedged")
+    __slots__ = ("batch", "skey", "t_mono", "hedged", "leg_ctx", "t_perf")
 
-    def __init__(self, batch: List[_Request], skey):
+    def __init__(self, batch: List[_Request], skey,
+                 leg_ctx=None, t_perf: float = 0.0):
         self.batch = batch
         self.skey = skey  # staging-pool key, None for serial items
         self.t_mono = time.monotonic()
         self.hedged = False
+        self.leg_ctx = leg_ctx
+        self.t_perf = t_perf
 
 
 class _Replica:
@@ -257,8 +270,9 @@ class ServingFleet(ServingRuntime):
 
     # -- admission (inherited) + retry-budget refill ---------------------
     def submit(self, X, *, model: str = "default",
-               raw_score: bool = False) -> _Request:
-        req = super().submit(X, model=model, raw_score=raw_score)
+               raw_score: bool = False, trace_ctx=None) -> _Request:
+        req = super().submit(X, model=model, raw_score=raw_score,
+                             trace_ctx=trace_ctx)
         if self._retry_rate > 0:
             with self._cv:
                 self._retry_tokens = min(_RETRY_TOKENS_CAP,
@@ -356,6 +370,10 @@ class ServingFleet(ServingRuntime):
             self._count_deadline(r.model)
             r.error = DeadlineExceeded(r.model, self._deadline_s * 1e3)
             r.t_done = t
+            if r.ctx is not None and r.ctx.sampled:
+                _trace.record_span(
+                    "serve.request", t - r.t0, ctx=r.ctx, model=r.model,
+                    rows=r.n, outcome="deadline", attempt=r.retries)
             r.event.set()
 
     def _stage_and_hand(self, g, batch: List[_Request]) -> None:
@@ -416,13 +434,20 @@ class ServingFleet(ServingRuntime):
             g, x_dev, active, total, nb, skey, pair = payload
             staging = (skey, pair)
         t_batch = time.perf_counter()
+        # the leg's trace identity: minted on receipt, stored on the
+        # inflight record so the SUPERVISOR thread (hedge sweep, hang
+        # detection) can link its spans to this exact dispatch attempt —
+        # explicit context, never this thread's (empty) ambient stack
+        leg_ctx = self._batch_ctx(batch)
         with self._cv:
-            rep.inflight = _Inflight(batch, staging[0] if staging else None)
+            rep.inflight = _Inflight(batch, staging[0] if staging else None,
+                                     leg_ctx=leg_ctx, t_perf=t_batch)
             rep.last_tick = time.monotonic()
         _obs.gauge(_obs.labeled("serve_replica_heartbeat_ts",
                                 replica=rep.idx)).set(time.time())
         err: Optional[BaseException] = None
         outs: Optional[List[np.ndarray]] = None
+        t_sync: Optional[float] = None
         try:
             try:
                 self._chaos(rep)  # stage A: batch received, not dispatched
@@ -435,12 +460,14 @@ class ServingFleet(ServingRuntime):
                     convert = ((not batch[0].raw)
                                and g.objective is not None)
                     res = g.predict_coalesced(x_dev, active, total,
-                                              convert=convert)
+                                              convert=convert,
+                                              trace_ctx=leg_ctx)
                     outs = []
                     off = 0
                     for r in batch:
                         outs.append(res[off:off + r.n])
                         off += r.n
+                t_sync = time.perf_counter()  # accounted sync retired
                 self._chaos(rep)  # stage B: dispatch retired, unpublished
             except _ReplicaDeath:
                 raise
@@ -456,9 +483,9 @@ class ServingFleet(ServingRuntime):
                 self._return_staging(*staging)
         if err is None:
             self._publish_success(rep, batch, outs, total, nb,
-                                  kind == "batch", t_batch)
+                                  kind == "batch", t_batch, t_sync, leg_ctx)
         else:
-            self._publish_failure(rep, batch, err)
+            self._publish_failure(rep, batch, err, t_batch, leg_ctx)
         rep.hand.task_done()
         with self._cv:
             rep.inflight = None
@@ -466,19 +493,20 @@ class ServingFleet(ServingRuntime):
             self._cv.notify_all()
 
     def _publish_success(self, rep: _Replica, batch, outs, total, nb,
-                         coalesced, t_batch) -> None:
+                         coalesced, t_batch, t_sync=None,
+                         leg_ctx=None) -> None:
         now = time.perf_counter()
+        attempt = max((r.retries for r in batch), default=0)
         for r, y in zip(batch, outs):
             if r.event.is_set():
                 continue  # a hedged/raced twin already delivered — the
                 # bits are identical either way (predict is pure)
             r.result = y
-            r.t_done = now
-            dt_ms = (now - r.t0) * 1e3
-            _obs.histogram("serve_request_latency_ms").observe(dt_ms)
-            _obs.histogram(_obs.labeled(
-                "serve_request_latency_ms", tenant=r.model)).observe(dt_ms)
-            r.event.set()
+            # shared completion path (runtime.py): latency + phase
+            # reservoirs, exemplar, and the serve.request span linked to
+            # THIS leg — the one that actually delivered the bits
+            self._finish_request(r, now, t_sync, leg_ctx,
+                                 outcome="ok", replica=rep.idx)
         dt_batch_ms = (now - t_batch) * 1e3
         _obs.histogram("serve_replica_batch_ms").observe(dt_batch_ms)
         _obs.histogram(_obs.labeled(
@@ -487,10 +515,12 @@ class ServingFleet(ServingRuntime):
             _obs.counter("serve_batches_total").inc()
             _obs.counter("serve_coalesced_rows_total").inc(total)
             _obs.histogram("serve_batch_occupancy").observe(total / nb)
-        _trace.record_span("serve.batch", now - t_batch,
-                           requests=len(batch), rows=total,
-                           model=batch[0].model, coalesced=coalesced,
-                           replica=rep.idx)
+        if leg_ctx is not None:  # None = no member sampled: batch span
+            _trace.record_span(  # obeys the admission decision too
+                "serve.batch", now - t_batch, ctx=leg_ctx,
+                requests=len(batch), rows=total,
+                model=batch[0].model, coalesced=coalesced,
+                replica=rep.idx, attempt=attempt, outcome="ok")
         with self._cv:
             for r in batch:
                 self._pending.discard(r)
@@ -508,10 +538,25 @@ class ServingFleet(ServingRuntime):
                 self._publish_fleet_gauges()
 
     def _publish_failure(self, rep: _Replica, batch,
-                         err: BaseException) -> None:
+                         err: BaseException, t_batch: float = 0.0,
+                         leg_ctx=None) -> None:
         _obs.counter("serve_replica_failures_total").inc()
         _obs.counter(_obs.labeled("serve_replica_failures_total",
                                   replica=rep.idx)).inc()
+        # the FAILED leg's span: its own identity (leg_ctx) plus links to
+        # every member request, so a request's trace slice adopts this
+        # leg even though the request span will link only to the leg
+        # that eventually delivered — death/hang × stage reconstructs
+        # from the export alone
+        now = time.perf_counter()
+        if leg_ctx is not None:  # None = no member sampled (admission)
+            _trace.record_span(
+                "serve.leg", now - (t_batch or now), ctx=leg_ctx,
+                links=_member_ctxs(batch),
+                replica=rep.idx, requests=len(batch),
+                attempt=max((r.retries for r in batch), default=0),
+                outcome="error", error=type(err).__name__,
+                model=batch[0].model)
         with self._cv:
             rep.fail_streak += 1
             self._breaker_failure_locked(rep, time.monotonic())
@@ -547,11 +592,31 @@ class ServingFleet(ServingRuntime):
             _obs.counter("serve_requeues_total").inc(len(requeue))
             _obs.event("serve_requeue", replica=rep.idx,
                        requests=len(requeue), error=type(err).__name__)
+            # the requeue decision as a span: links to every re-queued
+            # request, so "this request was redispatched off replica K
+            # after error E" reads straight out of the trace export
+            # (skipped when no member was sampled — admission decision)
+            rq_ctx = self._batch_ctx(requeue)
+            if rq_ctx is not None:
+                _trace.record_span(
+                    "serve.requeue", 0.0, ctx=rq_ctx,
+                    links=_member_ctxs(requeue), replica=rep.idx,
+                    requests=len(requeue), error=type(err).__name__,
+                    outcome="requeued", attempt=1)
         t = time.perf_counter()
         for r in fail:
             self._pending.discard(r)
             r.error = err
             r.t_done = t
+            # terminal failure closes the request's span too — every
+            # admitted sampled request leaves exactly one serve.request
+            # span in the recorder, whatever its fate
+            if r.ctx is not None and r.ctx.sampled:
+                _trace.record_span(
+                    "serve.request", t - r.t0, ctx=r.ctx,
+                    model=r.model, rows=r.n, outcome="failed",
+                    error=type(err).__name__, attempt=r.retries,
+                    replica=rep.idx)
             r.event.set()
         self._cv.notify_all()
         return len(requeue)
@@ -607,6 +672,19 @@ class ServingFleet(ServingRuntime):
         infl, rep.inflight = rep.inflight, None
         err = RuntimeError(
             f"replica {rep.idx} {'hung' if hung else 'died'} ({why})")
+        if infl is not None and infl.leg_ctx is not None:
+            # the leg that died/hung with work in flight: the span wears
+            # the leg's own stored context (minted by the replica thread
+            # on receipt — the supervisor/dying thread must NOT invent a
+            # fresh one) and links every stranded request; a None leg
+            # context means no member was sampled, so the span drops too
+            _trace.record_span(
+                "serve.leg", time.perf_counter() - (infl.t_perf or 0.0)
+                if infl.t_perf else 0.0,
+                ctx=infl.leg_ctx, links=_member_ctxs(infl.batch),
+                replica=rep.idx, requests=len(infl.batch),
+                attempt=max((r.retries for r in infl.batch), default=0),
+                outcome="hang" if hung else "death", error=why)
         if infl is not None:
             if hung and infl.skey is not None:
                 # the wedged thread still owns its pinned pair: grow the
@@ -731,6 +809,19 @@ class ServingFleet(ServingRuntime):
             _obs.counter("serve_hedges_total").inc()
             _obs.event("serve_hedge", replica=rep.idx, requests=len(twins),
                        delay_ms=round(delay * 1e3, 2))
+            # the hedge pair as links: the slow original leg + every
+            # hedged request — first result wins, and both legs stay
+            # reachable from the request's trace slice
+            hedge_links = list(_member_ctxs(twins) or [])
+            if infl.leg_ctx is not None:
+                hedge_links.append(infl.leg_ctx)
+            hedge_ctx = self._batch_ctx(twins)
+            if hedge_ctx is not None:  # None = no twin sampled
+                _trace.record_span(
+                    "serve.hedge", 0.0, ctx=hedge_ctx,
+                    links=hedge_links or None, replica=rep.idx,
+                    requests=len(twins), delay_ms=round(delay * 1e3, 2),
+                    outcome="hedged")
             self._cv.notify_all()
 
     # -- observability ---------------------------------------------------

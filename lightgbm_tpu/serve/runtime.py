@@ -117,13 +117,24 @@ _PREDICT_MAX_BODY = 32 << 20
 class _Request:
     """One queued predict: host rows + completion event.  ``x`` is
     already cast to f64 (mirroring ``Booster.predict``'s intake cast, so
-    the staged f32 batch holds the same bits an individual call would)."""
+    the staged f32 batch holds the same bits an individual call would).
+
+    ``ctx`` is the request's :class:`~..obs.trace.TraceContext` — minted
+    at admission, carried EXPLICITLY on the request across the
+    coalescer/dispatcher/replica thread handoffs (a thread-local stack
+    cannot follow them), so every span the request's journey emits files
+    under one trace id.  The ``t_*`` stamps are host ``perf_counter``
+    reads at points the pipeline already touches; the completion path
+    turns them into the queue/coalesce/staging/dispatch/sliceout phase
+    breakdown (zero new device pulls — the R9/R10 rule)."""
 
     __slots__ = ("x", "n", "model", "raw", "serial", "event", "result",
-                 "error", "t0", "t_done", "deadline", "retries", "avoid")
+                 "error", "t0", "t_done", "deadline", "retries", "avoid",
+                 "ctx", "t_dequeue", "t_stage", "t_hand")
 
     def __init__(self, x: np.ndarray, model: str, raw: bool,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 ctx: Optional[_trace.TraceContext] = None):
         self.x = x
         self.n = int(x.shape[0])
         self.model = model
@@ -141,6 +152,33 @@ class _Request:
         self.deadline = deadline
         self.retries = 0
         self.avoid = -1
+        self.ctx = ctx
+        # phase stamps (perf_counter): first coalescer pop, staging
+        # start, staged-and-uploaded.  A requeued/hedged request is
+        # re-stamped by its winning leg — the breakdown describes the
+        # journey that actually delivered the bits.
+        self.t_dequeue: Optional[float] = None
+        self.t_stage: Optional[float] = None
+        self.t_hand: Optional[float] = None
+
+
+def _phase_breakdown(r: "_Request", t_sync: Optional[float],
+                     now: float) -> Dict[str, float]:
+    """Per-request phase milliseconds from the host stamps the pipeline
+    already takes — queue (admission→first pop), coalesce (pop→staging
+    start), staging (pack+upload issue), dispatch (hand wait + device
+    execute through the accounted sync), sliceout (sync→publish).  A
+    missing stamp (serial requests skip staging; a failed dispatch never
+    syncs) collapses its phase to zero rather than guessing."""
+    t_dq = r.t_dequeue if r.t_dequeue is not None else r.t0
+    t_st = r.t_stage if r.t_stage is not None else t_dq
+    t_hd = r.t_hand if r.t_hand is not None else t_st
+    t_sy = t_sync if t_sync is not None else now
+    return {"queue": max(t_dq - r.t0, 0.0) * 1e3,
+            "coalesce": max(t_st - t_dq, 0.0) * 1e3,
+            "staging": max(t_hd - t_st, 0.0) * 1e3,
+            "dispatch": max(t_sy - t_hd, 0.0) * 1e3,
+            "sliceout": max(now - t_sy, 0.0) * 1e3}
 
 
 def _unwrap(model) -> Any:
@@ -359,19 +397,27 @@ class ServingRuntime:
 
     # -- client API ------------------------------------------------------
     def predict(self, X, *, model: str = "default", raw_score: bool = False,
-                timeout: Optional[float] = None) -> np.ndarray:
+                timeout: Optional[float] = None,
+                trace_ctx: Optional[_trace.TraceContext] = None) -> np.ndarray:
         """Blocking coalesced predict — semantics (and bits) of
         ``Booster.predict(X, raw_score=raw_score)``.  Raises
         :class:`Overloaded` when shed, ``TimeoutError`` past
         ``timeout`` seconds."""
-        return self.result(self.submit(X, model=model, raw_score=raw_score),
+        return self.result(self.submit(X, model=model, raw_score=raw_score,
+                                       trace_ctx=trace_ctx),
                            timeout=timeout)
 
     def submit(self, X, *, model: str = "default",
-               raw_score: bool = False) -> _Request:
+               raw_score: bool = False,
+               trace_ctx: Optional[_trace.TraceContext] = None) -> _Request:
         """Enqueue one request (admission control happens HERE — a shed
         raises immediately, an accepted request always resolves).
-        Returns a handle for :meth:`result`."""
+        Returns a handle for :meth:`result`.
+
+        ``trace_ctx`` is the request's trace identity when the caller
+        (the HTTP front door, honoring an inbound ``traceparent``)
+        already minted one; otherwise a fresh root context is minted
+        here — admission is the single sampling decision point."""
         g = self._table.get(model)
         if g is None:
             raise LightGBMError(f"model {model!r} is not served "
@@ -407,7 +453,9 @@ class ServingRuntime:
             if shed is None:
                 req = _Request(X, model, bool(raw_score),
                                deadline=(time.monotonic() + self._deadline_s
-                                         if self._deadline_s > 0 else None))
+                                         if self._deadline_s > 0 else None),
+                               ctx=(trace_ctx if trace_ctx is not None
+                                    else _trace.mint_request_context()))
                 self._queue.append(req)
                 self._pending.add(req)
                 self._queued_per_tenant[model] = (
@@ -541,6 +589,7 @@ class ServingRuntime:
 
     def _note_dequeued(self, req: _Request) -> None:
         """Under self._cv: tenant + depth bookkeeping for one pop."""
+        req.t_dequeue = time.perf_counter()  # queue-wait phase closes here
         left = self._queued_per_tenant.get(req.model, 1) - 1
         self._queued_per_tenant[req.model] = max(left, 0)
         _obs.gauge("serve_queue_depth").set(len(self._queue))
@@ -648,6 +697,9 @@ class ServingRuntime:
         return.)"""
         total = sum(r.n for r in batch)
         nb = _predict_bucket(total)
+        t_stage = time.perf_counter()  # coalesce-wait phase closes here
+        for r in batch:
+            r.t_stage = t_stage
         skey, pair = self._checkout_staging(nb, batch[0].x.shape[1])
         try:
             buf, mask = pair
@@ -660,12 +712,63 @@ class ServingRuntime:
             mask[off:] = False
             x_dev = jax.device_put(buf)
             active = None if off == nb else jax.device_put(mask)
+            t_hand = time.perf_counter()  # staged + uploaded (async): the
+            for r in batch:              # staging phase closes here
+                r.t_hand = t_hand
             return ("batch", batch, (g, x_dev, active, total, nb, skey, pair))
         except BaseException:
             self._return_staging(skey, pair)
             raise
 
     # -- dispatcher ------------------------------------------------------
+    @staticmethod
+    def _batch_ctx(batch: List[_Request]) -> Optional[_trace.TraceContext]:
+        """Identity for one dispatch leg's span: a SIBLING of the first
+        sampled member's context — same trace, NO parent edge.  The N
+        member request spans each carry a link TO this context instead
+        (the N-to-1 fan-in the coalescer creates cannot be expressed as
+        parentage: a span has one parent, a batch has N requests)."""
+        for r in batch:
+            if r.ctx is not None and r.ctx.sampled:
+                return r.ctx.sibling()
+        return None
+
+    def _finish_request(self, r: _Request, now: float,
+                        t_sync: Optional[float],
+                        leg_ctx: Optional[_trace.TraceContext] = None,
+                        outcome: str = "ok",
+                        replica: Optional[int] = None) -> None:
+        """Completion bookkeeping for ONE resolved request: stamp
+        ``t_done``, feed the latency + per-phase reservoirs (the latency
+        reservoir keeps this trace_id as its exemplar when sampled),
+        emit the ``serve.request`` span linked to the dispatch leg that
+        delivered the bits, and wake the waiter LAST.  Shared by the
+        solo dispatcher and the fleet's publish paths so every leg
+        speaks the same span vocabulary.  Host-side arithmetic only —
+        zero device pulls (the R9/R10 contract)."""
+        r.t_done = now
+        dt_ms = (now - r.t0) * 1e3
+        sampled = r.ctx is not None and r.ctx.sampled
+        _obs.histogram("serve_request_latency_ms").observe(
+            dt_ms, exemplar=(r.ctx.trace_id if sampled else None))
+        _obs.histogram(_obs.labeled(
+            "serve_request_latency_ms", tenant=r.model)).observe(dt_ms)
+        phases = _phase_breakdown(r, t_sync, now)
+        for ph, v in phases.items():
+            _obs.histogram(_obs.labeled(
+                "serve_phase_ms", phase=ph)).observe(v)
+        if sampled:
+            attrs: Dict[str, Any] = {
+                f"{ph}_ms": round(v, 3) for ph, v in phases.items()}
+            if replica is not None:
+                attrs["replica"] = replica
+            _trace.record_span(
+                "serve.request", now - r.t0, ctx=r.ctx,
+                links=([leg_ctx] if leg_ctx is not None else None),
+                model=r.model, rows=r.n, outcome=outcome,
+                attempt=r.retries, **attrs)
+        r.event.set()
+
     def _dispatch_loop(self) -> None:
         while True:
             item = self._hand.get()
@@ -674,6 +777,13 @@ class ServingRuntime:
                 return
             kind, batch, payload = item
             t_batch = time.perf_counter()
+            # the dispatch-leg span identity is minted BEFORE execution
+            # and carried explicitly — this dispatcher thread's ambient
+            # span stack is empty and must stay out of parentage (the
+            # cross-thread bug R21 now lints for)
+            leg_ctx = self._batch_ctx(batch)
+            t_sync: Optional[float] = None
+            outcome = "ok"
             staging = None
             try:
                 if kind == "serial":
@@ -681,13 +791,18 @@ class ServingRuntime:
                     g = payload if payload is not None \
                         else self._table[r.model]
                     r.result = g.predict(r.x, raw_score=r.raw)
+                    t_sync = time.perf_counter()
                 else:
                     g, x_dev, active, total, nb, skey, pair = payload
                     staging = (skey, pair)
                     convert = ((not batch[0].raw)
                                and g.objective is not None)
                     res = g.predict_coalesced(x_dev, active, total,
-                                              convert=convert)
+                                              convert=convert,
+                                              trace_ctx=leg_ctx)
+                    # the accounted sync retired inside predict_coalesced
+                    # — the dispatch phase closes on this host stamp
+                    t_sync = time.perf_counter()
                     off = 0
                     for r in batch:
                         r.result = res[off:off + r.n]
@@ -697,6 +812,7 @@ class ServingRuntime:
                     _obs.histogram("serve_batch_occupancy").observe(
                         total / nb)
             except BaseException as e:  # noqa: BLE001 — a failed batch
+                outcome = "error"
                 for r in batch:  # must fail its requests, not the thread
                     r.error = e
             finally:
@@ -710,17 +826,19 @@ class ServingRuntime:
                 # the reservoir is honest (the jaxlint-R9 contract)
                 now = time.perf_counter()
                 for r in batch:
-                    r.t_done = now
-                    dt_ms = (now - r.t0) * 1e3
-                    _obs.histogram("serve_request_latency_ms").observe(dt_ms)
-                    _obs.histogram(_obs.labeled(
-                        "serve_request_latency_ms",
-                        tenant=r.model)).observe(dt_ms)
-                    r.event.set()
-                _trace.record_span(
-                    "serve.batch", now - t_batch, requests=len(batch),
-                    rows=sum(r.n for r in batch), model=batch[0].model,
-                    coalesced=kind == "batch")
+                    self._finish_request(r, now, t_sync, leg_ctx, outcome)
+                # leg_ctx is None exactly when NO member was sampled —
+                # the admission-time decision covers the batch span too
+                # (an identityless record would leak spans under
+                # trace_sample=0)
+                if leg_ctx is not None:
+                    _trace.record_span(
+                        "serve.batch", now - t_batch, ctx=leg_ctx,
+                        requests=len(batch),
+                        rows=sum(r.n for r in batch),
+                        model=batch[0].model,
+                        coalesced=kind == "batch", outcome=outcome,
+                        attempt=0)
                 # unfinished_tasks drops to 0 only here: the coalescer's
                 # idle-pipeline flush reads it, so "idle" honestly means
                 # the previous batch has fully retired (sync included) —
@@ -734,45 +852,62 @@ class ServingRuntime:
 
 
     # -- /predict front door (obs/server.py owns the socket) -------------
-    def _http_predict(self, payload: Dict[str, Any]) -> Tuple[int, Dict]:
+    def _http_predict(self, payload: Dict[str, Any],
+                      traceparent: Optional[str] = None,
+                      ) -> Tuple[int, Dict, Optional[str]]:
         """One ``POST /predict`` request: JSON rows in, predictions out,
         routed through the SAME submit/result path every other caller
         uses — so shedding, deadlines and fleet health apply unchanged,
         mapped onto HTTP: Overloaded -> 429 (unhealthy -> 503),
         DeadlineExceeded/timeout -> 504, stopped runtime -> 503, bad
-        request -> 400."""
+        request -> 400.
+
+        The request's trace context is minted HERE, honoring an inbound
+        W3C ``traceparent`` (the caller's trace adopts our spans); the
+        outbound header and the ``trace_id`` body field are returned on
+        EVERY outcome — a shed or timed-out request is exactly the one
+        the caller needs to look up."""
         _obs.counter("serve_http_requests_total").inc()
+        ctx = _trace.mint_request_context(traceparent)
+        tp_out = _trace.format_traceparent(ctx)
+
+        def _done(code: int, body: Dict) -> Tuple[int, Dict, Optional[str]]:
+            body["trace_id"] = ctx.trace_id
+            return code, body, tp_out
+
         try:
             rows = payload.get("rows") if isinstance(payload, dict) else None
             if rows is None:
-                return 400, {"error": "bad_request",
-                             "detail": 'body must be JSON like '
-                                       '{"rows": [[...], ...], '
-                                       '"model": "default", '
-                                       '"raw_score": false}'}
+                return _done(400, {"error": "bad_request",
+                                   "detail": 'body must be JSON like '
+                                             '{"rows": [[...], ...], '
+                                             '"model": "default", '
+                                             '"raw_score": false}'})
             X = np.asarray(rows, dtype=np.float64)
             model = str(payload.get("model", "default"))
             raw = bool(payload.get("raw_score", False))
             y = self.predict(X, model=model, raw_score=raw,
-                             timeout=_PREDICT_HTTP_TIMEOUT_S)
-            return 200, {"model": model,
-                         "rows": int(np.atleast_2d(X).shape[0]),
-                         "predictions": np.asarray(y).tolist()}
+                             timeout=_PREDICT_HTTP_TIMEOUT_S,
+                             trace_ctx=ctx)
+            return _done(200, {"model": model,
+                               "rows": int(np.atleast_2d(X).shape[0]),
+                               "predictions": np.asarray(y).tolist()})
         except Overloaded as e:
             # admission refusals: 429 back-pressure, except an unhealthy
             # process, which is a 503 service condition
             code = 503 if e.reason == "unhealthy" else 429
-            return code, {"error": "overloaded", "reason": e.reason,
-                          "tenant": e.tenant}
+            return _done(code, {"error": "overloaded", "reason": e.reason,
+                                "tenant": e.tenant})
         except DeadlineExceeded as e:
-            return 504, {"error": "deadline_exceeded", "tenant": e.tenant,
-                         "deadline_ms": e.deadline_ms}
+            return _done(504, {"error": "deadline_exceeded",
+                               "tenant": e.tenant,
+                               "deadline_ms": e.deadline_ms})
         except TimeoutError as e:
-            return 504, {"error": "timeout", "detail": str(e)}
+            return _done(504, {"error": "timeout", "detail": str(e)})
         except LightGBMError as e:
-            return 503, {"error": "unavailable", "detail": str(e)}
+            return _done(503, {"error": "unavailable", "detail": str(e)})
         except (TypeError, ValueError, KeyError) as e:
-            return 400, {"error": "bad_request", "detail": str(e)}
+            return _done(400, {"error": "bad_request", "detail": str(e)})
 
 
 # -- audit hook (analysis/contracts.py predict_coalesced_bucket) --------

@@ -51,7 +51,7 @@ def test_known_intentional_suppressions_are_still_needed():
 def test_all_rules_are_registered():
     assert {"R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9", "R10",
             "R11", "R12", "R13", "R14", "R15", "R16", "R17", "R18", "R19",
-            "R20", "L1", "L2", "L3", "L4", "L5"} <= set(RULES)
+            "R20", "R21", "L1", "L2", "L3", "L4", "L5"} <= set(RULES)
 
 
 def test_package_has_zero_stale_pragmas():

@@ -2375,3 +2375,113 @@ def test_r20_pragma_suppression(tmp_path):
     """}, rules=["R20"])
     assert rep.findings == []
     assert len(rep.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# R21 unlinked-cross-thread-span
+# ---------------------------------------------------------------------------
+
+def test_r21_positive_implicit_span_in_thread_target(tmp_path):
+    """A record_span with no ctx/parent/links inside a Thread target:
+    the worker's thread-local span stack is empty, so the span roots a
+    fresh trace instead of joining the crossing request."""
+    rep = _scan_tree(tmp_path, {"serve/worker.py": """
+        import threading
+        from ..obs import trace as _trace
+
+        class Runtime:
+            def start(self):
+                self._t = threading.Thread(target=self._dispatch_loop,
+                                           daemon=True)
+                self._t.start()
+
+            def _dispatch_loop(self):
+                while True:
+                    batch = self._pop()
+                    _trace.record_span("serve.batch", 0.001, rows=8)
+    """}, rules=["R21"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].rule == "R21"
+    assert "_dispatch_loop" in rep.findings[0].message
+
+
+def test_r21_positive_executor_submitted_span_context_manager(tmp_path):
+    """executor.submit(fn) marks fn as a thread entry too; a bare
+    span() context manager there is the same empty-stack trap."""
+    rep = _scan_tree(tmp_path, {"continual/roller.py": """
+        from ..obs import trace as _trace
+
+        class Runner:
+            def kick(self, pool):
+                pool.submit(self._rollover)
+
+            def _rollover(self):
+                with _trace.span("continual.rollover", mode="refit"):
+                    self._do_roll()
+    """}, rules=["R21"])
+    assert len(rep.findings) == 1
+    assert rep.findings[0].rule == "R21"
+
+
+def test_r21_negative_explicit_ctx_and_links(tmp_path):
+    """Spans that carry their causal identity explicitly — ctx= on the
+    leg span, links= adopting the batch members — are the designed
+    cross-thread pattern and pass clean."""
+    rep = _scan_tree(tmp_path, {"serve/worker.py": """
+        import threading
+        from ..obs import trace as _trace
+
+        class Runtime:
+            def start(self):
+                self._t = threading.Thread(target=self._dispatch_loop,
+                                           daemon=True)
+
+            def _dispatch_loop(self):
+                while True:
+                    batch = self._pop()
+                    leg = batch[0].ctx.sibling()
+                    _trace.record_span("serve.batch", 0.001, ctx=leg,
+                                       links=[r.ctx for r in batch])
+    """}, rules=["R21"])
+    assert rep.findings == []
+
+
+def test_r21_negative_outside_scoped_dirs_and_non_entry(tmp_path):
+    """Both escapes at once: the identical implicit span OUTSIDE
+    serve//continual/ paths is out of scope, and a function never handed
+    to Thread/submit is not an entry even inside them."""
+    rep = _scan_tree(tmp_path, {
+        "obs/exporter.py": """
+            import threading
+            from . import trace as _trace
+
+            def start(self):
+                threading.Thread(target=_flush_loop, daemon=True).start()
+
+            def _flush_loop():
+                _trace.record_span("obs.flush", 0.001)
+        """,
+        "serve/helpers.py": """
+            from ..obs import trace as _trace
+
+            def note_admit(runtime):
+                _trace.record_span("serve.admit", 0.0001)
+        """}, rules=["R21"])
+    assert rep.findings == []
+
+
+def test_r21_pragma_suppression(tmp_path):
+    rep = _scan_tree(tmp_path, {"serve/worker.py": """
+        import threading
+        from ..obs import trace as _trace
+
+        class Runtime:
+            def start(self):
+                self._t = threading.Thread(target=self._gc_loop, daemon=True)
+
+            def _gc_loop(self):
+                while True:
+                    _trace.record_span("serve.gc", 0.001)  # jaxlint: disable=R21 (fixture: maintenance sweep owns no request; rootless by design)
+    """}, rules=["R21"])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1

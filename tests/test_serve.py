@@ -185,6 +185,15 @@ def test_coalesced_batch_budget_with_server_and_tracing_on():
     assert _trc.spans("predict.coalesced"), "no coalesced predict spans"
     occ = obs.histogram("serve_batch_occupancy")
     assert occ.count >= 2 and occ.max <= 1.0
+    # round 24: the latency series carries an OpenMetrics exemplar — a
+    # witness request's trace_id rides the _count line, and the phase
+    # reservoirs were fed at the already-accounted sync points
+    ex = obs.histogram("serve_request_latency_ms").exemplar
+    assert ex and len(ex["trace_id"]) == 32
+    assert f'# {{trace_id="{ex["trace_id"]}"}}' in prom
+    for ph in ("queue", "coalesce", "staging", "dispatch", "sliceout"):
+        assert obs.histogram(
+            obs.labeled("serve_phase_ms", phase=ph)).count >= 1, ph
 
 
 def test_rung_fill_flushes_before_the_admission_window():

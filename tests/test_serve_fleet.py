@@ -154,6 +154,17 @@ def test_chaos_matrix_zero_loss_bitwise(site, stage, replicas):
                 else "serve_replica_deaths_total")
         assert obs.counter(dead).value == 1
         assert obs.counter("serve_requeues_total").value >= 1
+        # round 24: every chaos cell leaves its trace — the lost leg's
+        # span wears the failure kind and the requeue links its members,
+        # so the matrix reconstructs from the export alone
+        from lightgbm_tpu.obs import trace as _trc
+
+        want = "hang" if site == "replica_hang" else "death"
+        legs = [s for s in _trc.spans("serve.leg")
+                if s["attrs"].get("outcome") == want]
+        assert legs and all("replica" in s["attrs"] for s in legs)
+        assert any(s.get("links") for s in legs)
+        assert _trc.spans("serve.requeue")
     finally:
         # stop() must return promptly even though the wedged incarnation
         # sleeps forever: the watchdog either marked it hung (skipped at
@@ -618,5 +629,26 @@ def test_acceptance_open_loop_death_zero_loss_bitwise_and_recovery():
         d.assert_no_recompile("recovered fleet warm batch")
         assert np.array_equal(out, bst.predict(X[:16], raw_score=True))
         assert _trc.spans("serve.batch")
+        # round 24: the whole death story reconstructs from the trace
+        # export alone — the killed dispatch left a serve.leg span
+        # (outcome=death) and the requeue decision a serve.requeue span,
+        # each naming its replica and linked to its member requests
+        legs = [s for s in _trc.spans("serve.leg")
+                if s["attrs"].get("outcome") == "death"]
+        assert legs, "no serve.leg span for the killed dispatch"
+        assert all("replica" in s["attrs"] for s in legs)
+        rqs = _trc.spans("serve.requeue")
+        assert rqs and rqs[0]["attrs"]["outcome"] == "requeued"
+        assert rqs[0].get("links"), "requeue span lost its member links"
+        retried = [s for s in _trc.spans("serve.request")
+                   if s["attrs"].get("attempt", 0) >= 1
+                   and s["attrs"].get("outcome") == "ok"]
+        assert retried, "no request span records its retried attempt"
+        # one requeued request's CONNECTED trace: its own span, the dead
+        # leg, the requeue record, and the winning batch — end to end
+        sl = _trc.trace_slice(retried[0]["trace"])
+        names = {s["name"] for s in sl}
+        assert {"serve.request", "serve.leg", "serve.requeue",
+                "serve.batch"} <= names, names
     finally:
         fl.stop()
